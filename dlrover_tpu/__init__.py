@@ -1,0 +1,22 @@
+"""dlrover_tpu — a TPU-native elastic distributed-training framework.
+
+A from-scratch rebuild of the capabilities of DLRover (the reference control
+plane for elastic PyTorch/GPU training) designed idiomatically for JAX/XLA on
+TPU pods:
+
+- a per-job **master** that rendezvouses hosts, monitors nodes, dispatches data
+  shards and drives diagnosis/auto-scaling (reference: dlrover/python/master/);
+- a per-host **elastic agent** (``dtpu-run``) that joins master rendezvous,
+  bootstraps ``jax.distributed``, forks worker processes and survives failures
+  (reference: dlrover/python/elastic_agent/);
+- **Flash Checkpoint** for pjit-sharded ``jax.Array`` pytrees: async
+  device→host→shared-memory snapshots persisted out-of-process so a crash
+  never loses a step (reference: dlrover/trainer/torch/flash_checkpoint/);
+- a first-class **parallelism + models layer** (mesh manager, DP/FSDP/TP/SP/EP
+  shardings, ring attention for long context, Llama-class reference model) that
+  the reference delegates to Megatron/DeepSpeed but a TPU-native stack must own;
+- **diagnosis**: node health checks as JAX programs, straggler detection, hang
+  detection, and a recovery ladder (restart worker → relaunch node → abort).
+"""
+
+__version__ = "0.1.0"

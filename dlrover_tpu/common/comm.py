@@ -1,0 +1,358 @@
+"""Typed message schema for master↔agent↔worker RPC.
+
+The reference pickles 60+ dataclasses into a 2-RPC gRPC envelope
+(dlrover/python/common/comm.py:105–544). This build keeps the typed-dataclass
+surface but serializes with msgpack + a type registry instead of pickle —
+schema'd, language-neutral (the C++ runtime components speak the same framing)
+and not an arbitrary-code-execution channel.
+
+Wire format of one message: msgpack map ``{"_t": <registered type name>,
+"f": {field: value, ...}}``. Nested registered dataclasses are encoded
+recursively; plain dicts/lists/scalars/bytes pass through.
+"""
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+import msgpack
+
+_REGISTRY: Dict[str, Type] = {}
+
+
+def message(cls):
+    """Class decorator: register a dataclass as a wire message."""
+    cls = dataclass(cls)
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _encode(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and type(obj).__name__ in _REGISTRY:
+        return {
+            "_t": type(obj).__name__,
+            "f": {
+                f.name: _encode(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)
+            },
+        }
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_encode(v) for v in obj]
+    return obj
+
+
+def _decode(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if "_t" in obj and obj.get("_t") in _REGISTRY:
+            cls = _REGISTRY[obj["_t"]]
+            fields = {k: _decode(v) for k, v in obj.get("f", {}).items()}
+            known = {f.name for f in dataclasses.fields(cls)}
+            return cls(**{k: v for k, v in fields.items() if k in known})
+        return {k: _decode(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode(v) for v in obj]
+    return obj
+
+
+def serialize(obj: Any) -> bytes:
+    return msgpack.packb(_encode(obj), use_bin_type=True)
+
+
+def deserialize(data: bytes) -> Any:
+    if not data:
+        return None
+    return _decode(
+        msgpack.unpackb(data, raw=False, strict_map_key=False)
+    )
+
+
+# --------------------------------------------------------------------------
+# Core envelope
+# --------------------------------------------------------------------------
+
+
+@message
+class BaseRequest:
+    node_id: int = -1
+    node_type: str = ""
+    data: Any = None
+
+
+@message
+class BaseResponse:
+    success: bool = True
+    message: str = ""
+    data: Any = None
+
+
+# --------------------------------------------------------------------------
+# Rendezvous (reference comm.py JoinRendezvousRequest etc.)
+# --------------------------------------------------------------------------
+
+
+@message
+class NodeMeta:
+    """What an agent reports about its host when joining."""
+
+    node_id: int = -1
+    node_rank: int = -1
+    host: str = ""
+    # number of worker processes this host contributes (for TPU: one process
+    # per host is canonical; local CPU tests use nproc>1)
+    local_world_size: int = 1
+    # TPU topology info from the metadata/env (chips per host etc.)
+    num_devices: int = 0
+    free_port: int = 0
+
+
+@message
+class JoinRendezvousRequest:
+    node_id: int = 0
+    node_rank: int = 0
+    local_world_size: int = 1
+    rdzv_name: str = ""
+    node_unit: int = 1
+    host: str = ""
+    free_port: int = 0
+
+
+@message
+class JoinRendezvousResponse:
+    round: int = 0
+
+
+@message
+class CommWorldRequest:
+    node_id: int = 0
+    rdzv_name: str = ""
+
+
+@message
+class CommWorldResponse:
+    rdzv_name: str = ""
+    round: int = 0
+    group: int = 0
+    # {node_rank: NodeMeta} for every participant in the cut world
+    world: Dict[int, Any] = field(default_factory=dict)
+    # jax.distributed bootstrap info derived from the world
+    coordinator_addr: str = ""
+
+
+@message
+class WaitingNodeNumRequest:
+    node_id: int = 0
+    rdzv_name: str = ""
+
+
+@message
+class WaitingNodeNumResponse:
+    waiting_num: int = 0
+
+
+# --------------------------------------------------------------------------
+# KV store / sync barrier
+# --------------------------------------------------------------------------
+
+
+@message
+class KeyValuePair:
+    key: str = ""
+    value: bytes = b""
+
+
+@message
+class KeyValueRequest:
+    op: str = "get"  # get | set | add | wait | delete | multi_get | multi_set
+    key: str = ""
+    value: bytes = b""
+    keys: List[str] = field(default_factory=list)
+    values: List[bytes] = field(default_factory=list)
+    timeout_s: float = 0.0
+
+
+@message
+class KeyValueResponse:
+    found: bool = False
+    value: bytes = b""
+    values: List[bytes] = field(default_factory=list)
+
+
+@message
+class BarrierRequest:
+    barrier_name: str = ""
+    node_rank: int = 0
+    world_size: int = 0
+    timeout_s: float = 300.0
+
+
+@message
+class BarrierResponse:
+    passed: bool = False
+
+
+# --------------------------------------------------------------------------
+# Node lifecycle / events / heartbeat
+# --------------------------------------------------------------------------
+
+
+@message
+class NodeStatusRequest:
+    node_id: int = 0
+    node_type: str = ""
+    status: str = ""
+    exit_reason: str = ""
+    restart_count: int = 0
+
+
+@message
+class HeartbeatRequest:
+    node_id: int = 0
+    timestamp: float = 0.0
+    # most recent global step + timestamp the agent has observed
+    global_step: int = 0
+    step_timestamp: float = 0.0
+
+
+@message
+class HeartbeatResponse:
+    # DiagnosisAction for the agent to execute, if any
+    action_type: str = "no_action"
+    action_data: Dict[str, Any] = field(default_factory=dict)
+
+
+@message
+class NodeFailureReport:
+    node_id: int = 0
+    error_data: str = ""
+    level: str = ""
+    restart_count: int = 0
+
+
+@message
+class NetworkCheckResult:
+    node_id: int = 0
+    normal: bool = True
+    elapsed_time: float = 0.0
+
+
+@message
+class StragglerExistRequest:
+    node_id: int = 0
+
+
+@message
+class NetworkReadyRequest:
+    node_id: int = 0
+
+
+@message
+class BoolResponse:
+    value: bool = False
+    reason: str = ""
+
+
+# --------------------------------------------------------------------------
+# Data sharding (reference comm.py Task/TaskResult, shard messages)
+# --------------------------------------------------------------------------
+
+
+@message
+class DatasetShardParams:
+    batch_size: int = 0
+    num_epochs: int = 1
+    dataset_size: int = 0
+    shuffle: bool = False
+    num_minibatches_per_shard: int = 2
+    dataset_name: str = ""
+    storage_type: str = ""
+    splitter: str = "batch"  # batch | text | streaming
+
+
+@message
+class Shard:
+    name: str = ""
+    start: int = 0
+    end: int = 0
+    record_indices: List[int] = field(default_factory=list)
+
+
+@message
+class TaskRequest:
+    dataset_name: str = ""
+    node_id: int = 0
+
+
+@message
+class TaskMessage:
+    task_id: int = -1
+    task_type: str = ""
+    shard: Optional[Any] = None  # Shard
+    dataset_name: str = ""
+
+
+@message
+class TaskResult:
+    dataset_name: str = ""
+    task_id: int = -1
+    node_id: int = 0
+    success: bool = True
+
+
+@message
+class ShardCheckpointRequest:
+    dataset_name: str = ""
+
+
+@message
+class ShardCheckpointResponse:
+    content: str = ""
+
+
+# --------------------------------------------------------------------------
+# Metrics / perf
+# --------------------------------------------------------------------------
+
+
+@message
+class GlobalStep:
+    node_id: int = 0
+    step: int = 0
+    timestamp: float = 0.0
+
+
+@message
+class ResourceStats:
+    node_id: int = 0
+    cpu_percent: float = 0.0
+    mem_used_mb: float = 0.0
+    device_util: Dict[int, float] = field(default_factory=dict)
+    device_mem_mb: Dict[int, float] = field(default_factory=dict)
+
+
+@message
+class PreCheckRequest:
+    node_id: int = 0
+
+
+@message
+class PreCheckResponse:
+    status: str = "pass"  # pass | fail | checking
+    reason: str = ""
+
+
+@message
+class ParallelConfigRequest:
+    node_id: int = 0
+
+
+@message
+class ParallelConfig:
+    """Auto-tuned runtime knobs pushed master→worker
+    (reference comm.py ParallelConfig / config/paral_config_tuner.py)."""
+
+    dataloader_batch_size: int = 0
+    dataloader_version: int = 0
+    grad_accum_steps: int = 0
+    version: int = 0

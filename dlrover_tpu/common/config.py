@@ -1,0 +1,86 @@
+"""Global configuration: ``Context`` singleton + ``DefaultValues``.
+
+Reference: dlrover/python/common/global_context.py:48,84 — a process-wide
+singleton of tunables (autoscale intervals, hang downtime, pending-node
+strategies) some of which can be overridden at runtime.
+"""
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+
+@dataclass
+class DefaultValues:
+    # --- master / servicer ---
+    server_worker_threads: int = 16
+    # --- rendezvous (reference rdzv_manager.py timeouts) ---
+    rdzv_timeout_s: float = 600.0
+    rdzv_lastcall_s: float = 3.0
+    rdzv_pend_timeout_s: float = 600.0
+    # --- heartbeats / monitoring ---
+    heartbeat_interval_s: float = 15.0
+    heartbeat_timeout_s: float = 300.0
+    monitor_interval_s: float = 0.2
+    # --- relaunch / restart budgets ---
+    node_max_relaunch: int = 3
+    worker_max_restart: int = 100
+    relaunch_on_worker_failure: int = 3
+    # --- hang detection ---
+    hang_downtime_s: float = 1800.0
+    step_hang_timeout_s: float = 600.0
+    # --- autoscale ---
+    autoscale_interval_s: float = 30.0
+    # --- flash checkpoint ---
+    ckpt_save_workers: int = 8
+    ckpt_commit_poll_s: float = 0.1
+    # --- data sharding ---
+    task_timeout_s: float = 1800.0
+
+
+class Context:
+    """Process-wide config singleton (reference global_context.py:48).
+
+    Values start from :class:`DefaultValues`, can be overridden via
+    ``DLROVER_TPU_<UPPER_NAME>`` environment variables or programmatically.
+    """
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._values: Dict[str, Any] = {}
+        defaults = DefaultValues()
+        for name in defaults.__dataclass_fields__:
+            default = getattr(defaults, name)
+            env = os.getenv("DLROVER_TPU_" + name.upper())
+            if env is not None:
+                caster = type(default)
+                default = caster(env)
+            self._values[name] = default
+
+    def __getattr__(self, name: str) -> Any:
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(name)
+
+    def set(self, name: str, value: Any) -> None:
+        self._values[name] = value
+
+    @classmethod
+    def singleton(cls) -> "Context":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+        return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+
+def get_context() -> Context:
+    return Context.singleton()

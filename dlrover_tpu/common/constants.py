@@ -1,0 +1,167 @@
+"""Constants and enums for the TPU-native elastic stack.
+
+Reference surface: dlrover/python/common/constants.py (node types, statuses,
+accelerators, rendezvous names, timeouts). Re-designed for TPU: accelerators
+are TPU generations, node-check runs over ICI/DCN, HCCL/NCCL specifics dropped.
+"""
+
+
+class PlatformType:
+    LOCAL = "local"
+    KUBERNETES = "kubernetes"
+    GKE_TPU = "gke_tpu"
+
+
+class Accelerator:
+    """Accelerator families (reference constants.py:434 Accelerators)."""
+
+    TPU = "tpu"
+    CPU = "cpu"  # JAX CPU backend — used by tests and local dev
+
+
+class NodeType:
+    MASTER = "master"
+    WORKER = "worker"
+    # PS/chief/evaluator exist in the reference for the TF stack; the TPU
+    # build is SPMD-only, so WORKER is the only trainable role.
+
+
+class NodeStatus:
+    INITIAL = "initial"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    DELETED = "deleted"
+    BREAKDOWN = "breakdown"
+
+    @classmethod
+    def terminal(cls, status: str) -> bool:
+        return status in (cls.SUCCEEDED, cls.FAILED, cls.DELETED)
+
+
+class NodeEventType:
+    ADDED = "added"
+    MODIFIED = "modified"
+    DELETED = "deleted"
+    ERROR = "error"
+
+
+class NodeExitReason:
+    """Why a worker/node terminated (reference constants.py NodeExitReason)."""
+
+    SUCCEEDED = "succeeded"
+    KILLED = "killed"
+    OOM = "oom"
+    FATAL_ERROR = "fatal_error"
+    HARDWARE_ERROR = "hardware_error"
+    PREEMPTED = "preempted"
+    RELAUNCHED = "relaunched"
+    UNKNOWN = "unknown"
+
+
+class JobStage:
+    INIT = "init"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUSPENDED = "suspended"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+
+
+class RendezvousName:
+    """Named rendezvous rounds served by the master
+    (reference constants.py RendezvousName: elastic-training / network-check)."""
+
+    TRAINING = "training"
+    NODE_CHECK = "node-check"
+
+
+class NetworkFailureReason:
+    NO_INIT = "no_init"
+    NODE_FAILURE = "node_failure"
+    WAITING_NODE = "waiting_node"
+
+
+class DiagnosisActionType:
+    NONE = "no_action"
+    # agent-level
+    RESTART_WORKER = "restart_worker"
+    RELAUNCH_WORKER = "relaunch_worker"
+    # master-level
+    MASTER_RELAUNCH_WORKER = "master_relaunch_worker"
+    JOB_ABORT = "job_abort"
+    EVENT = "event"
+
+
+class DiagnosisConstant:
+    MASTER_INSTANCE = -1
+    ANY_INSTANCE = -2
+    ACTION_EXPIRY_S = 60 * 5
+
+
+class TrainingExceptionLevel:
+    RDZV_ERROR = "rdzv_error"
+    PROCESS_ERROR = "process_error"
+    NODE_ERROR = "node_error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+class CheckpointConstant:
+    """Flash Checkpoint layout (reference:
+    dlrover/python/common/constants.py CheckpointConstant + ckpt_saver.py)."""
+
+    STATE_DICT_NAME = "state.dlrover"
+    META_NAME = "meta.dlrover"
+    TRACKER_FILE = "latest_step.txt"
+    DONE_DIR = "._done"
+    TEMP_DIR_PREFIX = "._tmp_"
+    SAVE_TIMEOUT_S = 600
+
+
+class SharedResourceName:
+    """Names of agent-served IPC resources (reference ckpt_saver.py constants)."""
+
+    SAVE_LOCK = "flash_ckpt_save_lock"
+    SAVE_EVENT_QUEUE = "flash_ckpt_event_queue"
+    SHM_META_DICT = "flash_ckpt_shm_meta"
+
+
+class GoodputEvent:
+    TRAINING_START = "training_start"
+    FAULT = "fault"
+    RECOVERY = "recovery"
+    CKPT_SAVE = "ckpt_save"
+    CKPT_RESTORE = "ckpt_restore"
+
+
+class EnvKey:
+    """Environment variables crossing the agent→worker fork boundary."""
+
+    JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
+    NODE_ID = "DLROVER_TPU_NODE_ID"
+    NODE_RANK = "DLROVER_TPU_NODE_RANK"
+    NODE_NUM = "DLROVER_TPU_NODE_NUM"
+    LOCAL_RANK = "DLROVER_TPU_LOCAL_RANK"
+    LOCAL_WORLD_SIZE = "DLROVER_TPU_LOCAL_WORLD_SIZE"
+    RANK = "DLROVER_TPU_RANK"
+    WORLD_SIZE = "DLROVER_TPU_WORLD_SIZE"
+    # jax.distributed bootstrap (set by the agent from master rendezvous)
+    COORDINATOR_ADDR = "DLROVER_TPU_COORDINATOR_ADDR"
+    PROCESS_ID = "DLROVER_TPU_PROCESS_ID"
+    NUM_PROCESSES = "DLROVER_TPU_NUM_PROCESSES"
+    RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
+    # fault injection for node-check benchmarks
+    # (reference: trainer/torch/node_check/utils.py:52 MOCK_ERR_RANK)
+    MOCK_ERR_RANK = "DLROVER_TPU_MOCK_ERR_RANK"
+
+
+class GRPC:
+    # retained name for familiarity; the transport is the typed msgpack RPC
+    MAX_MESSAGE_BYTES = 512 * 1024 * 1024
+
+
+class DefaultPort:
+    MASTER = 0  # 0 → pick a free port

@@ -1,0 +1,133 @@
+"""Node model + status machine.
+
+Reference: dlrover/python/common/node.py:41,134,159 (``Node``,
+``NodeResource``, ``NodeGroupResource``) and
+dlrover/python/master/node/status_flow.py:150 (allowed status transitions).
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
+
+
+@dataclass
+class NodeResource:
+    cpu: float = 0.0
+    memory_mb: float = 0.0
+    # TPU chips attached to the host (v5e: 1/4/8 per VM)
+    device_count: int = 0
+    device_type: str = ""
+
+    def to_dict(self) -> Dict:
+        return {
+            "cpu": self.cpu,
+            "memory_mb": self.memory_mb,
+            "device_count": self.device_count,
+            "device_type": self.device_type,
+        }
+
+
+@dataclass
+class NodeGroupResource:
+    count: int = 0
+    node_resource: NodeResource = field(default_factory=NodeResource)
+
+
+# Allowed transitions (reference status_flow.py NODE_STATE_FLOWS). A
+# transition not listed is ignored (stale watch events arrive out of order).
+_ALLOWED = {
+    NodeStatus.INITIAL: {
+        NodeStatus.PENDING,
+        NodeStatus.RUNNING,
+        NodeStatus.FAILED,
+        NodeStatus.DELETED,
+    },
+    NodeStatus.PENDING: {
+        NodeStatus.RUNNING,
+        NodeStatus.SUCCEEDED,
+        NodeStatus.FAILED,
+        NodeStatus.DELETED,
+    },
+    NodeStatus.RUNNING: {
+        NodeStatus.SUCCEEDED,
+        NodeStatus.FAILED,
+        NodeStatus.DELETED,
+        NodeStatus.BREAKDOWN,
+    },
+    NodeStatus.SUCCEEDED: {NodeStatus.DELETED},
+    NodeStatus.FAILED: {NodeStatus.DELETED, NodeStatus.PENDING},
+    NodeStatus.BREAKDOWN: {NodeStatus.DELETED, NodeStatus.PENDING},
+    NodeStatus.DELETED: set(),
+}
+
+
+def transition_allowed(from_status: str, to_status: str) -> bool:
+    if from_status == to_status:
+        return False
+    return to_status in _ALLOWED.get(from_status, set())
+
+
+@dataclass
+class Node:
+    """One host in the job (reference node.py:134)."""
+
+    type: str = "worker"
+    id: int = 0
+    rank: int = -1
+    name: str = ""
+    host: str = ""
+    status: str = NodeStatus.INITIAL
+    exit_reason: str = ""
+    relaunch_count: int = 0
+    max_relaunch_count: int = 3
+    relaunchable: bool = True
+    is_released: bool = False
+    config_resource: NodeResource = field(default_factory=NodeResource)
+    used_resource: NodeResource = field(default_factory=NodeResource)
+    create_time: float = field(default_factory=time.time)
+    start_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    heartbeat_time: float = 0.0
+    # rendezvous participation
+    local_world_size: int = 1
+    paral_config_version: int = 0
+
+    def update_status(self, status: str) -> bool:
+        if transition_allowed(self.status, status):
+            self.status = status
+            if status == NodeStatus.RUNNING and self.start_time is None:
+                self.start_time = time.time()
+            if NodeStatus.terminal(status):
+                self.finish_time = time.time()
+            return True
+        return False
+
+    def inc_relaunch_count(self) -> None:
+        self.relaunch_count += 1
+
+    def exhausted_relaunch(self) -> bool:
+        return self.relaunch_count >= self.max_relaunch_count
+
+    def should_relaunch(self) -> bool:
+        """Decide relaunch on failure (reference
+        dist_job_manager.py:905 ``_should_relaunch`` distilled)."""
+        if not self.relaunchable or self.is_released:
+            return False
+        if self.exit_reason == NodeExitReason.FATAL_ERROR:
+            return False
+        if self.exit_reason == NodeExitReason.OOM:
+            # reference stops relaunching OOM nodes unless resources grow;
+            # on TPU host-OOM is typically data-pipeline growth — retry once
+            return self.relaunch_count < 1
+        return not self.exhausted_relaunch()
+
+    def to_meta(self) -> Dict:
+        return {
+            "node_id": self.id,
+            "node_rank": self.rank,
+            "host": self.host,
+            "local_world_size": self.local_world_size,
+            "status": self.status,
+        }

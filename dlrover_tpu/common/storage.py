@@ -1,0 +1,143 @@
+"""Checkpoint storage abstraction.
+
+Reference: dlrover/python/common/storage.py:24,128,209,237 —
+``CheckpointStorage`` ABC, ``PosixDiskStorage``, and checkpoint-deletion
+strategies (``KeepStepIntervalStrategy``, ``KeepLatestStepStrategy``).
+
+TPU additions: storage paths may be GCS (``gs://``) on real pods; this round
+implements POSIX, keeps the ABC narrow enough that a GCS backend (gcsfs or
+the C++ writer) drops in.
+"""
+
+import os
+import shutil
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from dlrover_tpu.common.log import logger
+
+
+class CheckpointDeletionStrategy(ABC):
+    @abstractmethod
+    def clean_up(self, step: int, delete_func) -> None:
+        """Called after a checkpoint for ``step`` commits; may delete older
+        checkpoint dirs via ``delete_func(step)``."""
+
+
+class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
+    """Keep only checkpoints whose step % interval == 0
+    (reference storage.py:209)."""
+
+    def __init__(self, keep_interval: int, checkpoint_dir: str):
+        self._keep_interval = keep_interval
+        self._checkpoint_dir = checkpoint_dir
+        self._steps: List[int] = []
+
+    def clean_up(self, step: int, delete_func) -> None:
+        self._steps.append(step)
+        for s in list(self._steps):
+            if s != step and s % self._keep_interval != 0:
+                self._steps.remove(s)
+                delete_func(s)
+
+
+class KeepLatestStepStrategy(CheckpointDeletionStrategy):
+    """Keep at most N latest checkpoints (reference storage.py:237)."""
+
+    def __init__(self, max_to_keep: int, checkpoint_dir: str):
+        self._max_to_keep = max(1, max_to_keep)
+        self._checkpoint_dir = checkpoint_dir
+        self._steps: List[int] = []
+
+    def clean_up(self, step: int, delete_func) -> None:
+        self._steps.append(step)
+        self._steps.sort()
+        while len(self._steps) > self._max_to_keep:
+            s = self._steps.pop(0)
+            delete_func(s)
+
+
+class CheckpointStorage(ABC):
+    """Byte/file-level operations used by the async saver
+    (reference storage.py:24)."""
+
+    @abstractmethod
+    def write(self, content, path: str) -> None: ...
+
+    @abstractmethod
+    def read(self, path: str, mode: str = "rb"): ...
+
+    @abstractmethod
+    def safe_rmtree(self, dir_path: str) -> None: ...
+
+    @abstractmethod
+    def safe_remove(self, path: str) -> None: ...
+
+    @abstractmethod
+    def safe_makedirs(self, dir_path: str) -> None: ...
+
+    @abstractmethod
+    def safe_move(self, src: str, dst: str) -> None: ...
+
+    @abstractmethod
+    def exists(self, path: str) -> bool: ...
+
+    @abstractmethod
+    def listdir(self, path: str) -> List[str]: ...
+
+    def commit(self, step: int, success: bool) -> None:
+        """Hook called when a full checkpoint commit finishes."""
+
+
+class PosixDiskStorage(CheckpointStorage):
+    """Local/NFS/FUSE-mounted POSIX storage (reference storage.py:128)."""
+
+    def write(self, content, path: str) -> None:
+        mode = "wb" if isinstance(content, (bytes, bytearray, memoryview)) else "w"
+        with open(path, mode) as f:
+            f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def read(self, path: str, mode: str = "rb"):
+        if not os.path.exists(path):
+            return None
+        with open(path, mode) as f:
+            return f.read()
+
+    def safe_rmtree(self, dir_path: str) -> None:
+        shutil.rmtree(dir_path, ignore_errors=True)
+
+    def safe_remove(self, path: str) -> None:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def safe_makedirs(self, dir_path: str) -> None:
+        os.makedirs(dir_path, exist_ok=True)
+
+    def safe_move(self, src: str, dst: str) -> None:
+        try:
+            os.replace(src, dst)
+        except OSError as e:
+            logger.warning("move %s -> %s failed: %s", src, dst, e)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str) -> List[str]:
+        try:
+            return sorted(os.listdir(path))
+        except OSError:
+            return []
+
+
+def get_checkpoint_storage(path: str) -> CheckpointStorage:
+    if path.startswith("gs://"):
+        # GCS backend lands with the native writer; gate clearly for now.
+        raise NotImplementedError(
+            "GCS storage backend not yet wired; mount via gcsfuse and use a "
+            "POSIX path, or use PosixDiskStorage."
+        )
+    return PosixDiskStorage()

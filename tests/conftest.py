@@ -1,0 +1,16 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference test strategy (SURVEY.md §4): multi-node behavior is
+tested on one host — here with JAX's virtual CPU devices standing in for a
+TPU slice.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

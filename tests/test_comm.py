@@ -1,0 +1,39 @@
+"""Tests for the typed message schema (dlrover_tpu/common/comm.py)."""
+
+from dlrover_tpu.common import comm
+
+
+def test_roundtrip_base():
+    req = comm.BaseRequest(node_id=3, node_type="worker", data={"x": 1})
+    out = comm.deserialize(comm.serialize(req))
+    assert isinstance(out, comm.BaseRequest)
+    assert out.node_id == 3
+    assert out.data == {"x": 1}
+
+
+def test_roundtrip_nested_message():
+    meta = comm.NodeMeta(node_id=1, node_rank=0, host="h0", local_world_size=4)
+    resp = comm.CommWorldResponse(
+        rdzv_name="training", round=2, world={0: meta}, coordinator_addr="h0:1234"
+    )
+    out = comm.deserialize(comm.serialize(resp))
+    assert isinstance(out, comm.CommWorldResponse)
+    assert isinstance(out.world[0], comm.NodeMeta)
+    assert out.world[0].host == "h0"
+    assert out.coordinator_addr == "h0:1234"
+
+
+def test_bytes_payload():
+    kv = comm.KeyValueRequest(op="set", key="k", value=b"\x00\xffbin")
+    out = comm.deserialize(comm.serialize(kv))
+    assert out.value == b"\x00\xffbin"
+
+
+def test_unknown_fields_ignored():
+    # forward-compat: decoding a message with extra fields must not crash
+    raw = comm._encode(comm.BoolResponse(value=True))
+    raw["f"]["future_field"] = 42
+    import msgpack
+
+    out = comm.deserialize(msgpack.packb(raw, use_bin_type=True))
+    assert out.value is True

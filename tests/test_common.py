@@ -1,0 +1,84 @@
+"""Tests for node model, storage, config."""
+
+import os
+
+from dlrover_tpu.common.config import Context, get_context
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
+from dlrover_tpu.common.node import Node, transition_allowed
+from dlrover_tpu.common.storage import (
+    KeepLatestStepStrategy,
+    KeepStepIntervalStrategy,
+    PosixDiskStorage,
+)
+
+
+def test_status_flow():
+    assert transition_allowed(NodeStatus.INITIAL, NodeStatus.PENDING)
+    assert transition_allowed(NodeStatus.PENDING, NodeStatus.RUNNING)
+    assert transition_allowed(NodeStatus.RUNNING, NodeStatus.FAILED)
+    assert not transition_allowed(NodeStatus.SUCCEEDED, NodeStatus.RUNNING)
+    assert not transition_allowed(NodeStatus.RUNNING, NodeStatus.RUNNING)
+
+
+def test_node_relaunch_policy():
+    node = Node(id=0, max_relaunch_count=2)
+    node.update_status(NodeStatus.RUNNING)
+    node.update_status(NodeStatus.FAILED)
+    assert node.should_relaunch()
+    node.inc_relaunch_count()
+    node.inc_relaunch_count()
+    assert not node.should_relaunch()
+
+    fatal = Node(id=1)
+    fatal.exit_reason = NodeExitReason.FATAL_ERROR
+    assert not fatal.should_relaunch()
+
+    oom = Node(id=2)
+    oom.exit_reason = NodeExitReason.OOM
+    assert oom.should_relaunch()
+    oom.inc_relaunch_count()
+    assert not oom.should_relaunch()
+
+
+def test_context_env_override(monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_RDZV_TIMEOUT_S", "42.5")
+    Context.reset()
+    try:
+        ctx = get_context()
+        assert ctx.rdzv_timeout_s == 42.5
+        ctx.set("rdzv_timeout_s", 10.0)
+        assert ctx.rdzv_timeout_s == 10.0
+    finally:
+        Context.reset()
+
+
+def test_posix_storage_roundtrip(tmp_path):
+    storage = PosixDiskStorage()
+    p = str(tmp_path / "a" / "b")
+    storage.safe_makedirs(p)
+    f = os.path.join(p, "data.bin")
+    storage.write(b"hello", f)
+    assert storage.read(f) == b"hello"
+    assert storage.read(os.path.join(p, "missing")) is None
+    storage.safe_move(f, os.path.join(p, "data2.bin"))
+    assert storage.exists(os.path.join(p, "data2.bin"))
+    assert storage.listdir(p) == ["data2.bin"]
+    storage.safe_rmtree(p)
+    assert not storage.exists(p)
+
+
+def test_keep_latest_strategy(tmp_path):
+    deleted = []
+    strat = KeepLatestStepStrategy(max_to_keep=2, checkpoint_dir=str(tmp_path))
+    for step in (10, 20, 30, 40):
+        strat.clean_up(step, deleted.append)
+    assert deleted == [10, 20]
+
+
+def test_keep_interval_strategy(tmp_path):
+    deleted = []
+    strat = KeepStepIntervalStrategy(keep_interval=100, checkpoint_dir=str(tmp_path))
+    for step in (50, 100, 150, 200):
+        strat.clean_up(step, deleted.append)
+    assert 50 in deleted and 150 in deleted
+    assert 100 not in deleted and 200 not in deleted

@@ -1,0 +1,114 @@
+"""Tests for the agent↔worker local IPC layer."""
+
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common.multi_process import (
+    LocalIPCServer,
+    SharedDict,
+    SharedLock,
+    SharedQueue,
+    create_shared_memory,
+    unlink_shared_memory,
+)
+
+
+@pytest.fixture()
+def ipc_server(tmp_path):
+    server = LocalIPCServer(str(tmp_path / "ipc.sock"))
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_shared_lock(ipc_server):
+    lock1 = SharedLock("l", ipc_server.path)
+    lock2 = SharedLock("l", ipc_server.path)
+    assert lock1.acquire()
+    assert not lock2.acquire(blocking=False)
+    assert lock1.locked()
+    lock1.release()
+    assert lock2.acquire(blocking=False)
+    lock2.release()
+
+
+def test_shared_queue(ipc_server):
+    q = SharedQueue("q", ipc_server.path)
+    q.put({"step": 7, "path": "/tmp/x"})
+    assert q.qsize() == 1
+    item = q.get(timeout=1)
+    assert item["step"] == 7
+    with pytest.raises(queue.Empty):
+        q.get(timeout=0.05)
+
+
+def test_queue_visible_to_agent_process(ipc_server):
+    q = SharedQueue("events", ipc_server.path)
+    q.put([1, 2, 3])
+    # agent side reads the same queue in-process
+    local = ipc_server.local_queue("events")
+    assert local.get(timeout=1) == [1, 2, 3]
+
+
+def test_shared_dict(ipc_server):
+    d = SharedDict("meta", ipc_server.path)
+    d.set("rank0", {"offset": 128, "size": 4096})
+    assert d.get("rank0")["offset"] == 128
+    assert d.get("missing", "fallback") == "fallback"
+    d.update({"a": 1, "b": 2})
+    snap = d.snapshot()
+    assert snap["a"] == 1 and "rank0" in snap
+    d.delete("a")
+    assert d.get("a") is None
+
+
+def test_lock_concurrent(ipc_server):
+    results = []
+
+    def worker(i):
+        lock = SharedLock("c", ipc_server.path)
+        lock.acquire()
+        results.append(i)
+        time.sleep(0.01)
+        lock.release()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(results) == list(range(8))
+
+
+def test_shared_memory_survives_close():
+    name = f"dlrtpu_test_{os.getpid()}"
+    unlink_shared_memory(name)
+    shm = create_shared_memory(name, create=True, size=1024)
+    shm.buf[:4] = bytes([1, 2, 3, 4])
+    shm.close()
+    # re-open: bytes must still be there (no resource-tracker unlink)
+    shm2 = create_shared_memory(name, create=False)
+    assert shm2 is not None
+    assert list(shm2.buf[:4]) == [1, 2, 3, 4]
+    shm2.close()
+    unlink_shared_memory(name)
+
+
+def test_shared_memory_grow():
+    name = f"dlrtpu_grow_{os.getpid()}"
+    unlink_shared_memory(name)
+    shm = create_shared_memory(name, create=True, size=64)
+    shm.close()
+    shm2 = create_shared_memory(name, create=True, size=4096)
+    assert shm2.size >= 4096
+    shm2.close()
+    unlink_shared_memory(name)
+
+
+def test_open_missing_returns_none():
+    assert create_shared_memory("dlrtpu_missing_xyz", create=False) is None
