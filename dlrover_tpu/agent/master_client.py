@@ -1,0 +1,277 @@
+"""Typed client for the master RPC (agent + worker side).
+
+Reference: dlrover/python/elastic_agent/master_client.py:44 — a singleton
+exposing ~45 typed calls over the pickle envelope. Here every call maps to a
+named RPC method served by :class:`dlrover_tpu.master.servicer.MasterServicer`.
+"""
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.rpc import RPCClient
+
+
+class MasterClient:
+    _instance: Optional["MasterClient"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, master_addr: str, node_id: int = 0,
+                 node_rank: Optional[int] = None):
+        self._client = RPCClient(master_addr)
+        self._node_id = node_id
+        self._node_rank = node_id if node_rank is None else node_rank
+
+    @property
+    def master_addr(self) -> str:
+        return self._client.addr
+
+    @property
+    def node_id(self) -> int:
+        return self._node_id
+
+    # -- rendezvous --------------------------------------------------------
+
+    def join_rendezvous(
+        self, rdzv_name: str, node_rank: int, local_world_size: int,
+        host: str = "", free_port: int = 0, node_unit: int = 1,
+    ) -> int:
+        resp = self._client.call(
+            "join_rendezvous",
+            comm.JoinRendezvousRequest(
+                node_id=self._node_id,
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+                rdzv_name=rdzv_name,
+                node_unit=node_unit,
+                host=host,
+                free_port=free_port,
+            ),
+        )
+        return resp.round
+
+    def get_comm_world(
+        self, rdzv_name: str, node_rank: int
+    ) -> Tuple[int, int, Dict[int, comm.NodeMeta], str]:
+        resp = self._client.call(
+            "get_comm_world",
+            comm.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name),
+        )
+        return resp.round, resp.group, resp.world, resp.coordinator_addr
+
+    def num_nodes_waiting(self, rdzv_name: str) -> int:
+        resp = self._client.call(
+            "num_nodes_waiting",
+            comm.WaitingNodeNumRequest(node_id=self._node_id, rdzv_name=rdzv_name),
+        )
+        return resp.waiting_num
+
+    def report_network_check(self, normal: bool, elapsed: float) -> None:
+        self._client.call(
+            "report_network_check",
+            comm.NetworkCheckResult(
+                node_id=self._node_rank, normal=normal, elapsed_time=elapsed
+            ),
+        )
+
+    def check_fault_node(self) -> Tuple[List[int], str]:
+        resp = self._client.call(
+            "check_fault_node", comm.NetworkReadyRequest(node_id=self._node_id)
+        )
+        return resp.data["nodes"], resp.data["reason"]
+
+    def check_straggler(self) -> List[int]:
+        resp = self._client.call(
+            "check_straggler", comm.StragglerExistRequest(node_id=self._node_id)
+        )
+        return resp.data["nodes"]
+
+    def network_check_success(self) -> bool:
+        resp = self._client.call(
+            "network_check_success",
+            comm.NetworkReadyRequest(node_id=self._node_id),
+        )
+        return resp.value
+
+    # -- kv store ----------------------------------------------------------
+
+    def kv_set(self, key: str, value: bytes) -> None:
+        self._client.call("kv", comm.KeyValueRequest(op="set", key=key, value=value))
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        resp = self._client.call("kv", comm.KeyValueRequest(op="get", key=key))
+        return resp.value if resp.found else None
+
+    def kv_add(self, key: str, delta: int) -> int:
+        resp = self._client.call(
+            "kv",
+            comm.KeyValueRequest(op="add", key=key, value=str(delta).encode()),
+        )
+        return int(resp.value)
+
+    def kv_wait(self, key: str, timeout_s: float = 60.0) -> Optional[bytes]:
+        resp = self._client.call(
+            "kv", comm.KeyValueRequest(op="wait", key=key, timeout_s=timeout_s)
+        )
+        return resp.value if resp.found else None
+
+    def kv_delete(self, key: str) -> None:
+        self._client.call("kv", comm.KeyValueRequest(op="delete", key=key))
+
+    def kv_multi_get(self, keys: List[str]) -> List[bytes]:
+        resp = self._client.call(
+            "kv", comm.KeyValueRequest(op="multi_get", keys=keys)
+        )
+        return resp.values
+
+    def kv_multi_set(self, keys: List[str], values: List[bytes]) -> None:
+        self._client.call(
+            "kv", comm.KeyValueRequest(op="multi_set", keys=keys, values=values)
+        )
+
+    def barrier(self, name: str, node_rank: int, world_size: int,
+                timeout_s: float = 300.0) -> bool:
+        resp = self._client.call(
+            "barrier",
+            comm.BarrierRequest(
+                barrier_name=name, node_rank=node_rank,
+                world_size=world_size, timeout_s=timeout_s,
+            ),
+        )
+        return resp.passed
+
+    # -- node lifecycle ----------------------------------------------------
+
+    def update_node_status(self, status: str, exit_reason: str = "",
+                           restart_count: int = 0) -> None:
+        self._client.call(
+            "update_node_status",
+            comm.NodeStatusRequest(
+                node_id=self._node_id,
+                status=status,
+                exit_reason=exit_reason,
+                restart_count=restart_count,
+            ),
+        )
+
+    def heartbeat(self, global_step: int = 0,
+                  step_timestamp: float = 0.0) -> comm.HeartbeatResponse:
+        return self._client.call(
+            "heartbeat",
+            comm.HeartbeatRequest(
+                node_id=self._node_id,
+                timestamp=time.time(),
+                global_step=global_step,
+                step_timestamp=step_timestamp,
+            ),
+        )
+
+    def report_failure(self, error_data: str, level: str,
+                       restart_count: int = 0) -> None:
+        self._client.call(
+            "report_failure",
+            comm.NodeFailureReport(
+                node_id=self._node_id,
+                error_data=error_data,
+                level=level,
+                restart_count=restart_count,
+            ),
+        )
+
+    def report_global_step(self, step: int, timestamp: float = 0.0) -> None:
+        self._client.call(
+            "report_global_step",
+            comm.GlobalStep(
+                node_id=self._node_id, step=step,
+                timestamp=timestamp or time.time(),
+            ),
+        )
+
+    def report_resource_stats(self, cpu_percent: float, mem_used_mb: float) -> None:
+        self._client.call(
+            "report_resource_stats",
+            comm.ResourceStats(
+                node_id=self._node_id,
+                cpu_percent=cpu_percent,
+                mem_used_mb=mem_used_mb,
+            ),
+        )
+
+    # -- data shards -------------------------------------------------------
+
+    def setup_dataset(self, params: comm.DatasetShardParams) -> bool:
+        resp = self._client.call("setup_dataset", params)
+        return resp.success
+
+    def get_task(self, dataset_name: str) -> comm.TaskMessage:
+        return self._client.call(
+            "get_task",
+            comm.TaskRequest(dataset_name=dataset_name, node_id=self._node_id),
+        )
+
+    def report_task_result(self, dataset_name: str, task_id: int,
+                           success: bool = True) -> None:
+        self._client.call(
+            "report_task_result",
+            comm.TaskResult(
+                dataset_name=dataset_name,
+                task_id=task_id,
+                node_id=self._node_id,
+                success=success,
+            ),
+        )
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        resp = self._client.call(
+            "get_shard_checkpoint",
+            comm.ShardCheckpointRequest(dataset_name=dataset_name),
+        )
+        return resp.content
+
+    def restore_shard_checkpoint(self, content: str) -> None:
+        self._client.call(
+            "restore_shard_checkpoint",
+            comm.ShardCheckpointResponse(content=content),
+        )
+
+    # -- misc --------------------------------------------------------------
+
+    def get_pre_check_result(self) -> Tuple[str, str]:
+        resp = self._client.call(
+            "get_pre_check_result", comm.PreCheckRequest(node_id=self._node_id)
+        )
+        return resp.status, resp.reason
+
+    def ping(self) -> bool:
+        try:
+            self._client.try_call("ping", comm.BaseRequest())
+            return True
+        except (ConnectionError, OSError):
+            return False
+
+    # -- singleton wiring (worker processes build from env) ----------------
+
+    @classmethod
+    def singleton(cls) -> "MasterClient":
+        with cls._lock:
+            if cls._instance is None:
+                addr = os.environ[EnvKey.MASTER_ADDR]
+                node_id = int(os.getenv(EnvKey.NODE_ID, "0"))
+                cls._instance = cls(addr, node_id)
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+
+def build_master_client(master_addr: Optional[str] = None,
+                        node_id: int = 0) -> MasterClient:
+    """Factory (reference master_client.py:681)."""
+    if master_addr is None:
+        master_addr = os.environ[EnvKey.MASTER_ADDR]
+    return MasterClient(master_addr, node_id)

@@ -1,0 +1,186 @@
+"""``dtpu-run`` — the elastic launcher CLI.
+
+Reference: dlrover/trainer/torch/elastic_run.py:516–568 (``dlrover-run``):
+a superset of ``torchrun``. TPU translation: a superset of a plain
+``jax.distributed`` bootstrap — rendezvous via the job master, node health
+checks, elastic restarts, flash checkpoint.
+
+Usage:
+    python -m dlrover_tpu.agent.run --standalone --nproc_per_node=2 train.py
+    python -m dlrover_tpu.agent.run --master-addr=$MASTER --nnodes=2:4 \
+        --network-check train.py -- --model-arg=1
+"""
+
+import argparse
+import os
+import sys
+import time
+from typing import List, Optional
+
+from dlrover_tpu.agent.config import ElasticLaunchConfig
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training import ElasticTrainingAgent
+from dlrover_tpu.common.constants import NodeStatus, RendezvousName
+from dlrover_tpu.common.log import logger
+
+
+def parse_nnodes(value: str):
+    if ":" in value:
+        lo, hi = value.split(":", 1)
+        return int(lo), int(hi)
+    n = int(value)
+    return n, n
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "dtpu-run", description="TPU-native elastic training launcher"
+    )
+    p.add_argument("--standalone", action="store_true",
+                   help="run a local in-process master (single node)")
+    p.add_argument("--nnodes", default="1",
+                   help="number of nodes, or MIN:MAX for elastic jobs")
+    p.add_argument("--nproc_per_node", "--nproc-per-node", dest="nproc_per_node",
+                   type=int, default=1)
+    p.add_argument("--node_rank", "--node-rank", dest="node_rank",
+                   type=int, default=0)
+    p.add_argument("--master_addr", "--master-addr", dest="master_addr",
+                   default=os.getenv("DLROVER_TPU_MASTER_ADDR", ""))
+    p.add_argument("--job_name", "--job-name", dest="job_name",
+                   default=os.getenv("DLROVER_TPU_JOB_NAME", "local"))
+    p.add_argument("--max_restarts", "--max-restarts", dest="max_restarts",
+                   type=int, default=3)
+    p.add_argument("--monitor_interval", dest="monitor_interval",
+                   type=float, default=0.2)
+    p.add_argument("--network-check", dest="network_check",
+                   action="store_true",
+                   help="run node health checks before training")
+    p.add_argument("--comm-perf-test", dest="comm_perf_test",
+                   action="store_true")
+    p.add_argument("--exclude-straggler", dest="exclude_straggler",
+                   action="store_true")
+    p.add_argument("--node_unit", "--node-unit", dest="node_unit",
+                   type=int, default=1)
+    p.add_argument("--ckpt_dir", "--ckpt-dir", dest="ckpt_dir", default="")
+    p.add_argument("--no-save-at-breakpoint", dest="save_at_breakpoint",
+                   action="store_false")
+    p.add_argument("entrypoint", help="training script")
+    p.add_argument("args", nargs=argparse.REMAINDER)
+    return p
+
+
+def config_from_args(args) -> ElasticLaunchConfig:
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    config = ElasticLaunchConfig(
+        min_nodes=min_nodes,
+        max_nodes=max_nodes,
+        nproc_per_node=args.nproc_per_node,
+        node_rank=args.node_rank,
+        job_name=args.job_name,
+        master_addr=args.master_addr,
+        max_restarts=args.max_restarts,
+        monitor_interval_s=args.monitor_interval,
+        network_check=args.network_check,
+        comm_perf_test=args.comm_perf_test,
+        exclude_straggler=args.exclude_straggler,
+        node_unit=args.node_unit,
+        save_at_breakpoint=args.save_at_breakpoint,
+        ckpt_dir=args.ckpt_dir,
+        entrypoint=args.entrypoint,
+        args=[a for a in args.args if a != "--"],
+    )
+    config.auto_configure_params()
+    return config
+
+
+def _launch_local_master(config: ElasticLaunchConfig):
+    """In-process master for standalone mode (reference
+    elastic_run.py:296 ``_launch_dlrover_local_master`` — the reference uses
+    a subprocess; in-process keeps standalone single-PID)."""
+    from dlrover_tpu.master.master import LocalJobMaster
+
+    master = LocalJobMaster(
+        job_name=config.job_name,
+        node_num=config.min_nodes,
+        min_nodes=config.min_nodes,
+        max_nodes=config.max_nodes,
+        node_unit=config.node_unit,
+    )
+    master.prepare()
+    config.master_addr = master.addr
+    return master
+
+
+def wait_pre_check(client: MasterClient, timeout_s: float = 600.0) -> None:
+    """Poll the master pre-check gate (reference elastic_run.py:265)."""
+    start = time.time()
+    while time.time() - start < timeout_s:
+        status, reason = client.get_pre_check_result()
+        if status == "pass":
+            return
+        if status == "fail":
+            raise RuntimeError(f"pre-check failed: {reason}")
+        time.sleep(1.0)
+    raise TimeoutError("pre-check did not finish in time")
+
+
+def _run_network_check(config: ElasticLaunchConfig,
+                       client: MasterClient) -> bool:
+    from dlrover_tpu.diagnosis.node_check_agent import run_node_check
+
+    return run_node_check(config, client)
+
+
+def run(config: ElasticLaunchConfig) -> int:
+    master = None
+    if config.master_addr == "":
+        master = _launch_local_master(config)
+        logger.info("standalone master at %s", config.master_addr)
+    client = MasterClient(
+        config.master_addr, config.node_id, config.node_rank
+    )
+    try:
+        wait_pre_check(client)
+        if config.network_check:
+            ok = _run_network_check(config, client)
+            if not ok:
+                logger.error("node %s failed the network check — exiting "
+                             "so the scheduler can replace it",
+                             config.node_rank)
+                client.update_node_status(
+                    NodeStatus.FAILED, exit_reason="hardware_error"
+                )
+                return 1
+        from dlrover_tpu.ckpt.ckpt_saver import AsyncCheckpointSaver
+
+        saver = None
+        if config.ckpt_dir or config.save_at_breakpoint:
+            saver = AsyncCheckpointSaver(
+                ckpt_dir=config.ckpt_dir,
+                node_rank=config.node_rank,
+                local_world_size=config.nproc_per_node,
+                expected_frames=config.min_nodes * config.nproc_per_node,
+                is_commit_leader=(config.node_rank == 0),
+            )
+        agent = ElasticTrainingAgent(config, client, ckpt_saver=saver)
+        return agent.run()
+    finally:
+        if master is not None:
+            master.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    config = config_from_args(args)
+    if not args.standalone and not config.master_addr:
+        print("error: --master-addr required unless --standalone",
+              file=sys.stderr)
+        return 2
+    if args.standalone and config.master_addr:
+        logger.info("--standalone ignored: master addr %s given",
+                    config.master_addr)
+    return run(config)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,429 @@
+"""The elastic agent: rendezvous, worker process management, fault recovery.
+
+Reference: dlrover/python/elastic_agent/torch/training.py —
+``ElasticTrainingAgent``:484 (``_rendezvous``:604, ``_assign_worker_ranks``:791,
+``_initialize_workers``:856, ``_invoke_run``:969,
+``_process_diagnosis_action``:1111, ``_restart_workers``:1225) and
+``MasterRendezvousHandler``:272 (``next_rendezvous``:349).
+
+TPU-native redesign: instead of wrapping torchrun's agent, this is a small
+self-contained loop. Rendezvous hands out a **jax.distributed coordinator
+address** (rank-0 host + free port) rather than a torch Store; workers
+bootstrap PJRT with it. Elasticity = kill worker procs, re-join rendezvous,
+respawn with the new world (XLA's world is static per-process, so every
+membership change is a process restart — made cheap by the persistent JAX
+compilation cache, SURVEY.md §7 hard-part (b)).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from enum import Enum
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.agent.config import ElasticLaunchConfig
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.comm import NodeMeta
+from dlrover_tpu.common.constants import (
+    DiagnosisActionType,
+    EnvKey,
+    NodeStatus,
+    RendezvousName,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.multi_process import LocalIPCServer, ipc_socket_path
+from dlrover_tpu.common.rpc import find_free_port
+
+
+class RendezvousOutSyncError(Exception):
+    """Raised when the cut world went stale mid-poll (reference training.py:432)."""
+
+
+class MasterRendezvousHandler:
+    """Joins a named master rendezvous and polls for the cut world
+    (reference training.py:272)."""
+
+    def __init__(
+        self,
+        name: str,
+        client: MasterClient,
+        node_rank: int,
+        local_world_size: int,
+        timeout_s: float = 600.0,
+        node_unit: int = 1,
+    ):
+        self._name = name
+        self._client = client
+        self._node_rank = node_rank
+        self._local_world_size = local_world_size
+        self._timeout_s = timeout_s
+        self._node_unit = node_unit
+
+    def next_rendezvous(
+        self,
+    ) -> Tuple[int, Dict[int, NodeMeta], str]:
+        """Join, then poll until this node is in a cut world.
+
+        Returns (round, world {node_rank: NodeMeta}, coordinator_addr).
+        """
+        free_port = find_free_port("127.0.0.1")
+        self._client.join_rendezvous(
+            self._name,
+            self._node_rank,
+            self._local_world_size,
+            host=os.getenv("DLROVER_TPU_HOST_IP", "127.0.0.1"),
+            free_port=free_port,
+            node_unit=self._node_unit,
+        )
+        start = time.time()
+        while True:
+            rdzv_round, _, world, coordinator = self._client.get_comm_world(
+                self._name, self._node_rank
+            )
+            if world and self._node_rank in world:
+                return rdzv_round, world, coordinator
+            if time.time() - start > self._timeout_s:
+                raise TimeoutError(
+                    f"rendezvous {self._name} timed out after "
+                    f"{self._timeout_s}s (node_rank={self._node_rank})"
+                )
+            time.sleep(0.1)
+
+
+def assign_worker_ranks(
+    world: Dict[int, NodeMeta], node_rank: int
+) -> Tuple[int, int]:
+    """Compute (base_global_rank, world_size) from the cut world
+    (reference ``_assign_worker_ranks``:791 — rank order follows node rank)."""
+    world_size = sum(m.local_world_size for m in world.values())
+    base_rank = sum(
+        world[r].local_world_size for r in sorted(world) if r < node_rank
+    )
+    return base_rank, world_size
+
+
+class WorkerState(Enum):
+    INIT = "init"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+
+
+@dataclass
+class _Worker:
+    local_rank: int
+    global_rank: int
+    proc: subprocess.Popen
+
+
+class RunResult:
+    def __init__(self, state: WorkerState, failures: Optional[Dict] = None):
+        self.state = state
+        self.failures = failures or {}
+
+
+class ElasticTrainingAgent:
+    """Per-host agent driving rendezvous → spawn → monitor → recover
+    (reference training.py:484)."""
+
+    def __init__(
+        self,
+        config: ElasticLaunchConfig,
+        client: Optional[MasterClient] = None,
+        ckpt_saver=None,
+    ):
+        self._config = config
+        self._client = client or MasterClient(
+            config.master_addr, config.node_id, config.node_rank
+        )
+        self._workers: List[_Worker] = []
+        self._restart_count = 0
+        self._remaining_restarts = config.max_restarts
+        self._stop_flag = threading.Event()
+        self._action_lock = threading.Lock()
+        self._pending_action: Optional[str] = None
+        self._rdzv_handler = MasterRendezvousHandler(
+            RendezvousName.TRAINING,
+            self._client,
+            config.node_rank,
+            config.nproc_per_node,
+            timeout_s=config.rdzv_timeout_s,
+            node_unit=config.node_unit,
+        )
+        self._current_round = -1
+        self._world: Dict[int, NodeMeta] = {}
+        # agent-hosted IPC for flash checkpoint (SharedQueue/Lock/Dict + shm)
+        self._ipc_server = LocalIPCServer(ipc_socket_path(config.job_name))
+        self._ckpt_saver = ckpt_saver
+        self._hb_thread: Optional[threading.Thread] = None
+        self._last_global_step = 0
+        self._last_step_ts = 0.0
+
+    # -- rendezvous + spawn ------------------------------------------------
+
+    def _rendezvous(self) -> Tuple[str, int, int]:
+        """(reference ``_rendezvous``:604)"""
+        rdzv_round, world, coordinator = self._rdzv_handler.next_rendezvous()
+        self._current_round = rdzv_round
+        self._world = world
+        base_rank, world_size = assign_worker_ranks(
+            world, self._config.node_rank
+        )
+        logger.info(
+            "node %s rendezvous round %s: %s nodes, world_size=%s, "
+            "base_rank=%s, coordinator=%s",
+            self._config.node_rank, rdzv_round, len(world), world_size,
+            base_rank, coordinator,
+        )
+        if self._ckpt_saver is not None:
+            # commit quorum is a property of the *current* world
+            self._ckpt_saver.update_world(
+                node_rank=self._config.node_rank,
+                expected_frames=world_size,
+                is_commit_leader=(self._config.node_rank == min(world)),
+            )
+        return coordinator, base_rank, world_size
+
+    def _worker_env(
+        self, local_rank: int, global_rank: int, world_size: int,
+        coordinator: str,
+    ) -> Dict[str, str]:
+        env = dict(os.environ)
+        # make sure workers resolve the same dlrover_tpu the agent runs
+        import dlrover_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(dlrover_tpu.__file__))
+        pythonpath = env.get("PYTHONPATH", "")
+        if pkg_root not in pythonpath.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + pythonpath if pythonpath else "")
+            )
+        env.update(self._config.worker_env)
+        env.update({
+            EnvKey.JOB_NAME: self._config.job_name,
+            EnvKey.MASTER_ADDR: self._client.master_addr,
+            EnvKey.NODE_ID: str(self._config.node_id),
+            EnvKey.NODE_RANK: str(self._config.node_rank),
+            EnvKey.NODE_NUM: str(len(self._world)),
+            EnvKey.LOCAL_RANK: str(local_rank),
+            EnvKey.LOCAL_WORLD_SIZE: str(self._config.nproc_per_node),
+            EnvKey.RANK: str(global_rank),
+            EnvKey.WORLD_SIZE: str(world_size),
+            EnvKey.COORDINATOR_ADDR: coordinator,
+            EnvKey.PROCESS_ID: str(global_rank),
+            EnvKey.NUM_PROCESSES: str(world_size),
+            EnvKey.RESTART_COUNT: str(self._restart_count),
+            EnvKey.RDZV_ROUND: str(self._current_round),
+            "DLROVER_TPU_IPC_SOCKET": self._ipc_server.path,
+        })
+        return env
+
+    def _initialize_workers(self) -> None:
+        """(reference ``_initialize_workers``:856)"""
+        coordinator, base_rank, world_size = self._rendezvous()
+        self._workers = []
+        for local_rank in range(self._config.nproc_per_node):
+            global_rank = base_rank + local_rank
+            env = self._worker_env(
+                local_rank, global_rank, world_size, coordinator
+            )
+            cmd = [sys.executable, self._config.entrypoint, *self._config.args]
+            proc = subprocess.Popen(cmd, env=env)  # noqa: S603
+            self._workers.append(_Worker(local_rank, global_rank, proc))
+        logger.info(
+            "node %s spawned %s worker(s): pids=%s",
+            self._config.node_rank,
+            len(self._workers),
+            [w.proc.pid for w in self._workers],
+        )
+
+    # -- monitoring --------------------------------------------------------
+
+    def _monitor_workers(self) -> RunResult:
+        states = []
+        failures = {}
+        for w in self._workers:
+            code = w.proc.poll()
+            if code is None:
+                states.append(WorkerState.RUNNING)
+            elif code == 0:
+                states.append(WorkerState.SUCCEEDED)
+            else:
+                states.append(WorkerState.FAILED)
+                failures[w.global_rank] = code
+        if failures:
+            return RunResult(WorkerState.FAILED, failures)
+        if all(s == WorkerState.SUCCEEDED for s in states):
+            return RunResult(WorkerState.SUCCEEDED)
+        return RunResult(WorkerState.RUNNING)
+
+    def _membership_changed(self) -> bool:
+        """A new rendezvous round is forming (reference
+        ``_membership_changed``:1232)."""
+        try:
+            return self._client.num_nodes_waiting(RendezvousName.TRAINING) > 0
+        except ConnectionError:
+            return False
+
+    def _stop_workers(self, sig: int = signal.SIGTERM, grace_s: float = 10.0) -> None:
+        for w in self._workers:
+            if w.proc.poll() is None:
+                try:
+                    w.proc.send_signal(sig)
+                except ProcessLookupError:
+                    pass
+        deadline = time.time() + grace_s
+        for w in self._workers:
+            remaining = max(0.1, deadline - time.time())
+            try:
+                w.proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+                w.proc.wait()
+
+    def _restart_workers(self, reason: str) -> None:
+        """Soft restart: same host, new rendezvous round
+        (reference ``_restart_workers``:1225)."""
+        logger.info("restarting workers on node %s: %s",
+                    self._config.node_rank, reason)
+        # stop first: shm survives the workers, and persisting after they
+        # die removes any chance of reading a frame mid-write
+        self._stop_workers()
+        self._save_breakpoint_checkpoint(reason)
+        self._restart_count += 1
+        self._initialize_workers()
+
+    def _save_breakpoint_checkpoint(self, reason: str) -> None:
+        """Persist whatever checkpoint state is in shm before losing workers
+        (reference agent ``_save_ckpt_to_storage`` training.py:1186)."""
+        if self._ckpt_saver is not None and self._config.save_at_breakpoint:
+            try:
+                self._ckpt_saver.save_shm_to_storage(
+                    reason=reason, workers_dead=True
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("breakpoint checkpoint save failed")
+
+    # -- heartbeat / diagnosis actions -------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        from dlrover_tpu.common.config import get_context
+
+        interval = get_context().heartbeat_interval_s
+        while not self._stop_flag.wait(interval):
+            try:
+                resp = self._client.heartbeat(
+                    global_step=self._last_global_step,
+                    step_timestamp=self._last_step_ts,
+                )
+            except ConnectionError:
+                continue
+            if resp.action_type != DiagnosisActionType.NONE:
+                with self._action_lock:
+                    self._pending_action = resp.action_type
+                logger.info(
+                    "received diagnosis action %s (%s)",
+                    resp.action_type, resp.action_data,
+                )
+
+    def _take_pending_action(self) -> Optional[str]:
+        with self._action_lock:
+            action, self._pending_action = self._pending_action, None
+            return action
+
+    def observe_global_step(self, step: int, ts: float) -> None:
+        self._last_global_step = step
+        self._last_step_ts = ts
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self) -> int:
+        """(reference ``_invoke_run``:969)"""
+        self._ipc_server.start()
+        if self._ckpt_saver is not None:
+            self._ckpt_saver.start(self._ipc_server)
+            try:
+                # persist shm before dying on SIGTERM (pod preemption)
+                self._ckpt_saver.install_signal_handlers()
+            except ValueError:
+                pass  # not the main thread (in-process test harness)
+        self._client.update_node_status(NodeStatus.RUNNING)
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="agent-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+        try:
+            self._initialize_workers()
+            return self._monitor_loop()
+        finally:
+            self._stop_flag.set()
+            self._stop_workers()
+            if self._ckpt_saver is not None:
+                self._ckpt_saver.stop()
+            self._ipc_server.stop()
+
+    def _monitor_loop(self) -> int:
+        interval = self._config.monitor_interval_s
+        membership_poll = 0.0
+        while True:
+            time.sleep(interval)
+            result = self._monitor_workers()
+            if result.state == WorkerState.SUCCEEDED:
+                logger.info("node %s workers all succeeded",
+                            self._config.node_rank)
+                self._client.update_node_status(NodeStatus.SUCCEEDED)
+                return 0
+            if result.state == WorkerState.FAILED:
+                if not self._handle_worker_failure(result):
+                    return 1
+                continue
+            # healthy: check diagnosis actions and membership changes
+            action = self._take_pending_action()
+            if action in (
+                DiagnosisActionType.RESTART_WORKER,
+                DiagnosisActionType.RELAUNCH_WORKER,
+            ):
+                self._restart_workers(f"diagnosis action {action}")
+                continue
+            if action == DiagnosisActionType.JOB_ABORT:
+                logger.error("job abort action received")
+                self._client.update_node_status(
+                    NodeStatus.FAILED, exit_reason="job_abort"
+                )
+                return 1
+            now = time.time()
+            if now - membership_poll >= 1.0:
+                membership_poll = now
+                if self._membership_changed():
+                    self._restart_workers("membership changed")
+
+    def _handle_worker_failure(self, result: RunResult) -> bool:
+        """Returns True to continue (restarted), False to give up."""
+        logger.warning(
+            "node %s worker failure(s): %s",
+            self._config.node_rank, result.failures,
+        )
+        try:
+            self._client.report_failure(
+                error_data=str(result.failures),
+                level=TrainingExceptionLevel.PROCESS_ERROR,
+                restart_count=self._restart_count,
+            )
+        except ConnectionError:
+            pass
+        if self._remaining_restarts <= 0:
+            logger.error("restart budget exhausted on node %s",
+                         self._config.node_rank)
+            self._client.update_node_status(
+                NodeStatus.FAILED, exit_reason="fatal_error",
+                restart_count=self._restart_count,
+            )
+            return False
+        self._remaining_restarts -= 1
+        self._restart_workers(f"worker failure {result.failures}")
+        return True

@@ -1,0 +1,22 @@
+"""Flash Checkpoint for pjit-sharded ``jax.Array`` pytrees.
+
+Reference: dlrover/trainer/torch/flash_checkpoint/ + the agent-side saver
+dlrover/python/elastic_agent/torch/ckpt_saver.py. The split is the same:
+
+- the **worker** copies device shards into host shared memory and returns to
+  training in O(memcpy) time (:mod:`dlrover_tpu.ckpt.engine`);
+- the **agent process** persists shm to storage asynchronously, commits via
+  done-files + a tracker file, and still holds the bytes if the worker dies
+  (:mod:`dlrover_tpu.ckpt.ckpt_saver`) — breakpoint saves;
+- the user API is a :class:`~dlrover_tpu.ckpt.checkpointer.Checkpointer`
+  (save to memory every few steps, to storage occasionally).
+
+TPU-native: shard layout is keyed by each array's ``NamedSharding`` — a
+shard is saved once per replica group (``replica_id == 0``), so DP replicas
+dedup exactly like the reference's rank-0-only DDP saves, and TP/PP/FSDP
+shards map 1:1 with mesh coordinates.
+"""
+
+from dlrover_tpu.ckpt.checkpointer import Checkpointer, StorageType
+
+__all__ = ["Checkpointer", "StorageType"]

@@ -1,0 +1,377 @@
+"""Agent-side async checkpoint saver: shm → storage, commit, breakpoint saves.
+
+Reference: dlrover/python/elastic_agent/torch/ckpt_saver.py —
+``AsyncCheckpointSaver``:399 (daemon threads consuming a SharedQueue),
+``CommonDirCheckpointSaver.save_step_checkpoint``:925 (threadpool per-shard
+persist), ``commit_checkpoint``:992 (done-files + tracker), signal-handler
+persistence on SIGTERM (:533), ``save_shm_to_storage``:758 (breakpoint save).
+
+The reference needs a saver subclass per torch framework (DDP/Megatron/
+DeepSpeed/FSDP-DCP, :1266–1314) because each lays out shards differently;
+here the jax engine writes one self-describing frame per worker process, so
+a single saver persists them all — shard semantics live in the frame meta
+(NamedSharding start indices), not in the saver.
+
+Disk layout per checkpoint::
+
+    <ckpt_dir>/latest_step.txt                      # tracker (commit marker)
+    <ckpt_dir>/step_00000042/frame_<node>_<local>.dlrover
+    <ckpt_dir>/step_00000042/._done/done_<node>_<local>
+"""
+
+import os
+import queue
+import signal
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import CheckpointConstant, SharedResourceName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.storage import (
+    CheckpointDeletionStrategy,
+    CheckpointStorage,
+    PosixDiskStorage,
+)
+from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler, parse_frame
+
+
+def step_dir(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, f"step_{step:08d}")
+
+
+def frame_file(ckpt_dir: str, step: int, node_rank: int, local_rank: int) -> str:
+    return os.path.join(
+        step_dir(ckpt_dir, step), f"frame_{node_rank}_{local_rank}.dlrover"
+    )
+
+
+def latest_step(ckpt_dir: str, storage: Optional[CheckpointStorage] = None) -> int:
+    storage = storage or PosixDiskStorage()
+    tracker = os.path.join(ckpt_dir, CheckpointConstant.TRACKER_FILE)
+    content = storage.read(tracker, "r")
+    if not content:
+        return -1
+    try:
+        return int(str(content).strip())
+    except ValueError:
+        return -1
+
+
+def load_frames_for_step(
+    ckpt_dir: str, step: int, storage: Optional[CheckpointStorage] = None
+) -> List[Dict]:
+    storage = storage or PosixDiskStorage()
+    d = step_dir(ckpt_dir, step)
+    frames = []
+    for name in storage.listdir(d):
+        if not name.endswith(".dlrover"):
+            continue
+        blob = storage.read(os.path.join(d, name))
+        if blob is None:
+            continue
+        meta = parse_frame(blob)
+        if meta is not None:
+            frames.append(meta)
+    return frames
+
+
+def persist_shm_frame(
+    shm: SharedMemoryHandler,
+    ckpt_dir: str,
+    step: int,
+    storage: Optional[CheckpointStorage] = None,
+) -> bool:
+    """Persist one shm frame as an atomic file write (used directly by
+    agent-less workers)."""
+    storage = storage or PosixDiskStorage()
+    meta = shm.read_meta()
+    if meta is None or meta["step"] != step:
+        return False
+    blob = shm.read_frame_bytes()
+    if blob is None:
+        return False
+    d = step_dir(ckpt_dir, step)
+    storage.safe_makedirs(d)
+    target = frame_file(ckpt_dir, step, meta["node_rank"], meta["local_rank"])
+    tmp = target + ".tmp"
+    storage.write(blob, tmp)
+    storage.safe_move(tmp, target)
+    # agent-less path commits immediately (single process owns the dir)
+    tracker = os.path.join(ckpt_dir, CheckpointConstant.TRACKER_FILE)
+    storage.write(str(step), tracker)
+    return True
+
+
+class AsyncCheckpointSaver:
+    """Agent-process daemon that persists worker shm frames.
+
+    ``expected_frames`` is the number of frames a committed checkpoint must
+    contain across all hosts (world_size of worker processes); the
+    lowest-node-rank agent commits once the done-dir fills (reference
+    ``commit_checkpoint``:992 polls the same way).
+    """
+
+    _instance: Optional["AsyncCheckpointSaver"] = None
+
+    def __init__(
+        self,
+        ckpt_dir: str = "",
+        storage: Optional[CheckpointStorage] = None,
+        node_rank: int = 0,
+        local_world_size: int = 1,
+        expected_frames: Optional[int] = None,
+        is_commit_leader: Optional[bool] = None,
+        deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
+    ):
+        self.ckpt_dir = ckpt_dir
+        self._storage = storage or PosixDiskStorage()
+        self._node_rank = node_rank
+        self._local_world_size = local_world_size
+        self._expected_frames = expected_frames or local_world_size
+        self._is_commit_leader = (
+            (node_rank == 0) if is_commit_leader is None else is_commit_leader
+        )
+        self._deletion_strategy = deletion_strategy
+        self._ipc_server = None
+        self._stopped = threading.Event()
+        self._consumer: Optional[threading.Thread] = None
+        self._executor = ThreadPoolExecutor(
+            max_workers=get_context().ckpt_save_workers,
+            thread_name_prefix="ckpt-persist",
+        )
+        self._persisted_steps: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        AsyncCheckpointSaver._instance = self
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def update_world(
+        self, node_rank: int, expected_frames: int, is_commit_leader: bool
+    ) -> None:
+        """Called by the agent after every rendezvous: the commit quorum is
+        a property of the *current* world, not of launch-time config."""
+        self._node_rank = node_rank
+        self._expected_frames = max(1, expected_frames)
+        self._is_commit_leader = is_commit_leader
+        logger.info(
+            "ckpt saver world update: node_rank=%s expected_frames=%s "
+            "commit_leader=%s", node_rank, expected_frames, is_commit_leader,
+        )
+
+    def start(self, ipc_server) -> None:
+        self._ipc_server = ipc_server
+        self._consumer = threading.Thread(
+            target=self._consume_events, name="ckpt-saver", daemon=True
+        )
+        self._consumer.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._executor.shutdown(wait=False)
+
+    def install_signal_handlers(self) -> None:
+        """Persist shm on SIGTERM before dying (reference ckpt_saver.py:533).
+        Call from the agent main thread only."""
+        prev_term = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            logger.info("SIGTERM: persisting in-memory checkpoints")
+            try:
+                self.save_shm_to_storage(reason="sigterm")
+            finally:
+                if callable(prev_term):
+                    prev_term(signum, frame)
+                else:
+                    raise SystemExit(143)
+
+        signal.signal(signal.SIGTERM, _on_term)
+
+    # -- event loop --------------------------------------------------------
+
+    def _consume_events(self) -> None:
+        q = self._ipc_server.local_queue(SharedResourceName.SAVE_EVENT_QUEUE)
+        while not self._stopped.is_set():
+            try:
+                event = q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                self._handle_save_event(event)
+            except Exception:  # noqa: BLE001
+                logger.exception("checkpoint persist failed: %s", event)
+
+    def _local_shm_handlers(self) -> List[SharedMemoryHandler]:
+        """Worker shm segments registered in the meta dict."""
+        handlers = []
+        if self._ipc_server is None:
+            return handlers
+        meta = self._ipc_server.local_dict(SharedResourceName.SHM_META_DICT)
+        for info in dict(meta).values():
+            handlers.append(SharedMemoryHandler(info["shm"]))
+        return handlers
+
+    def _handle_save_event(self, event: Dict) -> None:
+        step = event["step"]
+        path = event.get("path") or self.ckpt_dir
+        if not path:
+            logger.warning("save event without a checkpoint dir — dropped")
+            return
+        self.save_step_checkpoint(step, path)
+
+    def save_step_checkpoint(self, step: int, path: str) -> None:
+        """Persist every local frame for ``step``, then commit
+        (reference ``save_step_checkpoint``:925)."""
+        handlers = self._local_shm_handlers()
+        futures = []
+        for shm in handlers:
+            futures.append(
+                self._executor.submit(self._persist_one, shm, path, step)
+            )
+        persisted = [f.result() for f in futures]
+        if not any(persisted):
+            logger.warning("no shm frame matched step %s — nothing persisted",
+                           step)
+            return
+        self._write_done_files(path, step, handlers)
+        if self._is_commit_leader:
+            self.commit_checkpoint(path, step)
+
+    def _frame_lock(self, shm: SharedMemoryHandler):
+        """The per-frame lock the worker writes under — the agent takes it
+        while copying shm out so a concurrent save can't tear the frame."""
+        from dlrover_tpu.common.multi_process import SharedLock
+
+        if self._ipc_server is None:
+            return None
+        return SharedLock(shm.name + ".lock", self._ipc_server.path)
+
+    def _persist_one(
+        self, shm: SharedMemoryHandler, path: str, step: int,
+        lock_timeout: float = CheckpointConstant.SAVE_TIMEOUT_S,
+    ) -> bool:
+        lock = self._frame_lock(shm)
+        if lock is not None and not lock.acquire(timeout=lock_timeout):
+            logger.warning(
+                "could not take frame lock for %s in %.0fs — skipping to "
+                "avoid a torn read", shm.name, lock_timeout,
+            )
+            return False
+        try:
+            meta = shm.read_meta()
+            if meta is None or meta["step"] != step:
+                return False
+            blob = shm.read_frame_bytes()
+            if blob is None:
+                return False
+        finally:
+            if lock is not None:
+                lock.release()
+        d = step_dir(path, step)
+        self._storage.safe_makedirs(d)
+        target = frame_file(path, step, meta["node_rank"], meta["local_rank"])
+        tmp = target + ".tmp"
+        self._storage.write(blob, tmp)
+        self._storage.safe_move(tmp, target)
+        with self._lock:
+            self._persisted_steps[shm.name] = step
+        logger.info("persisted %s (%.1f MB) for step %s",
+                    os.path.basename(target), len(blob) / 1e6, step)
+        return True
+
+    def _write_done_files(
+        self, path: str, step: int, handlers: List[SharedMemoryHandler]
+    ) -> None:
+        done_dir = os.path.join(step_dir(path, step), CheckpointConstant.DONE_DIR)
+        self._storage.safe_makedirs(done_dir)
+        for shm in handlers:
+            meta = shm.read_meta()
+            if meta is None:
+                continue
+            done = os.path.join(
+                done_dir, f"done_{meta['node_rank']}_{meta['local_rank']}"
+            )
+            self._storage.write("1", done)
+
+    def commit_checkpoint(
+        self, path: str, step: int, timeout_s: Optional[float] = None
+    ) -> bool:
+        """Wait for all hosts' done files, then move the tracker
+        (reference ``commit_checkpoint``:992)."""
+        timeout_s = timeout_s or CheckpointConstant.SAVE_TIMEOUT_S
+        done_dir = os.path.join(step_dir(path, step), CheckpointConstant.DONE_DIR)
+        poll = get_context().ckpt_commit_poll_s
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            count = len([
+                f for f in self._storage.listdir(done_dir)
+                if f.startswith("done_")
+            ])
+            if count >= self._expected_frames:
+                tracker = os.path.join(path, CheckpointConstant.TRACKER_FILE)
+                tmp = tracker + ".tmp"
+                self._storage.write(str(step), tmp)
+                self._storage.safe_move(tmp, tracker)
+                logger.info("checkpoint step %s committed (%s frames)",
+                            step, count)
+                if self._deletion_strategy is not None:
+                    self._deletion_strategy.clean_up(
+                        step,
+                        lambda s: self._storage.safe_rmtree(step_dir(path, s)),
+                    )
+                return True
+            if self._stopped.is_set():
+                return False
+            time.sleep(poll)
+        logger.error("checkpoint step %s commit timed out", step)
+        return False
+
+    # -- breakpoint saves --------------------------------------------------
+
+    def save_shm_to_storage(
+        self, reason: str = "", workers_dead: bool = False
+    ) -> int:
+        """Persist any shm frame newer than what's on disk — called when
+        workers fail, membership changes, or the agent gets SIGTERM
+        (reference ``save_shm_to_storage``:758). Returns #frames persisted.
+
+        ``workers_dead=True`` force-releases frame locks first: a worker
+        that died mid-save can never release its lock itself."""
+        if not self.ckpt_dir:
+            return 0
+        persisted = 0
+        handlers = self._local_shm_handlers()
+        steps = set()
+        for shm in handlers:
+            if workers_dead:
+                lock = self._frame_lock(shm)
+                if lock is not None:
+                    lock.release()
+            meta = shm.read_meta()
+            if meta is None:
+                continue
+            step = meta["step"]
+            with self._lock:
+                already = self._persisted_steps.get(shm.name, -1)
+            if step <= already:
+                continue
+            if self._persist_one(shm, self.ckpt_dir, step, lock_timeout=10.0):
+                persisted += 1
+                steps.add(step)
+        if persisted:
+            for step in steps:
+                self._write_done_files(self.ckpt_dir, step, handlers)
+                # breakpoint saves commit with whatever frames this host has:
+                # a partial-world checkpoint is still restorable per-host
+                self.commit_checkpoint(self.ckpt_dir, step, timeout_s=5.0)
+            logger.info(
+                "breakpoint save (%s): persisted %s frame(s) to %s",
+                reason, persisted, self.ckpt_dir,
+            )
+        return persisted
+
+    @classmethod
+    def get_instance(cls) -> Optional["AsyncCheckpointSaver"]:
+        return cls._instance

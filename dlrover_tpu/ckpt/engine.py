@@ -1,0 +1,498 @@
+"""Worker-side checkpoint engine: jax.Array pytree → host shared memory.
+
+Reference: dlrover/trainer/torch/flash_checkpoint/engine.py:154
+(``save_state_dict_to_memory``:340, ``get_state_dict_from_memory``:375) and
+full_ckpt_engine.py:33. TPU-native redesign:
+
+- the state is a **pytree of jax.Arrays** (train state), not a torch
+  state_dict; leaves are addressed by their tree path;
+- shard selection comes from each array's sharding: every *addressable*
+  shard with ``replica_id == 0`` is saved by this host — DP replicas dedup
+  to one copy exactly like the reference saving only on DP-rank-0
+  (megatron_engine.py:71 saving-ranks logic), while TP/FSDP/PP/SP/EP shards
+  land with their global start indices so storage restore can reassemble
+  under a different topology;
+- device→host copies are started async for all shards first
+  (``copy_to_host_async``), then drained into shm — the blocking time is one
+  HBM→host DMA of the state, not a serialize.
+
+Step-consistency across hosts on restore from shm uses the master KV store
+(each host publishes its shm step; restore falls back to storage when hosts
+disagree) — the reference does the same with a gloo allgather
+(engine.py:375).
+"""
+
+import os
+import queue
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.constants import EnvKey, SharedResourceName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.multi_process import SharedDict, SharedLock, SharedQueue
+from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler, shm_name
+
+
+def _tree_flatten_with_names(state) -> Tuple[List[Tuple[str, Any]], Any]:
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    named = [
+        (jax.tree_util.keystr(path), leaf) for path, leaf in flat
+    ]
+    return named, treedef
+
+
+def _is_jax_array(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Parse a dtype name, including the ml_dtypes families (bfloat16,
+    float8_*) numpy alone can't resolve."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class CheckpointEvent:
+    SAVE = "save"
+
+    @staticmethod
+    def save(step: int, path: str) -> Dict:
+        return {"type": CheckpointEvent.SAVE, "step": step, "path": path}
+
+
+class CheckpointEngine:
+    """One engine per worker process."""
+
+    def __init__(
+        self,
+        ckpt_dir: str,
+        job_name: Optional[str] = None,
+        node_rank: Optional[int] = None,
+        local_rank: Optional[int] = None,
+        ipc_socket: Optional[str] = None,
+        master_client=None,
+        world_size: Optional[int] = None,
+        rank: Optional[int] = None,
+    ):
+        self.ckpt_dir = ckpt_dir
+        self.job_name = job_name or os.getenv(EnvKey.JOB_NAME, "local")
+        self.node_rank = (
+            node_rank
+            if node_rank is not None
+            else int(os.getenv(EnvKey.NODE_RANK, "0"))
+        )
+        self.local_rank = (
+            local_rank
+            if local_rank is not None
+            else int(os.getenv(EnvKey.LOCAL_RANK, "0"))
+        )
+        self.rank = rank if rank is not None else int(os.getenv(EnvKey.RANK, "0"))
+        self.world_size = (
+            world_size
+            if world_size is not None
+            else int(os.getenv(EnvKey.WORLD_SIZE, "1"))
+        )
+        self._shm = SharedMemoryHandler(
+            shm_name(self.job_name, self.node_rank, self.local_rank)
+        )
+        socket_path = ipc_socket or os.getenv("DLROVER_TPU_IPC_SOCKET", "")
+        self._has_agent = bool(socket_path) and os.path.exists(socket_path)
+        if self._has_agent:
+            # one lock per shm frame (this worker's), shared with the agent
+            # saver so persists never race worker rewrites
+            self._save_lock = SharedLock(
+                self._shm.name + ".lock", socket_path
+            )
+            self._event_queue = SharedQueue(
+                SharedResourceName.SAVE_EVENT_QUEUE, socket_path
+            )
+            self._meta_dict = SharedDict(
+                SharedResourceName.SHM_META_DICT, socket_path
+            )
+        else:
+            self._save_lock = None
+            self._event_queue = None
+            self._meta_dict = None
+        self._master = master_client
+        self._latest_step = -1
+
+    # -- save --------------------------------------------------------------
+
+    def save_to_memory(self, step: int, state) -> bool:
+        """Snapshot ``state`` into shm. Returns False if skipped (agent busy
+        persisting the previous snapshot — reference engine.py:340 skips
+        rather than blocks)."""
+        if self._save_lock is not None:
+            if not self._save_lock.acquire(blocking=False):
+                logger.info(
+                    "step %s: skip memory save, agent persisting previous",
+                    step,
+                )
+                return False
+        try:
+            self._write_state_to_shm(step, state)
+            self._latest_step = step
+            if self._meta_dict is not None:
+                self._meta_dict.set(
+                    f"{self.node_rank}:{self.local_rank}",
+                    {
+                        "shm": self._shm.name,
+                        "step": step,
+                        "ts": time.time(),
+                        "persisted": False,
+                    },
+                )
+            if self._master is not None:
+                try:
+                    self._master.kv_set(
+                        f"ckpt/{self.job_name}/shm_step/{self.rank}",
+                        str(step).encode(),
+                    )
+                except ConnectionError:
+                    pass
+            return True
+        finally:
+            if self._save_lock is not None:
+                self._save_lock.release()
+
+    def save_to_storage(self, step: int, state, path: str = "") -> bool:
+        """Memory save + ask the agent to persist asynchronously."""
+        saved = self.save_to_memory(step, state)
+        if not saved:
+            return False
+        path = path or self.ckpt_dir
+        if self._event_queue is not None:
+            self._event_queue.put(CheckpointEvent.save(step, path))
+        else:
+            # no agent (bare worker): persist synchronously
+            from dlrover_tpu.ckpt.ckpt_saver import persist_shm_frame
+
+            persist_shm_frame(self._shm, path, step)
+        return True
+
+    def _write_state_to_shm(self, step: int, state) -> None:
+        import jax
+
+        named, _ = _tree_flatten_with_names(state)
+        leaves_meta: List[Dict] = []
+        buffers: List[np.ndarray] = []
+        offset = 0
+        pending: List[Tuple[Dict, Any]] = []
+        for path, leaf in named:
+            if _is_jax_array(leaf):
+                shards = [
+                    s for s in leaf.addressable_shards if s.replica_id == 0
+                ]
+                if not shards:
+                    # purely-replicated copy owned by another host
+                    leaves_meta.append({
+                        "path": path, "kind": "array",
+                        "dtype": str(leaf.dtype),
+                        "gshape": list(leaf.shape),
+                        "shards": [],
+                    })
+                    continue
+                for s in shards:
+                    # start async D2H for overlap; drained below
+                    try:
+                        s.data.copy_to_host_async()
+                    except Exception:  # noqa: BLE001 — CPU backend no-op
+                        pass
+                shard_metas = []
+                for s in shards:
+                    start = [
+                        (sl.start or 0) for sl in s.index
+                    ] if s.index else [0] * leaf.ndim
+                    pending.append((
+                        {
+                            "offset": offset,
+                            "nbytes": int(s.data.nbytes),
+                            "lshape": list(s.data.shape),
+                            "start": start,
+                        },
+                        s.data,
+                    ))
+                    shard_metas.append(pending[-1][0])
+                    offset += int(s.data.nbytes)
+                leaves_meta.append({
+                    "path": path, "kind": "array",
+                    "dtype": str(leaf.dtype),
+                    "gshape": list(leaf.shape),
+                    "shards": shard_metas,
+                })
+            elif isinstance(leaf, np.ndarray):
+                pending.append((
+                    {
+                        "offset": offset,
+                        "nbytes": int(leaf.nbytes),
+                        "lshape": list(leaf.shape),
+                        "start": [0] * leaf.ndim,
+                    },
+                    leaf,
+                ))
+                leaves_meta.append({
+                    "path": path, "kind": "array",
+                    "dtype": str(leaf.dtype),
+                    "gshape": list(leaf.shape),
+                    "shards": [pending[-1][0]],
+                })
+                offset += int(leaf.nbytes)
+            else:
+                if isinstance(leaf, np.generic):
+                    leaf = leaf.item()
+                leaves_meta.append({
+                    "path": path, "kind": "value", "value": leaf,
+                })
+        for _, data in pending:
+            buffers.append(np.asarray(data))
+        meta = {
+            "step": step,
+            "ts": time.time(),
+            "job": self.job_name,
+            "node_rank": self.node_rank,
+            "local_rank": self.local_rank,
+            "rank": self.rank,
+            "world_size": self.world_size,
+            "leaves": leaves_meta,
+        }
+        self._shm.write_frame(meta, buffers)
+
+    # -- load --------------------------------------------------------------
+
+    def shm_step(self) -> int:
+        return self._shm.step
+
+    def _shm_step_consistent(self) -> Optional[int]:
+        """All hosts must hold the same shm step to restore from memory
+        (reference engine.py:375 step-consistency allgather).
+
+        Keys and the barrier are scoped by the rendezvous round (set in the
+        worker env by the agent) so values from an earlier incarnation of
+        the job can never satisfy this incarnation's consistency check.
+        """
+        step = self.shm_step()
+        if step < 0:
+            return None
+        if self.world_size <= 1 or self._master is None:
+            return step
+        scope = os.getenv(EnvKey.RDZV_ROUND, "0")
+        prefix = f"ckpt/{self.job_name}/restore_step/r{scope}"
+        try:
+            self._master.kv_set(f"{prefix}/{self.rank}", str(step).encode())
+            passed = self._master.barrier(
+                f"ckpt_restore_r{scope}", self.rank, self.world_size,
+                timeout_s=60.0,
+            )
+            if not passed:
+                logger.warning(
+                    "restore barrier timed out — falling back to storage"
+                )
+                return None
+            keys = [f"{prefix}/{r}" for r in range(self.world_size)]
+            values = self._master.kv_multi_get(keys)
+            steps = {int(v) for v in values if v}
+            if len(steps) == 1 and len([v for v in values if v]) == self.world_size:
+                return steps.pop()
+            logger.warning(
+                "shm steps inconsistent across hosts (%s) — storage restore",
+                steps,
+            )
+            return None
+        except (ConnectionError, ValueError):
+            return step
+
+    def load(self, target, path: str = "") -> Tuple[Any, int]:
+        """Restore into the structure of ``target`` (a pytree whose array
+        leaves are jax.Arrays or ShapeDtypeStructs carrying shardings).
+
+        Returns (state, step); step == -1 when nothing was restored.
+        """
+        step = self._shm_step_consistent()
+        if step is not None and step >= 0:
+            state = self._load_from_shm(target)
+            if state is not None:
+                logger.info("restored step %s from shared memory", step)
+                return state, step
+        return self._load_from_storage(target, path or self.ckpt_dir)
+
+    def _load_from_shm(self, target):
+        meta = self._shm.read_meta()
+        if meta is None:
+            return None
+        lookup = {leaf["path"]: leaf for leaf in meta["leaves"]}
+
+        def reader(leaf_meta, shard_meta):
+            return self._shm.read_shard_bytes(shard_meta)
+
+        try:
+            return _assemble(target, lookup, reader)
+        except (KeyError, ValueError) as e:
+            logger.warning("shm restore incomplete (%s) — trying storage", e)
+            return None
+
+    def _load_from_storage(self, target, path: str) -> Tuple[Any, int]:
+        from dlrover_tpu.ckpt.ckpt_saver import (
+            latest_step,
+            load_frames_for_step,
+        )
+
+        if not path:
+            return None, -1
+        step = latest_step(path)
+        if step < 0:
+            return None, -1
+        frames = load_frames_for_step(path, step)
+        if not frames:
+            return None, -1
+        lookup: Dict[str, List[Dict]] = {}
+        for frame in frames:
+            for leaf in frame["leaves"]:
+                entry = dict(leaf)
+                entry["_frame"] = frame
+                lookup.setdefault(leaf["path"], []).append(entry)
+
+        merged = {}
+        for p, entries in lookup.items():
+            base = dict(entries[0])
+            base["shards"] = [
+                dict(s, _frame=e["_frame"])
+                for e in entries
+                for s in e.get("shards", [])
+            ]
+            merged[p] = base
+
+        from dlrover_tpu.ckpt.shm_handler import frame_shard_bytes
+
+        def reader(leaf_meta, shard_meta):
+            return frame_shard_bytes(shard_meta["_frame"], shard_meta)
+
+        state = _assemble(target, merged, reader)
+        logger.info("restored step %s from storage %s", step, path)
+        return state, step
+
+
+def _assemble(target, lookup: Dict[str, Dict], reader):
+    """Rebuild a pytree like ``target`` from saved leaf metas + a byte
+    reader. Handles re-sharding: each needed addressable shard is cut from
+    whichever saved shards cover its global index range."""
+    import jax
+
+    named, treedef = _tree_flatten_with_names(target)
+    out_leaves = []
+    for path, leaf in named:
+        if path not in lookup:
+            raise KeyError(path)
+        leaf_meta = lookup[path]
+        if leaf_meta["kind"] == "value":
+            out_leaves.append(leaf_meta["value"])
+            continue
+        dtype = _np_dtype(leaf_meta["dtype"])
+        gshape = tuple(leaf_meta["gshape"])
+        if _is_jax_array(leaf) or hasattr(leaf, "sharding"):
+            sharding = leaf.sharding
+            out_leaves.append(
+                _assemble_jax_array(
+                    gshape, dtype, sharding, leaf_meta, reader
+                )
+            )
+        else:
+            # plain numpy target: reassemble the full global array
+            out = np.zeros(gshape, dtype=dtype)
+            for shard_meta in leaf_meta["shards"]:
+                data = reader(leaf_meta, shard_meta)
+                arr = np.frombuffer(data, dtype=dtype).reshape(
+                    shard_meta["lshape"]
+                )
+                idx = tuple(
+                    slice(st, st + ln)
+                    for st, ln in zip(shard_meta["start"], shard_meta["lshape"])
+                )
+                out[idx] = arr
+            out_leaves.append(out)
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+def _assemble_jax_array(gshape, dtype, sharding, leaf_meta, reader):
+    import jax
+
+    def global_chunks():
+        """numpy view of the region covering one target shard."""
+        saved = leaf_meta["shards"]
+
+        def read_region(index):
+            want_start = [
+                (sl.start or 0) for sl in index
+            ] if index else [0] * len(gshape)
+            want_shape = [
+                ((sl.stop if sl.stop is not None else g) - (sl.start or 0))
+                for sl, g in zip(index, gshape)
+            ] if index else list(gshape)
+            out = np.zeros(want_shape, dtype=dtype)
+            want_total = int(np.prod(want_shape)) if want_shape else 1
+            filled = 0
+            for shard_meta in saved:
+                s_start = shard_meta["start"]
+                s_shape = shard_meta["lshape"]
+                # overlap of [want_start, want_start+want_shape) with
+                # [s_start, s_start+s_shape)
+                lo = [max(a, b) for a, b in zip(want_start, s_start)]
+                hi = [
+                    min(a + da, b + db)
+                    for a, da, b, db in zip(
+                        want_start, want_shape, s_start, s_shape
+                    )
+                ]
+                if any(l >= h for l, h in zip(lo, hi)):
+                    continue
+                data = reader(leaf_meta, shard_meta)
+                arr = np.frombuffer(data, dtype=dtype).reshape(s_shape)
+                src = tuple(
+                    slice(l - b, h - b) for l, h, b in zip(lo, hi, s_start)
+                )
+                dst = tuple(
+                    slice(l - w, h - w) for l, h, w in zip(lo, hi, want_start)
+                )
+                out[dst] = arr[src]
+                filled += int(np.prod([h - l for l, h in zip(lo, hi)]))
+            if filled < want_total:
+                # refuse to silently zero-fill a missing region: the
+                # checkpoint is incomplete for this leaf (e.g. a lost frame
+                # file) and resuming from zeros would corrupt training
+                raise ValueError(
+                    f"checkpoint incomplete for {leaf_meta['path']}: "
+                    f"{filled}/{want_total} elements covered in region "
+                    f"start={want_start} shape={want_shape}"
+                )
+            return out
+
+        return read_region
+
+    read_region = global_chunks()
+    if not gshape:
+        # scalar array
+        saved = leaf_meta["shards"]
+        if saved:
+            data = reader(leaf_meta, saved[0])
+            value = np.frombuffer(data, dtype=dtype).reshape(())
+        else:
+            value = np.zeros((), dtype=dtype)
+        return jax.device_put(value, sharding)
+
+    device_arrays = []
+    for d_idx in sharding.addressable_devices_indices_map(gshape).items():
+        device, index = d_idx
+        region = read_region(index)
+        device_arrays.append(jax.device_put(region, device))
+    return jax.make_array_from_single_device_arrays(
+        gshape, sharding, device_arrays
+    )

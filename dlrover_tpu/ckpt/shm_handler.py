@@ -1,0 +1,190 @@
+"""Shared-memory checkpoint buffer layout + reader/writer.
+
+Reference: dlrover/python/elastic_agent/torch/ckpt_saver.py
+``SharedMemoryHandler``:234 — pickled meta dict + flat tensor buffer
+(:286–367). This build's layout (no pickle):
+
+    [0:8)              little-endian uint64 = len(meta)
+    [8:8+len(meta))    msgpack meta (see below)
+    [data_start:...]   tensor bytes at meta-recorded offsets
+
+meta = {
+  "step": int, "ts": float, "job": str, "node_rank": int, "local_rank": int,
+  "leaves": [ {"path": str, "kind": "array"|"value",
+               "value": <small scalar/list, if kind=value>,
+               "dtype": str, "gshape": [..],         # if kind=array
+               "shards": [ {"offset": int, "nbytes": int,
+                            "lshape": [..], "start": [..]} ] } ]
+}
+
+``start`` is the per-dimension global start index of the shard (from the
+``jax.Array`` shard's index slices), so storage restore can reassemble the
+global array under any target topology.
+"""
+
+import struct
+import time
+from typing import Any, Dict, List, Optional
+
+import msgpack
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.multi_process import (
+    create_shared_memory,
+    unlink_shared_memory,
+)
+
+_U64 = struct.Struct("<Q")
+
+
+def shm_name(job_name: str, node_rank: int, local_rank: int) -> str:
+    return f"dlrtpu_{job_name}_{node_rank}_{local_rank}"
+
+
+class TensorShard:
+    """One contiguous saved shard of one array."""
+
+    def __init__(self, offset: int, nbytes: int, lshape: List[int],
+                 start: List[int]):
+        self.offset = offset
+        self.nbytes = nbytes
+        self.lshape = lshape
+        self.start = start
+
+    def to_meta(self) -> Dict:
+        return {
+            "offset": self.offset, "nbytes": self.nbytes,
+            "lshape": self.lshape, "start": self.start,
+        }
+
+
+def pack_frame(meta: Dict) -> bytes:
+    meta_bytes = msgpack.packb(meta, use_bin_type=True)
+    return _U64.pack(len(meta_bytes)) + meta_bytes
+
+
+class SharedMemoryHandler:
+    """Owns one shm segment holding one checkpoint frame."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._shm = None
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _ensure(self, size: int) -> bool:
+        if self._shm is not None and self._shm.size >= size:
+            return True
+        if self._shm is not None:
+            self._shm.close()
+            self._shm = None
+        # round up generously so step-to-step meta jitter doesn't re-create
+        alloc = max(1024, int(size * 1.05))
+        self._shm = create_shared_memory(self._name, create=True, size=alloc)
+        return self._shm is not None
+
+    def open(self) -> bool:
+        if self._shm is not None:
+            return True
+        self._shm = create_shared_memory(self._name, create=False)
+        return self._shm is not None
+
+    def close(self) -> None:
+        if self._shm is not None:
+            self._shm.close()
+            self._shm = None
+
+    def unlink(self) -> None:
+        self.close()
+        unlink_shared_memory(self._name)
+
+    # -- write -------------------------------------------------------------
+
+    def write_frame(self, meta: Dict, buffers: List[np.ndarray]) -> None:
+        """Write meta + tensor buffers. ``meta['leaves']`` offsets must match
+        the order/sizes of ``buffers``."""
+        header = pack_frame(meta)
+        data_start = len(header)
+        total = data_start + sum(int(b.nbytes) for b in buffers)
+        # offsets in meta are relative to data_start; rewrite header with
+        # absolute offsets now that we know data_start
+        for leaf in meta["leaves"]:
+            for shard in leaf.get("shards", []):
+                shard["abs_offset"] = data_start + shard["offset"]
+        header = pack_frame(meta)
+        # repacking can change len(header) (abs_offset adds bytes) — fix up
+        while len(header) != data_start:
+            data_start = len(header)
+            for leaf in meta["leaves"]:
+                for shard in leaf.get("shards", []):
+                    shard["abs_offset"] = data_start + shard["offset"]
+            header = pack_frame(meta)
+        total = data_start + sum(int(b.nbytes) for b in buffers)
+        if not self._ensure(total):
+            raise RuntimeError(f"cannot create shm segment {self._name}")
+        buf = self._shm.buf
+        buf[: len(header)] = header
+        pos = data_start
+        for b in buffers:
+            flat = np.ascontiguousarray(b).view(np.uint8).reshape(-1)
+            n = flat.nbytes
+            buf[pos : pos + n] = flat.data
+            pos += n
+
+    # -- read --------------------------------------------------------------
+
+    def read_meta(self) -> Optional[Dict]:
+        if not self.open():
+            return None
+        try:
+            (meta_len,) = _U64.unpack(bytes(self._shm.buf[:8]))
+            if meta_len == 0 or meta_len > self._shm.size:
+                return None
+            return msgpack.unpackb(
+                bytes(self._shm.buf[8 : 8 + meta_len]), raw=False
+            )
+        except Exception:  # noqa: BLE001 — torn/empty frame
+            return None
+
+    def read_shard_bytes(self, shard_meta: Dict) -> Optional[bytes]:
+        if not self.open():
+            return None
+        off = shard_meta["abs_offset"]
+        return bytes(self._shm.buf[off : off + shard_meta["nbytes"]])
+
+    def read_frame_bytes(self) -> Optional[bytes]:
+        """The entire frame (header + data) for persisting as one blob."""
+        meta = self.read_meta()
+        if meta is None:
+            return None
+        end = 8 + len(msgpack.packb(meta, use_bin_type=True))
+        for leaf in meta["leaves"]:
+            for shard in leaf.get("shards", []):
+                end = max(end, shard["abs_offset"] + shard["nbytes"])
+        return bytes(self._shm.buf[:end])
+
+    @property
+    def step(self) -> int:
+        meta = self.read_meta()
+        return int(meta["step"]) if meta else -1
+
+
+def parse_frame(blob: bytes) -> Optional[Dict]:
+    """Parse a persisted frame file back into (meta, memoryview-able bytes)."""
+    if len(blob) < 8:
+        return None
+    (meta_len,) = _U64.unpack(blob[:8])
+    if 8 + meta_len > len(blob):
+        return None
+    meta = msgpack.unpackb(blob[8 : 8 + meta_len], raw=False)
+    meta["_blob"] = blob
+    return meta
+
+
+def frame_shard_bytes(meta: Dict, shard_meta: Dict) -> bytes:
+    blob = meta["_blob"]
+    off = shard_meta["abs_offset"]
+    return blob[off : off + shard_meta["nbytes"]]

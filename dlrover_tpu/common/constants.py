@@ -153,6 +153,7 @@ class EnvKey:
     PROCESS_ID = "DLROVER_TPU_PROCESS_ID"
     NUM_PROCESSES = "DLROVER_TPU_NUM_PROCESSES"
     RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
+    RDZV_ROUND = "DLROVER_TPU_RDZV_ROUND"
     # fault injection for node-check benchmarks
     # (reference: trainer/torch/node_check/utils.py:52 MOCK_ERR_RANK)
     MOCK_ERR_RANK = "DLROVER_TPU_MOCK_ERR_RANK"

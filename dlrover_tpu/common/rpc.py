@@ -1,0 +1,175 @@
+"""Typed TCP RPC used between master ↔ agents/workers.
+
+The reference funnels everything through a 2-RPC gRPC service whose payload
+is a pickled dataclass (dlrover/proto/elastic_training.proto:29–33,
+dlrover/python/master/servicer.py:79). This build keeps the typed-dataclass
+surface (common/comm.py) but routes by *method name* over a msgpack-framed
+TCP stream: no pickle, no codegen, and the same framing the C++ runtime
+components speak.
+
+Frame: 4-byte big-endian length + msgpack map
+``{"m": method, "p": <serialized message>, "id": seq}`` → response
+``{"ok": bool, "p": <serialized message>, "err": str}``.
+"""
+
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.multi_process import recv_msg, send_msg
+
+
+class RPCError(RuntimeError):
+    pass
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        registry: Dict[str, Callable] = self.server.registry  # type: ignore[attr-defined]
+        while True:
+            try:
+                frame = recv_msg(self.request)
+            except (ConnectionError, OSError):
+                return
+            except Exception as e:  # noqa: BLE001 — bad frame: drop conn
+                logger.warning("rpc connection dropped on bad frame: %r", e)
+                return
+            method = frame.get("m", "")
+            handler = registry.get(method)
+            if handler is None:
+                resp = {"ok": False, "err": f"unknown rpc method {method!r}"}
+            else:
+                try:
+                    request = comm.deserialize(frame.get("p", b""))
+                    result = handler(request)
+                    resp = {"ok": True, "p": comm.serialize(result)}
+                except Exception as e:  # noqa: BLE001 — report to caller
+                    logger.exception("rpc handler %s failed", method)
+                    resp = {"ok": False, "err": repr(e)}
+            try:
+                send_msg(self.request, resp)
+            except (ConnectionError, OSError):
+                return
+
+
+class _ThreadedTCPServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class RPCServer:
+    """Threaded TCP server with a method registry."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._server = _ThreadedTCPServer((host, port), _Handler)
+        self._server.registry = {}  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def register(self, method: str, handler: Callable[[Any], Any]) -> None:
+        self._server.registry[method] = handler  # type: ignore[attr-defined]
+
+    def register_object(self, obj: Any, prefix: str = "") -> None:
+        """Register every public ``rpc_*`` method of ``obj``."""
+        for name in dir(obj):
+            if name.startswith("rpc_"):
+                self.register(prefix + name[len("rpc_"):], getattr(obj, name))
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="rpc-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RPCClient:
+    """Persistent-connection client with reconnect + retry.
+
+    Thread-safe: one socket per thread (thread-local), so concurrent calls
+    from monitor threads don't interleave frames.
+    """
+
+    def __init__(self, addr: str, timeout_s: float = 60.0, retries: int = 30):
+        host, port = addr.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self._timeout_s = timeout_s
+        self._retries = retries
+        self._tls = threading.local()
+        self._seq = 0
+
+    @property
+    def addr(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def _conn(self) -> socket.socket:
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            conn = socket.create_connection(
+                (self._host, self._port), timeout=self._timeout_s
+            )
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._tls.conn = conn
+        return conn
+
+    def _close(self) -> None:
+        conn = getattr(self._tls, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._tls.conn = None
+
+    def call(
+        self, method: str, request: Any = None, retries: Optional[int] = None
+    ) -> Any:
+        """Invoke ``method`` with a typed message; returns the typed reply.
+
+        Retries with backoff on transport errors — agents must ride through
+        brief master restarts (reference MasterClient retry decorator,
+        elastic_agent/master_client.py:30ish)."""
+        retries = self._retries if retries is None else retries
+        self._seq += 1
+        frame = {"m": method, "p": comm.serialize(request), "id": self._seq}
+        backoff = 0.1
+        last_err: Optional[Exception] = None
+        for attempt in range(retries):
+            try:
+                conn = self._conn()
+                send_msg(conn, frame)
+                resp = recv_msg(conn)
+                if not resp.get("ok"):
+                    raise RPCError(resp.get("err", "unknown rpc error"))
+                return comm.deserialize(resp.get("p", b""))
+            except (ConnectionError, OSError, socket.timeout) as e:
+                last_err = e
+                self._close()
+                if attempt < retries - 1:
+                    time.sleep(min(backoff, 5.0))
+                    backoff *= 1.6
+        raise ConnectionError(
+            f"rpc {method} to {self.addr} failed after "
+            f"{retries} attempts: {last_err}"
+        )
+
+    def try_call(self, method: str, request: Any = None) -> Any:
+        """One-shot call without retries (for probes/liveness checks)."""
+        return self.call(method, request, retries=1)
+
+
+def find_free_port(host: str = "") -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]

@@ -1,0 +1,159 @@
+"""Node-health check workloads, as JAX/host programs.
+
+Reference: dlrover/trainer/torch/node_check/nvidia_gpu.py + utils.py
+(``bm_allgather``:82, ``bm_allreduce``:112, ``mock_error``:52) — a matmul +
+collective benchmark each node runs under the node-check rendezvous.
+
+TPU translation (SURVEY.md §7 stage 5): the compute probe is a bf16 matmul
+on the local chip(s) — it catches a wedged PJRT runtime or a bad chip by
+timing MXU work; the network probe is a **host-to-host TCP transfer over
+DCN** between pair-group members. DCN (not ICI) is deliberate: when a bad
+chip wedges a slice's ICI, per-host DCN checks still localize the fault
+(SURVEY.md §7 hard-part (d)). Fault injection via the
+``DLROVER_TPU_MOCK_ERR_RANK`` env var mirrors the reference's
+``MOCK_ERR_RANK``.
+"""
+
+import os
+import socket
+import struct
+import time
+from typing import Dict, List
+
+from dlrover_tpu.common.comm import NodeMeta
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import logger
+
+
+def mock_error(node_rank: int) -> None:
+    """Raise if fault injection targets this node (reference utils.py:52)."""
+    mock = os.getenv(EnvKey.MOCK_ERR_RANK)
+    if mock is not None and int(mock) == node_rank:
+        raise RuntimeError(f"mock error on node {node_rank}")
+
+
+def matmul_benchmark(size: int = 1024, rounds: int = 4) -> float:
+    """Time bf16 matmuls on the local device(s); returns seconds.
+
+    Large square bf16 matmuls tile perfectly onto the MXU, so an anomalous
+    time means a sick chip/runtime rather than a bad workload fit.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _mm(x):
+        for _ in range(4):
+            x = jnp.matmul(x, x)
+            x = x / jnp.max(jnp.abs(x))
+        return x
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (size, size), dtype=jnp.bfloat16)
+    _mm(x).block_until_ready()  # compile outside the timed region
+    start = time.time()
+    for _ in range(rounds):
+        x = _mm(x)
+    x.block_until_ready()
+    return time.time() - start
+
+
+_LEN = struct.Struct(">Q")
+
+
+def _send_all(conn: socket.socket, payload: bytes) -> None:
+    conn.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_all(conn: socket.socket) -> bytes:
+    header = b""
+    while len(header) < _LEN.size:
+        chunk = conn.recv(_LEN.size - len(header))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        header += chunk
+    (size,) = _LEN.unpack(header)
+    buf = bytearray()
+    while len(buf) < size:
+        chunk = conn.recv(min(1 << 20, size - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def tcp_pair_benchmark(
+    node_rank: int,
+    group: Dict[int, NodeMeta],
+    payload_mb: float = 4.0,
+    timeout_s: float = 60.0,
+) -> float:
+    """All-to-one echo over DCN within a pair group; returns seconds.
+
+    The lowest-ranked member serves on its rendezvous-reported free port;
+    every other member streams a payload and reads it back. Both directions
+    of each link get exercised, which is what the reference's gloo allgather
+    achieves (utils.py:82) without needing a working device fabric.
+    """
+    ranks = sorted(group)
+    if len(ranks) < 2:
+        return 0.0
+    payload = os.urandom(int(payload_mb * 1024 * 1024))
+    leader = ranks[0]
+    leader_meta = group[leader]
+    start = time.time()
+    if node_rank == leader:
+        server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server.bind(("", leader_meta.free_port))
+        server.listen(len(ranks))
+        server.settimeout(timeout_s)
+        served = 0
+        try:
+            while served < len(ranks) - 1:
+                conn, _ = server.accept()
+                conn.settimeout(timeout_s)
+                data = _recv_all(conn)
+                _send_all(conn, data)
+                conn.close()
+                served += 1
+        finally:
+            server.close()
+    else:
+        deadline = time.time() + timeout_s
+        conn = None
+        while conn is None:
+            try:
+                conn = socket.create_connection(
+                    (leader_meta.host or "127.0.0.1", leader_meta.free_port),
+                    timeout=2.0,
+                )
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+        conn.settimeout(timeout_s)
+        _send_all(conn, payload)
+        echoed = _recv_all(conn)
+        conn.close()
+        if echoed != payload:
+            raise RuntimeError("tcp echo payload corrupted")
+    return time.time() - start
+
+
+def run_check_workload(
+    node_rank: int,
+    group: Dict[int, NodeMeta],
+    matmul_size: int = 1024,
+    payload_mb: float = 4.0,
+) -> float:
+    """The full per-node check: fault injection hook → matmul → pair DCN
+    echo. Returns total elapsed seconds; raises on failure."""
+    mock_error(node_rank)
+    mm = matmul_benchmark(size=matmul_size)
+    net = tcp_pair_benchmark(node_rank, group, payload_mb=payload_mb)
+    logger.info(
+        "node %s check: matmul=%.3fs net=%.3fs (group=%s)",
+        node_rank, mm, net, sorted(group),
+    )
+    return mm + net

@@ -1,0 +1,143 @@
+"""Dataset splitters: partition a dataset into dispatchable shards.
+
+Reference: dlrover/python/master/shard/dataset_splitter.py —
+``TableDatasetSplitter``:146 (range shards over a row-addressable table),
+``TextDatasetSplitter``:259 (optionally-shuffled record indices over a text
+file), ``StreamingDatasetSplitter``:361 (unbounded).
+
+A *shard* is a [start, end) range plus optional per-record indices; an
+*epoch* re-creates shards (re-shuffled if requested). Shard size =
+``batch_size × num_minibatches_per_shard`` so one shard feeds a worker for
+several steps between master round-trips.
+"""
+
+import random
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from dlrover_tpu.common.comm import DatasetShardParams, Shard
+from dlrover_tpu.common.log import logger
+
+
+class DatasetSplitter(ABC):
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int):
+        self.dataset_name = dataset_name
+        self.dataset_size = dataset_size
+        self.shard_size = max(1, shard_size)
+        self.num_epochs = max(1, num_epochs)
+        self.epoch = 0
+
+    @abstractmethod
+    def create_shards(self) -> List[Shard]:
+        """Create shards for the next epoch."""
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self.num_epochs
+
+    @staticmethod
+    def build(params: DatasetShardParams) -> "DatasetSplitter":
+        shard_size = max(
+            1, params.batch_size * max(1, params.num_minibatches_per_shard)
+        )
+        if params.splitter == "text":
+            return TextDatasetSplitter(
+                params.dataset_name, params.dataset_size, shard_size,
+                params.num_epochs, params.shuffle,
+            )
+        if params.splitter == "streaming":
+            return StreamingDatasetSplitter(
+                params.dataset_name, params.dataset_size, shard_size,
+                params.num_epochs,
+            )
+        return TableDatasetSplitter(
+            params.dataset_name, params.dataset_size, shard_size,
+            params.num_epochs, params.shuffle,
+        )
+
+
+class TableDatasetSplitter(DatasetSplitter):
+    """Range shards over a row-addressable dataset (reference :146)."""
+
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int, shuffle: bool = False):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self._shuffle = shuffle
+
+    def create_shards(self) -> List[Shard]:
+        self.epoch += 1
+        shards = [
+            Shard(
+                name=f"{self.dataset_name}:{start}:{min(start + self.shard_size, self.dataset_size)}",
+                start=start,
+                end=min(start + self.shard_size, self.dataset_size),
+            )
+            for start in range(0, self.dataset_size, self.shard_size)
+        ]
+        if self._shuffle:
+            random.shuffle(shards)
+        logger.info(
+            "dataset %s epoch %s: %s shards of %s rows",
+            self.dataset_name, self.epoch, len(shards), self.shard_size,
+        )
+        return shards
+
+
+class TextDatasetSplitter(DatasetSplitter):
+    """Shards carrying explicit (optionally shuffled) record indices
+    (reference :259)."""
+
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int, shuffle: bool = False):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self._shuffle = shuffle
+
+    def create_shards(self) -> List[Shard]:
+        self.epoch += 1
+        indices = list(range(self.dataset_size))
+        if self._shuffle:
+            random.shuffle(indices)
+        shards = []
+        for start in range(0, self.dataset_size, self.shard_size):
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(
+                Shard(
+                    name=f"{self.dataset_name}:{start}:{end}",
+                    start=start,
+                    end=end,
+                    record_indices=indices[start:end],
+                )
+            )
+        return shards
+
+
+class StreamingDatasetSplitter(DatasetSplitter):
+    """Unbounded dataset: emit shards forward from an advancing offset
+    (reference :361). ``dataset_size`` < 0 means truly unbounded."""
+
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int, fetch_batch: int = 32):
+        super().__init__(dataset_name, dataset_size, shard_size, 1)
+        self._offset = 0
+        self._fetch_batch = fetch_batch
+
+    def epoch_finished(self) -> bool:
+        return 0 <= self.dataset_size <= self._offset
+
+    def create_shards(self) -> List[Shard]:
+        shards = []
+        for _ in range(self._fetch_batch):
+            if 0 <= self.dataset_size <= self._offset:
+                break
+            end = self._offset + self.shard_size
+            if self.dataset_size >= 0:
+                end = min(end, self.dataset_size)
+            shards.append(
+                Shard(
+                    name=f"{self.dataset_name}:{self._offset}:{end}",
+                    start=self._offset,
+                    end=end,
+                )
+            )
+            self._offset = end
+        return shards

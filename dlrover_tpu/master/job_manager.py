@@ -1,0 +1,250 @@
+"""Job/node manager: node registry, status flow, heartbeats, relaunch policy.
+
+Reference: dlrover/python/master/node/dist_job_manager.py:103 (``start``:198,
+``_monitor_nodes``:457, ``_process_event``:752, ``_should_relaunch``:905,
+``_relaunch_node``:988) and local_job_manager.py:25. This build splits the
+same responsibilities: a :class:`JobManager` that owns the node table,
+heartbeat monitoring and relaunch decisions, and a pluggable
+:class:`~dlrover_tpu.master.scaler.Scaler` that actually (re)creates nodes.
+"""
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import (
+    DiagnosisActionType,
+    JobStage,
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node, NodeResource
+
+
+class NodeEvent:
+    def __init__(self, event_type: str, node: Node):
+        self.event_type = event_type
+        self.node = node
+
+
+class DiagnosisAction:
+    """An action the control plane wants executed (reference
+    diagnosis/common/diagnosis_action.py). Kept as a tiny value object."""
+
+    def __init__(
+        self,
+        action_type: str = DiagnosisActionType.NONE,
+        instance: int = -1,
+        reason: str = "",
+        data: Optional[Dict] = None,
+    ):
+        self.action_type = action_type
+        self.instance = instance
+        self.reason = reason
+        self.data = data or {}
+        self.timestamp = time.time()
+
+    def is_noop(self) -> bool:
+        return self.action_type == DiagnosisActionType.NONE
+
+
+class JobManager:
+    """Owns the node table and decides relaunch/abort.
+
+    Platform-agnostic: node creation/deletion goes through a ``scaler``
+    callable and liveness arrives via ``report_*`` RPCs and heartbeats, so
+    the same manager serves the local (subprocess) and k8s backends.
+    """
+
+    def __init__(
+        self,
+        job_name: str,
+        node_num: int,
+        scaler=None,
+        max_relaunch: Optional[int] = None,
+    ):
+        ctx = get_context()
+        self._job_name = job_name
+        self._node_num = node_num
+        self._scaler = scaler
+        self._max_relaunch = (
+            ctx.node_max_relaunch if max_relaunch is None else max_relaunch
+        )
+        self._nodes: Dict[int, Node] = {}
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._job_stage = JobStage.INIT
+        self._action_queue: List[DiagnosisAction] = []
+        self._event_callbacks: List[Callable[[NodeEvent], None]] = []
+        self._monitor_thread: Optional[threading.Thread] = None
+        for node_id in range(node_num):
+            self._nodes[node_id] = Node(
+                type=NodeType.WORKER,
+                id=node_id,
+                rank=node_id,
+                max_relaunch_count=self._max_relaunch,
+            )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._job_stage = JobStage.RUNNING
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_heartbeats, name="hb-monitor", daemon=True
+        )
+        self._monitor_thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    @property
+    def job_stage(self) -> str:
+        return self._job_stage
+
+    @property
+    def nodes(self) -> Dict[int, Node]:
+        return self._nodes
+
+    def add_event_callback(self, cb: Callable[[NodeEvent], None]) -> None:
+        self._event_callbacks.append(cb)
+
+    # -- RPC-driven state --------------------------------------------------
+
+    def get_node(self, node_id: int) -> Node:
+        with self._lock:
+            if node_id not in self._nodes:
+                self._nodes[node_id] = Node(
+                    type=NodeType.WORKER,
+                    id=node_id,
+                    rank=node_id,
+                    max_relaunch_count=self._max_relaunch,
+                )
+            return self._nodes[node_id]
+
+    def update_node_status(
+        self,
+        node_id: int,
+        status: str,
+        exit_reason: str = "",
+        restart_count: int = 0,
+    ) -> None:
+        node = self.get_node(node_id)
+        changed = node.update_status(status)
+        if exit_reason:
+            node.exit_reason = exit_reason
+        if changed:
+            self._process_event(NodeEvent(NodeEventType.MODIFIED, node))
+
+    def report_heartbeat(
+        self, node_id: int, timestamp: float
+    ) -> DiagnosisAction:
+        node = self.get_node(node_id)
+        node.heartbeat_time = timestamp or time.time()
+        if node.status in (NodeStatus.INITIAL, NodeStatus.PENDING):
+            node.update_status(NodeStatus.RUNNING)
+        return self._next_action(node_id)
+
+    def report_failure(
+        self, node_id: int, error_data: str, level: str, restart_count: int
+    ) -> None:
+        node = self.get_node(node_id)
+        node.exit_reason = NodeExitReason.FATAL_ERROR
+        logger.error(
+            "node %s reported %s failure: %s", node_id, level, error_data
+        )
+
+    # -- event processing / relaunch ladder --------------------------------
+
+    def _process_event(self, event: NodeEvent) -> None:
+        node = event.node
+        for cb in self._event_callbacks:
+            try:
+                cb(event)
+            except Exception:  # noqa: BLE001
+                logger.exception("node event callback failed")
+        if node.status == NodeStatus.FAILED:
+            self._handle_node_failure(node)
+        elif node.status == NodeStatus.SUCCEEDED:
+            self._check_job_completed()
+
+    def _handle_node_failure(self, node: Node) -> None:
+        if node.should_relaunch():
+            node.inc_relaunch_count()
+            logger.info(
+                "relaunching node %s (attempt %s/%s)",
+                node.id, node.relaunch_count, node.max_relaunch_count,
+            )
+            node.update_status(NodeStatus.PENDING)
+            if self._scaler is not None:
+                self._scaler.relaunch_node(node)
+        else:
+            logger.error(
+                "node %s failed beyond relaunch budget — aborting job",
+                node.id,
+            )
+            self._job_stage = JobStage.FAILED
+            self.enqueue_action(
+                DiagnosisAction(
+                    DiagnosisActionType.JOB_ABORT,
+                    instance=node.id,
+                    reason=f"node {node.id} exhausted relaunch budget",
+                )
+            )
+
+    def _check_job_completed(self) -> None:
+        with self._lock:
+            statuses = [n.status for n in self._nodes.values()]
+        if all(s == NodeStatus.SUCCEEDED for s in statuses):
+            self._job_stage = JobStage.SUCCEEDED
+
+    def all_nodes_finished(self) -> bool:
+        with self._lock:
+            return all(
+                NodeStatus.terminal(n.status) or n.is_released
+                for n in self._nodes.values()
+            )
+
+    # -- heartbeat monitoring ----------------------------------------------
+
+    def _monitor_heartbeats(self) -> None:
+        ctx = get_context()
+        while not self._stopped.wait(ctx.heartbeat_interval_s):
+            now = time.time()
+            for node in list(self._nodes.values()):
+                if node.status != NodeStatus.RUNNING:
+                    continue
+                if (
+                    node.heartbeat_time > 0
+                    and now - node.heartbeat_time > ctx.heartbeat_timeout_s
+                ):
+                    logger.warning(
+                        "node %s heartbeat timed out (%.0fs) — marking failed",
+                        node.id, now - node.heartbeat_time,
+                    )
+                    node.exit_reason = NodeExitReason.KILLED
+                    self.update_node_status(node.id, NodeStatus.FAILED)
+
+    # -- diagnosis action queue (master → agent via heartbeat replies) -----
+
+    def enqueue_action(self, action: DiagnosisAction) -> None:
+        with self._lock:
+            self._action_queue.append(action)
+
+    def _next_action(self, node_id: int) -> DiagnosisAction:
+        from dlrover_tpu.common.constants import DiagnosisConstant
+
+        now = time.time()
+        with self._lock:
+            # prune expired actions so the queue can't grow unbounded
+            self._action_queue = [
+                a for a in self._action_queue
+                if now - a.timestamp <= DiagnosisConstant.ACTION_EXPIRY_S
+            ]
+            for i, action in enumerate(self._action_queue):
+                if action.instance in (node_id, DiagnosisConstant.ANY_INSTANCE):
+                    return self._action_queue.pop(i)
+        return DiagnosisAction()

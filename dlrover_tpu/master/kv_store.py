@@ -1,0 +1,92 @@
+"""Master-hosted KV store + barrier service.
+
+Reference: dlrover/python/master/elastic_training/kv_store_service.py:18 and
+sync_service.py:25. The reference's KV store backs the torch rendezvous
+``Store``; here it is the generic control-plane KV agents/workers use for
+cross-host coordination that must work even when the device fabric is down
+(e.g. checkpoint replica bookkeeping).
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class KVStoreService:
+    def __init__(self) -> None:
+        self._store: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._cond:
+            self._store[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._store.get(key)
+
+    def add(self, key: str, delta: int) -> int:
+        """Atomic counter add (torch Store ``add`` semantics)."""
+        with self._cond:
+            cur = int(self._store.get(key, b"0"))
+            cur += delta
+            self._store[key] = str(cur).encode()
+            self._cond.notify_all()
+            return cur
+
+    def wait(self, key: str, timeout_s: float) -> Optional[bytes]:
+        deadline = time.time() + timeout_s
+        with self._cond:
+            while key not in self._store:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            return self._store[key]
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)
+
+    def multi_get(self, keys: List[str]) -> List[bytes]:
+        with self._lock:
+            return [self._store.get(k, b"") for k in keys]
+
+    def multi_set(self, keys: List[str], values: List[bytes]) -> None:
+        with self._cond:
+            for k, v in zip(keys, values):
+                self._store[k] = v
+            self._cond.notify_all()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._store.clear()
+
+
+class SyncService:
+    """Named barriers across nodes (reference sync_service.py:25)."""
+
+    def __init__(self) -> None:
+        self._barriers: Dict[str, set] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def join(self, name: str, node_rank: int, world_size: int,
+             timeout_s: float = 300.0) -> bool:
+        deadline = time.time() + timeout_s
+        with self._cond:
+            members = self._barriers.setdefault(name, set())
+            members.add(node_rank)
+            self._cond.notify_all()
+            while len(self._barriers.get(name, ())) < world_size:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+    def reset(self, name: str) -> None:
+        with self._lock:
+            self._barriers.pop(name, None)

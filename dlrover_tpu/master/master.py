@@ -1,0 +1,149 @@
+"""Job master composition + entrypoint.
+
+Reference: dlrover/python/master/main.py:46–100, dist_master.py:98
+(manager composition at :118–166) and local_master.py:41. The
+:class:`LocalJobMaster` is the single-node master used by
+``dtpu-run --standalone`` and by tests; :class:`DistributedJobMaster` adds
+node management against a cluster scheduler.
+"""
+
+import argparse
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import JobStage, RendezvousName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RPCServer
+from dlrover_tpu.master.job_manager import JobManager
+from dlrover_tpu.master.kv_store import KVStoreService, SyncService
+from dlrover_tpu.master.perf_monitor import PerfMonitor
+from dlrover_tpu.master.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+    RendezvousManager,
+)
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.task_manager import TaskManager
+
+
+class JobMaster:
+    """Common composition of master services (reference dist_master.py:118)."""
+
+    def __init__(
+        self,
+        job_name: str = "local",
+        port: int = 0,
+        node_num: int = 1,
+        min_nodes: Optional[int] = None,
+        max_nodes: Optional[int] = None,
+        node_unit: int = 1,
+        scaler=None,
+        diagnosis_master=None,
+    ):
+        self.job_name = job_name
+        self.job_manager = JobManager(job_name, node_num, scaler=scaler)
+        self.perf_monitor = PerfMonitor()
+        self.task_manager = TaskManager()
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService()
+        self.rdzv_managers: Dict[str, RendezvousManager] = {
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
+            RendezvousName.NODE_CHECK: NetworkCheckRendezvousManager(),
+        }
+        min_n = node_num if min_nodes is None else min_nodes
+        max_n = node_num if max_nodes is None else max_nodes
+        for manager in self.rdzv_managers.values():
+            manager.update_rdzv_params(min_n, max_n, node_unit=node_unit)
+        self.diagnosis_master = diagnosis_master
+        self.servicer = MasterServicer(
+            job_manager=self.job_manager,
+            rdzv_managers=self.rdzv_managers,
+            kv_store=self.kv_store,
+            sync_service=self.sync_service,
+            task_manager=self.task_manager,
+            perf_monitor=self.perf_monitor,
+            diagnosis_master=diagnosis_master,
+        )
+        self._server = RPCServer(port=port)
+        self._server.register_object(self.servicer)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def prepare(self) -> None:
+        self._server.start()
+        self.job_manager.start()
+        self.task_manager.start()
+        if self.diagnosis_master is not None:
+            self.diagnosis_master.start()
+        logger.info(
+            "master for job %s serving on port %s", self.job_name, self.port
+        )
+
+    def stop(self) -> None:
+        self.job_manager.stop()
+        self.task_manager.stop()
+        if self.diagnosis_master is not None:
+            self.diagnosis_master.stop()
+        self._server.stop()
+
+    def run(self, poll_s: float = 1.0) -> int:
+        """Block until the job finishes (reference dist_master.py:276)."""
+        try:
+            while True:
+                stage = self.job_manager.job_stage
+                if stage == JobStage.SUCCEEDED:
+                    logger.info("job %s succeeded", self.job_name)
+                    return 0
+                if stage == JobStage.FAILED:
+                    logger.error("job %s failed", self.job_name)
+                    return 1
+                time.sleep(poll_s)
+        finally:
+            self.stop()
+
+
+class LocalJobMaster(JobMaster):
+    """In-process master for standalone mode and tests
+    (reference local_master.py:41)."""
+
+
+class DistributedJobMaster(JobMaster):
+    """Master with cluster node management (reference dist_master.py:98).
+    The scheduler/scaler backend is injected (k8s, GKE TPU, or local)."""
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("dlrover_tpu master")
+    parser.add_argument("--job-name", default="local")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--node-num", type=int, default=1)
+    parser.add_argument("--min-nodes", type=int, default=None)
+    parser.add_argument("--max-nodes", type=int, default=None)
+    parser.add_argument("--node-unit", type=int, default=1)
+    parser.add_argument("--port-file", default="",
+                        help="write the bound port to this file (standalone)")
+    args = parser.parse_args(argv)
+    master = LocalJobMaster(
+        job_name=args.job_name,
+        port=args.port,
+        node_num=args.node_num,
+        min_nodes=args.min_nodes,
+        max_nodes=args.max_nodes,
+        node_unit=args.node_unit,
+    )
+    master.prepare()
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(master.port))
+    return master.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,93 @@
+"""Training performance monitor: global-step speed + goodput accounting.
+
+Reference: dlrover/python/master/monitor/perf_monitor.py:45 — collects
+reported global steps into speed samples; used by auto-scaling and hang
+detection. TPU addition: goodput bookkeeping (productive time / wall time)
+since goodput is the headline metric (BASELINE.md).
+"""
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+
+class GlobalStepRecord:
+    def __init__(self, step: int, timestamp: float):
+        self.step = step
+        self.timestamp = timestamp
+
+
+class PerfMonitor:
+    MAX_RECORDS = 256
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._records: List[GlobalStepRecord] = []
+        self._start_time = time.time()
+        self._init_step = 0
+        self._init_time = self._start_time
+        # goodput accounting: accumulated unproductive seconds
+        self._fault_started: Optional[float] = None
+        self._lost_seconds = 0.0
+
+    def reset_running_speed_monitor(self) -> None:
+        """Called on re-rendezvous: speed samples from the old world are void
+        (reference perf_monitor resets on worker count change)."""
+        with self._lock:
+            self._records.clear()
+
+    def collect_global_step(self, step: int, timestamp: float) -> None:
+        with self._lock:
+            if self._records and step <= self._records[-1].step:
+                return
+            self._records.append(GlobalStepRecord(step, timestamp))
+            if len(self._records) > self.MAX_RECORDS:
+                self._records.pop(0)
+
+    @property
+    def completed_global_step(self) -> int:
+        with self._lock:
+            return self._records[-1].step if self._records else 0
+
+    def running_speed(self, window: int = 8) -> float:
+        """Steps/second over the recent window."""
+        with self._lock:
+            if len(self._records) < 2:
+                return 0.0
+            recent = self._records[-window:]
+            dt = recent[-1].timestamp - recent[0].timestamp
+            ds = recent[-1].step - recent[0].step
+            return ds / dt if dt > 0 else 0.0
+
+    def last_step_time(self) -> float:
+        with self._lock:
+            return self._records[-1].timestamp if self._records else 0.0
+
+    def step_stalled(self, timeout_s: float) -> bool:
+        """True when steps stopped advancing for ``timeout_s`` (hang signal)."""
+        last = self.last_step_time()
+        if last <= 0:
+            return False
+        return time.time() - last > timeout_s
+
+    # -- goodput -----------------------------------------------------------
+
+    def fault_happened(self) -> None:
+        with self._lock:
+            if self._fault_started is None:
+                self._fault_started = time.time()
+
+    def fault_recovered(self) -> None:
+        with self._lock:
+            if self._fault_started is not None:
+                self._lost_seconds += time.time() - self._fault_started
+                self._fault_started = None
+
+    def goodput(self) -> float:
+        """Fraction of wall time spent training (1.0 = no lost time)."""
+        with self._lock:
+            wall = time.time() - self._start_time
+            lost = self._lost_seconds
+            if self._fault_started is not None:
+                lost += time.time() - self._fault_started
+            return max(0.0, (wall - lost) / wall) if wall > 0 else 1.0

@@ -1,0 +1,319 @@
+"""Master-side rendezvous managers.
+
+Reference: dlrover/python/master/elastic_training/rdzv_manager.py —
+``RendezvousManager`` base (:66, ``join_rendezvous``:268,
+``_check_rdzv_completed``:155), ``ElasticTrainingRendezvousManager`` (:409),
+``NetworkCheckRendezvousManager`` (:498: pair-grouping :598, straggler
+detection :772, fault detection :720).
+
+Semantics kept from the reference:
+- agents join a named rendezvous round; the master *cuts a world* when
+  ``min_nodes`` have joined and either ``max_nodes`` joined or a last-call
+  window expired;
+- the world size is truncated to a multiple of ``node_unit`` (TPU: a slice
+  needs full hosts — e.g. a v5e-64 slice spans 16 hosts, so node_unit=16
+  keeps the ICI mesh rectangular);
+- a node joining *after* a cut enters the next round, and agents polling
+  ``num_nodes_waiting`` notice and re-rendezvous (elastic membership change).
+
+TPU-native addition: the cut world carries the jax.distributed coordinator
+address (rank-0 host + its reported free port) so agents can bootstrap the
+PJRT distributed runtime — the analogue of the reference handing out a torch
+Store address.
+"""
+
+import time
+from abc import ABC, abstractmethod
+from threading import Lock
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.comm import NodeMeta
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import NetworkFailureReason, RendezvousName
+from dlrover_tpu.common.log import logger
+
+
+class RendezvousParameters:
+    """min/max nodes & timing knobs for one named rendezvous
+    (reference rdzv_manager.py RendezvousParameters)."""
+
+    def __init__(
+        self,
+        min_nodes: int,
+        max_nodes: int,
+        waiting_timeout: float = 0.0,
+        node_unit: int = 1,
+    ):
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.waiting_timeout = waiting_timeout or get_context().rdzv_lastcall_s
+        self.node_unit = max(1, node_unit)
+
+
+class RendezvousManager(ABC):
+    """Base rendezvous manager (reference rdzv_manager.py:66)."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._lock = Lock()
+        self._rdzv_params = RendezvousParameters(1, 1)
+        # nodes waiting for the next world cut: {node_rank: NodeMeta}
+        self._waiting_nodes: Dict[int, NodeMeta] = {}
+        # the most recently cut world: {node_rank: NodeMeta}
+        self._rdzv_nodes: Dict[int, NodeMeta] = {}
+        self._latest_rdzv_nodes: List[int] = []
+        self._lastcall_time: float = 0.0
+        self._rdzv_round = 0
+        self._start_rdzv_ts: float = 0.0
+        # node ranks that died mid-round and must not block the next cut
+        self._node_unit = 1
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def rdzv_round(self) -> int:
+        return self._rdzv_round
+
+    def update_rdzv_params(
+        self,
+        min_nodes: int,
+        max_nodes: int,
+        waiting_timeout: float = 0.0,
+        node_unit: int = 1,
+    ) -> None:
+        self._rdzv_params = RendezvousParameters(
+            min_nodes, max_nodes, waiting_timeout, node_unit
+        )
+        self._node_unit = node_unit
+
+    def add_alive_node(self, meta: NodeMeta) -> None:
+        """Node process started (used by managers that track liveness)."""
+
+    def remove_alive_node(self, node_rank: int) -> None:
+        """Node died: drop it from the waiting set so the next cut isn't
+        blocked by a ghost (reference ``remove_alive_node``)."""
+        with self._lock:
+            if node_rank in self._waiting_nodes:
+                del self._waiting_nodes[node_rank]
+                logger.info(
+                    "%s rdzv: removed dead node rank %s from waiting set",
+                    self._name, node_rank,
+                )
+
+    def join_rendezvous(self, meta: NodeMeta) -> int:
+        """Register a node for the next world cut; returns the round."""
+        with self._lock:
+            if not self._waiting_nodes:
+                self._start_rdzv_ts = time.time()
+            self._waiting_nodes[meta.node_rank] = meta
+            # a (re)joining node invalidates the previous world: agents still
+            # polling get_comm_world will block until the new round cuts, and
+            # agents mid-training notice via num_nodes_waiting (reference
+            # join_rendezvous clears the node cache the same way)
+            self._rdzv_nodes = {}
+            self._lastcall_time = time.time()
+        return self._rdzv_round
+
+    def num_nodes_waiting(self) -> int:
+        """Agents poll this; >0 while a new round is forming means a
+        membership change is coming (reference ``num_nodes_waiting``)."""
+        with self._lock:
+            return len(self._waiting_nodes)
+
+    def _check_rdzv_completed(self) -> bool:
+        """Cut the world if possible. Caller holds ``self._lock``.
+
+        Reference semantics (rdzv_manager.py:155): complete immediately at
+        max_nodes; otherwise complete when >= min_nodes and the last-call
+        window has expired; truncate to a multiple of node_unit, keeping the
+        lowest-ranked nodes; nodes cut out stay in the waiting set for the
+        next round.
+        """
+        params = self._rdzv_params
+        waiting = len(self._waiting_nodes)
+        completed = False
+        if waiting >= params.max_nodes:
+            completed = True
+        elif (
+            waiting >= params.min_nodes
+            and self._lastcall_time > 0
+            and time.time() - self._lastcall_time >= params.waiting_timeout
+        ):
+            completed = True
+        if not completed:
+            timeout = get_context().rdzv_timeout_s
+            if (
+                self._start_rdzv_ts > 0
+                and waiting > 0
+                and time.time() - self._start_rdzv_ts > timeout
+            ):
+                logger.warning(
+                    "%s rdzv round %s timed out with %s/%s nodes",
+                    self._name, self._rdzv_round, waiting, params.min_nodes,
+                )
+            return False
+
+        unit = params.node_unit
+        world_size = min(waiting, params.max_nodes)
+        world_size = (world_size // unit) * unit
+        if world_size < max(params.min_nodes, unit):
+            return False
+        ranks = sorted(self._waiting_nodes.keys())[:world_size]
+        self._rdzv_nodes = {r: self._waiting_nodes[r] for r in ranks}
+        self._latest_rdzv_nodes = ranks
+        for r in ranks:
+            del self._waiting_nodes[r]
+        self._rdzv_round += 1
+        self._lastcall_time = 0.0
+        self._start_rdzv_ts = 0.0
+        logger.info(
+            "%s rdzv round %s completed: world=%s (waiting leftover=%s)",
+            self._name, self._rdzv_round, ranks,
+            sorted(self._waiting_nodes),
+        )
+        return True
+
+    @abstractmethod
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, NodeMeta]]:
+        """Return (round, group, world). Empty world ⇒ not ready, poll again."""
+
+    def coordinator_addr(self) -> str:
+        """jax.distributed coordinator = lowest-rank node of the cut world."""
+        if not self._rdzv_nodes:
+            return ""
+        rank0 = min(self._rdzv_nodes)
+        meta = self._rdzv_nodes[rank0]
+        host = meta.host or "127.0.0.1"
+        return f"{host}:{meta.free_port}"
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    """The training rendezvous (reference rdzv_manager.py:409)."""
+
+    def __init__(self) -> None:
+        super().__init__(RendezvousName.TRAINING)
+
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, NodeMeta]]:
+        with self._lock:
+            if node_rank not in self._rdzv_nodes:
+                self._check_rdzv_completed()
+            if node_rank in self._rdzv_nodes:
+                return self._rdzv_round, 0, dict(self._rdzv_nodes)
+            return self._rdzv_round, 0, {}
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """Node-check rendezvous with pair-grouping fault localization
+    (reference rdzv_manager.py:498).
+
+    Round 0 groups nodes into pairs (i, i+1); each pair runs the check
+    workload (matmul + collective over the pair). Round 1 re-pairs so that
+    every node previously paired with a *failed* partner gets a partner that
+    passed — a node failing in both rounds is the faulty one; a node failing
+    only with a bad partner is exonerated. On TPU, pair traffic rides DCN
+    host-to-host, which keeps the check usable even when a slice's ICI is
+    wedged (SURVEY.md §7 hard-part (d)).
+    """
+
+    def __init__(self) -> None:
+        super().__init__(RendezvousName.NODE_CHECK)
+        self._node_status: Dict[int, bool] = {}
+        self._node_times: Dict[int, float] = {}
+        self._check_round = 0
+        self._fault_nodes: List[int] = []
+        self._straggler_nodes: List[int] = []
+
+    def get_comm_world(
+        self, node_rank: int
+    ) -> Tuple[int, int, Dict[int, NodeMeta]]:
+        with self._lock:
+            if node_rank not in self._rdzv_nodes:
+                # NOTE: _node_status deliberately survives the cut — round-2
+                # re-pairing and the passed-in-any-round verdict both need
+                # round-1 results (reference keeps the status map across
+                # check rounds for exactly this)
+                if self._check_rdzv_completed():
+                    self._check_round += 1
+            if node_rank not in self._rdzv_nodes:
+                return self._rdzv_round, 0, {}
+            groups = self._group_nodes(self._check_round)
+            for group_idx, group in enumerate(groups):
+                if node_rank in group:
+                    world = {r: self._rdzv_nodes[r] for r in group}
+                    return self._rdzv_round, group_idx, world
+            return self._rdzv_round, 0, {}
+
+    def _group_nodes(self, check_round: int) -> List[List[int]]:
+        """Pair nodes for the given check round (reference :598).
+
+        Round 1 (second round): pair each previously-failed node with a
+        previously-passed node so faults can be localized.
+        """
+        ranks = sorted(self._rdzv_nodes.keys())
+        if check_round <= 1 or not self._node_status:
+            pairs = [ranks[i : i + 2] for i in range(0, len(ranks), 2)]
+        else:
+            failed = [r for r in ranks if not self._node_status.get(r, True)]
+            passed = [r for r in ranks if self._node_status.get(r, True)]
+            pairs = []
+            while failed and passed:
+                pairs.append([failed.pop(0), passed.pop(0)])
+            rest = failed + passed
+            pairs.extend(rest[i : i + 2] for i in range(0, len(rest), 2))
+        # a lone last node joins the previous pair (group of 3) so it still
+        # has partners for the collective
+        if len(pairs) > 1 and len(pairs[-1]) == 1:
+            pairs[-2].extend(pairs.pop())
+        return pairs
+
+    def report_network_check_result(
+        self, node_rank: int, normal: bool, elapsed: float
+    ) -> None:
+        with self._lock:
+            prev = self._node_status.get(node_rank)
+            # a node that passed in any round of this check is healthy
+            self._node_status[node_rank] = bool(prev) or normal
+            if normal and elapsed > 0:
+                self._node_times[node_rank] = elapsed
+
+    def check_fault_node(self) -> Tuple[List[int], str]:
+        """Return (fault_node_ranks, reason); empty reason ⇒ verdict ready
+        (reference :720)."""
+        with self._lock:
+            if not self._rdzv_nodes:
+                return [], NetworkFailureReason.NO_INIT
+            reported = set(self._node_status)
+            expected = set(self._rdzv_nodes)
+            if not expected.issubset(reported):
+                return [], NetworkFailureReason.WAITING_NODE
+            faults = sorted(
+                r for r in expected if not self._node_status.get(r, False)
+            )
+            self._fault_nodes = faults
+            reason = NetworkFailureReason.NODE_FAILURE if faults else ""
+            return faults, reason
+
+    def get_stragglers(self) -> List[int]:
+        """Nodes slower than 2× the median check time (reference
+        ``_detect_stragglers``:772 uses the same multiple)."""
+        with self._lock:
+            if len(self._node_times) < 2:
+                return []
+            times = sorted(self._node_times.values())
+            median = times[len(times) // 2]
+            if median <= 0:
+                return []
+            self._straggler_nodes = sorted(
+                r for r, t in self._node_times.items() if t > 2.0 * median
+            )
+            return list(self._straggler_nodes)
+
+    def network_check_success(self) -> bool:
+        faults, reason = self.check_fault_node()
+        return not faults and reason == ""

@@ -1,0 +1,209 @@
+"""Task manager: dispatch dataset shards to workers, re-queue on failure.
+
+Reference: dlrover/python/master/shard/task_manager.py:35
+(``report_dataset_task``:125, ``task_hanged``:144) +
+batch_dataset_manager.py. Workers pull shard *tasks*; tasks held by a dead
+worker go back on the todo queue (the data-loss-free elasticity property);
+the whole dispatch position can be checkpointed/restored so a master restart
+resumes mid-epoch.
+"""
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from dlrover_tpu.common.comm import DatasetShardParams, Shard, TaskMessage
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.dataset_splitter import DatasetSplitter
+
+
+class _PendingTask:
+    def __init__(self, task: TaskMessage, node_id: int):
+        self.task = task
+        self.node_id = node_id
+        self.start_time = time.time()
+
+
+class _DatasetManager:
+    def __init__(self, splitter: DatasetSplitter):
+        self.splitter = splitter
+        self.todo: Deque[TaskMessage] = deque()
+        self.doing: Dict[int, _PendingTask] = {}
+        self.next_task_id = 0
+        self.completed = 0
+
+    def refill(self) -> None:
+        if self.todo or self.doing:
+            return
+        if self.splitter.epoch_finished():
+            return
+        for shard in self.splitter.create_shards():
+            self.todo.append(
+                TaskMessage(
+                    task_id=self.next_task_id,
+                    task_type="train",
+                    shard=shard,
+                    dataset_name=self.splitter.dataset_name,
+                )
+            )
+            self.next_task_id += 1
+
+    def finished(self) -> bool:
+        return (
+            self.splitter.epoch_finished()
+            and not self.todo
+            and not self.doing
+        )
+
+
+class TaskManager:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._datasets: Dict[str, _DatasetManager] = {}
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._check_hanged_tasks, name="task-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def new_dataset(self, params: DatasetShardParams) -> None:
+        with self._lock:
+            if params.dataset_name in self._datasets:
+                return
+            splitter = DatasetSplitter.build(params)
+            self._datasets[params.dataset_name] = _DatasetManager(splitter)
+            logger.info("task manager: registered dataset %s (size=%s)",
+                        params.dataset_name, params.dataset_size)
+
+    def get_task(self, node_id: int, dataset_name: str) -> Optional[TaskMessage]:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return None
+            ds.refill()
+            if not ds.todo:
+                return None
+            task = ds.todo.popleft()
+            ds.doing[task.task_id] = _PendingTask(task, node_id)
+            return task
+
+    def report_task_result(
+        self, dataset_name: str, task_id: int, node_id: int, success: bool
+    ) -> None:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return
+            pending = ds.doing.pop(task_id, None)
+            if pending is None:
+                return
+            if success:
+                ds.completed += 1
+            else:
+                ds.todo.appendleft(pending.task)
+
+    def recover_tasks(self, node_id: int) -> None:
+        """Re-queue all in-flight tasks of a dead worker (reference
+        TaskRescheduleCallback, node/event_callback.py)."""
+        with self._lock:
+            for ds in self._datasets.values():
+                stale = [
+                    tid for tid, p in ds.doing.items() if p.node_id == node_id
+                ]
+                for tid in stale:
+                    ds.todo.appendleft(ds.doing.pop(tid).task)
+                if stale:
+                    logger.info(
+                        "re-queued %s tasks of dead node %s on dataset %s",
+                        len(stale), node_id, ds.splitter.dataset_name,
+                    )
+
+    def finished(self, dataset_name: str) -> bool:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            return ds.finished() if ds else True
+
+    # -- hang detection ----------------------------------------------------
+
+    def _check_hanged_tasks(self) -> None:
+        timeout = get_context().task_timeout_s
+        while not self._stopped.wait(30.0):
+            now = time.time()
+            with self._lock:
+                for ds in self._datasets.values():
+                    hanged = [
+                        tid for tid, p in ds.doing.items()
+                        if now - p.start_time > timeout
+                    ]
+                    for tid in hanged:
+                        pending = ds.doing.pop(tid)
+                        ds.todo.appendleft(pending.task)
+                        logger.warning(
+                            "task %s on node %s hanged > %.0fs — re-queued",
+                            tid, pending.node_id, timeout,
+                        )
+
+    # -- shard checkpoint (reference task_manager shard checkpoint) --------
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return ""
+            todo = [t.task_id for t in ds.todo]
+            doing = list(ds.doing.keys())
+            shards = {
+                t.task_id: [t.shard.start, t.shard.end]
+                for t in list(ds.todo) + [p.task for p in ds.doing.values()]
+            }
+            return json.dumps({
+                "dataset": dataset_name,
+                "epoch": ds.splitter.epoch,
+                "todo": todo + doing,  # in-flight counts as not-done
+                "shards": shards,
+                "next_task_id": ds.next_task_id,
+                "completed": ds.completed,
+            })
+
+    def restore_shard_checkpoint(self, content: str) -> None:
+        if not content:
+            return
+        data = json.loads(content)
+        with self._lock:
+            ds = self._datasets.get(data["dataset"])
+            if ds is None:
+                return
+            ds.splitter.epoch = data["epoch"]
+            ds.todo.clear()
+            ds.doing.clear()
+            ds.completed = data.get("completed", 0)
+            for tid in data["todo"]:
+                start, end = data["shards"][str(tid)] if isinstance(
+                    next(iter(data["shards"].keys()), 0), str
+                ) else data["shards"][tid]
+                ds.todo.append(
+                    TaskMessage(
+                        task_id=int(tid),
+                        task_type="train",
+                        shard=Shard(
+                            name=f"{data['dataset']}:{start}:{end}",
+                            start=start,
+                            end=end,
+                        ),
+                        dataset_name=data["dataset"],
+                    )
+                )
+            ds.next_task_id = data["next_task_id"]
+            logger.info(
+                "restored shard checkpoint for %s: %s pending tasks",
+                data["dataset"], len(ds.todo),
+            )

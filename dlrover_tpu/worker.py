@@ -1,0 +1,89 @@
+"""Worker-process API: bootstrap + control-plane helpers.
+
+What the reference achieves with torchrun env vars (RANK/WORLD_SIZE/...) plus
+``init_process_group``, a TPU worker gets from :func:`init`: read the env the
+agent set, bootstrap ``jax.distributed`` with the master-rendezvoused
+coordinator, and hand back a :class:`WorkerContext` with the control-plane
+client (steps, shards, kv) wired up.
+"""
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import logger
+
+
+@dataclass
+class WorkerContext:
+    rank: int
+    world_size: int
+    local_rank: int
+    local_world_size: int
+    node_rank: int
+    node_num: int
+    restart_count: int
+    master: Optional[MasterClient]
+    job_name: str = "local"
+
+    @property
+    def is_leader(self) -> bool:
+        return self.rank == 0
+
+    def report_step(self, step: int) -> None:
+        if self.master is not None:
+            try:
+                self.master.report_global_step(step, time.time())
+            except ConnectionError:
+                pass
+
+    @property
+    def ipc_socket(self) -> str:
+        return os.getenv("DLROVER_TPU_IPC_SOCKET", "")
+
+
+def init(initialize_jax_distributed: bool = True) -> WorkerContext:
+    """Bootstrap the worker from the agent-provided environment.
+
+    With >1 process in the world, calls ``jax.distributed.initialize`` with
+    the coordinator the master rendezvoused (rank-0 host + free port) — the
+    analogue of the reference bootstrapping a torch Store from the master KV
+    (master_kv_store.py:24).
+    """
+    rank = int(os.getenv(EnvKey.RANK, "0"))
+    world_size = int(os.getenv(EnvKey.WORLD_SIZE, "1"))
+    coordinator = os.getenv(EnvKey.COORDINATOR_ADDR, "")
+    if initialize_jax_distributed and world_size > 1 and coordinator:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world_size,
+            process_id=rank,
+        )
+        logger.info(
+            "jax.distributed initialized: rank=%s/%s coordinator=%s",
+            rank, world_size, coordinator,
+        )
+    master_addr = os.getenv(EnvKey.MASTER_ADDR, "")
+    master = None
+    if master_addr:
+        master = MasterClient(
+            master_addr,
+            int(os.getenv(EnvKey.NODE_ID, "0")),
+            int(os.getenv(EnvKey.NODE_RANK, "0")),
+        )
+    return WorkerContext(
+        rank=rank,
+        world_size=world_size,
+        local_rank=int(os.getenv(EnvKey.LOCAL_RANK, "0")),
+        local_world_size=int(os.getenv(EnvKey.LOCAL_WORLD_SIZE, "1")),
+        node_rank=int(os.getenv(EnvKey.NODE_RANK, "0")),
+        node_num=int(os.getenv(EnvKey.NODE_NUM, "1")),
+        restart_count=int(os.getenv(EnvKey.RESTART_COUNT, "0")),
+        master=master,
+        job_name=os.getenv(EnvKey.JOB_NAME, "local"),
+    )

@@ -3,14 +3,27 @@
 Mirrors the reference test strategy (SURVEY.md §4): multi-node behavior is
 tested on one host — here with JAX's virtual CPU devices standing in for a
 TPU slice.
+
+The environment's axon TPU plugin registers itself from sitecustomize at
+interpreter start (before conftest), so env vars alone are not enough — the
+platform must also be overridden via jax.config before any backend
+initializes. Worker subprocesses spawned by agent tests DO honor the env
+vars (their sitecustomize sees the cleared PALLAS_AXON_POOL_IPS).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+# the axon TPU plugin force-registers when this is set; clear it so worker
+# subprocesses come up on CPU too
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402 — must follow the env setup above
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,40 @@
+"""Tiny elastic training script used by the e2e agent tests.
+
+Invariant: the checkpointed weight always equals step+1, so after any
+crash/resume combination the final value is 10 — and ``start`` in the output
+file reveals whether the restarted run actually resumed from a checkpoint.
+"""
+
+import os
+import sys
+
+import jax.numpy as jnp
+
+from dlrover_tpu import worker
+from dlrover_tpu.ckpt import Checkpointer, StorageType
+
+ctx = worker.init()
+ckpt_dir, out_file = sys.argv[1], sys.argv[2]
+crash_step = int(os.getenv("CRASH_AT_STEP", "-1"))
+if os.getenv("CRASH_IMMEDIATELY") == "1":
+    os._exit(7)
+
+state = {"w": jnp.zeros((4, 4), jnp.float32), "step": 0}
+ckpt = Checkpointer(ckpt_dir)
+state, step = ckpt.load_checkpoint(state)
+start = step + 1 if step >= 0 else 0
+
+for s in range(start, 10):
+    state = {"w": state["w"] + 1.0, "step": s}
+    ckpt.save_checkpoint(s, state, StorageType.DISK)
+    ctx.report_step(s)
+    if s == crash_step and (
+        ctx.restart_count == 0 or os.getenv("ALWAYS_CRASH") == "1"
+    ):
+        print(f"worker rank {ctx.rank} crashing at step {s}", flush=True)
+        os._exit(7)
+
+with open(out_file, "w") as f:
+    f.write(f"done w={float(state['w'][0, 0])} start={start} "
+            f"restarts={ctx.restart_count}")
+print("training complete", flush=True)

@@ -1,0 +1,254 @@
+"""Flash Checkpoint tests: real shm, sharded jax.Arrays on the 8-device CPU
+mesh (reference strategy: checkpoint tests use real shm, SURVEY.md §4.4)."""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.ckpt.ckpt_saver import (
+    AsyncCheckpointSaver,
+    latest_step,
+    step_dir,
+)
+from dlrover_tpu.ckpt.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.ckpt.engine import CheckpointEngine
+from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler, shm_name
+from dlrover_tpu.common.multi_process import LocalIPCServer, unlink_shared_memory
+
+
+JOB = f"ckpttest{os.getpid()}"
+
+
+@pytest.fixture(autouse=True)
+def _clean_shm():
+    yield
+    for lr in range(4):
+        unlink_shared_memory(shm_name(JOB, 0, lr))
+
+
+@pytest.fixture()
+def mesh():
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devices, ("data", "model"))
+
+
+def make_state(mesh):
+    w = jax.device_put(
+        jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+        NamedSharding(mesh, P("data", "model")),
+    )
+    b = jax.device_put(
+        jnp.ones((8,), dtype=jnp.float32), NamedSharding(mesh, P(None))
+    )
+    return {"params": {"w": w, "b": b}, "step": 3, "lr": 0.5}
+
+
+def test_engine_roundtrip_no_agent(tmp_path, mesh):
+    engine = CheckpointEngine(
+        str(tmp_path), job_name=JOB, node_rank=0, local_rank=0,
+        ipc_socket="/nonexistent", world_size=1, rank=0,
+    )
+    state = make_state(mesh)
+    assert engine.save_to_memory(7, state)
+    # restore into a same-sharded target
+    target = jax.tree.map(lambda x: x, state)
+    restored, step = engine.load(target)
+    assert step == 7
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.asarray(state["params"]["w"])
+    )
+    assert restored["step"] == 3 and restored["lr"] == 0.5
+    # sharding preserved
+    assert restored["params"]["w"].sharding == state["params"]["w"].sharding
+
+
+def test_replicated_array_saved_once(tmp_path, mesh):
+    engine = CheckpointEngine(
+        str(tmp_path), job_name=JOB, node_rank=0, local_rank=0,
+        ipc_socket="/nonexistent", world_size=1, rank=0,
+    )
+    state = make_state(mesh)
+    engine.save_to_memory(1, state)
+    shm = SharedMemoryHandler(shm_name(JOB, 0, 0))
+    meta = shm.read_meta()
+    b_leaf = next(l for l in meta["leaves"] if "'b'" in l["path"])
+    # replicated on 8 devices but stored exactly once (replica_id 0)
+    assert len(b_leaf["shards"]) == 1
+    w_leaf = next(l for l in meta["leaves"] if "'w'" in l["path"])
+    assert len(w_leaf["shards"]) == 8  # 4x2 mesh, one shard per device
+    shm.close()
+
+
+def test_storage_save_and_resharded_restore(tmp_path, mesh):
+    engine = CheckpointEngine(
+        str(tmp_path), job_name=JOB, node_rank=0, local_rank=0,
+        ipc_socket="/nonexistent", world_size=1, rank=0,
+    )
+    state = make_state(mesh)
+    assert engine.save_to_storage(11, state)
+    assert latest_step(str(tmp_path)) == 11
+    # restore under a DIFFERENT topology: transpose-sharded target
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh2 = Mesh(devices, ("data", "model"))
+    target = {
+        "params": {
+            "w": jax.device_put(
+                jnp.zeros((8, 8), jnp.float32),
+                NamedSharding(mesh2, P("model", "data")),
+            ),
+            "b": jax.device_put(
+                jnp.zeros((8,), jnp.float32), NamedSharding(mesh2, P("data"))
+            ),
+        },
+        "step": 0, "lr": 0.0,
+    }
+    # wipe shm to force the storage path
+    engine._shm.unlink()
+    restored, step = engine.load(target)
+    assert step == 11
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]),
+        np.arange(64, dtype=np.float32).reshape(8, 8),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["b"]), np.ones((8,), np.float32)
+    )
+    assert restored["params"]["w"].sharding.spec == P("model", "data")
+    assert restored["step"] == 3
+
+
+def test_load_nothing_returns_minus_one(tmp_path, mesh):
+    engine = CheckpointEngine(
+        str(tmp_path), job_name=JOB, node_rank=0, local_rank=0,
+        ipc_socket="/nonexistent", world_size=1, rank=0,
+    )
+    state, step = engine.load(make_state(mesh))
+    assert step == -1
+
+
+@pytest.fixture()
+def agent_ipc(tmp_path):
+    server = LocalIPCServer(str(tmp_path / "ipc.sock"))
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_async_save_via_agent(tmp_path, mesh, agent_ipc):
+    ckpt_dir = str(tmp_path / "ckpt")
+    saver = AsyncCheckpointSaver(
+        ckpt_dir=ckpt_dir, node_rank=0, local_world_size=1, expected_frames=1
+    )
+    saver.start(agent_ipc)
+    try:
+        engine = CheckpointEngine(
+            ckpt_dir, job_name=JOB, node_rank=0, local_rank=0,
+            ipc_socket=agent_ipc.path, world_size=1, rank=0,
+        )
+        state = make_state(mesh)
+        assert engine.save_to_storage(21, state)
+        deadline = time.time() + 10
+        while latest_step(ckpt_dir) != 21 and time.time() < deadline:
+            time.sleep(0.05)
+        assert latest_step(ckpt_dir) == 21
+        assert os.path.exists(
+            os.path.join(step_dir(ckpt_dir, 21), "frame_0_0.dlrover")
+        )
+    finally:
+        saver.stop()
+
+
+def test_breakpoint_save_after_worker_death(tmp_path, mesh, agent_ipc):
+    """THE flash-checkpoint property: worker saves to memory only and dies;
+    the agent persists the shm bytes (reference save_shm_to_storage:758)."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    saver = AsyncCheckpointSaver(
+        ckpt_dir=ckpt_dir, node_rank=0, local_world_size=1, expected_frames=1
+    )
+    saver.start(agent_ipc)
+    try:
+        engine = CheckpointEngine(
+            ckpt_dir, job_name=JOB, node_rank=0, local_rank=0,
+            ipc_socket=agent_ipc.path, world_size=1, rank=0,
+        )
+        state = make_state(mesh)
+        assert engine.save_to_memory(33, state)  # memory only — no event
+        assert latest_step(ckpt_dir) == -1
+        # "worker dies"; agent does a breakpoint save
+        n = saver.save_shm_to_storage(reason="worker failed")
+        assert n == 1
+        assert latest_step(ckpt_dir) == 33
+        # a fresh engine (restarted worker) restores from storage
+        engine2 = CheckpointEngine(
+            ckpt_dir, job_name=JOB, node_rank=0, local_rank=0,
+            ipc_socket="/nonexistent", world_size=1, rank=0,
+        )
+        engine2._shm.unlink()
+        restored, step = engine2.load(make_state(mesh))
+        assert step == 33
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]),
+            np.arange(64, dtype=np.float32).reshape(8, 8),
+        )
+    finally:
+        saver.stop()
+
+
+def test_breakpoint_save_skips_already_persisted(tmp_path, mesh, agent_ipc):
+    ckpt_dir = str(tmp_path / "ckpt")
+    saver = AsyncCheckpointSaver(
+        ckpt_dir=ckpt_dir, node_rank=0, local_world_size=1, expected_frames=1
+    )
+    saver.start(agent_ipc)
+    try:
+        engine = CheckpointEngine(
+            ckpt_dir, job_name=JOB, node_rank=0, local_rank=0,
+            ipc_socket=agent_ipc.path, world_size=1, rank=0,
+        )
+        engine.save_to_storage(5, make_state(mesh))
+        deadline = time.time() + 10
+        while latest_step(ckpt_dir) != 5 and time.time() < deadline:
+            time.sleep(0.05)
+        assert saver.save_shm_to_storage(reason="restart") == 0
+    finally:
+        saver.stop()
+
+
+def test_checkpointer_api(tmp_path, mesh):
+    ckpt = Checkpointer(
+        str(tmp_path), job_name=JOB, node_rank=0, local_rank=0,
+        ipc_socket="/nonexistent", world_size=1, rank=0,
+    )
+    state = make_state(mesh)
+    assert ckpt.save_checkpoint(2, state, StorageType.MEMORY)
+    restored, step = ckpt.load_checkpoint(state)
+    assert step == 2
+    assert ckpt.save_checkpoint(4, state, StorageType.DISK)
+    ckpt.engine._shm.unlink()
+    restored, step = ckpt.load_checkpoint(state)
+    assert step == 4
+
+
+def test_bfloat16_roundtrip(tmp_path, mesh):
+    engine = CheckpointEngine(
+        str(tmp_path), job_name=JOB, node_rank=0, local_rank=0,
+        ipc_socket="/nonexistent", world_size=1, rank=0,
+    )
+    x = jax.device_put(
+        jnp.arange(32, dtype=jnp.bfloat16).reshape(4, 8),
+        NamedSharding(mesh, P("data", None)),
+    )
+    engine.save_to_memory(1, {"x": x})
+    restored, step = engine.load({"x": x})
+    assert step == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored["x"], dtype=np.float32),
+        np.asarray(x, dtype=np.float32),
+    )
+    assert restored["x"].dtype == jnp.bfloat16

@@ -1,0 +1,206 @@
+"""Master stack tests: RPC end-to-end with a real LocalJobMaster + MasterClient
+(reference pattern: in-process master as fixture, SURVEY.md §4.1)."""
+
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import (
+    DiagnosisActionType,
+    JobStage,
+    NodeStatus,
+    RendezvousName,
+)
+from dlrover_tpu.agent.master_client import MasterClient, build_master_client
+from dlrover_tpu.master.job_manager import DiagnosisAction
+from dlrover_tpu.master.master import LocalJobMaster
+
+
+@pytest.fixture()
+def master():
+    m = LocalJobMaster(job_name="t", node_num=2)
+    for mgr in m.rdzv_managers.values():
+        mgr.update_rdzv_params(2, 2, waiting_timeout=0.05)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+def client_for(master, node_id):
+    return MasterClient(master.addr, node_id)
+
+
+def test_ping(master):
+    assert client_for(master, 0).ping()
+
+
+def test_rendezvous_via_rpc(master):
+    c0, c1 = client_for(master, 0), client_for(master, 1)
+    c0.join_rendezvous(RendezvousName.TRAINING, 0, 1, host="127.0.0.1", free_port=1234)
+    c1.join_rendezvous(RendezvousName.TRAINING, 1, 1, host="127.0.0.1", free_port=1235)
+    rnd, group, world, coord = c0.get_comm_world(RendezvousName.TRAINING, 0)
+    assert rnd == 1 and sorted(world) == [0, 1]
+    assert isinstance(world[0], comm.NodeMeta)
+    assert coord == "127.0.0.1:1234"
+
+
+def test_kv_store_rpc(master):
+    c = client_for(master, 0)
+    c.kv_set("a", b"1")
+    assert c.kv_get("a") == b"1"
+    assert c.kv_get("missing") is None
+    assert c.kv_add("ctr", 5) == 5
+    assert c.kv_add("ctr", 2) == 7
+    c.kv_multi_set(["x", "y"], [b"xv", b"yv"])
+    assert c.kv_multi_get(["x", "y", "z"]) == [b"xv", b"yv", b""]
+    # wait blocks until another client sets
+    result = {}
+
+    def waiter():
+        result["v"] = c.kv_wait("later", timeout_s=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    client_for(master, 1).kv_set("later", b"done")
+    t.join(timeout=5)
+    assert result["v"] == b"done"
+
+
+def test_barrier_rpc(master):
+    c0, c1 = client_for(master, 0), client_for(master, 1)
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(c0.barrier("b1", 0, 2, timeout_s=5.0))
+    )
+    t.start()
+    time.sleep(0.05)
+    assert c1.barrier("b1", 1, 2, timeout_s=5.0)
+    t.join(timeout=5)
+    assert results == [True]
+
+
+def test_barrier_timeout(master):
+    c = client_for(master, 0)
+    assert not c.barrier("never", 0, 2, timeout_s=0.2)
+
+
+def test_node_status_and_heartbeat(master):
+    c = client_for(master, 0)
+    c.update_node_status(NodeStatus.RUNNING)
+    resp = c.heartbeat(global_step=10)
+    assert resp.action_type == DiagnosisActionType.NONE
+    assert master.job_manager.get_node(0).status == NodeStatus.RUNNING
+    assert master.perf_monitor.completed_global_step == 10
+
+
+def test_heartbeat_returns_queued_action(master):
+    master.job_manager.enqueue_action(
+        DiagnosisAction(DiagnosisActionType.RESTART_WORKER, instance=0, reason="hang")
+    )
+    resp = client_for(master, 0).heartbeat()
+    assert resp.action_type == DiagnosisActionType.RESTART_WORKER
+    assert resp.action_data["reason"] == "hang"
+    # action for node 0 must not be delivered to node 1
+    resp1 = client_for(master, 1).heartbeat()
+    assert resp1.action_type == DiagnosisActionType.NONE
+
+
+def test_job_failure_after_relaunch_budget(master):
+    c = client_for(master, 0)
+    node = master.job_manager.get_node(0)
+    node.max_relaunch_count = 1
+    c.update_node_status(NodeStatus.RUNNING)
+    c.update_node_status(NodeStatus.FAILED)
+    # first failure → relaunch (status back to pending)
+    assert master.job_manager.get_node(0).status == NodeStatus.PENDING
+    c.update_node_status(NodeStatus.RUNNING)
+    c.update_node_status(NodeStatus.FAILED)
+    assert master.job_manager.job_stage == JobStage.FAILED
+
+
+def test_job_succeeds_when_all_nodes_succeed(master):
+    for node_id in range(2):
+        c = client_for(master, node_id)
+        c.update_node_status(NodeStatus.RUNNING)
+        c.update_node_status(NodeStatus.SUCCEEDED)
+    assert master.job_manager.job_stage == JobStage.SUCCEEDED
+
+
+def test_data_sharding_rpc(master):
+    c = client_for(master, 0)
+    params = comm.DatasetShardParams(
+        batch_size=4, num_epochs=1, dataset_size=40,
+        num_minibatches_per_shard=2, dataset_name="ds", splitter="batch",
+    )
+    assert c.setup_dataset(params)
+    seen_rows = 0
+    task_ids = []
+    while True:
+        task = c.get_task("ds")
+        if task.task_id < 0:
+            break
+        task_ids.append(task.task_id)
+        seen_rows += task.shard.end - task.shard.start
+        c.report_task_result("ds", task.task_id, success=True)
+    assert seen_rows == 40
+    assert len(task_ids) == 5  # 40 rows / (4*2) per shard
+    assert master.task_manager.finished("ds")
+
+
+def test_failed_task_requeued(master):
+    c = client_for(master, 0)
+    c.setup_dataset(comm.DatasetShardParams(
+        batch_size=2, num_epochs=1, dataset_size=4,
+        num_minibatches_per_shard=1, dataset_name="d2",
+    ))
+    t1 = c.get_task("d2")
+    c.report_task_result("d2", t1.task_id, success=False)
+    t2 = c.get_task("d2")
+    assert t2.task_id == t1.task_id  # failed shard comes back first
+
+
+def test_shard_checkpoint_roundtrip(master):
+    c = client_for(master, 0)
+    c.setup_dataset(comm.DatasetShardParams(
+        batch_size=2, num_epochs=1, dataset_size=12,
+        num_minibatches_per_shard=1, dataset_name="d3",
+    ))
+    t1 = c.get_task("d3")  # in-flight
+    ckpt = c.get_shard_checkpoint("d3")
+    assert ckpt
+    # simulate master restart: restore into a fresh dataset
+    master.task_manager._datasets.pop("d3")
+    c.setup_dataset(comm.DatasetShardParams(
+        batch_size=2, num_epochs=1, dataset_size=12,
+        num_minibatches_per_shard=1, dataset_name="d3",
+    ))
+    c.restore_shard_checkpoint(ckpt)
+    rows = 0
+    while True:
+        t = c.get_task("d3")
+        if t.task_id < 0:
+            break
+        rows += t.shard.end - t.shard.start
+        c.report_task_result("d3", t.task_id)
+    assert rows == 12  # the in-flight shard was not lost
+
+
+def test_task_recovery_on_node_death(master):
+    c0, c1 = client_for(master, 0), client_for(master, 1)
+    c0.setup_dataset(comm.DatasetShardParams(
+        batch_size=1, num_epochs=1, dataset_size=6,
+        num_minibatches_per_shard=1, dataset_name="d4",
+    ))
+    t_dead = c0.get_task("d4")
+    master.task_manager.recover_tasks(0)
+    rows = 0
+    while True:
+        t = c1.get_task("d4")
+        if t.task_id < 0:
+            break
+        rows += t.shard.end - t.shard.start
+        c1.report_task_result("d4", t.task_id)
+    assert rows == 6

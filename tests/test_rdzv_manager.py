@@ -1,0 +1,180 @@
+"""Rendezvous manager tests — driven directly with fake node metas, no
+sockets (reference test strategy: tests/test_rdzv_manager.py drives
+join_rendezvous/get_comm_world with fake node dicts)."""
+
+import time
+
+from dlrover_tpu.common.comm import NodeMeta
+from dlrover_tpu.master.rdzv_manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+
+
+def _meta(rank, port=9000):
+    return NodeMeta(
+        node_id=rank, node_rank=rank, host=f"10.0.0.{rank}",
+        local_world_size=1, free_port=port + rank,
+    )
+
+
+def test_world_cut_at_max_nodes():
+    m = ElasticTrainingRendezvousManager()
+    m.update_rdzv_params(2, 4, waiting_timeout=10.0)
+    for r in range(4):
+        m.join_rendezvous(_meta(r))
+    rnd, group, world = m.get_comm_world(0)
+    assert rnd == 1 and len(world) == 4
+    assert world[2].host == "10.0.0.2"
+    # all nodes see the same world
+    _, _, world1 = m.get_comm_world(3)
+    assert sorted(world1) == [0, 1, 2, 3]
+
+
+def test_world_cut_after_lastcall_with_min_nodes():
+    m = ElasticTrainingRendezvousManager()
+    m.update_rdzv_params(2, 4, waiting_timeout=0.1)
+    m.join_rendezvous(_meta(0))
+    m.join_rendezvous(_meta(1))
+    m.join_rendezvous(_meta(2))
+    _, _, world = m.get_comm_world(0)
+    assert world == {}  # lastcall not expired yet
+    time.sleep(0.15)
+    _, _, world = m.get_comm_world(0)
+    assert sorted(world) == [0, 1, 2]
+
+
+def test_node_unit_truncation():
+    """World size must be a multiple of node_unit (TPU slice granularity)."""
+    m = ElasticTrainingRendezvousManager()
+    m.update_rdzv_params(2, 8, waiting_timeout=0.05, node_unit=2)
+    for r in range(5):
+        m.join_rendezvous(_meta(r))
+    time.sleep(0.1)
+    _, _, world = m.get_comm_world(0)
+    assert sorted(world) == [0, 1, 2, 3]  # 5 truncated to 4
+    # the leftover node waits for the next round
+    assert m.num_nodes_waiting() == 1
+    _, _, w4 = m.get_comm_world(4)
+    assert w4 == {}
+
+
+def test_coordinator_addr_is_rank0():
+    m = ElasticTrainingRendezvousManager()
+    m.update_rdzv_params(2, 2, waiting_timeout=5.0)
+    m.join_rendezvous(_meta(1))
+    m.join_rendezvous(_meta(0))
+    _, _, world = m.get_comm_world(0)
+    assert len(world) == 2
+    assert m.coordinator_addr() == "10.0.0.0:9000"
+
+
+def test_dead_node_removed_from_waiting():
+    m = ElasticTrainingRendezvousManager()
+    m.update_rdzv_params(2, 3, waiting_timeout=0.05)
+    m.join_rendezvous(_meta(0))
+    m.join_rendezvous(_meta(1))
+    m.join_rendezvous(_meta(2))
+    m.remove_alive_node(2)
+    time.sleep(0.1)
+    _, _, world = m.get_comm_world(0)
+    assert sorted(world) == [0, 1]
+
+
+def test_second_round_membership_change():
+    m = ElasticTrainingRendezvousManager()
+    m.update_rdzv_params(2, 2, waiting_timeout=0.05)
+    for r in range(2):
+        m.join_rendezvous(_meta(r))
+    rnd1, _, world = m.get_comm_world(0)
+    assert len(world) == 2
+    # node 1 dies and rejoins — new round forms
+    m.join_rendezvous(_meta(1))
+    assert m.num_nodes_waiting() == 1
+    m.join_rendezvous(_meta(0))
+    rnd2, _, world2 = m.get_comm_world(0)
+    assert rnd2 == rnd1 + 1 and sorted(world2) == [0, 1]
+
+
+class TestNetworkCheck:
+    def _manager(self, n):
+        m = NetworkCheckRendezvousManager()
+        m.update_rdzv_params(n, n, waiting_timeout=0.01)
+        for r in range(n):
+            m.join_rendezvous(_meta(r))
+        return m
+
+    def test_pair_grouping(self):
+        m = self._manager(4)
+        _, g0, w0 = m.get_comm_world(0)
+        _, g1, w1 = m.get_comm_world(1)
+        _, g2, w2 = m.get_comm_world(2)
+        assert sorted(w0) == [0, 1] and g0 == g1
+        assert sorted(w2) == [2, 3] and g2 != g0
+
+    def test_odd_node_joins_last_group(self):
+        m = self._manager(5)
+        _, _, w4 = m.get_comm_world(4)
+        assert sorted(w4) == [2, 3, 4]
+
+    def test_fault_detection(self):
+        m = self._manager(4)
+        for r in range(4):
+            m.get_comm_world(r)
+        m.report_network_check_result(0, True, 1.0)
+        m.report_network_check_result(1, True, 1.0)
+        m.report_network_check_result(2, False, 0.0)
+        m.report_network_check_result(3, False, 0.0)
+        faults, reason = m.check_fault_node()
+        assert faults == [2, 3] and reason == "node_failure"
+        # second round: 2 passes with a good partner, 3 still fails
+        m.report_network_check_result(2, True, 1.0)
+        m.report_network_check_result(3, False, 0.0)
+        faults, reason = m.check_fault_node()
+        assert faults == [3]
+
+    def test_straggler_detection(self):
+        m = self._manager(4)
+        for r in range(4):
+            m.get_comm_world(r)
+        times = {0: 1.0, 1: 1.1, 2: 0.9, 3: 5.0}
+        for r, t in times.items():
+            m.report_network_check_result(r, True, t)
+        assert m.get_stragglers() == [3]
+        assert m.network_check_success()
+
+    def test_round2_repairs_failed_with_healthy(self):
+        """After a failed round 1, round 2 must pair each failed node with a
+        node that passed — the fault-localization property."""
+        m = self._manager(4)
+        for r in range(4):
+            m.get_comm_world(r)
+        # pair (2,3) failed: node 3 is actually bad, 2 was collateral
+        m.report_network_check_result(0, True, 1.0)
+        m.report_network_check_result(1, True, 1.0)
+        m.report_network_check_result(2, False, 0.0)
+        m.report_network_check_result(3, False, 0.0)
+        # round 2: everyone re-joins
+        for r in range(4):
+            m.join_rendezvous(_meta(r))
+        groups = {}
+        for r in range(4):
+            _, g, w = m.get_comm_world(r)
+            groups[r] = sorted(w)
+        # 2 and 3 must now have a previously-passed partner, not each other
+        assert 3 not in groups[2]
+        assert any(p in (0, 1) for p in groups[2] if p != 2)
+        assert any(p in (0, 1) for p in groups[3] if p != 3)
+        # node 2 passes with a good partner; 3 fails again → only 3 faulty
+        m.report_network_check_result(2, True, 1.0)
+        m.report_network_check_result(3, False, 0.0)
+        faults, _ = m.check_fault_node()
+        assert faults == [3]
+
+    def test_all_pass(self):
+        m = self._manager(2)
+        m.get_comm_world(0)
+        m.report_network_check_result(0, True, 1.0)
+        m.report_network_check_result(1, True, 1.2)
+        assert m.network_check_success()
+        assert m.get_stragglers() == []
