@@ -87,7 +87,7 @@ def config_from_args(args) -> ElasticLaunchConfig:
         save_at_breakpoint=args.save_at_breakpoint,
         ckpt_dir=args.ckpt_dir,
         entrypoint=args.entrypoint,
-        args=[a for a in args.args if a != "--"],
+        args=args.args[1:] if args.args[:1] == ["--"] else list(args.args),
     )
     config.auto_configure_params()
     return config

@@ -225,17 +225,18 @@ class AsyncCheckpointSaver:
         """Persist every local frame for ``step``, then commit
         (reference ``save_step_checkpoint``:925)."""
         handlers = self._local_shm_handlers()
-        futures = []
-        for shm in handlers:
-            futures.append(
-                self._executor.submit(self._persist_one, shm, path, step)
-            )
-        persisted = [f.result() for f in futures]
-        if not any(persisted):
+        futures = [
+            (shm, self._executor.submit(self._persist_one, shm, path, step))
+            for shm in handlers
+        ]
+        persisted = [shm for shm, f in futures if f.result()]
+        if not persisted:
             logger.warning("no shm frame matched step %s — nothing persisted",
                            step)
             return
-        self._write_done_files(path, step, handlers)
+        # done markers ONLY for frames that really landed — a skipped or
+        # stale frame must hold the commit quorum open
+        self._write_done_files(path, step, persisted)
         if self._is_commit_leader:
             self.commit_checkpoint(path, step)
 
@@ -362,10 +363,18 @@ class AsyncCheckpointSaver:
                 steps.add(step)
         if persisted:
             for step in steps:
-                self._write_done_files(self.ckpt_dir, step, handlers)
-                # breakpoint saves commit with whatever frames this host has:
-                # a partial-world checkpoint is still restorable per-host
-                self.commit_checkpoint(self.ckpt_dir, step, timeout_s=5.0)
+                done = [
+                    h for h in handlers
+                    if (m := h.read_meta()) is not None and m["step"] == step
+                ]
+                self._write_done_files(self.ckpt_dir, step, done)
+                # commit still demands the full-world quorum: on a
+                # membership change every agent breakpoint-saves, so the
+                # done-dir fills and the leader's wait succeeds; a lone
+                # host's partial save leaves the tracker untouched (correct
+                # — a partial step must never become the restore point).
+                if self._is_commit_leader:
+                    self.commit_checkpoint(self.ckpt_dir, step, timeout_s=30.0)
             logger.info(
                 "breakpoint save (%s): persisted %s frame(s) to %s",
                 reason, persisted, self.ckpt_dir,

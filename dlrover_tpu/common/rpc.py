@@ -31,6 +31,8 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         registry: Dict[str, Callable] = self.server.registry  # type: ignore[attr-defined]
+        dedup = self.server.dedup  # type: ignore[attr-defined]
+        dedup_lock = self.server.dedup_lock  # type: ignore[attr-defined]
         while True:
             try:
                 frame = recv_msg(self.request)
@@ -40,6 +42,19 @@ class _Handler(socketserver.BaseRequestHandler):
                 logger.warning("rpc connection dropped on bad frame: %r", e)
                 return
             method = frame.get("m", "")
+            # exactly-once across client retries: a retried frame carries
+            # the same (client uuid, seq); replay the cached response
+            # instead of re-executing (kv add / counters are not idempotent)
+            key = (frame.get("c"), frame.get("id"))
+            if key[0] is not None:
+                with dedup_lock:
+                    cached = dedup.get(key)
+                if cached is not None:
+                    try:
+                        send_msg(self.request, cached)
+                        continue
+                    except (ConnectionError, OSError):
+                        return
             handler = registry.get(method)
             if handler is None:
                 resp = {"ok": False, "err": f"unknown rpc method {method!r}"}
@@ -51,6 +66,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 except Exception as e:  # noqa: BLE001 — report to caller
                     logger.exception("rpc handler %s failed", method)
                     resp = {"ok": False, "err": repr(e)}
+            if key[0] is not None:
+                with dedup_lock:
+                    dedup[key] = resp
+                    while len(dedup) > 8192:
+                        dedup.pop(next(iter(dedup)))
             try:
                 send_msg(self.request, resp)
             except (ConnectionError, OSError):
@@ -68,6 +88,8 @@ class RPCServer:
     def __init__(self, host: str = "0.0.0.0", port: int = 0):
         self._server = _ThreadedTCPServer((host, port), _Handler)
         self._server.registry = {}  # type: ignore[attr-defined]
+        self._server.dedup = {}  # type: ignore[attr-defined]
+        self._server.dedup_lock = threading.Lock()  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -101,13 +123,20 @@ class RPCClient:
     from monitor threads don't interleave frames.
     """
 
-    def __init__(self, addr: str, timeout_s: float = 60.0, retries: int = 30):
+    def __init__(self, addr: str, timeout_s: float = 330.0, retries: int = 30):
+        # timeout must exceed the longest server-side blocking op (barrier:
+        # 300s) or the client retries a call the server is still executing;
+        # a dead master is detected fast anyway (connect() fails immediately)
+        import uuid
+
         host, port = addr.rsplit(":", 1)
         self._host, self._port = host, int(port)
         self._timeout_s = timeout_s
         self._retries = retries
         self._tls = threading.local()
+        self._client_id = uuid.uuid4().hex
         self._seq = 0
+        self._seq_lock = threading.Lock()
 
     @property
     def addr(self) -> str:
@@ -141,8 +170,13 @@ class RPCClient:
         brief master restarts (reference MasterClient retry decorator,
         elastic_agent/master_client.py:30ish)."""
         retries = self._retries if retries is None else retries
-        self._seq += 1
-        frame = {"m": method, "p": comm.serialize(request), "id": self._seq}
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        frame = {
+            "m": method, "p": comm.serialize(request),
+            "id": seq, "c": self._client_id,
+        }
         backoff = 0.1
         last_err: Optional[Exception] = None
         for attempt in range(retries):

@@ -47,6 +47,8 @@ class DiagnosisAction:
         self.reason = reason
         self.data = data or {}
         self.timestamp = time.time()
+        # node ids a broadcast (ANY_INSTANCE) action was delivered to
+        self.delivered: set = set()
 
     def is_noop(self) -> bool:
         return self.action_type == DiagnosisActionType.NONE
@@ -146,6 +148,14 @@ class JobManager:
         node.heartbeat_time = timestamp or time.time()
         if node.status in (NodeStatus.INITIAL, NodeStatus.PENDING):
             node.update_status(NodeStatus.RUNNING)
+        if self._job_stage == JobStage.FAILED:
+            # a failed job aborts every surviving agent, regardless of which
+            # node's failure tipped it over
+            return DiagnosisAction(
+                DiagnosisActionType.JOB_ABORT,
+                instance=node_id,
+                reason="job failed",
+            )
         return self._next_action(node_id)
 
     def report_failure(
@@ -245,6 +255,11 @@ class JobManager:
                 if now - a.timestamp <= DiagnosisConstant.ACTION_EXPIRY_S
             ]
             for i, action in enumerate(self._action_queue):
-                if action.instance in (node_id, DiagnosisConstant.ANY_INSTANCE):
+                if action.instance == node_id:
                     return self._action_queue.pop(i)
+                if action.instance == DiagnosisConstant.ANY_INSTANCE:
+                    # broadcast: deliver to each node once, expire later
+                    if node_id not in action.delivered:
+                        action.delivered.add(node_id)
+                        return action
         return DiagnosisAction()

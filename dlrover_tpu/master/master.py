@@ -67,6 +67,15 @@ class JobMaster:
         )
         self._server = RPCServer(port=port)
         self._server.register_object(self.servicer)
+        # a dead node's in-flight data shards go straight back on the queue
+        # (reference TaskRescheduleCallback, node/event_callback.py)
+        from dlrover_tpu.common.constants import NodeStatus as _NS
+
+        def _on_node_event(event):
+            if event.node.status in (_NS.FAILED, _NS.DELETED, _NS.BREAKDOWN):
+                self.task_manager.recover_tasks(event.node.id)
+
+        self.job_manager.add_event_callback(_on_node_event)
 
     @property
     def port(self) -> int:

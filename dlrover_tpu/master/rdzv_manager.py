@@ -229,6 +229,17 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self._fault_nodes: List[int] = []
         self._straggler_nodes: List[int] = []
 
+    def join_rendezvous(self, meta) -> int:
+        """A healthy node re-joining starts a fresh check: drop its sticky
+        pass so a replaced/re-sickened host can't ride an old verdict. A
+        *failed* node keeps its False — round-2 re-pairing and the
+        passed-in-any-round exoneration depend on it."""
+        with self._lock:
+            if self._node_status.get(meta.node_rank) is True:
+                del self._node_status[meta.node_rank]
+            self._node_times.pop(meta.node_rank, None)
+        return super().join_rendezvous(meta)
+
     def get_comm_world(
         self, node_rank: int
     ) -> Tuple[int, int, Dict[int, NodeMeta]]:

@@ -1,0 +1,225 @@
+"""Llama-class decoder-only transformer, TPU-first.
+
+The flagship model of the framework (the reference orchestrates external
+Llama trainers — BASELINE.json's driver workload is Llama-7B). Design
+choices for the MXU/XLA:
+
+- **pure-functional params pytree** (no framework classes): shardings ride
+  on the arrays, flash-checkpoint and pjit see plain leaves;
+- **scanned layers**: per-layer params are stacked on a leading axis and the
+  decoder runs as one ``lax.scan`` — O(1) HLO size in depth, the standard
+  TPU compile-time win;
+- **bf16 params/activations, f32 logits+softmax**: MXU-native;
+- **GQA** (n_kv_heads ≤ n_heads), RoPE, RMSNorm, SwiGLU — Llama-2/3 shapes;
+- **remat** per layer (``jax.checkpoint``) to trade FLOPs for HBM;
+- attention is pluggable: dense causal for short S, ring attention over the
+  ``sp`` mesh axis for long context (parallel/ring_attention.py).
+
+Logical sharding axes per param are in :func:`param_logical_axes`; combined
+with parallel/sharding.py rules this yields fsdp/tp sharded params without
+touching model code.
+"""
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.parallel.ring_attention import (
+    full_causal_attention,
+    ring_attention,
+)
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_ring_attention: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama7b() -> "LlamaConfig":
+        """Llama-2-7B shapes (MHA: 32 kv heads) — 6.74B params."""
+        return LlamaConfig(n_kv_heads=32)
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "LlamaConfig":
+        """CI-sized config."""
+        return LlamaConfig(
+            vocab_size=vocab_size, dim=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, ffn_dim=128, max_seq_len=128, remat=False,
+        )
+
+
+def param_logical_axes(config: LlamaConfig) -> Dict:
+    """Logical sharding axes per param (see parallel/sharding.py rules)."""
+    return {
+        "tok_embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "norm"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "ffn_norm": ("layers", "norm"),
+            "w1": ("layers", "embed", "mlp"),
+            "w3": ("layers", "embed", "mlp"),
+            "w2": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(config: LlamaConfig, key) -> Dict:
+    """He-style init, params in config.dtype (bf16)."""
+    c = config
+    keys = jax.random.split(key, 8)
+    dt = c.dtype
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * (fan_in ** -0.5)).astype(dt)
+
+    L = c.n_layers
+    q_dim = c.n_heads * c.head_dim
+    kv_dim = c.n_kv_heads * c.head_dim
+    return {
+        "tok_embed": dense(keys[0], (c.vocab_size, c.dim), c.dim),
+        "layers": {
+            "attn_norm": jnp.ones((L, c.dim), dtype=dt),
+            "wq": dense(keys[1], (L, c.dim, q_dim), c.dim),
+            "wk": dense(keys[2], (L, c.dim, kv_dim), c.dim),
+            "wv": dense(keys[3], (L, c.dim, kv_dim), c.dim),
+            "wo": dense(keys[4], (L, q_dim, c.dim), q_dim),
+            "ffn_norm": jnp.ones((L, c.dim), dtype=dt),
+            "w1": dense(keys[5], (L, c.dim, c.ffn_dim), c.dim),
+            "w3": dense(keys[6], (L, c.dim, c.ffn_dim), c.dim),
+            "w2": dense(keys[7], (L, c.ffn_dim, c.dim), c.ffn_dim),
+        },
+        "final_norm": jnp.ones((c.dim,), dtype=dt),
+        "lm_head": dense(keys[0], (c.dim, c.vocab_size), c.dim),
+    }
+
+
+def _rms_norm(x, weight, eps: float):
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * weight
+
+
+def _rope(x, positions, theta: float):
+    """Rotary embedding. x: (B, S, H, D)."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x1 * sin + x2 * cos
+    out = jnp.stack([y1, y2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def _attention(x, layer, config: LlamaConfig, positions, mesh):
+    c = config
+    B, S, _ = x.shape
+    q = jnp.einsum("bsd,dh->bsh", x, layer["wq"])
+    k = jnp.einsum("bsd,dh->bsh", x, layer["wk"])
+    v = jnp.einsum("bsd,dh->bsh", x, layer["wv"])
+    q = q.reshape(B, S, c.n_heads, c.head_dim)
+    k = k.reshape(B, S, c.n_kv_heads, c.head_dim)
+    v = v.reshape(B, S, c.n_kv_heads, c.head_dim)
+    q = _rope(q, positions, c.rope_theta)
+    k = _rope(k, positions, c.rope_theta)
+    # GQA: repeat kv heads to match q heads
+    rep = c.n_heads // c.n_kv_heads
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # (B,H,S,D)
+    if c.use_ring_attention and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        out = ring_attention(q, k, v, mesh)
+    else:
+        out = full_causal_attention(q, k, v)
+    out = out.transpose(0, 2, 1, 3).reshape(B, S, c.n_heads * c.head_dim)
+    return jnp.einsum("bsh,hd->bsd", out, layer["wo"])
+
+
+def _mlp(x, layer):
+    gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, layer["w1"]))
+    up = jnp.einsum("bsd,df->bsf", x, layer["w3"])
+    return jnp.einsum("bsf,fd->bsd", gate * up, layer["w2"])
+
+
+def forward(
+    params: Dict,
+    tokens,
+    config: LlamaConfig,
+    mesh=None,
+):
+    """tokens (B, S) int32 → logits (B, S, vocab) f32."""
+    c = config
+    B, S = tokens.shape
+    x = params["tok_embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+
+    def layer_fn(h, layer):
+        h = h + _attention(
+            _rms_norm(h, layer["attn_norm"], c.norm_eps),
+            layer, c, positions, mesh,
+        )
+        h = h + _mlp(_rms_norm(h, layer["ffn_norm"], c.norm_eps), layer)
+        return h, None
+
+    scan_fn = layer_fn
+    if c.remat:
+        scan_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+    return logits
+
+
+def next_token_loss(params, tokens, config: LlamaConfig, mesh=None):
+    """Causal LM loss: predict tokens[1:] from tokens[:-1]."""
+    logits = forward(params, tokens[:, :-1], config, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def num_params(config: LlamaConfig) -> int:
+    c = config
+    q_dim, kv_dim = c.n_heads * c.head_dim, c.n_kv_heads * c.head_dim
+    per_layer = (
+        2 * c.dim  # norms
+        + c.dim * q_dim + 2 * c.dim * kv_dim + q_dim * c.dim  # attn
+        + 3 * c.dim * c.ffn_dim  # w1, w3: (dim, ffn); w2: (ffn, dim)
+    )
+    return (
+        c.vocab_size * c.dim
+        + c.n_layers * per_layer
+        + c.dim
+        + c.dim * c.vocab_size
+    )

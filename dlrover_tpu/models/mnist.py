@@ -1,0 +1,54 @@
+"""Small MLP/CNN classifier — the e2e smoke-test model.
+
+Reference analogue: examples/pytorch/mnist (the reference's chaos-test and
+fault-tolerance demos all drive a 4-node MNIST job). Used here the same
+way: tiny, compiles in seconds, exercises the full elastic/checkpoint path.
+"""
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MnistConfig:
+    input_dim: int = 784
+    hidden_dim: int = 256
+    n_classes: int = 10
+    dtype: object = jnp.float32
+
+
+def param_logical_axes(config: MnistConfig) -> Dict:
+    return {
+        "w1": ("embed", "mlp"),
+        "b1": ("mlp",),
+        "w2": ("mlp", None),
+        "b2": (None,),
+    }
+
+
+def init_params(config: MnistConfig, key) -> Dict:
+    k1, k2 = jax.random.split(key)
+    c = config
+    return {
+        "w1": jax.random.normal(k1, (c.input_dim, c.hidden_dim), c.dtype)
+        * (c.input_dim ** -0.5),
+        "b1": jnp.zeros((c.hidden_dim,), c.dtype),
+        "w2": jax.random.normal(k2, (c.hidden_dim, c.n_classes), c.dtype)
+        * (c.hidden_dim ** -0.5),
+        "b2": jnp.zeros((c.n_classes,), c.dtype),
+    }
+
+
+def forward(params: Dict, x):
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def loss_fn(params: Dict, batch):
+    x, y = batch["x"], batch["y"]
+    logits = forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()

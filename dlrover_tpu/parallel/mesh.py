@@ -1,0 +1,167 @@
+"""Device-mesh management: axis planning, sharding rules, elastic re-mesh.
+
+The reference implements no parallelism math — it orchestrates Megatron/
+DeepSpeed (SURVEY.md §2.7). A TPU-native framework owns this layer: one
+``Mesh`` whose named axes carry every strategy, with XLA GSPMD inserting the
+collectives:
+
+- ``dp``   — pure data parallel (params replicated)
+- ``fsdp`` — data parallel with fully-sharded params/opt state (ZeRO-3)
+- ``sp``   — sequence/context parallel (ring attention axis, long context)
+- ``tp``   — tensor parallel (innermost: highest-bandwidth ICI neighbors)
+- ``ep``   — expert parallel for MoE layers (groups experts across hosts)
+- ``pp``   — pipeline stages (outermost: least traffic between stages)
+
+Elastic re-mesh policy: ``tp``/``pp``/``ep`` are fixed by the model shapes;
+``dp × fsdp`` absorbs world-size changes (reference analogue: ElasticTrainer
+keeps global batch fixed while DDP world changes, trainer.py:307 — here the
+mesh itself re-forms and grad-accum rescales, trainer/elastic.py).
+"""
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+
+# axis order: outermost (cheapest link, least traffic) → innermost
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+# axes whose size is fixed by the model, not the cluster
+MODEL_AXES = ("pp", "tp", "ep")
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A concrete axis assignment for a device count."""
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for v in self.axes.values():
+            n *= v
+        return n
+
+    def size(self, axis: str) -> int:
+        return self.axes.get(axis, 1)
+
+    @property
+    def dp_total(self) -> int:
+        """Number of data-parallel replicas of the batch axis
+        (dp × fsdp: both shard the batch; fsdp additionally shards params)."""
+        return self.size("dp") * self.size("fsdp")
+
+    def nontrivial_axes(self) -> List[str]:
+        return [a for a in AXIS_ORDER if self.size(a) > 1]
+
+
+def plan_mesh(
+    n_devices: int,
+    tp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
+    sp: int = 1,
+    fsdp: Optional[int] = None,
+    dp: Optional[int] = None,
+) -> MeshPlan:
+    """Fill in dp/fsdp so the axis product covers ``n_devices``.
+
+    Unspecified ``fsdp`` absorbs the remainder (ZeRO-style sharding is the
+    TPU default — params live sharded in HBM); set ``fsdp=1, dp=None`` for
+    pure replication.
+    """
+    fixed = tp * pp * ep * sp
+    if n_devices % fixed != 0:
+        raise ValueError(
+            f"n_devices={n_devices} not divisible by tp*pp*ep*sp={fixed}"
+        )
+    remainder = n_devices // fixed
+    if fsdp is None and dp is None:
+        fsdp, dp = remainder, 1
+    elif fsdp is None:
+        if remainder % dp != 0:
+            raise ValueError(f"remainder {remainder} not divisible by dp={dp}")
+        fsdp = remainder // dp
+    elif dp is None:
+        if remainder % fsdp != 0:
+            raise ValueError(
+                f"remainder {remainder} not divisible by fsdp={fsdp}"
+            )
+        dp = remainder // fsdp
+    if dp * fsdp != remainder:
+        raise ValueError(
+            f"dp*fsdp={dp * fsdp} != remainder {remainder} "
+            f"(n_devices={n_devices}, fixed={fixed})"
+        )
+    return MeshPlan(axes={
+        "pp": pp, "dp": dp, "fsdp": fsdp, "ep": ep, "sp": sp, "tp": tp,
+    })
+
+
+def build_mesh(plan: MeshPlan, devices: Optional[list] = None):
+    """Materialize a jax Mesh from a plan.
+
+    Axis order follows :data:`AXIS_ORDER` so ``tp`` lands on adjacent
+    devices (contiguous device ids ≈ ICI neighbors on TPU slices)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < plan.n_devices:
+        raise ValueError(
+            f"plan needs {plan.n_devices} devices, have {len(devices)}"
+        )
+    shape = tuple(plan.size(a) for a in AXIS_ORDER)
+    dev_array = np.array(devices[: plan.n_devices]).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+class ElasticMeshManager:
+    """Re-plans the mesh when the world size changes (the TPU analogue of
+    elastic DDP world re-formation)."""
+
+    def __init__(self, tp: int = 1, pp: int = 1, ep: int = 1, sp: int = 1):
+        self._tp, self._pp, self._ep, self._sp = tp, pp, ep, sp
+        self._plan: Optional[MeshPlan] = None
+
+    @property
+    def plan(self) -> Optional[MeshPlan]:
+        return self._plan
+
+    @property
+    def min_unit(self) -> int:
+        """Smallest usable device count — also the rendezvous ``node_unit``
+        seed: worlds must keep dp×fsdp ≥ 1 with model axes intact."""
+        return self._tp * self._pp * self._ep * self._sp
+
+    def usable_devices(self, n_devices: int) -> int:
+        return (n_devices // self.min_unit) * self.min_unit
+
+    def replan(self, n_devices: int) -> MeshPlan:
+        usable = self.usable_devices(n_devices)
+        if usable == 0:
+            raise ValueError(
+                f"{n_devices} devices cannot host tp={self._tp} pp={self._pp} "
+                f"ep={self._ep} sp={self._sp} (needs ≥ {self.min_unit})"
+            )
+        if usable != n_devices:
+            logger.warning(
+                "using %s of %s devices (world must be a multiple of %s)",
+                usable, n_devices, self.min_unit,
+            )
+        self._plan = plan_mesh(
+            usable, tp=self._tp, pp=self._pp, ep=self._ep, sp=self._sp
+        )
+        logger.info("mesh plan for %s devices: %s", usable, self._plan.axes)
+        return self._plan
+
+    def build(self, devices: Optional[list] = None):
+        if self._plan is None:
+            import jax
+
+            self.replan(len(devices) if devices is not None else
+                        jax.device_count())
+        return build_mesh(self._plan, devices)

@@ -1,0 +1,136 @@
+"""Ring attention: causal attention over a sequence-sharded mesh axis.
+
+The reference has NO long-context layer (SURVEY.md §5.7) — it launches
+Megatron jobs that bring their own. A TPU-native stack owns it. This is the
+blockwise/ring formulation (Liu et al., Ring Attention; Milakov & Gimelshein
+online softmax): the sequence axis is sharded over mesh axis ``sp``; each
+device keeps its Q block resident and the K/V blocks rotate around the ring
+via ``ppermute`` (nearest-neighbor ICI traffic — the cheapest collective a
+TPU has), while a numerically-stable online softmax folds each visiting
+block into the running (max, denom, numerator) accumulators in f32.
+
+Causality with a ring: sequence blocks are contiguous chunks in ring order,
+so a whole visiting block is either fully attendable (its chunk precedes
+ours), fully masked (it follows ours), or the diagonal chunk (ours) which
+uses the triangular mask. The fully-masked steps still rotate K/V (the ring
+must stay in lockstep) but contribute nothing.
+
+Exposed as ``ring_attention(q, k, v, mesh)`` — a drop-in for full attention
+when S is sharded — plus ``_ring_attention_local`` for direct shard_map use.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attend(q, k, v, mask, m, l, o, scale):
+    """Fold one K/V block into the online-softmax accumulators.
+
+    q: (B, H, Sq, D); k/v: (B, H, Sk, D); mask: (Sq, Sk) bool (True=keep);
+    m: (B, H, Sq) running max; l: (B, H, Sq) running denom;
+    o: (B, H, Sq, D) running numerator. All accumulators f32.
+    """
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # guard: a fully-masked row keeps m=-inf; exp(-inf - -inf) would be NaN
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(scores - m_safe[..., None])
+    p = jnp.where(mask[None, None, :, :], p, 0.0)
+    correction = jnp.where(
+        jnp.isneginf(m), 0.0, jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m) - m_safe)
+    )
+    l_new = l * correction + p.sum(axis=-1)
+    o_new = o * correction[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+    )
+    return m_new, l_new, o_new
+
+
+def _ring_attention_local(q, k, v, axis_name: str, scale: float):
+    """Per-device ring attention body (inside shard_map).
+
+    q/k/v: (B, H, S_local, D) — the local sequence chunk; chunks are laid
+    out contiguously in ring order (chunk r of the global sequence lives on
+    ring position r).
+    """
+    sp = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    qf = q.astype(jnp.float32)
+
+    rows = jnp.arange(s_local)
+    cols = jnp.arange(s_local)
+    tri = rows[:, None] >= cols[None, :]  # causal within a chunk
+    full = jnp.ones((s_local, s_local), dtype=bool)
+    empty = jnp.zeros((s_local, s_local), dtype=bool)
+
+    m0 = jnp.full(q.shape[:3], -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros(q.shape[:3], dtype=jnp.float32)
+    o0 = jnp.zeros(qf.shape, dtype=jnp.float32)
+
+    def step(i, carry):
+        m, l, o, k_blk, v_blk = carry
+        # after i rotations the visiting block started on ring position
+        # (my_idx - i) mod sp  — ppermute sends to (j+1) % sp each step
+        src = (my_idx - i) % sp
+        mask = jnp.where(
+            src == my_idx, tri, jnp.where(src < my_idx, full, empty)
+        )
+        m, l, o = _block_attend(qf, k_blk, v_blk, mask, m, l, o, scale)
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return m, l, o, k_next, v_next
+
+    m, l, o, _, _ = jax.lax.fori_loop(0, sp, step, (m0, l0, o0, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (none in causal LM)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q, k, v,
+    mesh: Mesh,
+    sp_axis: str = "sp",
+    batch_spec=P(("dp", "fsdp"), "tp", "sp", None),
+    scale: Optional[float] = None,
+):
+    """Causal attention with the sequence axis sharded over ``sp_axis``.
+
+    q/k/v: (B, H, S, D) jax.Arrays (S sharded over sp). Returns same shape/
+    sharding. Inside jit, composes with the surrounding GSPMD program via
+    shard_map.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    fn = functools.partial(
+        _ring_attention_local, axis_name=sp_axis, scale=scale
+    )
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(batch_spec, batch_spec, batch_spec),
+        out_specs=batch_spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def full_causal_attention(q, k, v, scale: Optional[float] = None):
+    """Reference dense causal attention (B, H, S, D) — the correctness
+    oracle for ring attention and the single-device fallback."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = q.shape[2]
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", probs.astype(v.dtype), v
+    ).astype(q.dtype)

@@ -1,0 +1,118 @@
+"""Logical-axis sharding rules → PartitionSpecs.
+
+The flax ``logical axis rules`` idea, standalone: model code annotates each
+param with logical axis names; one rules table maps those to mesh axes. The
+checkpoint engine needs no extra metadata — the resulting NamedShardings
+ride on the arrays (SURVEY.md §2.7: ckpt shard layout keyed by mesh axes).
+"""
+
+from typing import Dict, Optional, Sequence, Tuple
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# logical axis name → mesh axis (or None = replicate).
+# "batch" spreads over both data axes; "embed" (the hidden dim of params)
+# shards over fsdp (ZeRO-3-style); "heads"/"mlp" shard over tp; "vocab"
+# over tp (output projection all-gathers logits); "expert" over ep;
+# "seq" over sp (ring attention axis); "layers"/"stage" over pp.
+DEFAULT_RULES: Dict[str, Optional[object]] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "mlp": "tp",
+    "vocab": "tp",
+    "expert": "ep",
+    "stage": "pp",
+    "layers": None,
+    "norm": None,
+    "head_dim": None,
+}
+
+
+def spec_for(
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Dict] = None,
+) -> P:
+    rules = rules or DEFAULT_RULES
+    return P(*[
+        rules.get(name) if name is not None else None
+        for name in logical_axes
+    ])
+
+
+def sharding_for(mesh, logical_axes: Sequence[Optional[str]],
+                 rules: Optional[Dict] = None) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(logical_axes, rules))
+
+
+def tree_shardings(mesh, logical_tree, rules: Optional[Dict] = None):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    import jax
+
+    return jax.tree.map(
+        lambda axes: sharding_for(mesh, axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def _axis_size(mesh, axis) -> int:
+    if axis is None:
+        return 1
+    if isinstance(axis, (tuple, list)):
+        n = 1
+        for a in axis:
+            n *= mesh.shape.get(a, 1)
+        return n
+    return mesh.shape.get(axis, 1)
+
+
+def valid_spec_for(mesh, shape, logical_axes: Sequence[Optional[str]],
+                   rules: Optional[Dict] = None) -> P:
+    """Like :func:`spec_for` but drops (replicates) any mesh axis whose size
+    does not divide the corresponding array dimension — e.g. an elastic
+    re-mesh landing on fsdp=3 with a dim of 64 replicates that dim instead
+    of failing. GSPMD would need padding for uneven shards; replication is
+    always-correct and the planner keeps axes power-of-two in practice."""
+    spec = spec_for(logical_axes, rules)
+    cleaned = []
+    for dim, axis in zip(shape, spec):
+        size = _axis_size(mesh, axis)
+        cleaned.append(axis if (size > 1 and dim % size == 0) else
+                       (axis if size == 1 else None))
+    return P(*cleaned)
+
+
+def shard_tree(mesh, state, logical_tree, rules: Optional[Dict] = None):
+    """device_put a pytree according to its logical axes (with per-leaf
+    divisibility validation)."""
+    import jax
+
+    def put(axes, leaf):
+        spec = valid_spec_for(mesh, leaf.shape, axes, rules)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    # logical_tree leads: its tuple leaves (marked via is_leaf) pair with
+    # the array leaves of ``state`` at the same tree positions
+    return jax.tree.map(
+        put, logical_tree, state,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(a, (str, type(None))) for a in x
+        ),
+    )
+
+
+def batch_sharding(mesh) -> NamedSharding:
+    """Input batch: (batch, seq) over ((dp, fsdp), sp)."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+
+def with_batch_constraint(x):
+    """Annotate an activation inside jit: batch over data axes, seq over sp."""
+    import jax
+
+    return jax.lax.with_sharding_constraint(
+        x, P(("dp", "fsdp"), "sp")
+    )

@@ -1,0 +1,130 @@
+"""ElasticTrainer: fixed global batch under a changing world.
+
+Reference: dlrover/trainer/torch/elastic/trainer.py:181 — ``ElasticTrainer``
+keeps the *global* batch size constant as the DDP world grows/shrinks by
+rescaling gradient-accumulation steps (``_set_gradient_accumulation_steps``
+:307). TPU translation: the mesh re-forms (parallel/mesh.py) and this
+trainer recomputes ``grad_accum = global_batch / (micro_batch × dp_total)``,
+so optimization dynamics (tokens per optimizer step) are identical before
+and after any elastic event.
+
+The train step is one jit: ``lax.scan`` over the accumulation microbatches
+(grads accumulated in f32), then one optimizer update — donated state, so
+params/opt-state update in place in HBM.
+"""
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.parallel.mesh import ElasticMeshManager, MeshPlan
+
+
+class TrainStepResult(NamedTuple):  # NamedTuple ⇒ a pytree, jit can return it
+    loss: Any
+    grad_norm: Any
+
+
+def make_train_state(params, optimizer) -> Dict:
+    return {
+        "params": params,
+        "opt_state": optimizer.init(params),
+        "step": jnp.zeros((), dtype=jnp.int32),
+    }
+
+
+class ElasticTrainer:
+    def __init__(
+        self,
+        loss_fn: Callable,  # loss_fn(params, microbatch) -> scalar
+        optimizer,          # optax GradientTransformation
+        global_batch_size: int,
+        micro_batch_per_replica: int,
+        mesh_manager: Optional[ElasticMeshManager] = None,
+    ):
+        self._loss_fn = loss_fn
+        self._optimizer = optimizer
+        self.global_batch_size = global_batch_size
+        self.micro_batch_per_replica = micro_batch_per_replica
+        self._mesh_manager = mesh_manager
+        self.grad_accum_steps = 1
+        self._train_step = None
+
+    def configure_for_world(self, plan: MeshPlan) -> int:
+        """(Re)compute grad-accum for the current mesh
+        (reference trainer.py:307 semantics)."""
+        dp_total = plan.dp_total
+        denom = self.micro_batch_per_replica * dp_total
+        if self.global_batch_size % denom != 0:
+            raise ValueError(
+                f"global_batch_size={self.global_batch_size} not divisible "
+                f"by micro_batch×dp_total={denom} — adjust micro batch or "
+                f"constrain the world with node_unit"
+            )
+        self.grad_accum_steps = self.global_batch_size // denom
+        self._train_step = None  # world changed ⇒ retrace
+        logger.info(
+            "elastic trainer: dp_total=%s grad_accum=%s (global batch %s)",
+            dp_total, self.grad_accum_steps, self.global_batch_size,
+        )
+        return self.grad_accum_steps
+
+    @property
+    def micro_batch_global(self) -> int:
+        """Rows per microbatch across the whole mesh."""
+        return self.global_batch_size // self.grad_accum_steps
+
+    def _build_step(self):
+        loss_fn = self._loss_fn
+        optimizer = self._optimizer
+        accum = self.grad_accum_steps
+
+        def step_fn(state, batch):
+            """batch: (accum, micro_batch_global, ...) — leading accum axis
+            iterated sequentially, second axis sharded over data axes."""
+            params = state["params"]
+
+            def micro_step(carry, microbatch):
+                grad_acc, loss_acc = carry
+                loss, grads = jax.value_and_grad(loss_fn)(params, microbatch)
+                grads = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), grad_acc, grads
+                )
+                return (grads, loss_acc + loss), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro_step, (zeros, jnp.zeros((), jnp.float32)), batch
+            )
+            grads = jax.tree.map(lambda g: g / accum, grads)
+            grad_norm = optax_global_norm(grads)
+            updates, new_opt = optimizer.update(
+                grads, state["opt_state"], params
+            )
+            new_params = jax.tree.map(
+                lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+                params, updates,
+            )
+            new_state = {
+                "params": new_params,
+                "opt_state": new_opt,
+                "step": state["step"] + 1,
+            }
+            return new_state, TrainStepResult(loss_sum / accum, grad_norm)
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def train_step(self, state, batch):
+        if self._train_step is None:
+            self._train_step = self._build_step()
+        return self._train_step(state, batch)
+
+
+def optax_global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))

@@ -1,0 +1,48 @@
+"""Keep the driver entry points green on the CPU mesh."""
+
+import os
+import subprocess
+import sys
+
+import jax
+
+
+def test_entry_jittable():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == 2 and out.ndim == 3
+    assert bool(jax.numpy.isfinite(out).all())
+
+
+def test_dryrun_multichip_8():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
+
+
+def test_bench_smoke_cpu(tmp_path):
+    """bench.py must print exactly one parseable JSON line."""
+    import json
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_DIM": "128",
+        "BENCH_LAYERS": "2",
+        "BENCH_CKPT_DIR": str(tmp_path / "bench"),
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
+    assert result["value"] > 0

@@ -165,7 +165,10 @@ class TestNetworkCheck:
         assert 3 not in groups[2]
         assert any(p in (0, 1) for p in groups[2] if p != 2)
         assert any(p in (0, 1) for p in groups[3] if p != 3)
-        # node 2 passes with a good partner; 3 fails again → only 3 faulty
+        # round 2: every node re-runs the workload and re-reports; node 2
+        # passes with a good partner, 3 fails again → only 3 faulty
+        m.report_network_check_result(0, True, 1.0)
+        m.report_network_check_result(1, True, 1.0)
         m.report_network_check_result(2, True, 1.0)
         m.report_network_check_result(3, False, 0.0)
         faults, _ = m.check_fault_node()
