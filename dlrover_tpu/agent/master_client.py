@@ -157,8 +157,8 @@ class MasterClient:
             ),
         )
 
-    def heartbeat(self, global_step: int = 0,
-                  step_timestamp: float = 0.0) -> comm.HeartbeatResponse:
+    def heartbeat(self, global_step: int = 0, step_timestamp: float = 0.0,
+                  gauges=None) -> comm.HeartbeatResponse:
         return self._client.call(
             "heartbeat",
             comm.HeartbeatRequest(
@@ -166,6 +166,7 @@ class MasterClient:
                 timestamp=time.time(),
                 global_step=global_step,
                 step_timestamp=step_timestamp,
+                gauges=gauges or {},
             ),
         )
 

@@ -38,6 +38,7 @@ from dlrover_tpu.common.constants import (
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.multi_process import LocalIPCServer, ipc_socket_path
 from dlrover_tpu.common.rpc import find_free_port
+from dlrover_tpu.diagnosis.diagnosis_agent import DiagnosisAgent
 
 
 class RendezvousOutSyncError(Exception):
@@ -163,6 +164,9 @@ class ElasticTrainingAgent:
         self._hb_thread: Optional[threading.Thread] = None
         self._last_global_step = 0
         self._last_step_ts = 0.0
+        # node-side diagnosis: telemetry gauges for heartbeats + the
+        # restart-vs-relaunch verdict on worker failure
+        self._diagnosis = DiagnosisAgent()
 
     # -- rendezvous + spawn ------------------------------------------------
 
@@ -296,6 +300,11 @@ class ElasticTrainingAgent:
         self._stop_workers()
         self._save_breakpoint_checkpoint(reason)
         self._restart_count += 1
+        # drop the stale step observation: heartbeats must not re-populate
+        # the master's PerfMonitor with pre-restart timestamps (that would
+        # immediately re-arm the hang detector after a hang restart)
+        self._last_global_step = 0
+        self._last_step_ts = 0.0
         self._initialize_workers()
 
     def _save_breakpoint_checkpoint(self, reason: str) -> None:
@@ -320,6 +329,7 @@ class ElasticTrainingAgent:
                 resp = self._client.heartbeat(
                     global_step=self._last_global_step,
                     step_timestamp=self._last_step_ts,
+                    gauges=self._diagnosis.collect_gauges(),
                 )
             except ConnectionError:
                 continue
@@ -384,12 +394,20 @@ class ElasticTrainingAgent:
                 continue
             # healthy: check diagnosis actions and membership changes
             action = self._take_pending_action()
-            if action in (
-                DiagnosisActionType.RESTART_WORKER,
-                DiagnosisActionType.RELAUNCH_WORKER,
-            ):
+            if action == DiagnosisActionType.RESTART_WORKER:
                 self._restart_workers(f"diagnosis action {action}")
                 continue
+            if action == DiagnosisActionType.RELAUNCH_WORKER:
+                # pod-level: exit so the master's relaunch ladder replaces
+                # this node (a wedged chip must not be soft-restarted onto)
+                logger.warning("relaunch action — exiting for pod replacement")
+                self._stop_workers()
+                self._save_breakpoint_checkpoint("relaunch action")
+                self._client.update_node_status(
+                    NodeStatus.FAILED, exit_reason="relaunched",
+                    restart_count=self._restart_count,
+                )
+                return 1
             if action == DiagnosisActionType.JOB_ABORT:
                 logger.error("job abort action received")
                 self._client.update_node_status(
@@ -403,7 +421,11 @@ class ElasticTrainingAgent:
                     self._restart_workers("membership changed")
 
     def _handle_worker_failure(self, result: RunResult) -> bool:
-        """Returns True to continue (restarted), False to give up."""
+        """Returns True to continue (restarted), False to give up.
+
+        The DiagnosisAgent decides RESTART_WORKER (in place) vs
+        RELAUNCH_WORKER (this agent exits non-zero; the master's relaunch
+        ladder replaces the pod) — reference diagnose_training_failure:137."""
         logger.warning(
             "node %s worker failure(s): %s",
             self._config.node_rank, result.failures,
@@ -416,11 +438,21 @@ class ElasticTrainingAgent:
             )
         except ConnectionError:
             pass
-        if self._remaining_restarts <= 0:
-            logger.error("restart budget exhausted on node %s",
-                         self._config.node_rank)
+        # the budget counts only failure-driven restarts (_restart_count
+        # also grows on membership changes); the verdict is the single
+        # decision point for giving up in place
+        verdict = self._diagnosis.diagnose_training_failure(
+            result.failures, self._remaining_restarts
+        )
+        if verdict == DiagnosisActionType.RELAUNCH_WORKER:
+            logger.error(
+                "giving up in-place restarts on node %s (verdict=%s, "
+                "remaining=%s)", self._config.node_rank, verdict,
+                self._remaining_restarts,
+            )
+            self._save_breakpoint_checkpoint("relaunch")
             self._client.update_node_status(
-                NodeStatus.FAILED, exit_reason="fatal_error",
+                NodeStatus.FAILED, exit_reason="relaunched",
                 restart_count=self._restart_count,
             )
             return False

@@ -213,6 +213,9 @@ class HeartbeatRequest:
     # most recent global step + timestamp the agent has observed
     global_step: int = 0
     step_timestamp: float = 0.0
+    # profiler-plane gauges (tpu_timer hang/latency families) forwarded so
+    # the master's hang diagnostician can require all-node agreement
+    gauges: Dict[str, float] = field(default_factory=dict)
 
 
 @message

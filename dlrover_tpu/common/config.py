@@ -27,9 +27,15 @@ class DefaultValues:
     node_max_relaunch: int = 3
     worker_max_restart: int = 100
     relaunch_on_worker_failure: int = 3
-    # --- hang detection ---
+    # --- hang detection / diagnosis ---
     hang_downtime_s: float = 1800.0
     step_hang_timeout_s: float = 600.0
+    diagnosis_interval_s: float = 60.0
+    # hang default is observe-only (reference: hang_detection level gates
+    # whether the master acts on a detected hang)
+    hang_restart_workers: bool = False
+    # pre-check operator chain names; empty disables (reference --pre-check-ops)
+    precheck_ops: list = field(default_factory=list)
     # --- autoscale ---
     autoscale_interval_s: float = 30.0
     # --- flash checkpoint ---
@@ -37,6 +43,14 @@ class DefaultValues:
     ckpt_commit_poll_s: float = 0.1
     # --- data sharding ---
     task_timeout_s: float = 1800.0
+
+
+def _cast_env(env: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return env.strip().lower() in ("1", "true", "yes", "on")
+    if isinstance(default, list):
+        return [s for s in (p.strip() for p in env.split(",")) if s]
+    return type(default)(env)
 
 
 class Context:
@@ -56,8 +70,7 @@ class Context:
             default = getattr(defaults, name)
             env = os.getenv("DLROVER_TPU_" + name.upper())
             if env is not None:
-                caster = type(default)
-                default = caster(env)
+                default = _cast_env(env, default)
             self._values[name] = default
 
     def __getattr__(self, name: str) -> Any:

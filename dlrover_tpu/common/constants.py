@@ -100,6 +100,15 @@ class DiagnosisConstant:
     ACTION_EXPIRY_S = 60 * 5
 
 
+class PreCheckStatus:
+    """Master pre-check verdict polled by agents before training starts
+    (reference constants.py PreCheckStatus)."""
+
+    PASS = "pass"
+    FAIL = "fail"
+    CHECKING = "checking"
+
+
 class TrainingExceptionLevel:
     RDZV_ERROR = "rdzv_error"
     PROCESS_ERROR = "process_error"

@@ -19,6 +19,9 @@ class NodeResource:
     # TPU chips attached to the host (v5e: 1/4/8 per VM)
     device_count: int = 0
     device_type: str = ""
+    # mean device duty-cycle % over the last report window (None = no
+    # telemetry yet; diagnosis must not infer a stall from absence)
+    device_util: Optional[float] = None
 
     def to_dict(self) -> Dict:
         return {

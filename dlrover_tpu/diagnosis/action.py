@@ -1,0 +1,126 @@
+"""Diagnosis actions: what the control plane wants executed, and by whom.
+
+Reference: dlrover/python/diagnosis/common/diagnosis_action.py (action class
+tree + per-instance queues, :371-file). Actions flow master → agent inside
+heartbeat replies (servicer.rpc_heartbeat) and agent-internal via
+:class:`DiagnosisActionQueue`. Redesign notes: actions are plain value
+objects keyed by ``action_type`` strings (constants.DiagnosisActionType) so
+they serialize over the msgpack RPC without a class registry.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import DiagnosisActionType, DiagnosisConstant
+from dlrover_tpu.common.log import logger
+
+
+class DiagnosisAction:
+    """Base action (reference diagnosis_action.py ``DiagnosisAction``)."""
+
+    def __init__(
+        self,
+        action_type: str = DiagnosisActionType.NONE,
+        instance: int = DiagnosisConstant.MASTER_INSTANCE,
+        reason: str = "",
+        data: Optional[Dict] = None,
+        expired_time_s: float = DiagnosisConstant.ACTION_EXPIRY_S,
+    ):
+        self.action_type = action_type
+        self.instance = instance
+        self.reason = reason
+        self.data = data or {}
+        self.timestamp = time.time()
+        self.expired_time_s = expired_time_s
+        # node ids a broadcast (ANY_INSTANCE) action was delivered to
+        self.delivered: set = set()
+
+    def is_noop(self) -> bool:
+        return self.action_type == DiagnosisActionType.NONE
+
+    def is_expired(self, now: Optional[float] = None) -> bool:
+        return ((now or time.time()) - self.timestamp) > self.expired_time_s
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"{type(self).__name__}(type={self.action_type},"
+            f" instance={self.instance}, reason={self.reason!r})"
+        )
+
+
+class NoAction(DiagnosisAction):
+    def __init__(self):
+        super().__init__(DiagnosisActionType.NONE)
+
+
+class EventAction(DiagnosisAction):
+    """Publish a structured event, no state change (reference EventAction)."""
+
+    def __init__(self, event_type: str = "", msg: str = "", **labels):
+        super().__init__(
+            DiagnosisActionType.EVENT,
+            reason=msg,
+            data={"event_type": event_type, **labels},
+        )
+
+
+class NodeAction(DiagnosisAction):
+    """Restart or relaunch a specific node's workers (reference
+    NodeAction: RESTART_WORKER soft in-pod vs RELAUNCH_WORKER pod-level)."""
+
+    def __init__(
+        self,
+        node_id: int,
+        action_type: str = DiagnosisActionType.RESTART_WORKER,
+        reason: str = "",
+    ):
+        super().__init__(action_type, instance=node_id, reason=reason)
+
+
+class JobAbortAction(DiagnosisAction):
+    def __init__(self, reason: str = "", instance: int = DiagnosisConstant.ANY_INSTANCE):
+        super().__init__(
+            DiagnosisActionType.JOB_ABORT, instance=instance, reason=reason
+        )
+
+
+class DiagnosisActionQueue:
+    """Per-instance action queue with expiry + broadcast semantics
+    (reference diagnosis_action.py ``DiagnosisActionQueue``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._actions: List[DiagnosisAction] = []
+
+    def add_action(self, action: DiagnosisAction) -> None:
+        if action.is_noop():
+            return
+        with self._lock:
+            for existing in self._actions:
+                if (
+                    existing.action_type == action.action_type
+                    and existing.instance == action.instance
+                ):
+                    return  # dedup identical pending actions
+            logger.info("queueing diagnosis action %r", action)
+            self._actions.append(action)
+
+    def next_action(self, instance: int) -> DiagnosisAction:
+        now = time.time()
+        with self._lock:
+            self._actions = [
+                a for a in self._actions if not a.is_expired(now)
+            ]
+            for i, action in enumerate(self._actions):
+                if action.instance == instance:
+                    return self._actions.pop(i)
+                if action.instance == DiagnosisConstant.ANY_INSTANCE:
+                    if instance not in action.delivered:
+                        action.delivered.add(instance)
+                        return action
+        return NoAction()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._actions)

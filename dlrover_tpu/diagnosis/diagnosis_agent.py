@@ -1,0 +1,144 @@
+"""Node-side diagnosis: collect local telemetry, decide restart vs relaunch.
+
+Reference: dlrover/python/elastic_agent/diagnosis/diagnosis_agent.py:55
+(``diagnose_training_failure``:137 — RESTART_WORKER while the in-pod restart
+budget lasts, then RELAUNCH_WORKER to get a fresh pod) plus the periodic
+metric collectors (xpu-timer scrape :85, resource usage :86) whose readings
+ride to the master inside heartbeats.
+
+TPU redesign: collectors are pluggable callables returning gauge dicts; the
+tpu_timer collector scrapes the local profiler daemon's Prometheus endpoint
+when one is running (observability/), and the resource collector reads
+psutil. Failures are classified by exit code: XLA/PJRT init or compile
+failures are node-level (relaunch — the chip may be wedged), Python errors
+are process-level (restart in place).
+"""
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.constants import DiagnosisActionType
+from dlrover_tpu.common.log import logger
+
+# exit codes that indicate the host/chip is unhealthy, not the user code:
+# SIGABRT (libtpu CHECK failures abort) and SIGSEGV, in both encodings —
+# subprocess.Popen reports -signum; shells report 128+signum
+_NODE_LEVEL_EXIT_CODES = {-6, -11, 134, 139}
+
+
+class GaugeCollector:
+    """A named periodic gauge source (reference datacollector/*)."""
+
+    name = "base"
+
+    def collect(self) -> Dict[str, float]:
+        return {}
+
+
+class ResourceCollector(GaugeCollector):
+    """Host cpu/mem usage (reference monitor/resource.py:86 feeds the same
+    numbers; here they also ride heartbeats as gauges)."""
+
+    name = "resource"
+
+    def collect(self) -> Dict[str, float]:
+        try:
+            import psutil
+        except ImportError:  # pragma: no cover
+            return {}
+        return {
+            "node_cpu_percent": psutil.cpu_percent(interval=None),
+            "node_mem_percent": psutil.virtual_memory().percent,
+        }
+
+
+class TpuTimerCollector(GaugeCollector):
+    """Scrape the local tpu_timer daemon's Prometheus endpoint for the
+    hang/latency gauge families (reference
+    datacollector/xpu_timer_metric_collector.py:28)."""
+
+    name = "tpu_timer"
+
+    def __init__(self, port: int = 18889, host: str = "127.0.0.1"):
+        self._url = f"http://{host}:{port}/metrics"
+
+    def collect(self) -> Dict[str, float]:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(self._url, timeout=2) as resp:
+                text = resp.read().decode()
+        except OSError:
+            return {}
+        gauges: Dict[str, float] = {}
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            parts = line.rsplit(" ", 1)
+            if len(parts) != 2:
+                continue
+            name = parts[0].split("{", 1)[0].strip()
+            if not name.startswith("XPU_TIMER"):
+                continue
+            try:
+                value = float(parts[1])
+            except ValueError:
+                continue
+            # keep the max across kernels/labels per family — hang is a
+            # boolean-ish gauge, latency families report worst-case
+            gauges[name] = max(gauges.get(name, float("-inf")), value)
+        return gauges
+
+
+class WorkerFailure:
+    def __init__(self, exit_codes: Dict[int, int], restarts_remaining: int):
+        self.exit_codes = exit_codes  # global_rank → exit code
+        self.restarts_remaining = restarts_remaining
+        self.timestamp = time.time()
+
+
+class DiagnosisAgent:
+    """Per-host diagnosis (reference diagnosis_agent.py:55)."""
+
+    def __init__(
+        self,
+        collectors: Optional[List[GaugeCollector]] = None,
+    ):
+        self._collectors = (
+            collectors if collectors is not None
+            else [ResourceCollector(), TpuTimerCollector()]
+        )
+        self._failures: List[WorkerFailure] = []
+
+    def collect_gauges(self) -> Dict[str, float]:
+        gauges: Dict[str, float] = {}
+        for c in self._collectors:
+            try:
+                gauges.update(c.collect())
+            except Exception:  # noqa: BLE001 — telemetry is best-effort
+                logger.exception("collector %s failed", c.name)
+        return gauges
+
+    def diagnose_training_failure(
+        self, exit_codes: Dict[int, int], restarts_remaining: int
+    ) -> str:
+        """RESTART_WORKER (same host) vs RELAUNCH_WORKER (new pod)
+        (reference diagnose_training_failure:137). The caller owns the
+        restart budget counter; this is the single decision point."""
+        self._failures.append(WorkerFailure(exit_codes, restarts_remaining))
+        if any(c in _NODE_LEVEL_EXIT_CODES for c in exit_codes.values()):
+            logger.warning(
+                "node-level failure (exit codes %s) — requesting pod relaunch",
+                exit_codes,
+            )
+            return DiagnosisActionType.RELAUNCH_WORKER
+        if restarts_remaining <= 0:
+            logger.warning(
+                "in-place restart budget spent — requesting pod relaunch"
+            )
+            return DiagnosisActionType.RELAUNCH_WORKER
+        return DiagnosisActionType.RESTART_WORKER
+
+    @property
+    def failure_count(self) -> int:
+        return len(self._failures)

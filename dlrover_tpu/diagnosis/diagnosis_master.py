@@ -1,0 +1,222 @@
+"""Master-side diagnosis: pre-check chain + periodic hang/stall inference.
+
+Reference: dlrover/python/master/diagnosis/diagnosis_master.py:72
+(``pre_check``:99, metric hang check ``check_tensor_drop_zero``:359) and the
+inference-chain CheckTrainingHangOperator. Detection sources implemented here
+(SURVEY.md §5.3): step-progress stall from the PerfMonitor, profiler hang
+gauges carried in agent heartbeats (the tpu_timer analogue of
+``XPU_TIMER_COMMON_HANG``), and per-node silence already handled by the job
+manager's heartbeat monitor.
+
+Redesign: instead of a 0.1 s inference loop over a queue (reference
+``_diagnose_job`` dist_master.py:223), one periodic thread evaluates all
+registered diagnosticians; actions land in the JobManager's queue and ride
+back to agents in heartbeat replies.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import DiagnosisActionType, DiagnosisConstant
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.diagnosis.action import (
+    DiagnosisAction,
+    EventAction,
+    NoAction,
+    NodeAction,
+)
+from dlrover_tpu.diagnosis.diagnostician import (
+    Diagnostician,
+    DiagnosticianRegistry,
+    Observation,
+)
+from dlrover_tpu.diagnosis.precheck import (
+    PreCheckRunner,
+    get_precheck_operators,
+)
+
+# gauge names the agent forwards from the profiler plane
+# (tpu_timer constant.h mirrors the reference's XPU_TIMER_* families)
+HANG_GAUGE = "XPU_TIMER_COMMON_HANG"
+
+
+class TrainingHangDiagnostician(Diagnostician):
+    """Hang = global step stopped advancing AND (if profiler gauges exist)
+    every node reports the hang gauge set (reference
+    check_training_hang_operator.py:29 requires all-node agreement)."""
+
+    name = "training_hang"
+
+    def __init__(self, perf_monitor, node_gauges: Dict[int, tuple]):
+        # node_gauges: node_id → (gauge dict, receive timestamp), shared
+        # with (and mutated by) DiagnosisMaster.observe_heartbeat
+        self._perf_monitor = perf_monitor
+        self._node_gauges = node_gauges
+
+    def observe(self, **kwargs) -> Observation:
+        ctx = get_context()
+        if not self._perf_monitor.step_stalled(ctx.hang_downtime_s):
+            return Observation()
+        # only nodes whose agent recently forwarded the profiler hang gauge
+        # get a vote — a node without tpu_timer (or whose daemon died and
+        # left a stale snapshot) must not count as "not hung"
+        now = time.time()
+        fresh_s = 3 * get_context().heartbeat_interval_s
+        votes = {
+            nid: g[HANG_GAUGE] > 0
+            for nid, (g, ts) in self._node_gauges.items()
+            if HANG_GAUGE in g and now - ts <= fresh_s
+        }
+        if votes and not all(votes.values()):
+            # steps stalled but some chip still launching ops — likely a
+            # straggler or slow eval, not a collective hang
+            return Observation(
+                "step_stall",
+                {"votes": sum(votes.values()), "nodes": len(votes)},
+            )
+        return Observation("training_hang", {"nodes": list(votes)})
+
+    def resolve(self, observation: Observation, **kwargs) -> DiagnosisAction:
+        if observation.problem == "step_stall":
+            return EventAction(
+                "step_stall",
+                msg="global step stalled without unanimous hang gauges",
+                **observation.data,
+            )
+        ctx = get_context()
+        if not ctx.hang_restart_workers:
+            return EventAction("training_hang", msg="hang detected (observe-only)")
+        logger.warning("training hang detected — restarting all workers")
+        return DiagnosisAction(
+            DiagnosisActionType.RESTART_WORKER,
+            instance=DiagnosisConstant.ANY_INSTANCE,
+            reason="training hang",
+        )
+
+
+class MetricStallDiagnostician(Diagnostician):
+    """Device-utilization collapse: every node's reported device util dropped
+    to ~zero while the job claims to be training (reference
+    ``check_tensor_drop_zero`` diagnosis_master.py:359 over GPU tensor-core
+    metrics; here over the agents' ResourceStats device_util)."""
+
+    name = "metric_stall"
+
+    def __init__(self, job_manager, stall_util: float = 0.5):
+        self._job_manager = job_manager
+        self._stall_util = stall_util
+
+    def observe(self, **kwargs) -> Observation:
+        utils: List[float] = []
+        for node in self._job_manager.nodes.values():
+            if node.status != "running":
+                continue
+            if node.used_resource.device_util is None:
+                return Observation()  # no telemetry → no verdict
+            utils.append(node.used_resource.device_util)
+        if utils and all(u < self._stall_util for u in utils):
+            return Observation("device_stall", {"utils": utils})
+        return Observation()
+
+    def resolve(self, observation: Observation, **kwargs) -> DiagnosisAction:
+        return EventAction(
+            "device_stall",
+            msg="all devices near-idle while job running",
+            **observation.data,
+        )
+
+
+class DiagnosisMaster:
+    """Composes pre-check + periodic diagnosis (reference
+    diagnosis_master.py:72)."""
+
+    def __init__(
+        self,
+        job_manager,
+        perf_monitor=None,
+        precheck_ops: Optional[List[str]] = None,
+    ):
+        ctx = get_context()
+        self._job_manager = job_manager
+        self._perf_monitor = perf_monitor
+        # node_id → (latest profiler gauges, receive timestamp)
+        self._node_gauges: Dict[int, tuple] = {}
+        self._precheck = PreCheckRunner(
+            get_precheck_operators(
+                ctx.precheck_ops if precheck_ops is None else precheck_ops
+            )
+        )
+        self._registry = DiagnosticianRegistry(self._sink_action)
+        if perf_monitor is not None:
+            self._registry.register(
+                TrainingHangDiagnostician(perf_monitor, self._node_gauges),
+                period_s=ctx.diagnosis_interval_s,
+            )
+        self._registry.register(
+            MetricStallDiagnostician(job_manager),
+            period_s=ctx.diagnosis_interval_s,
+        )
+        self._precheck_thread: Optional[threading.Thread] = None
+
+    def _sink_action(self, action: DiagnosisAction) -> None:
+        """EVENT actions go to the event log; everything else rides to
+        agents via the JobManager's delivery queue (which no EVENT consumer
+        drains — queueing them there would only clog dedup)."""
+        if action.action_type == DiagnosisActionType.EVENT:
+            logger.info(
+                "diagnosis event %s: %s %s",
+                action.data.get("event_type", ""), action.reason, action.data,
+            )
+            return
+        self._job_manager.enqueue_action(action)
+
+    # -- pre-check ---------------------------------------------------------
+
+    def pre_check(self, blocking: bool = False) -> None:
+        """(reference pre_check diagnosis_master.py:99)"""
+        if blocking:
+            self._run_precheck()
+            return
+        self._precheck_thread = threading.Thread(
+            target=self._run_precheck,
+            name="pre-check",
+            daemon=True,
+        )
+        self._precheck_thread.start()
+
+    def _run_precheck(self) -> None:
+        if not self._precheck.run(self._job_manager):
+            # a failed chain must fail the job: agents block in
+            # wait_pre_check and the master would otherwise wait forever
+            self._job_manager.fail_job(
+                f"pre-check failed: {self._precheck.status()[1]}"
+            )
+
+    def pre_check_status(self):
+        return self._precheck.status()
+
+    # -- runtime diagnosis -------------------------------------------------
+
+    def observe_heartbeat(self, req) -> None:
+        """Fold one agent heartbeat into diagnosis state (gauges from the
+        profiler plane; step data goes to the PerfMonitor via the servicer).
+        Every heartbeat replaces the snapshot — an empty dict means the
+        node's collectors went silent and its old votes are void."""
+        self._node_gauges[req.node_id] = (
+            dict(getattr(req, "gauges", None) or {}), time.time()
+        )
+
+    def diagnose_once(self) -> None:
+        """Run every registered diagnostician once (tests drive this
+        directly instead of waiting out the periodic threads)."""
+        for name in list(self._registry._diagnosticians):
+            self._registry.diagnose(name)
+
+    def start(self) -> None:
+        self.pre_check()
+        self._registry.start_observing()
+
+    def stop(self) -> None:
+        self._registry.stop()

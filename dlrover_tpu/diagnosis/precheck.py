@@ -1,0 +1,155 @@
+"""Pre-check operator chain: gate training start on cluster health.
+
+Reference: dlrover/python/master/diagnosis/precheck_operator.py
+(``SchedulingPreCheckOperator``:91 — all nodes scheduled within a deadline;
+``ConnectionPreCheckOperator``:352 — all agents connected; ``NoPreCheckOperator``)
+driven by DiagnosisMaster.pre_check (diagnosis_master.py:99). Agents block in
+``wait_pre_check`` (elastic_run.py:265 analogue: agent/run.py) until PASS.
+
+TPU note: "scheduled" means the TPU hosts of the slice have registered with
+the master — a wedged host blocks the whole slice, so surfacing it *before*
+jax.distributed.initialize (which would hang) is the point of this chain.
+"""
+
+import time
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.common.constants import NodeStatus, PreCheckStatus
+from dlrover_tpu.common.log import logger
+
+
+class PreCheckResult:
+    def __init__(self, passed: bool = True, reason: str = "", abnormal_nodes=None):
+        self.passed = passed
+        self.reason = reason
+        self.abnormal_nodes: List[int] = abnormal_nodes or []
+
+
+class PreCheckOperator:
+    """Base operator (reference precheck_operator.py)."""
+
+    name = "base"
+    # how long check() may keep returning not-passed before the chain fails
+    timeout_s = 300.0
+    retry_interval_s = 0.5
+
+    def check(self, job_manager) -> PreCheckResult:
+        return PreCheckResult()
+
+    def run(self, job_manager) -> PreCheckResult:
+        """Poll check() until pass or timeout."""
+        deadline = time.time() + self.timeout_s
+        while True:
+            result = self.check(job_manager)
+            if result.passed or time.time() >= deadline:
+                return result
+            time.sleep(self.retry_interval_s)
+
+
+class NoPreCheckOperator(PreCheckOperator):
+    name = "no_check"
+
+
+class SchedulingPreCheckOperator(PreCheckOperator):
+    """All expected nodes have registered/started within the deadline
+    (reference SchedulingPreCheckOperator:91 — pod pending-timeout check)."""
+
+    name = "scheduling"
+
+    def __init__(self, timeout_s: float = 300.0):
+        self.timeout_s = timeout_s
+
+    def check(self, job_manager) -> PreCheckResult:
+        # a node is "scheduled" once its agent has contacted the master in
+        # any way (heartbeat_time is set by record_node_contact on pre-check
+        # polls — status stays INITIAL until the real heartbeat loop starts)
+        pending = [
+            n.id
+            for n in job_manager.nodes.values()
+            if n.heartbeat_time <= 0
+            and n.status in (NodeStatus.INITIAL, NodeStatus.PENDING)
+        ]
+        if pending:
+            return PreCheckResult(
+                passed=False,
+                reason=f"nodes not scheduled: {sorted(pending)}",
+                abnormal_nodes=pending,
+            )
+        return PreCheckResult()
+
+
+class ConnectionPreCheckOperator(PreCheckOperator):
+    """All running nodes have heartbeated recently — i.e. the agent on every
+    host can actually reach the master (reference
+    ConnectionPreCheckOperator:352)."""
+
+    name = "connection"
+
+    def __init__(self, timeout_s: float = 120.0, max_silence_s: float = 30.0):
+        self.timeout_s = timeout_s
+        self._max_silence_s = max_silence_s
+
+    def check(self, job_manager) -> PreCheckResult:
+        now = time.time()
+        silent = [
+            n.id
+            for n in job_manager.nodes.values()
+            if n.heartbeat_time <= 0
+            or now - n.heartbeat_time > self._max_silence_s
+        ]
+        if silent:
+            return PreCheckResult(
+                passed=False,
+                reason=f"agents not connected: {sorted(silent)}",
+                abnormal_nodes=silent,
+            )
+        return PreCheckResult()
+
+
+def get_precheck_operators(names: List[str]) -> List[PreCheckOperator]:
+    """Build the configured chain (reference: master args
+    ``--pre-check-ops``; empty/["no_check"] disables)."""
+    table = {
+        NoPreCheckOperator.name: NoPreCheckOperator,
+        SchedulingPreCheckOperator.name: SchedulingPreCheckOperator,
+        ConnectionPreCheckOperator.name: ConnectionPreCheckOperator,
+    }
+    ops = []
+    for name in names:
+        if name not in table:
+            logger.warning("unknown pre-check operator %r — skipping", name)
+            continue
+        ops.append(table[name]())
+    return ops
+
+
+class PreCheckRunner:
+    """Runs the chain once, exposes status for rpc_get_pre_check_result."""
+
+    def __init__(self, operators: Optional[List[PreCheckOperator]] = None):
+        self._operators = operators if operators is not None else []
+        self._status = (
+            PreCheckStatus.PASS if not self._operators
+            else PreCheckStatus.CHECKING
+        )
+        self._reason = ""
+
+    def status(self) -> Tuple[str, str]:
+        return self._status, self._reason
+
+    def run(self, job_manager) -> bool:
+        if not self._operators:
+            self._status = PreCheckStatus.PASS
+            return True
+        self._status = PreCheckStatus.CHECKING
+        for op in self._operators:
+            result = op.run(job_manager)
+            if not result.passed:
+                self._status = PreCheckStatus.FAIL
+                self._reason = f"{op.name}: {result.reason}"
+                logger.error("pre-check failed — %s", self._reason)
+                return False
+            logger.info("pre-check %s passed", op.name)
+        self._status = PreCheckStatus.PASS
+        self._reason = ""
+        return True

@@ -23,35 +23,17 @@ from dlrover_tpu.common.constants import (
 )
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.diagnosis.action import (  # noqa: F401 — re-exported
+    DiagnosisAction,
+    DiagnosisActionQueue,
+    JobAbortAction,
+)
 
 
 class NodeEvent:
     def __init__(self, event_type: str, node: Node):
         self.event_type = event_type
         self.node = node
-
-
-class DiagnosisAction:
-    """An action the control plane wants executed (reference
-    diagnosis/common/diagnosis_action.py). Kept as a tiny value object."""
-
-    def __init__(
-        self,
-        action_type: str = DiagnosisActionType.NONE,
-        instance: int = -1,
-        reason: str = "",
-        data: Optional[Dict] = None,
-    ):
-        self.action_type = action_type
-        self.instance = instance
-        self.reason = reason
-        self.data = data or {}
-        self.timestamp = time.time()
-        # node ids a broadcast (ANY_INSTANCE) action was delivered to
-        self.delivered: set = set()
-
-    def is_noop(self) -> bool:
-        return self.action_type == DiagnosisActionType.NONE
 
 
 class JobManager:
@@ -80,7 +62,7 @@ class JobManager:
         self._lock = threading.Lock()
         self._stopped = threading.Event()
         self._job_stage = JobStage.INIT
-        self._action_queue: List[DiagnosisAction] = []
+        self._action_queue = DiagnosisActionQueue()
         self._event_callbacks: List[Callable[[NodeEvent], None]] = []
         self._monitor_thread: Optional[threading.Thread] = None
         for node_id in range(node_num):
@@ -144,10 +126,7 @@ class JobManager:
     def report_heartbeat(
         self, node_id: int, timestamp: float
     ) -> DiagnosisAction:
-        node = self.get_node(node_id)
-        node.heartbeat_time = timestamp or time.time()
-        if node.status in (NodeStatus.INITIAL, NodeStatus.PENDING):
-            node.update_status(NodeStatus.RUNNING)
+        self.record_node_contact(node_id, timestamp, running=True)
         if self._job_stage == JobStage.FAILED:
             # a failed job aborts every surviving agent, regardless of which
             # node's failure tipped it over
@@ -157,6 +136,26 @@ class JobManager:
                 reason="job failed",
             )
         return self._next_action(node_id)
+
+    def record_node_contact(
+        self, node_id: int, timestamp: float = 0.0, running: bool = False
+    ) -> None:
+        """Any RPC from a node's agent proves it is scheduled + connected —
+        pre-check polling itself counts (agents poll get_pre_check_result
+        before they start heartbeating). Only the real heartbeat loop
+        promotes to RUNNING (``running=True``): promotion arms the
+        heartbeat-timeout monitor, which must not fire during the silent
+        window between pre-check and the agent's run loop (network check)."""
+        node = self.get_node(node_id)
+        node.heartbeat_time = timestamp or time.time()
+        if running and node.status in (NodeStatus.INITIAL, NodeStatus.PENDING):
+            node.update_status(NodeStatus.RUNNING)
+
+    def fail_job(self, reason: str) -> None:
+        """Fail the whole job (pre-check failure, abort actions)."""
+        logger.error("job %s failed: %s", self._job_name, reason)
+        self._job_stage = JobStage.FAILED
+        self.enqueue_action(JobAbortAction(reason=reason))
 
     def report_failure(
         self, node_id: int, error_data: str, level: str, restart_count: int
@@ -182,15 +181,16 @@ class JobManager:
             self._check_job_completed()
 
     def _handle_node_failure(self, node: Node) -> None:
-        if node.should_relaunch():
+        # without a scaler (standalone/local master) nobody can replace the
+        # node — a relaunchable failure is still a fatal one here
+        if node.should_relaunch() and self._scaler is not None:
             node.inc_relaunch_count()
             logger.info(
                 "relaunching node %s (attempt %s/%s)",
                 node.id, node.relaunch_count, node.max_relaunch_count,
             )
             node.update_status(NodeStatus.PENDING)
-            if self._scaler is not None:
-                self._scaler.relaunch_node(node)
+            self._scaler.relaunch_node(node)
         else:
             logger.error(
                 "node %s failed beyond relaunch budget — aborting job",
@@ -198,9 +198,7 @@ class JobManager:
             )
             self._job_stage = JobStage.FAILED
             self.enqueue_action(
-                DiagnosisAction(
-                    DiagnosisActionType.JOB_ABORT,
-                    instance=node.id,
+                JobAbortAction(
                     reason=f"node {node.id} exhausted relaunch budget",
                 )
             )
@@ -241,25 +239,7 @@ class JobManager:
     # -- diagnosis action queue (master → agent via heartbeat replies) -----
 
     def enqueue_action(self, action: DiagnosisAction) -> None:
-        with self._lock:
-            self._action_queue.append(action)
+        self._action_queue.add_action(action)
 
     def _next_action(self, node_id: int) -> DiagnosisAction:
-        from dlrover_tpu.common.constants import DiagnosisConstant
-
-        now = time.time()
-        with self._lock:
-            # prune expired actions so the queue can't grow unbounded
-            self._action_queue = [
-                a for a in self._action_queue
-                if now - a.timestamp <= DiagnosisConstant.ACTION_EXPIRY_S
-            ]
-            for i, action in enumerate(self._action_queue):
-                if action.instance == node_id:
-                    return self._action_queue.pop(i)
-                if action.instance == DiagnosisConstant.ANY_INSTANCE:
-                    # broadcast: deliver to each node once, expire later
-                    if node_id not in action.delivered:
-                        action.delivered.add(node_id)
-                        return action
-        return DiagnosisAction()
+        return self._action_queue.next_action(node_id)

@@ -55,6 +55,12 @@ class JobMaster:
         max_n = node_num if max_nodes is None else max_nodes
         for manager in self.rdzv_managers.values():
             manager.update_rdzv_params(min_n, max_n, node_unit=node_unit)
+        if diagnosis_master is None:
+            from dlrover_tpu.diagnosis.diagnosis_master import DiagnosisMaster
+
+            diagnosis_master = DiagnosisMaster(
+                self.job_manager, self.perf_monitor
+            )
         self.diagnosis_master = diagnosis_master
         self.servicer = MasterServicer(
             job_manager=self.job_manager,

@@ -184,6 +184,10 @@ class MasterServicer:
         node = self._job_manager.get_node(req.node_id)
         node.used_resource.cpu = req.cpu_percent
         node.used_resource.memory_mb = req.mem_used_mb
+        if req.device_util:
+            node.used_resource.device_util = sum(
+                req.device_util.values()
+            ) / len(req.device_util)
         return comm.BaseResponse()
 
     # -- pre-check ---------------------------------------------------------
@@ -191,6 +195,9 @@ class MasterServicer:
     def rpc_get_pre_check_result(
         self, req: comm.PreCheckRequest
     ) -> comm.PreCheckResponse:
+        # polling is proof of scheduling+connection — the pre-check
+        # operators read exactly this state, so record it or they deadlock
+        self._job_manager.record_node_contact(req.node_id)
         if self._diagnosis_master is None:
             return comm.PreCheckResponse(status="pass")
         status, reason = self._diagnosis_master.pre_check_status()

@@ -1,0 +1,325 @@
+"""Diagnosis subsystem tests: actions, pre-check chain, hang detection,
+restart-vs-relaunch verdicts (reference test model: SURVEY.md §4 —
+rendezvous/diagnosis managers driven directly with fake state)."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import (
+    DiagnosisActionType,
+    DiagnosisConstant,
+    NodeStatus,
+    PreCheckStatus,
+)
+from dlrover_tpu.diagnosis.action import (
+    DiagnosisAction,
+    DiagnosisActionQueue,
+    EventAction,
+    JobAbortAction,
+    NoAction,
+    NodeAction,
+)
+from dlrover_tpu.diagnosis.diagnosis_agent import (
+    DiagnosisAgent,
+    GaugeCollector,
+)
+from dlrover_tpu.diagnosis.diagnosis_master import (
+    HANG_GAUGE,
+    DiagnosisMaster,
+    TrainingHangDiagnostician,
+)
+from dlrover_tpu.diagnosis.precheck import (
+    ConnectionPreCheckOperator,
+    PreCheckRunner,
+    SchedulingPreCheckOperator,
+    get_precheck_operators,
+)
+from dlrover_tpu.master.job_manager import JobManager
+from dlrover_tpu.master.perf_monitor import PerfMonitor
+
+
+class TestActionQueue:
+    def test_targeted_delivery(self):
+        q = DiagnosisActionQueue()
+        q.add_action(NodeAction(2, DiagnosisActionType.RESTART_WORKER, "x"))
+        assert q.next_action(1).is_noop()
+        action = q.next_action(2)
+        assert action.action_type == DiagnosisActionType.RESTART_WORKER
+        assert q.next_action(2).is_noop()  # consumed
+
+    def test_broadcast_delivers_once_per_node(self):
+        q = DiagnosisActionQueue()
+        q.add_action(JobAbortAction("bad"))
+        assert q.next_action(0).action_type == DiagnosisActionType.JOB_ABORT
+        assert q.next_action(1).action_type == DiagnosisActionType.JOB_ABORT
+        assert q.next_action(0).is_noop()
+
+    def test_dedup_and_expiry(self):
+        q = DiagnosisActionQueue()
+        a = NodeAction(1, DiagnosisActionType.RESTART_WORKER)
+        q.add_action(a)
+        q.add_action(NodeAction(1, DiagnosisActionType.RESTART_WORKER))
+        assert len(q) == 1
+        a.timestamp -= DiagnosisConstant.ACTION_EXPIRY_S + 1
+        assert q.next_action(1).is_noop()
+
+    def test_noop_not_queued(self):
+        q = DiagnosisActionQueue()
+        q.add_action(NoAction())
+        assert len(q) == 0
+
+
+class TestPreCheck:
+    def _manager(self, n=2):
+        return JobManager("t", n)
+
+    def test_scheduling_fails_on_pending_nodes(self):
+        jm = self._manager()
+        op = SchedulingPreCheckOperator(timeout_s=0)
+        result = op.run(jm)
+        assert not result.passed
+        assert result.abnormal_nodes == [0, 1]
+        for node in jm.nodes.values():
+            node.update_status(NodeStatus.RUNNING)
+        assert op.run(jm).passed
+
+    def test_connection_requires_recent_heartbeats(self):
+        jm = self._manager()
+        op = ConnectionPreCheckOperator(timeout_s=0, max_silence_s=30)
+        assert not op.run(jm).passed
+        now = time.time()
+        for node in jm.nodes.values():
+            node.heartbeat_time = now
+        assert op.run(jm).passed
+
+    def test_runner_chain_and_status(self):
+        jm = self._manager(1)
+        jm.nodes[0].update_status(NodeStatus.RUNNING)
+        jm.nodes[0].heartbeat_time = time.time()
+        runner = PreCheckRunner(get_precheck_operators(
+            ["scheduling", "connection"]
+        ))
+        assert runner.status()[0] == PreCheckStatus.CHECKING
+        assert runner.run(jm)
+        assert runner.status()[0] == PreCheckStatus.PASS
+
+    def test_empty_chain_passes(self):
+        runner = PreCheckRunner([])
+        assert runner.status()[0] == PreCheckStatus.PASS
+        assert runner.run(self._manager())
+
+
+class TestHangDetection:
+    def test_no_stall_no_action(self):
+        pm = PerfMonitor()
+        pm.collect_global_step(10, time.time())
+        d = TrainingHangDiagnostician(pm, {})
+        assert d.diagnose().is_noop()
+
+    def test_stall_with_unanimous_gauges_restarts(self):
+        ctx = get_context()
+        ctx.set("hang_downtime_s", 0.01)
+        ctx.set("hang_restart_workers", True)
+        try:
+            pm = PerfMonitor()
+            pm.collect_global_step(10, time.time() - 100)
+            now = time.time()
+            gauges = {0: ({HANG_GAUGE: 1.0}, now), 1: ({HANG_GAUGE: 1.0}, now)}
+            d = TrainingHangDiagnostician(pm, gauges)
+            action = d.diagnose()
+            assert action.action_type == DiagnosisActionType.RESTART_WORKER
+            assert action.instance == DiagnosisConstant.ANY_INSTANCE
+        finally:
+            get_context().reset()
+
+    def test_stall_without_unanimity_is_event_only(self):
+        ctx = get_context()
+        ctx.set("hang_downtime_s", 0.01)
+        ctx.set("hang_restart_workers", True)
+        try:
+            pm = PerfMonitor()
+            pm.collect_global_step(10, time.time() - 100)
+            now = time.time()
+            gauges = {0: ({HANG_GAUGE: 1.0}, now), 1: ({HANG_GAUGE: 0.0}, now)}
+            d = TrainingHangDiagnostician(pm, gauges)
+            action = d.diagnose()
+            assert action.action_type == DiagnosisActionType.EVENT
+        finally:
+            get_context().reset()
+
+    def test_observe_only_by_default(self):
+        ctx = get_context()
+        ctx.set("hang_downtime_s", 0.01)
+        try:
+            pm = PerfMonitor()
+            pm.collect_global_step(10, time.time() - 100)
+            d = TrainingHangDiagnostician(pm, {})
+            action = d.diagnose()
+            assert action.action_type == DiagnosisActionType.EVENT
+        finally:
+            get_context().reset()
+
+
+class TestDiagnosisMaster:
+    def test_heartbeat_gauges_feed_hang_check(self):
+        jm = JobManager("t", 2)
+        pm = PerfMonitor()
+        dm = DiagnosisMaster(jm, pm, precheck_ops=[])
+
+        class Req:
+            node_id = 0
+            gauges = {HANG_GAUGE: 1.0}
+
+        dm.observe_heartbeat(Req())
+        assert dm._node_gauges[0][0][HANG_GAUGE] == 1.0
+
+    def test_hang_action_reaches_agent_heartbeat(self):
+        ctx = get_context()
+        ctx.set("hang_downtime_s", 0.01)
+        ctx.set("hang_restart_workers", True)
+        try:
+            jm = JobManager("t", 1)
+            pm = PerfMonitor()
+            pm.collect_global_step(5, time.time() - 100)
+            dm = DiagnosisMaster(jm, pm, precheck_ops=[])
+            dm.diagnose_once()
+            action = jm.report_heartbeat(0, time.time())
+            assert action.action_type == DiagnosisActionType.RESTART_WORKER
+        finally:
+            get_context().reset()
+
+    def test_precheck_status_via_master(self):
+        jm = JobManager("t", 1)
+        dm = DiagnosisMaster(jm, None, precheck_ops=[])
+        dm.pre_check(blocking=True)
+        assert dm.pre_check_status()[0] == PreCheckStatus.PASS
+
+
+class TestPreCheckOverRpc:
+    def test_polling_satisfies_scheduling_and_connection(self):
+        """Agents poll get_pre_check_result before they heartbeat — polling
+        itself must count as scheduled+connected or the chain deadlocks."""
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.common.config import Context
+        from dlrover_tpu.master.master import LocalJobMaster
+
+        Context.reset()
+        get_context().set("precheck_ops", ["scheduling", "connection"])
+        try:
+            master = LocalJobMaster(job_name="pc", node_num=1)
+            master.prepare()
+            try:
+                client = MasterClient(master.addr, 0, 0)
+                deadline = time.time() + 20
+                status = reason = None
+                while time.time() < deadline:
+                    status, reason = client.get_pre_check_result()
+                    if status == PreCheckStatus.PASS:
+                        break
+                    time.sleep(0.2)
+                assert status == PreCheckStatus.PASS, (status, reason)
+            finally:
+                master.stop()
+        finally:
+            Context.reset()
+
+    def test_failed_chain_fails_the_job(self):
+        from dlrover_tpu.common.constants import JobStage
+        from dlrover_tpu.diagnosis.precheck import PreCheckOperator, PreCheckResult
+
+        class AlwaysFail(PreCheckOperator):
+            name = "always_fail"
+            timeout_s = 0
+
+            def check(self, jm):
+                return PreCheckResult(passed=False, reason="nope")
+
+        jm = JobManager("t", 1)
+        dm = DiagnosisMaster(jm, None, precheck_ops=[])
+        dm._precheck = PreCheckRunner([AlwaysFail()])
+        dm.pre_check(blocking=True)
+        assert jm.job_stage == JobStage.FAILED
+        assert dm.pre_check_status()[0] == PreCheckStatus.FAIL
+
+    def test_hang_vote_ignores_nodes_without_gauge(self):
+        """Resource-only gauges (no XPU_TIMER) must not veto the hang
+        verdict — otherwise hang restart is unreachable without tpu_timer."""
+        ctx = get_context()
+        ctx.set("hang_downtime_s", 0.01)
+        ctx.set("hang_restart_workers", True)
+        try:
+            pm = PerfMonitor()
+            pm.collect_global_step(10, time.time() - 100)
+            now = time.time()
+            gauges = {
+                0: ({"node_cpu_percent": 50.0}, now),
+                1: ({"node_cpu_percent": 40.0}, now),
+            }
+            d = TrainingHangDiagnostician(pm, gauges)
+            action = d.diagnose()
+            assert action.action_type == DiagnosisActionType.RESTART_WORKER
+        finally:
+            get_context().reset()
+
+
+class TestDiagnosisAgent:
+    def test_restart_then_relaunch_ladder(self):
+        agent = DiagnosisAgent()
+        assert (
+            agent.diagnose_training_failure({0: 1}, restarts_remaining=2)
+            == DiagnosisActionType.RESTART_WORKER
+        )
+        assert (
+            agent.diagnose_training_failure({0: 1}, restarts_remaining=0)
+            == DiagnosisActionType.RELAUNCH_WORKER
+        )
+
+    def test_node_level_exit_code_relaunches_immediately(self):
+        agent = DiagnosisAgent()
+        # Popen encodes SIGABRT as -6; shells as 134 — both are node-level
+        for code in (-6, 134, -11, 139):
+            assert (
+                agent.diagnose_training_failure({0: code}, 5)
+                == DiagnosisActionType.RELAUNCH_WORKER
+            )
+
+    def test_stale_gauges_do_not_vote(self):
+        from dlrover_tpu.common.config import get_context
+        ctx = get_context()
+        ctx.set("hang_downtime_s", 0.01)
+        ctx.set("hang_restart_workers", True)
+        try:
+            pm = PerfMonitor()
+            pm.collect_global_step(10, time.time() - 100)
+            # node 1's snapshot is ancient (daemon died holding HANG=0):
+            # it must not veto the live nodes' unanimous hang vote
+            gauges = {
+                0: ({HANG_GAUGE: 1.0}, time.time()),
+                1: ({HANG_GAUGE: 0.0}, time.time() - 10_000),
+            }
+            d = TrainingHangDiagnostician(pm, gauges)
+            action = d.diagnose()
+            assert action.action_type == DiagnosisActionType.RESTART_WORKER
+        finally:
+            get_context().reset()
+
+    def test_collectors_merge_and_survive_errors(self):
+        class Good(GaugeCollector):
+            def collect(self):
+                return {"a": 1.0}
+
+        class Bad(GaugeCollector):
+            def collect(self):
+                raise RuntimeError("boom")
+
+        agent = DiagnosisAgent(collectors=[Good(), Bad()])
+        assert agent.collect_gauges() == {"a": 1.0}
+
+    def test_resource_collector_returns_floats(self):
+        agent = DiagnosisAgent()
+        gauges = agent.collect_gauges()
+        # psutil is available in the image; tpu_timer daemon is not running
+        assert "node_cpu_percent" in gauges
+        assert all(isinstance(v, float) for v in gauges.values())
