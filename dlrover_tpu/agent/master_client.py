@@ -191,13 +191,18 @@ class MasterClient:
             ),
         )
 
-    def report_resource_stats(self, cpu_percent: float, mem_used_mb: float) -> None:
+    def report_resource_stats(
+        self, cpu_percent: float, mem_used_mb: float,
+        device_util=None, device_mem_mb=None,
+    ) -> None:
         self._client.call(
             "report_resource_stats",
             comm.ResourceStats(
                 node_id=self._node_id,
                 cpu_percent=cpu_percent,
                 mem_used_mb=mem_used_mb,
+                device_util=device_util or {},
+                device_mem_mb=device_mem_mb or {},
             ),
         )
 

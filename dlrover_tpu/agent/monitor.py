@@ -1,0 +1,162 @@
+"""Agent-side monitors: host/chip resource usage + training progress.
+
+Reference: dlrover/python/elastic_agent/monitor/resource.py:86
+(``ResourceMonitor`` — psutil/pynvml usage reported to the master every 15 s)
+and monitor/training.py:40,75 (``TorchTrainingMonitor`` — global step read
+from a metrics file the worker writes, reported to the master).
+
+TPU redesign: device telemetry comes from PJRT ``memory_stats()`` plus the
+tpu_timer daemon's gauges rather than nvml; training progress flows through
+the agent-served :class:`SharedDict` IPC (the same channel Flash Checkpoint
+uses) instead of a file — workers publish ``{"step": N, "ts": ...}`` and the
+monitor forwards it to both the agent (hang bookkeeping) and the master
+(PerfMonitor speed/goodput).
+"""
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+
+TRAINING_METRICS_DICT = "training_metrics"
+
+
+def collect_host_usage() -> Dict[str, float]:
+    import psutil
+
+    vm = psutil.virtual_memory()
+    return {
+        "cpu_percent": psutil.cpu_percent(interval=None),
+        "mem_percent": vm.percent,
+        "mem_used_mb": vm.used / (1 << 20),
+    }
+
+
+def collect_device_stats() -> Dict[int, Dict[str, float]]:
+    """Per-local-device HBM usage via PJRT memory stats. Device *utilization*
+    (duty cycle) is only available from the profiler plane (tpu_timer) — the
+    agent process must NOT touch jax itself (it would grab the TPU from its
+    workers), so this reads nothing unless explicitly enabled."""
+    return {}
+
+
+class ResourceMonitor:
+    """Report host+device usage to the master periodically
+    (reference resource.py:86)."""
+
+    def __init__(
+        self,
+        client,
+        interval_s: float = 15.0,
+        extra_device_stats: Optional[Callable[[], Dict]] = None,
+    ):
+        self._client = client
+        self._interval_s = interval_s
+        self._extra_device_stats = extra_device_stats or collect_device_stats
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="resource-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def report_once(self) -> None:
+        usage = collect_host_usage()
+        devices = self._extra_device_stats()
+        # only forward fields that were actually measured: a device with
+        # memory stats but no duty cycle must NOT read as 0% utilization
+        # (None-means-no-telemetry — diagnosis would infer a false stall)
+        self._client.report_resource_stats(
+            cpu_percent=usage["cpu_percent"],
+            mem_used_mb=usage["mem_used_mb"],
+            device_util={
+                d: s["duty_cycle_pct"] for d, s in devices.items()
+                if "duty_cycle_pct" in s
+            },
+            device_mem_mb={
+                d: s["hbm_used_mb"] for d, s in devices.items()
+                if "hbm_used_mb" in s
+            },
+        )
+
+    def _loop(self) -> None:
+        # prime psutil's cpu_percent baseline
+        try:
+            collect_host_usage()
+        except Exception:  # noqa: BLE001
+            pass
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self.report_once()
+            except ConnectionError:
+                continue
+            except Exception:  # noqa: BLE001
+                logger.exception("resource report failed")
+
+
+class TrainingMonitor:
+    """Forward worker-published training progress to agent + master
+    (reference monitor/training.py:40 — there via a metrics file; here via
+    the agent-served SharedDict the workers already talk to)."""
+
+    def __init__(
+        self,
+        ipc_server,
+        client,
+        on_step: Optional[Callable[[int, float], None]] = None,
+        interval_s: float = 5.0,
+    ):
+        self._ipc_server = ipc_server
+        self._client = client
+        self._on_step = on_step
+        self._interval_s = interval_s
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_reported = -1
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="training-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def reset(self) -> None:
+        """Forget progress across a worker restart: restored workers may
+        resume from an earlier checkpointed step, and suppressing their
+        reports until they re-pass the pre-crash step would read as a hang."""
+        self._last_reported = -1
+        try:
+            self._ipc_server.local_dict(TRAINING_METRICS_DICT).clear()
+        except Exception:  # noqa: BLE001
+            logger.exception("training metrics reset failed")
+
+    def poll_once(self) -> Optional[int]:
+        metrics = self._ipc_server.local_dict(TRAINING_METRICS_DICT)
+        step = metrics.get("step")
+        if step is None or step <= self._last_reported:
+            return None
+        ts = metrics.get("ts", time.time())
+        self._last_reported = step
+        if self._on_step is not None:
+            self._on_step(step, ts)
+        try:
+            self._client.report_global_step(step, ts)
+        except ConnectionError:
+            pass
+        return step
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("training progress poll failed")

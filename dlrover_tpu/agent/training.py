@@ -36,6 +36,7 @@ from dlrover_tpu.common.constants import (
     TrainingExceptionLevel,
 )
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.event import AgentEvent, get_emitter
 from dlrover_tpu.common.multi_process import LocalIPCServer, ipc_socket_path
 from dlrover_tpu.common.rpc import find_free_port
 from dlrover_tpu.diagnosis.diagnosis_agent import DiagnosisAgent
@@ -167,12 +168,17 @@ class ElasticTrainingAgent:
         # node-side diagnosis: telemetry gauges for heartbeats + the
         # restart-vs-relaunch verdict on worker failure
         self._diagnosis = DiagnosisAgent()
+        self._events = get_emitter(f"agent_{config.node_rank}")
+        self._training_monitor = None
 
     # -- rendezvous + spawn ------------------------------------------------
 
     def _rendezvous(self) -> Tuple[str, int, int]:
         """(reference ``_rendezvous``:604)"""
-        rdzv_round, world, coordinator = self._rdzv_handler.next_rendezvous()
+        with self._events.span(AgentEvent.RENDEZVOUS):
+            rdzv_round, world, coordinator = (
+                self._rdzv_handler.next_rendezvous()
+            )
         self._current_round = rdzv_round
         self._world = world
         base_rank, world_size = assign_worker_ranks(
@@ -295,6 +301,7 @@ class ElasticTrainingAgent:
         (reference ``_restart_workers``:1225)."""
         logger.info("restarting workers on node %s: %s",
                     self._config.node_rank, reason)
+        self._events.instant(AgentEvent.RESTART, reason=reason)
         # stop first: shm survives the workers, and persisting after they
         # die removes any chance of reading a frame mid-write
         self._stop_workers()
@@ -302,9 +309,12 @@ class ElasticTrainingAgent:
         self._restart_count += 1
         # drop the stale step observation: heartbeats must not re-populate
         # the master's PerfMonitor with pre-restart timestamps (that would
-        # immediately re-arm the hang detector after a hang restart)
+        # immediately re-arm the hang detector after a hang restart), and
+        # restored workers may legitimately resume from an earlier step
         self._last_global_step = 0
         self._last_step_ts = 0.0
+        if getattr(self, "_training_monitor", None) is not None:
+            self._training_monitor.reset()
         self._initialize_workers()
 
     def _save_breakpoint_checkpoint(self, reason: str) -> None:
@@ -367,11 +377,27 @@ class ElasticTrainingAgent:
             target=self._heartbeat_loop, name="agent-heartbeat", daemon=True
         )
         self._hb_thread.start()
+        # periodic host-usage reports + worker-published step forwarding
+        # (reference monitor/resource.py:86, monitor/training.py:40)
+        from dlrover_tpu.agent.monitor import ResourceMonitor, TrainingMonitor
+        from dlrover_tpu.common.config import get_context
+
+        resource_monitor = ResourceMonitor(
+            self._client, interval_s=get_context().resource_report_interval_s
+        )
+        self._training_monitor = TrainingMonitor(
+            self._ipc_server, self._client,
+            on_step=self.observe_global_step,
+        )
+        resource_monitor.start()
+        self._training_monitor.start()
         try:
             self._initialize_workers()
             return self._monitor_loop()
         finally:
             self._stop_flag.set()
+            resource_monitor.stop()
+            self._training_monitor.stop()
             self._stop_workers()
             if self._ckpt_saver is not None:
                 self._ckpt_saver.stop()
@@ -429,6 +455,10 @@ class ElasticTrainingAgent:
         logger.warning(
             "node %s worker failure(s): %s",
             self._config.node_rank, result.failures,
+        )
+        self._events.instant(
+            AgentEvent.WORKER_FAIL, failures=result.failures,
+            restart_count=self._restart_count,
         )
         try:
             self._client.report_failure(

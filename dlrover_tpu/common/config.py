@@ -38,6 +38,8 @@ class DefaultValues:
     precheck_ops: list = field(default_factory=list)
     # --- autoscale ---
     autoscale_interval_s: float = 30.0
+    # --- monitors ---
+    resource_report_interval_s: float = 15.0
     # --- flash checkpoint ---
     ckpt_save_workers: int = 8
     ckpt_commit_poll_s: float = 0.1

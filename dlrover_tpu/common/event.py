@@ -1,0 +1,250 @@
+"""Structured training events: instants + duration spans, exporters, goodput.
+
+Reference: dlrover/python/training_event/ — ``DurationSpan`` (emitter.py:136),
+predefined master/agent events (predefined/_dlrover.py:37,52), file exporter
+(exporter.py), and the offline goodput analysis enabled by tailing the event
+files (diagnosis/datacollector/atorch_event_collector.py). The reference's
+spans let Ant compute *goodput* — productive training time over wall time —
+per job from logs alone; this build keeps that property.
+
+Format: one JSON object per line — ``{"ts", "name", "phase", "event_id",
+"content"}`` with phase ∈ {BEGIN, END, INSTANT}. A span is the BEGIN/END
+pair sharing an event_id.
+"""
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, TextIO
+
+from dlrover_tpu.common.log import logger
+
+
+class EventPhase:
+    BEGIN = "BEGIN"
+    END = "END"
+    INSTANT = "INSTANT"
+
+
+# predefined event names (reference predefined/_dlrover.py:37,52)
+class MasterEvent:
+    JOB_START = "master#job_start"
+    JOB_FINISH = "master#job_finish"
+    RENDEZVOUS = "master#rendezvous"
+    NODE_RELAUNCH = "master#node_relaunch"
+    FAULT_DETECT = "master#fault_detect"
+
+
+class AgentEvent:
+    START = "agent#start"
+    RENDEZVOUS = "agent#rendezvous"
+    WORKER_SPAWN = "agent#worker_spawn"
+    WORKER_FAIL = "agent#worker_fail"
+    RESTART = "agent#restart"
+    CKPT_SAVE = "agent#ckpt_save"
+    CKPT_RESTORE = "agent#ckpt_restore"
+
+
+class TrainEvent:
+    STEP = "train#step"
+    TRAINING = "train#training"  # the productive span goodput counts
+    CKPT_SAVE = "train#ckpt_save"
+    CKPT_RESTORE = "train#ckpt_restore"
+
+
+class Exporter:
+    def export(self, record: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+
+class LogExporter(Exporter):
+    def export(self, record: Dict[str, Any]) -> None:
+        logger.info("event %s", json.dumps(record, sort_keys=True))
+
+
+class FileExporter(Exporter):
+    """Append-only JSONL (reference exporter.py TextFileExporter)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh: Optional[TextIO] = None
+
+    def export(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            if self._fh is None:
+                self._fh = open(self._path, "a", buffering=1)
+            self._fh.write(json.dumps(record, sort_keys=True) + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class MemoryExporter(Exporter):
+    """Test/introspection sink."""
+
+    def __init__(self):
+        self.records: List[Dict[str, Any]] = []
+
+    def export(self, record: Dict[str, Any]) -> None:
+        self.records.append(record)
+
+
+class DurationSpan:
+    """begin()/end() pair or context manager (reference emitter.py:136)."""
+
+    def __init__(self, emitter: "EventEmitter", name: str, content: Dict):
+        self._emitter = emitter
+        self.name = name
+        self.content = content
+        self.event_id = next(emitter._ids)
+        self._begin_ts: Optional[float] = None
+
+    def begin(self) -> "DurationSpan":
+        self._begin_ts = time.time()
+        self._emitter._emit(
+            self.name, EventPhase.BEGIN, self.event_id, self.content
+        )
+        return self
+
+    def end(self, **extra) -> float:
+        """Returns the span duration in seconds."""
+        now = time.time()
+        duration = now - (self._begin_ts or now)
+        self._emitter._emit(
+            self.name, EventPhase.END, self.event_id,
+            {**self.content, **extra, "duration_s": duration},
+        )
+        return duration
+
+    def __enter__(self) -> "DurationSpan":
+        return self.begin()
+
+    def __exit__(self, exc_type, *_):
+        self.end(ok=exc_type is None)
+
+
+class EventEmitter:
+    """Per-process event source (reference emitter.py + predefined users)."""
+
+    def __init__(self, target: str = "", exporters: Optional[List[Exporter]] = None):
+        self.target = target  # "master" | "agent_<rank>" | "worker_<rank>"
+        self._exporters = exporters if exporters is not None else [LogExporter()]
+        self._ids = itertools.count(1)
+
+    def add_exporter(self, exporter: Exporter) -> None:
+        self._exporters.append(exporter)
+
+    def instant(self, name: str, **content) -> None:
+        self._emit(name, EventPhase.INSTANT, next(self._ids), content)
+
+    def span(self, name: str, **content) -> DurationSpan:
+        return DurationSpan(self, name, content)
+
+    def _emit(
+        self, name: str, phase: str, event_id: int, content: Dict
+    ) -> None:
+        record = {
+            "ts": time.time(),
+            "target": self.target,
+            "name": name,
+            "phase": phase,
+            "event_id": event_id,
+            "content": content,
+        }
+        for exporter in self._exporters:
+            try:
+                exporter.export(record)
+            except Exception:  # noqa: BLE001 — telemetry must not kill work
+                logger.exception("event export failed")
+
+
+_emitters: Dict[str, EventEmitter] = {}
+_default_lock = threading.Lock()
+
+
+def get_emitter(target: str = "") -> EventEmitter:
+    """Per-target process-wide emitter (two agents hosted in one test
+    process must not share an identity); writes JSONL next to the job when
+    ``DLROVER_TPU_EVENT_DIR`` is set."""
+    with _default_lock:
+        if target not in _emitters:
+            exporters: List[Exporter] = [LogExporter()]
+            event_dir = os.getenv("DLROVER_TPU_EVENT_DIR", "")
+            if event_dir:
+                exporters.append(FileExporter(os.path.join(
+                    event_dir, f"events_{target or os.getpid()}.jsonl"
+                )))
+            _emitters[target] = EventEmitter(target, exporters)
+        return _emitters[target]
+
+
+def reset_emitter() -> None:
+    with _default_lock:
+        _emitters.clear()
+
+
+# -- offline goodput analysis (reference AtorchEventCollector) --------------
+
+
+def compute_goodput(records: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Productive-time fraction from an event stream: the union of
+    ``train#training`` spans over the wall clock between the first BEGIN and
+    the last event. Unterminated spans (crash) count as unproductive from
+    BEGIN — exactly what a fault costs."""
+    intervals = []
+    opens: Dict[int, float] = {}
+    first_ts = last_ts = None
+    for r in records:
+        ts = r["ts"]
+        first_ts = ts if first_ts is None else min(first_ts, ts)
+        last_ts = ts if last_ts is None else max(last_ts, ts)
+        if r["name"] != TrainEvent.TRAINING:
+            continue
+        if r["phase"] == EventPhase.BEGIN:
+            opens[r["event_id"]] = ts
+        elif r["phase"] == EventPhase.END:
+            begin = opens.pop(r["event_id"], None)
+            if begin is not None:
+                intervals.append((begin, ts))
+    if first_ts is None or last_ts <= first_ts:
+        return {"wall_s": 0.0, "productive_s": 0.0, "goodput": 0.0}
+    # merge overlapping productive intervals
+    intervals.sort()
+    productive = 0.0
+    cur_start = cur_end = None
+    for start, end in intervals:
+        if cur_end is None or start > cur_end:
+            if cur_end is not None:
+                productive += cur_end - cur_start
+            cur_start, cur_end = start, end
+        else:
+            cur_end = max(cur_end, end)
+    if cur_end is not None:
+        productive += cur_end - cur_start
+    wall = last_ts - first_ts
+    return {
+        "wall_s": wall,
+        "productive_s": productive,
+        "goodput": productive / wall,
+    }
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail line from a crash
+    return records

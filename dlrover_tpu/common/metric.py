@@ -1,0 +1,111 @@
+"""Runtime metrics model: per-device TPU metrics, node aggregates, job context.
+
+Reference: dlrover/python/common/metric/metric.py:38,79 (``GpuMetric``/
+``NpuMetric`` + node aggregates) and metric/context.py:26
+(``JobMetricContext`` — bounded time-series the master's diagnosis reads).
+TPU redesign: the metric vocabulary is TPU-native (duty cycle, HBM,
+TensorCore utilization from libtpu/PJRT counters) instead of nvml fields,
+and the job context keys by node_id since TPU hosts are the failure unit.
+"""
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class TpuMetric:
+    """One chip's health sample (reference GpuMetric metric.py:38)."""
+
+    device_id: int = 0
+    duty_cycle_pct: float = 0.0  # fraction of time the core executed ops
+    hbm_used_mb: float = 0.0
+    hbm_total_mb: float = 0.0
+    tensorcore_util_pct: float = 0.0  # MXU issue rate when available
+
+    @property
+    def hbm_used_frac(self) -> float:
+        return (
+            self.hbm_used_mb / self.hbm_total_mb if self.hbm_total_mb else 0.0
+        )
+
+
+@dataclass
+class NodeMetrics:
+    """One host's sample: CPU/mem + its chips (reference NodeGpuMetric)."""
+
+    node_id: int = 0
+    timestamp: float = field(default_factory=time.time)
+    cpu_percent: float = 0.0
+    mem_percent: float = 0.0
+    mem_used_mb: float = 0.0
+    devices: List[TpuMetric] = field(default_factory=list)
+
+    def avg_duty_cycle(self) -> Optional[float]:
+        if not self.devices:
+            return None
+        return sum(d.duty_cycle_pct for d in self.devices) / len(self.devices)
+
+
+class JobMetricContext:
+    """Bounded per-node metric time-series (reference context.py:26).
+
+    The master's diagnosis reads windows of these to answer "did every
+    chip's duty cycle collapse" (the check_tensor_drop_zero analogue).
+    """
+
+    MAX_SAMPLES_PER_NODE = 240  # ~1h at 15 s cadence
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._series: "OrderedDict[int, List[NodeMetrics]]" = OrderedDict()
+
+    def add_node_metrics(self, metrics: NodeMetrics) -> None:
+        with self._lock:
+            series = self._series.setdefault(metrics.node_id, [])
+            series.append(metrics)
+            if len(series) > self.MAX_SAMPLES_PER_NODE:
+                series.pop(0)
+
+    def latest(self, node_id: int) -> Optional[NodeMetrics]:
+        with self._lock:
+            series = self._series.get(node_id)
+            return series[-1] if series else None
+
+    def window(self, node_id: int, seconds: float) -> List[NodeMetrics]:
+        cutoff = time.time() - seconds
+        with self._lock:
+            return [
+                m for m in self._series.get(node_id, [])
+                if m.timestamp >= cutoff
+            ]
+
+    def node_ids(self) -> List[int]:
+        with self._lock:
+            return list(self._series)
+
+    def all_duty_cycles_below(
+        self, threshold_pct: float, seconds: float
+    ) -> bool:
+        """True iff every node with device telemetry stayed under
+        ``threshold_pct`` duty cycle for the whole window (and at least one
+        node has telemetry) — the tensor-drop-zero hang signal."""
+        any_node = False
+        for node_id in self.node_ids():
+            window = self.window(node_id, seconds)
+            cycles = [
+                c for c in (m.avg_duty_cycle() for m in window)
+                if c is not None
+            ]
+            if not cycles:
+                continue
+            any_node = True
+            if any(c >= threshold_pct for c in cycles):
+                return False
+        return any_node
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()

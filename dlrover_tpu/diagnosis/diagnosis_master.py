@@ -97,27 +97,34 @@ class TrainingHangDiagnostician(Diagnostician):
 
 
 class MetricStallDiagnostician(Diagnostician):
-    """Device-utilization collapse: every node's reported device util dropped
-    to ~zero while the job claims to be training (reference
-    ``check_tensor_drop_zero`` diagnosis_master.py:359 over GPU tensor-core
-    metrics; here over the agents' ResourceStats device_util)."""
+    """Device-utilization collapse: every node's reported duty cycle stayed
+    near zero for a whole window while the job claims to be training
+    (reference ``check_tensor_drop_zero`` diagnosis_master.py:359 over GPU
+    tensor-core metrics; here over the JobMetricContext duty-cycle series —
+    nodes without telemetry abstain)."""
 
     name = "metric_stall"
 
-    def __init__(self, job_manager, stall_util: float = 0.5):
-        self._job_manager = job_manager
-        self._stall_util = stall_util
+    def __init__(
+        self,
+        metric_context,
+        stall_util_pct: float = 0.5,
+        window_s: float = 300.0,
+    ):
+        self._metric_context = metric_context
+        self._stall_util_pct = stall_util_pct
+        self._window_s = window_s
 
     def observe(self, **kwargs) -> Observation:
-        utils: List[float] = []
-        for node in self._job_manager.nodes.values():
-            if node.status != "running":
-                continue
-            if node.used_resource.device_util is None:
-                return Observation()  # no telemetry → no verdict
-            utils.append(node.used_resource.device_util)
-        if utils and all(u < self._stall_util for u in utils):
-            return Observation("device_stall", {"utils": utils})
+        if self._metric_context is None:
+            return Observation()
+        if self._metric_context.all_duty_cycles_below(
+            self._stall_util_pct, self._window_s
+        ):
+            return Observation("device_stall", {
+                "window_s": self._window_s,
+                "threshold_pct": self._stall_util_pct,
+            })
         return Observation()
 
     def resolve(self, observation: Observation, **kwargs) -> DiagnosisAction:
@@ -137,6 +144,7 @@ class DiagnosisMaster:
         job_manager,
         perf_monitor=None,
         precheck_ops: Optional[List[str]] = None,
+        metric_context=None,
     ):
         ctx = get_context()
         self._job_manager = job_manager
@@ -155,7 +163,7 @@ class DiagnosisMaster:
                 period_s=ctx.diagnosis_interval_s,
             )
         self._registry.register(
-            MetricStallDiagnostician(job_manager),
+            MetricStallDiagnostician(metric_context),
             period_s=ctx.diagnosis_interval_s,
         )
         self._precheck_thread: Optional[threading.Thread] = None

@@ -41,10 +41,18 @@ class JobMaster:
         scaler=None,
         diagnosis_master=None,
     ):
+        from dlrover_tpu.common.metric import JobMetricContext
+
         self.job_name = job_name
         self.job_manager = JobManager(job_name, node_num, scaler=scaler)
         self.perf_monitor = PerfMonitor()
         self.task_manager = TaskManager()
+        self.metric_context = JobMetricContext()
+        from dlrover_tpu.master.stats import JobMetricCollector
+
+        self.metric_collector = JobMetricCollector(
+            self.job_manager, self.perf_monitor
+        )
         self.kv_store = KVStoreService()
         self.sync_service = SyncService()
         self.rdzv_managers: Dict[str, RendezvousManager] = {
@@ -59,7 +67,8 @@ class JobMaster:
             from dlrover_tpu.diagnosis.diagnosis_master import DiagnosisMaster
 
             diagnosis_master = DiagnosisMaster(
-                self.job_manager, self.perf_monitor
+                self.job_manager, self.perf_monitor,
+                metric_context=self.metric_context,
             )
         self.diagnosis_master = diagnosis_master
         self.servicer = MasterServicer(
@@ -70,6 +79,7 @@ class JobMaster:
             task_manager=self.task_manager,
             perf_monitor=self.perf_monitor,
             diagnosis_master=diagnosis_master,
+            metric_context=self.metric_context,
         )
         self._server = RPCServer(port=port)
         self._server.register_object(self.servicer)
@@ -92,9 +102,15 @@ class JobMaster:
         return f"127.0.0.1:{self.port}"
 
     def prepare(self) -> None:
+        from dlrover_tpu.common.event import MasterEvent, get_emitter
+
+        get_emitter("master").instant(
+            MasterEvent.JOB_START, job=self.job_name
+        )
         self._server.start()
         self.job_manager.start()
         self.task_manager.start()
+        self.metric_collector.start()
         if self.diagnosis_master is not None:
             self.diagnosis_master.start()
         logger.info(
@@ -104,12 +120,15 @@ class JobMaster:
     def stop(self) -> None:
         self.job_manager.stop()
         self.task_manager.stop()
+        self.metric_collector.stop()
         if self.diagnosis_master is not None:
             self.diagnosis_master.stop()
         self._server.stop()
 
     def run(self, poll_s: float = 1.0) -> int:
         """Block until the job finishes (reference dist_master.py:276)."""
+        from dlrover_tpu.common.event import MasterEvent, get_emitter
+
         try:
             while True:
                 stage = self.job_manager.job_stage
@@ -121,6 +140,10 @@ class JobMaster:
                     return 1
                 time.sleep(poll_s)
         finally:
+            get_emitter("master").instant(
+                MasterEvent.JOB_FINISH,
+                job=self.job_name, stage=self.job_manager.job_stage,
+            )
             self.stop()
 
 

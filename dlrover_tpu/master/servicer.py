@@ -30,6 +30,7 @@ class MasterServicer:
         task_manager=None,
         perf_monitor=None,
         diagnosis_master=None,
+        metric_context=None,
     ):
         self._job_manager = job_manager
         self._rdzv_managers = rdzv_managers
@@ -38,6 +39,7 @@ class MasterServicer:
         self._task_manager = task_manager
         self._perf_monitor = perf_monitor
         self._diagnosis_master = diagnosis_master
+        self._metric_context = metric_context
         self._start_time = time.time()
 
     # -- rendezvous --------------------------------------------------------
@@ -188,6 +190,22 @@ class MasterServicer:
             node.used_resource.device_util = sum(
                 req.device_util.values()
             ) / len(req.device_util)
+        if self._metric_context is not None:
+            from dlrover_tpu.common.metric import NodeMetrics, TpuMetric
+
+            self._metric_context.add_node_metrics(NodeMetrics(
+                node_id=req.node_id,
+                cpu_percent=req.cpu_percent,
+                mem_used_mb=req.mem_used_mb,
+                devices=[
+                    TpuMetric(
+                        device_id=d,
+                        duty_cycle_pct=util,
+                        hbm_used_mb=req.device_mem_mb.get(d, 0.0),
+                    )
+                    for d, util in req.device_util.items()
+                ],
+            ))
         return comm.BaseResponse()
 
     # -- pre-check ---------------------------------------------------------

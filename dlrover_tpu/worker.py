@@ -44,6 +44,41 @@ class WorkerContext:
     def ipc_socket(self) -> str:
         return os.getenv("DLROVER_TPU_IPC_SOCKET", "")
 
+    def training_span(self, **content):
+        """The productive-time span offline goodput analysis counts
+        (common/event.py compute_goodput). Use around the training loop:
+
+            with ctx.training_span():
+                for batch in data: ...
+
+        Crashing inside the span leaves it unterminated — exactly the lost
+        time a fault costs."""
+        from dlrover_tpu.common.event import TrainEvent, get_emitter
+
+        return get_emitter(f"worker_{self.rank}").span(
+            TrainEvent.TRAINING, rank=self.rank, **content
+        )
+
+    def publish_step(self, step: int) -> None:
+        """Publish progress to the local agent via the SharedDict IPC (the
+        agent's TrainingMonitor forwards it to the master — reference
+        monitor/training.py:40 reads a metrics file instead). Cheaper than
+        :meth:`report_step` (unix socket, no cross-host RPC) and also feeds
+        the agent's own hang bookkeeping."""
+        if not self.ipc_socket:
+            return
+        from dlrover_tpu.agent.monitor import TRAINING_METRICS_DICT
+        from dlrover_tpu.common.multi_process import SharedDict
+
+        if not hasattr(self, "_metrics_dict"):
+            self._metrics_dict = SharedDict(
+                TRAINING_METRICS_DICT, self.ipc_socket
+            )
+        try:
+            self._metrics_dict.update({"step": step, "ts": time.time()})
+        except OSError:
+            pass
+
 
 def init(initialize_jax_distributed: bool = True) -> WorkerContext:
     """Bootstrap the worker from the agent-provided environment.

@@ -1,0 +1,139 @@
+"""Training-event spans, goodput computation, job stats collection."""
+
+import json
+import time
+
+from dlrover_tpu.common.event import (
+    DurationSpan,
+    EventEmitter,
+    EventPhase,
+    FileExporter,
+    MemoryExporter,
+    TrainEvent,
+    compute_goodput,
+    load_events,
+)
+from dlrover_tpu.master.job_manager import JobManager
+from dlrover_tpu.master.perf_monitor import PerfMonitor
+from dlrover_tpu.master.stats import JobMetricCollector, LocalStatsReporter
+
+
+class TestEmitter:
+    def test_span_begin_end_share_id(self):
+        sink = MemoryExporter()
+        em = EventEmitter("t", [sink])
+        span = em.span("x#y", foo=1)
+        span.begin()
+        time.sleep(0.01)
+        d = span.end(bar=2)
+        assert d >= 0.01
+        begin, end = sink.records
+        assert begin["phase"] == EventPhase.BEGIN
+        assert end["phase"] == EventPhase.END
+        assert begin["event_id"] == end["event_id"]
+        assert end["content"]["bar"] == 2
+        assert end["content"]["duration_s"] == d
+
+    def test_context_manager_marks_failure(self):
+        sink = MemoryExporter()
+        em = EventEmitter("t", [sink])
+        try:
+            with em.span("x#z"):
+                raise ValueError("boom")
+        except ValueError:
+            pass
+        assert sink.records[-1]["content"]["ok"] is False
+
+    def test_instant(self):
+        sink = MemoryExporter()
+        em = EventEmitter("t", [sink])
+        em.instant("a#b", n=3)
+        assert sink.records[0]["phase"] == EventPhase.INSTANT
+        assert sink.records[0]["content"] == {"n": 3}
+
+    def test_file_exporter_roundtrip(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        em = EventEmitter("t", [FileExporter(path)])
+        em.instant("a#b", k="v")
+        with em.span(TrainEvent.TRAINING):
+            pass
+        records = load_events(path)
+        assert len(records) == 3
+        assert records[0]["content"] == {"k": "v"}
+
+    def test_exporter_failure_does_not_raise(self):
+        class Bad:
+            def export(self, record):
+                raise RuntimeError("sink died")
+
+        em = EventEmitter("t", [Bad()])
+        em.instant("a#b")  # must not raise
+
+
+class TestGoodput:
+    def _rec(self, ts, name, phase, event_id):
+        return {"ts": ts, "name": name, "phase": phase, "event_id": event_id}
+
+    def test_simple_fraction(self):
+        t0 = 1000.0
+        records = [
+            self._rec(t0, TrainEvent.TRAINING, EventPhase.BEGIN, 1),
+            self._rec(t0 + 80, TrainEvent.TRAINING, EventPhase.END, 1),
+            self._rec(t0 + 100, "agent#restart", EventPhase.INSTANT, 2),
+        ]
+        g = compute_goodput(records)
+        assert abs(g["goodput"] - 0.8) < 1e-9
+        assert g["wall_s"] == 100.0
+
+    def test_unterminated_span_counts_as_lost(self):
+        t0 = 1000.0
+        records = [
+            self._rec(t0, TrainEvent.TRAINING, EventPhase.BEGIN, 1),
+            self._rec(t0 + 50, "agent#worker_fail", EventPhase.INSTANT, 2),
+        ]
+        g = compute_goodput(records)
+        assert g["goodput"] == 0.0
+
+    def test_overlapping_spans_merge(self):
+        t0 = 0.0
+        records = [
+            self._rec(t0, TrainEvent.TRAINING, EventPhase.BEGIN, 1),
+            self._rec(t0 + 5, TrainEvent.TRAINING, EventPhase.BEGIN, 2),
+            self._rec(t0 + 8, TrainEvent.TRAINING, EventPhase.END, 1),
+            self._rec(t0 + 10, TrainEvent.TRAINING, EventPhase.END, 2),
+        ]
+        g = compute_goodput(records)
+        assert g["productive_s"] == 10.0
+        assert g["goodput"] == 1.0
+
+    def test_empty(self):
+        assert compute_goodput([])["goodput"] == 0.0
+
+
+class TestStats:
+    def test_collect_once(self):
+        jm = JobManager("t", 2)
+        for node in jm.nodes.values():
+            node.update_status("running")
+            node.used_resource.cpu = 50.0
+            node.used_resource.memory_mb = 1000.0
+        jm.nodes[0].used_resource.device_util = 90.0
+        pm = PerfMonitor()
+        pm.collect_global_step(100, time.time())
+        collector = JobMetricCollector(jm, pm)
+        stats = collector.collect_once()
+        assert stats.node_count == 2
+        assert stats.running_nodes == 2
+        assert stats.cpu_percent_avg == 50.0
+        assert stats.mem_used_mb_total == 2000.0
+        assert stats.device_util_avg == 90.0
+        assert stats.global_step == 100
+        assert collector.reporter.latest() is stats
+
+    def test_reporter_bound(self):
+        r = LocalStatsReporter()
+        from dlrover_tpu.master.stats import JobRuntimeStats
+
+        for _ in range(r.MAX_SAMPLES + 5):
+            r.report(JobRuntimeStats())
+        assert len(r.history()) == r.MAX_SAMPLES

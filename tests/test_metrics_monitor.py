@@ -1,0 +1,193 @@
+"""Metrics model + agent monitors (reference test model: drive managers
+directly, use the real IPC server in-process — SURVEY.md §4)."""
+
+import time
+
+from dlrover_tpu.agent.monitor import (
+    TRAINING_METRICS_DICT,
+    ResourceMonitor,
+    TrainingMonitor,
+    collect_host_usage,
+)
+from dlrover_tpu.common.metric import (
+    JobMetricContext,
+    NodeMetrics,
+    TpuMetric,
+)
+
+
+class TestMetricModel:
+    def test_node_aggregate(self):
+        m = NodeMetrics(node_id=1, devices=[
+            TpuMetric(0, duty_cycle_pct=80.0, hbm_used_mb=100, hbm_total_mb=16_000),
+            TpuMetric(1, duty_cycle_pct=40.0),
+        ])
+        assert m.avg_duty_cycle() == 60.0
+        assert NodeMetrics(node_id=2).avg_duty_cycle() is None
+        assert abs(m.devices[0].hbm_used_frac - 100 / 16_000) < 1e-9
+
+    def test_context_window_and_bound(self):
+        ctx = JobMetricContext()
+        for i in range(ctx.MAX_SAMPLES_PER_NODE + 10):
+            ctx.add_node_metrics(NodeMetrics(node_id=0))
+        assert len(ctx.window(0, 1e9)) == ctx.MAX_SAMPLES_PER_NODE
+        assert ctx.latest(0) is not None
+        assert ctx.node_ids() == [0]
+
+    def test_all_duty_cycles_below(self):
+        ctx = JobMetricContext()
+        # no telemetry at all → no verdict
+        assert not ctx.all_duty_cycles_below(5.0, 60)
+        ctx.add_node_metrics(NodeMetrics(
+            node_id=0, devices=[TpuMetric(0, duty_cycle_pct=1.0)]
+        ))
+        ctx.add_node_metrics(NodeMetrics(
+            node_id=1, devices=[TpuMetric(0, duty_cycle_pct=2.0)]
+        ))
+        assert ctx.all_duty_cycles_below(5.0, 60)
+        ctx.add_node_metrics(NodeMetrics(
+            node_id=1, devices=[TpuMetric(0, duty_cycle_pct=90.0)]
+        ))
+        assert not ctx.all_duty_cycles_below(5.0, 60)
+
+
+class FakeClient:
+    def __init__(self):
+        self.resource_reports = []
+        self.steps = []
+
+    def report_resource_stats(self, **kwargs):
+        self.resource_reports.append(kwargs)
+
+    def report_global_step(self, step, ts):
+        self.steps.append((step, ts))
+
+
+class TestResourceMonitor:
+    def test_host_usage_shape(self):
+        usage = collect_host_usage()
+        assert set(usage) == {"cpu_percent", "mem_percent", "mem_used_mb"}
+        assert usage["mem_used_mb"] > 0
+
+    def test_report_once(self):
+        client = FakeClient()
+        mon = ResourceMonitor(client, extra_device_stats=lambda: {
+            0: {"duty_cycle_pct": 55.0, "hbm_used_mb": 123.0},
+        })
+        mon.report_once()
+        report = client.resource_reports[0]
+        assert report["cpu_percent"] >= 0
+        assert report["device_util"] == {0: 55.0}
+        assert report["device_mem_mb"] == {0: 123.0}
+
+
+class TestTrainingMonitor:
+    def test_forwards_fresh_steps_only(self):
+        class FakeIPC:
+            def __init__(self):
+                self._d = {}
+
+            def local_dict(self, name):
+                assert name == TRAINING_METRICS_DICT
+                return self._d
+
+        ipc = FakeIPC()
+        client = FakeClient()
+        seen = []
+        mon = TrainingMonitor(
+            ipc, client, on_step=lambda s, ts: seen.append(s)
+        )
+        assert mon.poll_once() is None  # nothing published yet
+        ipc._d.update({"step": 5, "ts": time.time()})
+        assert mon.poll_once() == 5
+        assert mon.poll_once() is None  # stale
+        ipc._d["step"] = 4
+        assert mon.poll_once() is None  # regression ignored
+        ipc._d["step"] = 9
+        assert mon.poll_once() == 9
+        assert seen == [5, 9]
+        assert [s for s, _ in client.steps] == [5, 9]
+
+
+def test_training_monitor_reset_allows_step_regression():
+    """After a restart+restore, workers resume from an earlier step — reset
+    must let those reports through (a suppressed catch-up window would read
+    as a hang on the master)."""
+    class FakeIPC:
+        def __init__(self):
+            self._d = {}
+
+        def local_dict(self, name):
+            return self._d
+
+    ipc = FakeIPC()
+    mon = TrainingMonitor(ipc, FakeClient())
+    ipc._d.update({"step": 150, "ts": time.time()})
+    assert mon.poll_once() == 150
+    mon.reset()
+    assert ipc._d == {}  # restored workers publish from scratch
+    ipc._d.update({"step": 100, "ts": time.time()})
+    assert mon.poll_once() == 100
+
+
+def test_resource_monitor_omits_unmeasured_fields():
+    """HBM-only stats must not turn into a 0% utilization sample."""
+    client = FakeClient()
+    mon = ResourceMonitor(client, extra_device_stats=lambda: {
+        0: {"hbm_used_mb": 8000.0},
+    })
+    mon.report_once()
+    report = client.resource_reports[0]
+    assert report["device_util"] == {}
+    assert report["device_mem_mb"] == {0: 8000.0}
+
+
+def test_worker_training_span_emits_goodput_events(tmp_path, monkeypatch):
+    from dlrover_tpu.common.event import (
+        compute_goodput, load_events, reset_emitter,
+    )
+    from dlrover_tpu.worker import WorkerContext
+
+    monkeypatch.setenv("DLROVER_TPU_EVENT_DIR", str(tmp_path))
+    reset_emitter()
+    try:
+        ctx = WorkerContext(
+            rank=3, world_size=4, local_rank=0, local_world_size=1,
+            node_rank=0, node_num=1, restart_count=0, master=None,
+        )
+        with ctx.training_span():
+            time.sleep(0.02)
+        records = load_events(str(tmp_path / "events_worker_3.jsonl"))
+        g = compute_goodput(records)
+        assert g["productive_s"] > 0
+        assert g["goodput"] > 0.9
+    finally:
+        reset_emitter()
+
+
+def test_worker_publish_step_roundtrip(tmp_path):
+    """Worker publish_step → agent IPC dict → TrainingMonitor, over the
+    real unix-socket server."""
+    from dlrover_tpu.common.multi_process import LocalIPCServer
+    from dlrover_tpu.worker import WorkerContext
+
+    sock = str(tmp_path / "ipc.sock")
+    server = LocalIPCServer(sock)
+    server.start()
+    try:
+        ctx = WorkerContext(
+            rank=0, world_size=1, local_rank=0, local_world_size=1,
+            node_rank=0, node_num=1, restart_count=0, master=None,
+        )
+        import os
+
+        os.environ["DLROVER_TPU_IPC_SOCKET"] = sock
+        try:
+            ctx.publish_step(42)
+        finally:
+            del os.environ["DLROVER_TPU_IPC_SOCKET"]
+        client = FakeClient()
+        mon = TrainingMonitor(server, client)
+        assert mon.poll_once() == 42
+    finally:
+        server.stop()
