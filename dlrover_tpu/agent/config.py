@@ -45,6 +45,9 @@ class ElasticLaunchConfig:
     worker_env: Dict[str, str] = field(default_factory=dict)
     # checkpoint dir the agent persists breakpoint saves into
     ckpt_dir: str = ""
+    # cross-host in-memory checkpoint redundancy: backup-group size
+    # (reference flash_checkpoint/replica.py; 0/1 disables)
+    ckpt_replica: int = 0
 
     def auto_configure_params(self) -> None:
         """Fill topology-dependent defaults from the environment

@@ -119,6 +119,9 @@ class TrainingMonitor:
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_reported = -1
+        # serializes poll_once vs reset: a reset landing mid-poll must not
+        # let the in-flight poll re-publish the pre-restart step
+        self._poll_lock = threading.Lock()
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -133,21 +136,23 @@ class TrainingMonitor:
         """Forget progress across a worker restart: restored workers may
         resume from an earlier checkpointed step, and suppressing their
         reports until they re-pass the pre-crash step would read as a hang."""
-        self._last_reported = -1
-        try:
-            self._ipc_server.local_dict(TRAINING_METRICS_DICT).clear()
-        except Exception:  # noqa: BLE001
-            logger.exception("training metrics reset failed")
+        with self._poll_lock:
+            self._last_reported = -1
+            try:
+                self._ipc_server.local_dict(TRAINING_METRICS_DICT).clear()
+            except Exception:  # noqa: BLE001
+                logger.exception("training metrics reset failed")
 
     def poll_once(self) -> Optional[int]:
-        metrics = self._ipc_server.local_dict(TRAINING_METRICS_DICT)
-        step = metrics.get("step")
-        if step is None or step <= self._last_reported:
-            return None
-        ts = metrics.get("ts", time.time())
-        self._last_reported = step
-        if self._on_step is not None:
-            self._on_step(step, ts)
+        with self._poll_lock:
+            metrics = self._ipc_server.local_dict(TRAINING_METRICS_DICT)
+            step = metrics.get("step")
+            if step is None or step <= self._last_reported:
+                return None
+            ts = metrics.get("ts", time.time())
+            self._last_reported = step
+            if self._on_step is not None:
+                self._on_step(step, ts)
         try:
             self._client.report_global_step(step, ts)
         except ConnectionError:

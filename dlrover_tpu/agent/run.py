@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--node_unit", "--node-unit", dest="node_unit",
                    type=int, default=1)
     p.add_argument("--ckpt_dir", "--ckpt-dir", dest="ckpt_dir", default="")
+    p.add_argument("--ckpt_replica", "--ckpt-replica", dest="ckpt_replica",
+                   type=int, default=0,
+                   help="cross-host checkpoint backup-group size (0=off)")
     p.add_argument("--no-save-at-breakpoint", dest="save_at_breakpoint",
                    action="store_false")
     p.add_argument("entrypoint", help="training script")
@@ -86,6 +89,7 @@ def config_from_args(args) -> ElasticLaunchConfig:
         node_unit=args.node_unit,
         save_at_breakpoint=args.save_at_breakpoint,
         ckpt_dir=args.ckpt_dir,
+        ckpt_replica=args.ckpt_replica,
         entrypoint=args.entrypoint,
         args=args.args[1:] if args.args[:1] == ["--"] else list(args.args),
     )

@@ -170,6 +170,7 @@ class ElasticTrainingAgent:
         self._diagnosis = DiagnosisAgent()
         self._events = get_emitter(f"agent_{config.node_rank}")
         self._training_monitor = None
+        self._replica_service = None
 
     # -- rendezvous + spawn ------------------------------------------------
 
@@ -229,6 +230,7 @@ class ElasticTrainingAgent:
             EnvKey.NUM_PROCESSES: str(world_size),
             EnvKey.RESTART_COUNT: str(self._restart_count),
             EnvKey.RDZV_ROUND: str(self._current_round),
+            EnvKey.REPLICA_GROUP: str(self._config.ckpt_replica),
             "DLROVER_TPU_IPC_SOCKET": self._ipc_server.path,
         })
         return env
@@ -365,6 +367,21 @@ class ElasticTrainingAgent:
     def run(self) -> int:
         """(reference ``_invoke_run``:969)"""
         self._ipc_server.start()
+        if self._config.ckpt_replica > 1:
+            # agent-hosted store for peers' shm frames; survives worker
+            # crashes and serves a relaunched peer its frame back
+            from dlrover_tpu.ckpt.replica import ReplicaManager, ReplicaService
+
+            self._replica_service = ReplicaService()
+            self._replica_service.start()
+            # registers this agent's reachable address in the master KV;
+            # workers (push) and relaunched peers (fetch) resolve it there
+            self._replica_manager = ReplicaManager(
+                self._config.job_name, self._config.node_rank,
+                self._config.max_nodes, self._client,
+                service=self._replica_service,
+                group_size=self._config.ckpt_replica,
+            )
         if self._ckpt_saver is not None:
             self._ckpt_saver.start(self._ipc_server)
             try:
@@ -401,6 +418,8 @@ class ElasticTrainingAgent:
             self._stop_workers()
             if self._ckpt_saver is not None:
                 self._ckpt_saver.stop()
+            if self._replica_service is not None:
+                self._replica_service.stop()
             self._ipc_server.stop()
 
     def _monitor_loop(self) -> int:

@@ -18,5 +18,6 @@ shards map 1:1 with mesh coordinates.
 """
 
 from dlrover_tpu.ckpt.checkpointer import Checkpointer, StorageType
+from dlrover_tpu.ckpt.replica import ReplicaManager, ReplicaService
 
-__all__ = ["Checkpointer", "StorageType"]
+__all__ = ["Checkpointer", "StorageType", "ReplicaManager", "ReplicaService"]

@@ -83,6 +83,7 @@ class CheckpointEngine:
         master_client=None,
         world_size: Optional[int] = None,
         rank: Optional[int] = None,
+        replica_manager=None,
     ):
         self.ckpt_dir = ckpt_dir
         self.job_name = job_name or os.getenv(EnvKey.JOB_NAME, "local")
@@ -124,7 +125,24 @@ class CheckpointEngine:
             self._event_queue = None
             self._meta_dict = None
         self._master = master_client
+        if replica_manager is None:
+            replica_manager = self._replica_manager_from_env()
+        self._replicas = replica_manager
         self._latest_step = -1
+
+    def _replica_manager_from_env(self):
+        """Workers under an agent with ``--ckpt-replica`` build their push
+        side automatically (peer addresses resolve via the master KV)."""
+        group = int(os.getenv(EnvKey.REPLICA_GROUP, "0"))
+        node_num = int(os.getenv(EnvKey.NODE_NUM, "1"))
+        if group <= 1 or node_num <= 1 or self._master is None:
+            return None
+        from dlrover_tpu.ckpt.replica import ReplicaManager
+
+        return ReplicaManager(
+            self.job_name, self.node_rank, node_num, self._master,
+            service=None, group_size=group,
+        )
 
     # -- save --------------------------------------------------------------
 
@@ -142,6 +160,10 @@ class CheckpointEngine:
         try:
             self._write_state_to_shm(step, state)
             self._latest_step = step
+            if self._replicas is not None:
+                # overlaps with training; reference replica.py:116 blocks on
+                # a gloo allgather here instead
+                self._replicas.backup_async(self._shm, self.local_rank)
             if self._meta_dict is not None:
                 self._meta_dict.set(
                     f"{self.node_rank}:{self.local_rank}",
@@ -317,6 +339,13 @@ class CheckpointEngine:
 
         Returns (state, step); step == -1 when nothing was restored.
         """
+        if self._replicas is not None:
+            # a relaunched node's shm is empty — pull own frame from a
+            # backup-group peer first (replica.py restore semantics)
+            try:
+                self._replicas.try_restore_shm(self._shm, self.local_rank)
+            except Exception as e:  # noqa: BLE001 — degrade to storage
+                logger.warning("replica restore failed: %r", e)
         step = self._shm_step_consistent()
         if step is not None and step >= 0:
             state = self._load_from_shm(target)

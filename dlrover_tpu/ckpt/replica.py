@@ -1,0 +1,282 @@
+"""Cross-host in-memory checkpoint replicas.
+
+Reference: dlrover/trainer/torch/flash_checkpoint/replica.py —
+``ShardCkptReplicaManager.backup``:116 gloo-allgathers the shm bytes across a
+backup group so a *relaunched* node (whose own shm died with the pod) can
+restore its shard from a surviving peer. TPU-native redesign:
+
+- the exchange rides a **host-side TCP path** (this module), never the
+  ICI/DCN data fabric — replicas must survive exactly the situations where
+  devices are wedged (SURVEY.md §5.8: control plane independent of the
+  data plane);
+- instead of a symmetric allgather (every member holds every shard), each
+  host *pushes* its frame to the other members of its backup group and
+  serves its stored peer frames over an RPC port registered in the master
+  KV store — same redundancy, but pair-wise transfers overlap with training
+  instead of a blocking collective;
+- the backup group is ``group_size`` consecutive node ranks (reference
+  replica.py:84 builds gloo groups the same way, over node ranks).
+
+Restore path (engine.load): local shm dead → fetch own frame from any group
+peer → write it back into local shm → normal shm restore continues.
+"""
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RPCClient, RPCServer, local_host_ip
+from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler
+
+
+def backup_peers(node_rank: int, node_num: int, group_size: int = 2) -> List[int]:
+    """Other members of this rank's backup group (consecutive-rank blocks;
+    the trailing partial block forms its own smaller group)."""
+    if group_size <= 1 or node_num <= 1:
+        return []
+    start = (node_rank // group_size) * group_size
+    end = min(start + group_size, node_num)
+    return [r for r in range(start, end) if r != node_rank]
+
+
+class ReplicaService:
+    """Serves this host's stored checkpoint frames (its own + peers') over
+    TCP. Runs inside the agent process so frames survive worker crashes."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._store: Dict[Tuple[int, int], Tuple[int, bytes]] = {}
+        self._lock = threading.Lock()
+        self._server = RPCServer(host, port)
+        self._server.register("replica_put", self._on_put)
+        self._server.register("replica_get", self._on_get)
+        self._server.register("replica_list", self._on_list)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop()
+
+    # -- local store -------------------------------------------------------
+
+    def put(self, owner_rank: int, local_rank: int, step: int,
+            blob: bytes) -> None:
+        with self._lock:
+            key = (owner_rank, local_rank)
+            held = self._store.get(key)
+            if held is None or held[0] <= step:
+                self._store[key] = (step, blob)
+
+    def get(self, owner_rank: int, local_rank: int) -> Optional[Tuple[int, bytes]]:
+        with self._lock:
+            return self._store.get((owner_rank, local_rank))
+
+    def entries(self) -> List[List[int]]:
+        with self._lock:
+            return [
+                [o, l, step] for (o, l), (step, _) in self._store.items()
+            ]
+
+    # -- rpc handlers ------------------------------------------------------
+
+    def _on_put(self, req: comm.ReplicaPutRequest) -> comm.BoolResponse:
+        self.put(req.owner_rank, req.local_rank, req.step, req.blob)
+        return comm.BoolResponse(value=True)
+
+    def _on_get(self, req: comm.ReplicaGetRequest) -> comm.ReplicaFrameResponse:
+        held = self.get(req.owner_rank, req.local_rank)
+        if held is None:
+            return comm.ReplicaFrameResponse(
+                found=False, owner_rank=req.owner_rank,
+                local_rank=req.local_rank,
+            )
+        step, blob = held
+        return comm.ReplicaFrameResponse(
+            found=True, owner_rank=req.owner_rank, local_rank=req.local_rank,
+            step=step, blob=blob,
+        )
+
+    def _on_list(self, req) -> comm.ReplicaListResponse:
+        return comm.ReplicaListResponse(entries=self.entries())
+
+
+class ReplicaManager:
+    """Client side: pushes this host's frames to group peers and fetches
+    frames back after a relaunch. Peer addresses live in the master KV store
+    under ``replica/{job}/addr/{node_rank}``."""
+
+    def __init__(
+        self,
+        job_name: str,
+        node_rank: int,
+        node_num: int,
+        master_client,
+        service: Optional[ReplicaService] = None,
+        group_size: int = 2,
+        host: Optional[str] = None,
+    ):
+        self.job_name = job_name
+        self.node_rank = node_rank
+        self.node_num = node_num
+        self.group_size = group_size
+        self._master = master_client
+        self._service = service
+        # the address peers dial — must be reachable cross-host, never
+        # loopback (override with DLROVER_TPU_HOST_IP in pod specs)
+        self._host = host or local_host_ip()
+        self._clients: Dict[int, RPCClient] = {}
+        self._backup_thread: Optional[threading.Thread] = None
+        if service is not None and master_client is not None:
+            master_client.kv_set(
+                self._addr_key(node_rank),
+                f"{self._host}:{service.port}".encode(),
+            )
+
+    def _addr_key(self, rank: int) -> str:
+        return f"replica/{self.job_name}/addr/{rank}"
+
+    @property
+    def peers(self) -> List[int]:
+        return backup_peers(self.node_rank, self.node_num, self.group_size)
+
+    def _peer_client(self, rank: int) -> Optional[RPCClient]:
+        client = self._clients.get(rank)
+        if client is not None:
+            return client
+        if self._master is None:
+            return None
+        addr = self._master.kv_get(self._addr_key(rank))
+        if not addr:
+            return None
+        client = RPCClient(addr.decode(), timeout_s=60.0, retries=3)
+        self._clients[rank] = client
+        return client
+
+    # -- backup ------------------------------------------------------------
+
+    def _push_blob(self, blob: bytes, step: int, local_rank: int) -> int:
+        """Distribute one frame snapshot to this node's agent store and
+        every group peer. Returns the number of stores that took it."""
+        acked = 0
+        if self._service is not None:
+            # agent-side manager: store directly — a *restarted worker
+            # process* (agent alive) restores from agent RAM even if the
+            # shm segment was torn down with the worker
+            self._service.put(self.node_rank, local_rank, step, blob)
+            acked += 1
+            targets = self.peers
+        else:
+            # worker-side manager: own node first (lands in the local
+            # agent's ReplicaService), then group peers
+            targets = [self.node_rank, *self.peers]
+        for rank in targets:
+            client = self._peer_client(rank)
+            if client is None:
+                continue
+            try:
+                client.call(
+                    "replica_put",
+                    comm.ReplicaPutRequest(
+                        owner_rank=self.node_rank, local_rank=local_rank,
+                        step=step, blob=blob,
+                    ),
+                )
+                acked += 1
+            except (ConnectionError, OSError) as e:
+                logger.warning("replica push to node %s failed: %r", rank, e)
+                self._clients.pop(rank, None)
+        return acked
+
+    def backup(self, shm: SharedMemoryHandler, local_rank: int = 0,
+               step: Optional[int] = None) -> int:
+        """Snapshot + push the current frame in ``shm``. Returns the number
+        of stores (local agent + peers) that acked."""
+        blob = shm.read_frame_bytes()
+        if blob is None:
+            return 0
+        step = shm.step if step is None else step
+        return self._push_blob(blob, step, local_rank)
+
+    def backup_async(self, shm: SharedMemoryHandler,
+                     local_rank: int = 0) -> None:
+        """Snapshot the frame NOW (caller still holds the engine save lock,
+        so the bytes are consistent) and push on a background thread — the
+        training step never waits on the host network. The reference's gloo
+        allgather *blocks* the step here (replica.py:116); overlapping the
+        push is the TPU-side win, and the synchronous part is one host-RAM
+        memcpy."""
+        if self._backup_thread is not None and self._backup_thread.is_alive():
+            return  # previous push still in flight; next save retries
+        blob = shm.read_frame_bytes()
+        if blob is None:
+            return
+        step = shm.step
+
+        def _run():
+            try:
+                self._push_blob(blob, step, local_rank)
+            except Exception as e:  # noqa: BLE001 — never kill training
+                logger.warning("async replica backup failed: %r", e)
+
+        self._backup_thread = threading.Thread(
+            target=_run, name="ckpt-replica-backup", daemon=True
+        )
+        self._backup_thread.start()
+
+    def wait_backup(self, timeout_s: float = 60.0) -> None:
+        if self._backup_thread is not None:
+            self._backup_thread.join(timeout_s)
+
+    # -- restore -----------------------------------------------------------
+
+    def fetch(self, local_rank: int = 0) -> Optional[Tuple[int, bytes]]:
+        """Fetch this host's latest frame: local agent store first (worker
+        restart with agent alive), then any group peer (pod relaunch)."""
+        best: Optional[Tuple[int, bytes]] = None
+        if self._service is not None:
+            held = self._service.get(self.node_rank, local_rank)
+            if held is not None:
+                best = held
+        remote_ranks = (
+            self.peers if self._service is not None
+            else [self.node_rank, *self.peers]
+        )
+        for rank in remote_ranks:
+            client = self._peer_client(rank)
+            if client is None:
+                continue
+            try:
+                resp = client.call(
+                    "replica_get",
+                    comm.ReplicaGetRequest(
+                        owner_rank=self.node_rank, local_rank=local_rank
+                    ),
+                )
+            except (ConnectionError, OSError):
+                self._clients.pop(rank, None)
+                continue
+            if resp.found and (best is None or resp.step > best[0]):
+                best = (resp.step, resp.blob)
+        return best
+
+    def try_restore_shm(self, shm: SharedMemoryHandler,
+                        local_rank: int = 0) -> int:
+        """If a peer holds a newer frame than local shm, write it back into
+        the local segment. Returns the restored step (-1 if nothing)."""
+        held = self.fetch(local_rank)
+        if held is None:
+            return -1
+        step, blob = held
+        if step <= shm.step:
+            return shm.step
+        shm.write_raw(blob)
+        logger.info(
+            "restored node %s local %s shm frame (step %s) from replica",
+            self.node_rank, local_rank, step,
+        )
+        return step

@@ -134,6 +134,13 @@ class SharedMemoryHandler:
             buf[pos : pos + n] = flat.data
             pos += n
 
+    def write_raw(self, blob: bytes) -> None:
+        """Write a complete pre-framed blob (e.g. a peer replica fetched
+        over TCP) into the segment verbatim."""
+        if not self._ensure(len(blob)):
+            raise RuntimeError(f"cannot create shm segment {self._name}")
+        self._shm.buf[: len(blob)] = blob
+
     # -- read --------------------------------------------------------------
 
     def read_meta(self) -> Optional[Dict]:

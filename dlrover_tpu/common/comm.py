@@ -359,3 +359,40 @@ class ParallelConfig:
     dataloader_version: int = 0
     grad_accum_steps: int = 0
     version: int = 0
+
+
+# --------------------------------------------------------------------------
+# Checkpoint replica exchange (host↔host, reference flash_checkpoint/replica.py)
+# --------------------------------------------------------------------------
+
+
+@message
+class ReplicaPutRequest:
+    """Push one shm checkpoint frame to a backup peer."""
+
+    owner_rank: int = 0      # node rank that produced the frame
+    local_rank: int = 0
+    step: int = -1
+    blob: bytes = b""
+
+
+@message
+class ReplicaGetRequest:
+    owner_rank: int = 0
+    local_rank: int = 0
+
+
+@message
+class ReplicaFrameResponse:
+    found: bool = False
+    owner_rank: int = 0
+    local_rank: int = 0
+    step: int = -1
+    blob: bytes = b""
+
+
+@message
+class ReplicaListResponse:
+    """(owner_rank, local_rank, step) triples held by a peer."""
+
+    entries: List[List[int]] = field(default_factory=list)

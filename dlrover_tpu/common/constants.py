@@ -163,6 +163,8 @@ class EnvKey:
     NUM_PROCESSES = "DLROVER_TPU_NUM_PROCESSES"
     RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
     RDZV_ROUND = "DLROVER_TPU_RDZV_ROUND"
+    # checkpoint replica backup-group size (0/1 = off)
+    REPLICA_GROUP = "DLROVER_TPU_REPLICA_GROUP"
     # fault injection for node-check benchmarks
     # (reference: trainer/torch/node_check/utils.py:52 MOCK_ERR_RANK)
     MOCK_ERR_RANK = "DLROVER_TPU_MOCK_ERR_RANK"

@@ -207,3 +207,25 @@ def find_free_port(host: str = "") -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.bind((host, 0))
         return s.getsockname()[1]
+
+
+def local_host_ip() -> str:
+    """The address other hosts should dial to reach services bound here.
+
+    ``DLROVER_TPU_HOST_IP`` (set by the operator/pod spec) wins; otherwise
+    the kernel's routing choice toward a public address (no packet is sent —
+    UDP connect only selects a source address)."""
+    import os
+
+    env = os.getenv("DLROVER_TPU_HOST_IP")
+    if env:
+        return env
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 53))
+            return s.getsockname()[0]
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"

@@ -197,13 +197,18 @@ class MasterServicer:
                 node_id=req.node_id,
                 cpu_percent=req.cpu_percent,
                 mem_used_mb=req.mem_used_mb,
+                # union of both sparse dicts: duty cycle is often absent on
+                # TPU (profiler plane only) while HBM stats arrive — a
+                # device reporting either must land in the context
                 devices=[
                     TpuMetric(
                         device_id=d,
-                        duty_cycle_pct=util,
+                        duty_cycle_pct=req.device_util.get(d, 0.0),
                         hbm_used_mb=req.device_mem_mb.get(d, 0.0),
                     )
-                    for d, util in req.device_util.items()
+                    for d in sorted(
+                        set(req.device_util) | set(req.device_mem_mb)
+                    )
                 ],
             ))
         return comm.BaseResponse()
