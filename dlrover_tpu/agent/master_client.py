@@ -182,13 +182,15 @@ class MasterClient:
             ),
         )
 
-    def report_global_step(self, step: int, timestamp: float = 0.0) -> None:
+    def report_global_step(self, step: int, timestamp: float = 0.0,
+                           retries: Optional[int] = None) -> None:
         self._client.call(
             "report_global_step",
             comm.GlobalStep(
                 node_id=self._node_id, step=step,
                 timestamp=timestamp or time.time(),
             ),
+            retries=retries,
         )
 
     def report_resource_stats(

@@ -120,8 +120,11 @@ class TrainingMonitor:
         self._thread: Optional[threading.Thread] = None
         self._last_reported = -1
         # serializes poll_once vs reset: a reset landing mid-poll must not
-        # let the in-flight poll re-publish the pre-restart step
+        # let the in-flight poll re-publish the pre-restart step; the
+        # generation lets the master publish (outside the lock — it can
+        # block on retries) detect a reset that landed after the read
         self._poll_lock = threading.Lock()
+        self._generation = 0
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -137,6 +140,7 @@ class TrainingMonitor:
         resume from an earlier checkpointed step, and suppressing their
         reports until they re-pass the pre-crash step would read as a hang."""
         with self._poll_lock:
+            self._generation += 1
             self._last_reported = -1
             try:
                 self._ipc_server.local_dict(TRAINING_METRICS_DICT).clear()
@@ -145,6 +149,7 @@ class TrainingMonitor:
 
     def poll_once(self) -> Optional[int]:
         with self._poll_lock:
+            gen = self._generation
             metrics = self._ipc_server.local_dict(TRAINING_METRICS_DICT)
             step = metrics.get("step")
             if step is None or step <= self._last_reported:
@@ -154,7 +159,11 @@ class TrainingMonitor:
             if self._on_step is not None:
                 self._on_step(step, ts)
         try:
-            self._client.report_global_step(step, ts)
+            # single attempt: a retry storm could deliver a pre-restart
+            # step minutes after a reset (the master also drops reports
+            # timestamped before its last re-rendezvous as a backstop)
+            if gen == self._generation:
+                self._client.report_global_step(step, ts, retries=1)
         except ConnectionError:
             pass
         return step

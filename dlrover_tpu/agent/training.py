@@ -370,17 +370,14 @@ class ElasticTrainingAgent:
         if self._config.ckpt_replica > 1:
             # agent-hosted store for peers' shm frames; survives worker
             # crashes and serves a relaunched peer its frame back
-            from dlrover_tpu.ckpt.replica import ReplicaManager, ReplicaService
+            from dlrover_tpu.ckpt.replica import ReplicaService
 
             self._replica_service = ReplicaService()
             self._replica_service.start()
-            # registers this agent's reachable address in the master KV;
+            # publish this agent's reachable address in the master KV;
             # workers (push) and relaunched peers (fetch) resolve it there
-            self._replica_manager = ReplicaManager(
-                self._config.job_name, self._config.node_rank,
-                self._config.max_nodes, self._client,
-                service=self._replica_service,
-                group_size=self._config.ckpt_replica,
+            self._replica_service.register(
+                self._client, self._config.job_name, self._config.node_rank
             )
         if self._ckpt_saver is not None:
             self._ckpt_saver.start(self._ipc_server)

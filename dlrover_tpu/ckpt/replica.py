@@ -22,11 +22,16 @@ peer → write it back into local shm → normal shm restore continues.
 """
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.log import logger
-from dlrover_tpu.common.rpc import RPCClient, RPCServer, local_host_ip
+from dlrover_tpu.common.rpc import RPCClient, RPCError, RPCServer, local_host_ip
+
+# one bad peer (dead, address reused, handler error) must never abort the
+# loop over the remaining peers
+_PEER_ERRORS = (ConnectionError, OSError, RPCError)
 from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler
 
 
@@ -46,6 +51,9 @@ class ReplicaService:
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0):
         self._store: Dict[Tuple[int, int], Tuple[int, bytes]] = {}
+        # in-flight chunked uploads: (owner, local, step) → {idx: bytes}
+        self._partial: Dict[Tuple[int, int, int], Dict[int, bytes]] = {}
+        self._partial_ts: Dict[Tuple[int, int, int], float] = {}
         self._lock = threading.Lock()
         self._server = RPCServer(host, port)
         self._server.register("replica_put", self._on_put)
@@ -56,6 +64,16 @@ class ReplicaService:
     def port(self) -> int:
         return self._server.port
 
+    def register(self, master_client, job_name: str, node_rank: int,
+                 host: Optional[str] = None) -> str:
+        """Publish this service's reachable address in the master KV (the
+        discovery point for worker pushes and peer fetches)."""
+        addr = f"{host or local_host_ip()}:{self.port}"
+        master_client.kv_set(
+            f"replica/{job_name}/addr/{node_rank}", addr.encode()
+        )
+        return addr
+
     def start(self) -> None:
         self._server.start()
 
@@ -64,6 +82,8 @@ class ReplicaService:
 
     # -- local store -------------------------------------------------------
 
+    PARTIAL_TTL_S = 3600.0
+
     def put(self, owner_rank: int, local_rank: int, step: int,
             blob: bytes) -> None:
         with self._lock:
@@ -71,6 +91,15 @@ class ReplicaService:
             held = self._store.get(key)
             if held is None or held[0] <= step:
                 self._store[key] = (step, blob)
+            # any in-flight chunked upload at or below this step is now
+            # moot; expire abandoned ones (dead uploader) by age too
+            now = time.monotonic()
+            for k in list(self._partial):
+                stale = k[:2] == key and k[2] <= step
+                expired = now - self._partial_ts.get(k, now) > self.PARTIAL_TTL_S
+                if stale or expired:
+                    self._partial.pop(k, None)
+                    self._partial_ts.pop(k, None)
 
     def get(self, owner_rank: int, local_rank: int) -> Optional[Tuple[int, bytes]]:
         with self._lock:
@@ -85,7 +114,22 @@ class ReplicaService:
     # -- rpc handlers ------------------------------------------------------
 
     def _on_put(self, req: comm.ReplicaPutRequest) -> comm.BoolResponse:
-        self.put(req.owner_rank, req.local_rank, req.step, req.blob)
+        if req.chunk_count <= 1:
+            self.put(req.owner_rank, req.local_rank, req.step, req.blob)
+            return comm.BoolResponse(value=True)
+        key = (req.owner_rank, req.local_rank, req.step)
+        with self._lock:
+            chunks = self._partial.setdefault(key, {})
+            self._partial_ts.setdefault(key, time.monotonic())
+            chunks[req.chunk_index] = req.blob
+            done = len(chunks) == req.chunk_count
+            if done:
+                blob = b"".join(chunks[i] for i in range(req.chunk_count))
+                del self._partial[key]
+                self._partial_ts.pop(key, None)
+        if done:
+            # put() also sweeps older/expired partials for this owner
+            self.put(req.owner_rank, req.local_rank, req.step, blob)
         return comm.BoolResponse(value=True)
 
     def _on_get(self, req: comm.ReplicaGetRequest) -> comm.ReplicaFrameResponse:
@@ -96,9 +140,17 @@ class ReplicaService:
                 local_rank=req.local_rank,
             )
         step, blob = held
+        if req.chunk_bytes <= 0:
+            return comm.ReplicaFrameResponse(
+                found=True, owner_rank=req.owner_rank,
+                local_rank=req.local_rank, step=step, blob=blob,
+            )
+        count = max(1, -(-len(blob) // req.chunk_bytes))
+        lo = req.chunk_index * req.chunk_bytes
         return comm.ReplicaFrameResponse(
             found=True, owner_rank=req.owner_rank, local_rank=req.local_rank,
-            step=step, blob=blob,
+            step=step, blob=blob[lo : lo + req.chunk_bytes],
+            chunk_index=req.chunk_index, chunk_count=count,
         )
 
     def _on_list(self, req) -> comm.ReplicaListResponse:
@@ -109,6 +161,10 @@ class ReplicaManager:
     """Client side: pushes this host's frames to group peers and fetches
     frames back after a relaunch. Peer addresses live in the master KV store
     under ``replica/{job}/addr/{node_rank}``."""
+
+    # frames can exceed the 4 GiB transport frame limit (big per-host
+    # model+optimizer shards) — split transfers well below it
+    CHUNK_BYTES = 256 * 1024 * 1024
 
     def __init__(
         self,
@@ -132,10 +188,8 @@ class ReplicaManager:
         self._clients: Dict[int, RPCClient] = {}
         self._backup_thread: Optional[threading.Thread] = None
         if service is not None and master_client is not None:
-            master_client.kv_set(
-                self._addr_key(node_rank),
-                f"{self._host}:{service.port}".encode(),
-            )
+            service.register(master_client, job_name, node_rank,
+                             host=self._host)
 
     def _addr_key(self, rank: int) -> str:
         return f"replica/{self.job_name}/addr/{rank}"
@@ -174,20 +228,27 @@ class ReplicaManager:
             # worker-side manager: own node first (lands in the local
             # agent's ReplicaService), then group peers
             targets = [self.node_rank, *self.peers]
+        n_chunks = max(1, -(-len(blob) // self.CHUNK_BYTES))
         for rank in targets:
             client = self._peer_client(rank)
             if client is None:
                 continue
             try:
-                client.call(
-                    "replica_put",
-                    comm.ReplicaPutRequest(
-                        owner_rank=self.node_rank, local_rank=local_rank,
-                        step=step, blob=blob,
-                    ),
-                )
+                for i in range(n_chunks):
+                    lo = i * self.CHUNK_BYTES
+                    client.call(
+                        "replica_put",
+                        comm.ReplicaPutRequest(
+                            owner_rank=self.node_rank,
+                            local_rank=local_rank,
+                            step=step,
+                            blob=blob[lo : lo + self.CHUNK_BYTES],
+                            chunk_index=i,
+                            chunk_count=n_chunks,
+                        ),
+                    )
                 acked += 1
-            except (ConnectionError, OSError) as e:
+            except _PEER_ERRORS as e:
                 logger.warning("replica push to node %s failed: %r", rank, e)
                 self._clients.pop(rank, None)
         return acked
@@ -251,18 +312,46 @@ class ReplicaManager:
             if client is None:
                 continue
             try:
-                resp = client.call(
-                    "replica_get",
-                    comm.ReplicaGetRequest(
-                        owner_rank=self.node_rank, local_rank=local_rank
-                    ),
-                )
-            except (ConnectionError, OSError):
+                held = self._fetch_from(client, local_rank)
+            except _PEER_ERRORS:
                 self._clients.pop(rank, None)
                 continue
-            if resp.found and (best is None or resp.step > best[0]):
-                best = (resp.step, resp.blob)
+            if held is not None and (best is None or held[0] > best[0]):
+                best = held
         return best
+
+    def _fetch_from(self, client: RPCClient,
+                    local_rank: int) -> Optional[Tuple[int, bytes]]:
+        """Chunked download of this node's frame from one peer. Restarts
+        once if the peer's stored frame advances mid-download."""
+        for _ in range(2):
+            resp = client.call(
+                "replica_get",
+                comm.ReplicaGetRequest(
+                    owner_rank=self.node_rank, local_rank=local_rank,
+                    chunk_index=0, chunk_bytes=self.CHUNK_BYTES,
+                ),
+            )
+            if not resp.found:
+                return None
+            step = resp.step
+            parts = [resp.blob]
+            consistent = True
+            for i in range(1, resp.chunk_count):
+                nxt = client.call(
+                    "replica_get",
+                    comm.ReplicaGetRequest(
+                        owner_rank=self.node_rank, local_rank=local_rank,
+                        chunk_index=i, chunk_bytes=self.CHUNK_BYTES,
+                    ),
+                )
+                if not nxt.found or nxt.step != step:
+                    consistent = False
+                    break
+                parts.append(nxt.blob)
+            if consistent:
+                return step, b"".join(parts)
+        return None
 
     def try_restore_shm(self, shm: SharedMemoryHandler,
                         local_rank: int = 0) -> int:

@@ -20,7 +20,11 @@ class TpuMetric:
     """One chip's health sample (reference GpuMetric metric.py:38)."""
 
     device_id: int = 0
-    duty_cycle_pct: float = 0.0  # fraction of time the core executed ops
+    # fraction of time the core executed ops; None = telemetry unavailable
+    # (on TPU the duty cycle needs the profiler plane — HBM stats arrive
+    # without it, and a device with memory stats only must NOT read as 0%
+    # utilization or diagnosis infers a false stall)
+    duty_cycle_pct: Optional[float] = None
     hbm_used_mb: float = 0.0
     hbm_total_mb: float = 0.0
     tensorcore_util_pct: float = 0.0  # MXU issue rate when available
@@ -44,9 +48,13 @@ class NodeMetrics:
     devices: List[TpuMetric] = field(default_factory=list)
 
     def avg_duty_cycle(self) -> Optional[float]:
-        if not self.devices:
+        cycles = [
+            d.duty_cycle_pct for d in self.devices
+            if d.duty_cycle_pct is not None
+        ]
+        if not cycles:
             return None
-        return sum(d.duty_cycle_pct for d in self.devices) / len(self.devices)
+        return sum(cycles) / len(cycles)
 
 
 class JobMetricContext:

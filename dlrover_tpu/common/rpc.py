@@ -66,7 +66,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 except Exception as e:  # noqa: BLE001 — report to caller
                     logger.exception("rpc handler %s failed", method)
                     resp = {"ok": False, "err": repr(e)}
-            if key[0] is not None:
+            # don't pin bulk payloads (checkpoint replica frames) in the
+            # dedup cache for thousands of entries — large responses come
+            # from idempotent methods, so replay-on-retry is safe
+            resp_bytes = len(resp.get("p", b"") or b"")
+            if key[0] is not None and resp_bytes <= 1024 * 1024:
                 with dedup_lock:
                     dedup[key] = resp
                     while len(dedup) > 8192:

@@ -29,15 +29,21 @@ class PerfMonitor:
         # goodput accounting: accumulated unproductive seconds
         self._fault_started: Optional[float] = None
         self._lost_seconds = 0.0
+        self._last_reset_ts = 0.0
 
     def reset_running_speed_monitor(self) -> None:
         """Called on re-rendezvous: speed samples from the old world are void
         (reference perf_monitor resets on worker count change)."""
         with self._lock:
             self._records.clear()
+            self._last_reset_ts = time.time()
 
     def collect_global_step(self, step: int, timestamp: float) -> None:
         with self._lock:
+            if timestamp and timestamp < self._last_reset_ts:
+                # a pre-restart report delivered late (agent retry storm)
+                # must not refresh progress after the world re-formed
+                return
             if self._records and step <= self._records[-1].step:
                 return
             self._records.append(GlobalStepRecord(step, timestamp))

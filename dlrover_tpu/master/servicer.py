@@ -197,13 +197,13 @@ class MasterServicer:
                 node_id=req.node_id,
                 cpu_percent=req.cpu_percent,
                 mem_used_mb=req.mem_used_mb,
-                # union of both sparse dicts: duty cycle is often absent on
-                # TPU (profiler plane only) while HBM stats arrive — a
-                # device reporting either must land in the context
+                # union of both sparse dicts; a device with HBM stats but
+                # no duty cycle keeps duty_cycle_pct=None (not 0.0 — that
+                # would read as a stall to diagnosis)
                 devices=[
                     TpuMetric(
                         device_id=d,
-                        duty_cycle_pct=req.device_util.get(d, 0.0),
+                        duty_cycle_pct=req.device_util.get(d),
                         hbm_used_mb=req.device_mem_mb.get(d, 0.0),
                     )
                     for d in sorted(

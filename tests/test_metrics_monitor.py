@@ -59,7 +59,7 @@ class FakeClient:
     def report_resource_stats(self, **kwargs):
         self.resource_reports.append(kwargs)
 
-    def report_global_step(self, step, ts):
+    def report_global_step(self, step, ts, retries=None):
         self.steps.append((step, ts))
 
 
