@@ -158,7 +158,7 @@ class MasterClient:
         )
 
     def heartbeat(self, global_step: int = 0, step_timestamp: float = 0.0,
-                  gauges=None) -> comm.HeartbeatResponse:
+                  gauges=None, rdzv_round: int = -1) -> comm.HeartbeatResponse:
         return self._client.call(
             "heartbeat",
             comm.HeartbeatRequest(
@@ -167,6 +167,7 @@ class MasterClient:
                 global_step=global_step,
                 step_timestamp=step_timestamp,
                 gauges=gauges or {},
+                rdzv_round=rdzv_round,
             ),
         )
 
@@ -183,12 +184,14 @@ class MasterClient:
         )
 
     def report_global_step(self, step: int, timestamp: float = 0.0,
-                           retries: Optional[int] = None) -> None:
+                           retries: Optional[int] = None,
+                           rdzv_round: int = -1) -> None:
         self._client.call(
             "report_global_step",
             comm.GlobalStep(
                 node_id=self._node_id, step=step,
                 timestamp=timestamp or time.time(),
+                rdzv_round=rdzv_round,
             ),
             retries=retries,
         )

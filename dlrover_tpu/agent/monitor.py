@@ -111,11 +111,15 @@ class TrainingMonitor:
         client,
         on_step: Optional[Callable[[int, float], None]] = None,
         interval_s: float = 5.0,
+        round_provider: Optional[Callable[[], int]] = None,
     ):
         self._ipc_server = ipc_server
         self._client = client
         self._on_step = on_step
         self._interval_s = interval_s
+        # stamps step reports with the agent's rendezvous round so the
+        # master can drop reports from a pre-restart world (clock-free)
+        self._round_provider = round_provider or (lambda: -1)
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_reported = -1
@@ -161,9 +165,12 @@ class TrainingMonitor:
         try:
             # single attempt: a retry storm could deliver a pre-restart
             # step minutes after a reset (the master also drops reports
-            # timestamped before its last re-rendezvous as a backstop)
+            # carrying an older rendezvous round as a backstop)
             if gen == self._generation:
-                self._client.report_global_step(step, ts, retries=1)
+                self._client.report_global_step(
+                    step, ts, retries=1,
+                    rdzv_round=self._round_provider(),
+                )
         except ConnectionError:
             pass
         return step

@@ -342,6 +342,7 @@ class ElasticTrainingAgent:
                     global_step=self._last_global_step,
                     step_timestamp=self._last_step_ts,
                     gauges=self._diagnosis.collect_gauges(),
+                    rdzv_round=self._current_round,
                 )
             except ConnectionError:
                 continue
@@ -402,6 +403,7 @@ class ElasticTrainingAgent:
         self._training_monitor = TrainingMonitor(
             self._ipc_server, self._client,
             on_step=self.observe_global_step,
+            round_provider=lambda: self._current_round,
         )
         resource_monitor.start()
         self._training_monitor.start()

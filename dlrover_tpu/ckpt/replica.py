@@ -50,7 +50,11 @@ class ReplicaService:
     TCP. Runs inside the agent process so frames survive worker crashes."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0):
-        self._store: Dict[Tuple[int, int], Tuple[int, bytes]] = {}
+        # (owner, local) → (step, blob, version); the version changes on
+        # EVERY overwrite (same-step re-pushes included) so a chunked
+        # download spanning an overwrite can detect the switch
+        self._store: Dict[Tuple[int, int], Tuple[int, bytes, int]] = {}
+        self._version_seq = 0
         # in-flight chunked uploads: (owner, local, step) → {idx: bytes}
         self._partial: Dict[Tuple[int, int, int], Dict[int, bytes]] = {}
         self._partial_ts: Dict[Tuple[int, int, int], float] = {}
@@ -90,7 +94,8 @@ class ReplicaService:
             key = (owner_rank, local_rank)
             held = self._store.get(key)
             if held is None or held[0] <= step:
-                self._store[key] = (step, blob)
+                self._version_seq += 1
+                self._store[key] = (step, blob, self._version_seq)
             # any in-flight chunked upload at or below this step is now
             # moot; expire abandoned ones (dead uploader) by age too
             now = time.monotonic()
@@ -103,12 +108,19 @@ class ReplicaService:
 
     def get(self, owner_rank: int, local_rank: int) -> Optional[Tuple[int, bytes]]:
         with self._lock:
+            held = self._store.get((owner_rank, local_rank))
+            return None if held is None else (held[0], held[1])
+
+    def _get_versioned(
+        self, owner_rank: int, local_rank: int
+    ) -> Optional[Tuple[int, bytes, int]]:
+        with self._lock:
             return self._store.get((owner_rank, local_rank))
 
     def entries(self) -> List[List[int]]:
         with self._lock:
             return [
-                [o, l, step] for (o, l), (step, _) in self._store.items()
+                [o, l, step] for (o, l), (step, _, _) in self._store.items()
             ]
 
     # -- rpc handlers ------------------------------------------------------
@@ -133,24 +145,25 @@ class ReplicaService:
         return comm.BoolResponse(value=True)
 
     def _on_get(self, req: comm.ReplicaGetRequest) -> comm.ReplicaFrameResponse:
-        held = self.get(req.owner_rank, req.local_rank)
+        held = self._get_versioned(req.owner_rank, req.local_rank)
         if held is None:
             return comm.ReplicaFrameResponse(
                 found=False, owner_rank=req.owner_rank,
                 local_rank=req.local_rank,
             )
-        step, blob = held
+        step, blob, version = held
         if req.chunk_bytes <= 0:
             return comm.ReplicaFrameResponse(
                 found=True, owner_rank=req.owner_rank,
                 local_rank=req.local_rank, step=step, blob=blob,
+                version=version,
             )
         count = max(1, -(-len(blob) // req.chunk_bytes))
         lo = req.chunk_index * req.chunk_bytes
         return comm.ReplicaFrameResponse(
             found=True, owner_rank=req.owner_rank, local_rank=req.local_rank,
             step=step, blob=blob[lo : lo + req.chunk_bytes],
-            chunk_index=req.chunk_index, chunk_count=count,
+            chunk_index=req.chunk_index, chunk_count=count, version=version,
         )
 
     def _on_list(self, req) -> comm.ReplicaListResponse:
@@ -334,7 +347,7 @@ class ReplicaManager:
             )
             if not resp.found:
                 return None
-            step = resp.step
+            step, version = resp.step, resp.version
             parts = [resp.blob]
             consistent = True
             for i in range(1, resp.chunk_count):
@@ -345,7 +358,9 @@ class ReplicaManager:
                         chunk_index=i, chunk_bytes=self.CHUNK_BYTES,
                     ),
                 )
-                if not nxt.found or nxt.step != step:
+                # a same-step overwrite mid-download changes the store
+                # version — mixing chunks across versions corrupts the frame
+                if not nxt.found or nxt.version != version:
                     consistent = False
                     break
                 parts.append(nxt.blob)

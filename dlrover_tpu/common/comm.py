@@ -213,6 +213,8 @@ class HeartbeatRequest:
     # most recent global step + timestamp the agent has observed
     global_step: int = 0
     step_timestamp: float = 0.0
+    # the agent's current rendezvous round (staleness token, see GlobalStep)
+    rdzv_round: int = -1
     # profiler-plane gauges (tpu_timer hang/latency families) forwarded so
     # the master's hang diagnostician can require all-node agreement
     gauges: Dict[str, float] = field(default_factory=dict)
@@ -323,6 +325,10 @@ class GlobalStep:
     node_id: int = 0
     step: int = 0
     timestamp: float = 0.0
+    # the rendezvous round the reporting agent is in: the master drops
+    # reports from older rounds (a clock-free staleness token — agent and
+    # master wall clocks must never be compared)
+    rdzv_round: int = -1
 
 
 @message
@@ -368,18 +374,24 @@ class ParallelConfig:
 
 @message
 class ReplicaPutRequest:
-    """Push one shm checkpoint frame to a backup peer."""
+    """Push one shm checkpoint frame (or one chunk of it) to a backup peer.
+    Frames can exceed the 4 GiB transport frame limit, so pushes are
+    chunked; the peer commits to its store when all chunks arrived."""
 
     owner_rank: int = 0      # node rank that produced the frame
     local_rank: int = 0
     step: int = -1
     blob: bytes = b""
+    chunk_index: int = 0
+    chunk_count: int = 1
 
 
 @message
 class ReplicaGetRequest:
     owner_rank: int = 0
     local_rank: int = 0
+    chunk_index: int = 0
+    chunk_bytes: int = 0  # 0 = whole frame in one response
 
 
 @message
@@ -389,6 +401,12 @@ class ReplicaFrameResponse:
     local_rank: int = 0
     step: int = -1
     blob: bytes = b""
+    chunk_index: int = 0
+    chunk_count: int = 1
+    # the peer store's monotonically-increasing version of this frame: a
+    # same-step overwrite changes it, so a chunked download spanning the
+    # overwrite is detected and restarted
+    version: int = 0
 
 
 @message

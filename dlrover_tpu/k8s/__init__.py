@@ -1,0 +1,11 @@
+"""Kubernetes control plane: API abstraction, TPU pod specs, scalers,
+watchers, and the ElasticJob reconciler (operator equivalent).
+
+Reference: dlrover/python/master/scaler/pod_scaler.py, watcher/k8s_watcher.py,
+scheduler/kubernetes.py, and the Go operator go/elasticjob/. TPU redesign:
+nodes are GKE TPU pod-slice hosts (`google.com/tpu` resources + topology
+selectors) instead of GPU pods, and the whole plane is programmed against a
+:class:`~dlrover_tpu.k8s.api.K8sApi` interface with an in-memory
+implementation, so single-host dev and tests run the identical scaler/
+watcher/reconciler code paths the cluster runs.
+"""

@@ -1,0 +1,405 @@
+"""Kubernetes API boundary.
+
+Reference: dlrover/python/scheduler/kubernetes.py:125 — a ``k8sClient``
+singleton that tests patch (SURVEY.md §4.2). This build makes the boundary
+an explicit interface instead of a patched singleton:
+
+- :class:`K8sApi` — the minimal surface the scalers/watchers/reconciler
+  need (pods, services, custom objects, watches);
+- :class:`InMemoryK8sApi` — a product-grade fake: full CRUD + watch streams
+  over in-process queues. It is the "local cluster" backend for dev and the
+  fixture for tests — the same scaler code runs against either;
+- :class:`RealK8sApi` — thin adapter over the official ``kubernetes``
+  client, import-gated so the package works without it installed.
+
+Objects are plain dicts in k8s manifest shape (``metadata``/``spec``/
+``status``) — no model classes to drift from the server's schema.
+"""
+
+import copy
+import queue
+import threading
+import time
+from abc import ABC, abstractmethod
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from dlrover_tpu.common.log import logger
+
+
+class WatchEvent:
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+    def __init__(self, event_type: str, obj: Dict):
+        self.type = event_type
+        self.object = obj
+
+    def __repr__(self) -> str:
+        name = self.object.get("metadata", {}).get("name", "?")
+        return f"WatchEvent({self.type}, {name})"
+
+
+class K8sApi(ABC):
+    """The API surface the control plane programs against."""
+
+    # -- pods --------------------------------------------------------------
+
+    @abstractmethod
+    def create_pod(self, namespace: str, pod: Dict) -> Dict: ...
+
+    @abstractmethod
+    def delete_pod(self, namespace: str, name: str) -> bool: ...
+
+    @abstractmethod
+    def get_pod(self, namespace: str, name: str) -> Optional[Dict]: ...
+
+    @abstractmethod
+    def list_pods(self, namespace: str,
+                  label_selector: str = "") -> List[Dict]: ...
+
+    @abstractmethod
+    def patch_pod_status(self, namespace: str, name: str,
+                         status: Dict) -> Optional[Dict]: ...
+
+    # -- services ----------------------------------------------------------
+
+    @abstractmethod
+    def create_service(self, namespace: str, service: Dict) -> Dict: ...
+
+    @abstractmethod
+    def get_service(self, namespace: str, name: str) -> Optional[Dict]: ...
+
+    # -- custom objects (ElasticJob / ScalePlan CRDs) ----------------------
+
+    @abstractmethod
+    def create_custom_object(self, namespace: str, plural: str,
+                             obj: Dict) -> Dict: ...
+
+    @abstractmethod
+    def get_custom_object(self, namespace: str, plural: str,
+                          name: str) -> Optional[Dict]: ...
+
+    @abstractmethod
+    def list_custom_objects(self, namespace: str,
+                            plural: str) -> List[Dict]: ...
+
+    @abstractmethod
+    def patch_custom_object(self, namespace: str, plural: str, name: str,
+                            patch: Dict) -> Optional[Dict]: ...
+
+    @abstractmethod
+    def delete_custom_object(self, namespace: str, plural: str,
+                             name: str) -> bool: ...
+
+    # -- watches -----------------------------------------------------------
+
+    @abstractmethod
+    def watch_pods(self, namespace: str, label_selector: str = "",
+                   timeout_s: Optional[float] = None
+                   ) -> Iterator[WatchEvent]: ...
+
+    @abstractmethod
+    def watch_custom_objects(self, namespace: str, plural: str,
+                             timeout_s: Optional[float] = None
+                             ) -> Iterator[WatchEvent]: ...
+
+
+def _match_selector(labels: Dict[str, str], selector: str) -> bool:
+    if not selector:
+        return True
+    for clause in selector.split(","):
+        k, _, v = clause.partition("=")
+        if labels.get(k.strip()) != v.strip():
+            return False
+    return True
+
+
+class InMemoryK8sApi(K8sApi):
+    """In-process cluster state with watch streams.
+
+    Watch semantics mirror list-watch: subscribers receive every mutation
+    made after subscription; ``list_*`` gives the current state for the
+    initial reconcile pass.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        # (namespace, kind-or-plural, name) → object
+        self._objects: Dict[Tuple[str, str, str], Dict] = {}
+        self._subscribers: List[Tuple[str, str, "queue.Queue[WatchEvent]"]] = []
+
+    # -- internals ---------------------------------------------------------
+
+    def _emit(self, namespace: str, kind: str, event: WatchEvent) -> None:
+        for ns, k, q in list(self._subscribers):
+            if ns == namespace and k == kind:
+                q.put(event)
+
+    def _put(self, namespace: str, kind: str, obj: Dict,
+             event_type: str) -> Dict:
+        name = obj["metadata"]["name"]
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            obj["metadata"].setdefault("namespace", namespace)
+            obj["metadata"].setdefault("creationTimestamp", time.time())
+            self._objects[(namespace, kind, name)] = obj
+        self._emit(namespace, kind, WatchEvent(event_type, copy.deepcopy(obj)))
+        return copy.deepcopy(obj)
+
+    def _get(self, namespace: str, kind: str, name: str) -> Optional[Dict]:
+        with self._lock:
+            obj = self._objects.get((namespace, kind, name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def _delete(self, namespace: str, kind: str, name: str) -> bool:
+        with self._lock:
+            obj = self._objects.pop((namespace, kind, name), None)
+        if obj is None:
+            return False
+        self._emit(namespace, kind,
+                   WatchEvent(WatchEvent.DELETED, copy.deepcopy(obj)))
+        return True
+
+    def _watch(self, namespace: str, kind: str,
+               timeout_s: Optional[float]) -> Iterator[WatchEvent]:
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        entry = (namespace, kind, q)
+        self._subscribers.append(entry)
+        deadline = None if timeout_s is None else time.time() + timeout_s
+        try:
+            while True:
+                remaining = (
+                    None if deadline is None else deadline - time.time()
+                )
+                if remaining is not None and remaining <= 0:
+                    return
+                try:
+                    yield q.get(timeout=remaining if remaining else 0.5)
+                except queue.Empty:
+                    if deadline is None:
+                        continue
+                    return
+        finally:
+            self._subscribers.remove(entry)
+
+    # -- pods --------------------------------------------------------------
+
+    def create_pod(self, namespace: str, pod: Dict) -> Dict:
+        pod.setdefault("status", {"phase": "Pending"})
+        return self._put(namespace, "pods", pod, WatchEvent.ADDED)
+
+    def delete_pod(self, namespace: str, name: str) -> bool:
+        return self._delete(namespace, "pods", name)
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Dict]:
+        return self._get(namespace, "pods", name)
+
+    def list_pods(self, namespace: str, label_selector: str = "") -> List[Dict]:
+        with self._lock:
+            pods = [
+                copy.deepcopy(o)
+                for (ns, kind, _), o in self._objects.items()
+                if ns == namespace and kind == "pods"
+            ]
+        return [
+            p for p in pods
+            if _match_selector(p["metadata"].get("labels", {}), label_selector)
+        ]
+
+    def patch_pod_status(self, namespace: str, name: str,
+                         status: Dict) -> Optional[Dict]:
+        with self._lock:
+            obj = self._objects.get((namespace, "pods", name))
+            if obj is None:
+                return None
+            obj.setdefault("status", {}).update(status)
+            snapshot = copy.deepcopy(obj)
+        self._emit(namespace, "pods",
+                   WatchEvent(WatchEvent.MODIFIED, copy.deepcopy(snapshot)))
+        return snapshot
+
+    # -- services ----------------------------------------------------------
+
+    def create_service(self, namespace: str, service: Dict) -> Dict:
+        return self._put(namespace, "services", service, WatchEvent.ADDED)
+
+    def get_service(self, namespace: str, name: str) -> Optional[Dict]:
+        return self._get(namespace, "services", name)
+
+    # -- custom objects ----------------------------------------------------
+
+    def create_custom_object(self, namespace: str, plural: str,
+                             obj: Dict) -> Dict:
+        return self._put(namespace, plural, obj, WatchEvent.ADDED)
+
+    def get_custom_object(self, namespace: str, plural: str,
+                          name: str) -> Optional[Dict]:
+        return self._get(namespace, plural, name)
+
+    def list_custom_objects(self, namespace: str, plural: str) -> List[Dict]:
+        with self._lock:
+            return [
+                copy.deepcopy(o)
+                for (ns, kind, _), o in self._objects.items()
+                if ns == namespace and kind == plural
+            ]
+
+    def patch_custom_object(self, namespace: str, plural: str, name: str,
+                            patch: Dict) -> Optional[Dict]:
+        with self._lock:
+            obj = self._objects.get((namespace, plural, name))
+            if obj is None:
+                return None
+            _deep_merge(obj, patch)
+            snapshot = copy.deepcopy(obj)
+        self._emit(namespace, plural,
+                   WatchEvent(WatchEvent.MODIFIED, copy.deepcopy(snapshot)))
+        return snapshot
+
+    def delete_custom_object(self, namespace: str, plural: str,
+                             name: str) -> bool:
+        return self._delete(namespace, plural, name)
+
+    # -- watches -----------------------------------------------------------
+
+    def watch_pods(self, namespace: str, label_selector: str = "",
+                   timeout_s: Optional[float] = None) -> Iterator[WatchEvent]:
+        for event in self._watch(namespace, "pods", timeout_s):
+            labels = event.object.get("metadata", {}).get("labels", {})
+            if _match_selector(labels, label_selector):
+                yield event
+
+    def watch_custom_objects(self, namespace: str, plural: str,
+                             timeout_s: Optional[float] = None
+                             ) -> Iterator[WatchEvent]:
+        yield from self._watch(namespace, plural, timeout_s)
+
+
+def _deep_merge(dst: Dict, src: Dict) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+
+
+class RealK8sApi(K8sApi):
+    """Adapter over the official ``kubernetes`` package (import-gated).
+
+    Reference: dlrover/python/scheduler/kubernetes.py k8sClient. Only the
+    surface the control plane uses is adapted; CRD group/version follow
+    :mod:`dlrover_tpu.k8s.crd`.
+    """
+
+    GROUP = "elastic.dlrover-tpu.org"
+    VERSION = "v1alpha1"
+
+    def __init__(self) -> None:
+        try:
+            from kubernetes import client, config, watch  # type: ignore
+        except ImportError as e:  # pragma: no cover — cluster-only path
+            raise RuntimeError(
+                "RealK8sApi needs the 'kubernetes' package; use "
+                "InMemoryK8sApi for local runs"
+            ) from e
+        try:  # pragma: no cover
+            config.load_incluster_config()
+        except Exception:  # noqa: BLE001 — fall back to kubeconfig
+            config.load_kube_config()
+        self._core = client.CoreV1Api()
+        self._custom = client.CustomObjectsApi()
+        self._watch_mod = watch
+
+    # pragma: no cover — exercised only on a real cluster
+    def create_pod(self, namespace, pod):
+        return self._core.create_namespaced_pod(namespace, pod).to_dict()
+
+    def delete_pod(self, namespace, name):
+        try:
+            self._core.delete_namespaced_pod(name, namespace)
+            return True
+        except Exception:  # noqa: BLE001
+            logger.exception("delete pod %s failed", name)
+            return False
+
+    def get_pod(self, namespace, name):
+        try:
+            return self._core.read_namespaced_pod(name, namespace).to_dict()
+        except Exception:  # noqa: BLE001
+            return None
+
+    def list_pods(self, namespace, label_selector=""):
+        ret = self._core.list_namespaced_pod(
+            namespace, label_selector=label_selector
+        )
+        return [p.to_dict() for p in ret.items]
+
+    def patch_pod_status(self, namespace, name, status):
+        return self._core.patch_namespaced_pod_status(
+            name, namespace, {"status": status}
+        ).to_dict()
+
+    def create_service(self, namespace, service):
+        return self._core.create_namespaced_service(
+            namespace, service
+        ).to_dict()
+
+    def get_service(self, namespace, name):
+        try:
+            return self._core.read_namespaced_service(
+                name, namespace
+            ).to_dict()
+        except Exception:  # noqa: BLE001
+            return None
+
+    def create_custom_object(self, namespace, plural, obj):
+        return self._custom.create_namespaced_custom_object(
+            self.GROUP, self.VERSION, namespace, plural, obj
+        )
+
+    def get_custom_object(self, namespace, plural, name):
+        try:
+            return self._custom.get_namespaced_custom_object(
+                self.GROUP, self.VERSION, namespace, plural, name
+            )
+        except Exception:  # noqa: BLE001
+            return None
+
+    def list_custom_objects(self, namespace, plural):
+        ret = self._custom.list_namespaced_custom_object(
+            self.GROUP, self.VERSION, namespace, plural
+        )
+        return ret.get("items", [])
+
+    def patch_custom_object(self, namespace, plural, name, patch):
+        return self._custom.patch_namespaced_custom_object(
+            self.GROUP, self.VERSION, namespace, plural, name, patch
+        )
+
+    def delete_custom_object(self, namespace, plural, name):
+        try:
+            self._custom.delete_namespaced_custom_object(
+                self.GROUP, self.VERSION, namespace, plural, name
+            )
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def watch_pods(self, namespace, label_selector="", timeout_s=None):
+        w = self._watch_mod.Watch()
+        for ev in w.stream(
+            self._core.list_namespaced_pod, namespace,
+            label_selector=label_selector,
+            timeout_seconds=int(timeout_s) if timeout_s else None,
+        ):
+            yield WatchEvent(ev["type"], ev["object"].to_dict())
+
+    def watch_custom_objects(self, namespace, plural, timeout_s=None):
+        w = self._watch_mod.Watch()
+        for ev in w.stream(
+            self._custom.list_namespaced_custom_object,
+            self.GROUP, self.VERSION, namespace, plural,
+            timeout_seconds=int(timeout_s) if timeout_s else None,
+        ):
+            yield WatchEvent(ev["type"], ev["object"])

@@ -1,0 +1,136 @@
+"""ElasticJob / ScalePlan custom-resource shapes.
+
+Reference: go/elasticjob/api/v1alpha1/elasticjob_types.go:29–130 — the
+``ElasticJob`` CRD (replica specs per node type, suspend, phases) and the
+``ScalePlan`` CRD the master emits for the operator to execute. TPU
+redesign: one worker replica type (SPMD), and the replica resource speaks
+GKE TPU vocabulary — accelerator type (e.g. ``tpu-v5-lite-podslice``),
+chips per host, and slice topology (``2x4``) instead of GPU counts.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+GROUP = "elastic.dlrover-tpu.org"
+VERSION = "v1alpha1"
+ELASTICJOB_PLURAL = "elasticjobs"
+SCALEPLAN_PLURAL = "scaleplans"
+
+
+class JobPhase:
+    """(reference elasticjob_types.go JobPhase values)"""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SCALING = "Scaling"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    SUSPENDED = "Suspended"
+
+
+@dataclass
+class TpuReplicaSpec:
+    """Worker replica spec (reference ReplicaSpec + GPU resources →
+    TPU slice vocabulary)."""
+
+    replicas: int = 1
+    min_replicas: int = 0          # elasticity floor (0 → replicas)
+    max_replicas: int = 0          # elasticity ceiling (0 → replicas)
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    cpu: float = 4.0
+    memory_mb: int = 8192
+    # GKE TPU scheduling vocabulary
+    accelerator: str = "tpu-v5-lite-podslice"   # gke-tpu-accelerator
+    topology: str = ""                          # gke-tpu-topology, e.g. 2x4
+    chips_per_host: int = 4                     # google.com/tpu request
+    env: Dict[str, str] = field(default_factory=dict)
+
+    def to_manifest(self) -> Dict:
+        return {
+            "replicas": self.replicas,
+            "minReplicas": self.min_replicas or self.replicas,
+            "maxReplicas": self.max_replicas or self.replicas,
+            "image": self.image,
+            "command": list(self.command),
+            "resources": {
+                "cpu": self.cpu,
+                "memoryMB": self.memory_mb,
+                "accelerator": self.accelerator,
+                "topology": self.topology,
+                "chipsPerHost": self.chips_per_host,
+            },
+            "env": dict(self.env),
+        }
+
+    @classmethod
+    def from_manifest(cls, m: Dict) -> "TpuReplicaSpec":
+        res = m.get("resources", {})
+        return cls(
+            replicas=m.get("replicas", 1),
+            min_replicas=m.get("minReplicas", 0),
+            max_replicas=m.get("maxReplicas", 0),
+            image=m.get("image", ""),
+            command=list(m.get("command", [])),
+            cpu=res.get("cpu", 4.0),
+            memory_mb=res.get("memoryMB", 8192),
+            accelerator=res.get("accelerator", "tpu-v5-lite-podslice"),
+            topology=res.get("topology", ""),
+            chips_per_host=res.get("chipsPerHost", 4),
+            env=dict(m.get("env", {})),
+        )
+
+
+def elastic_job(
+    name: str,
+    namespace: str = "default",
+    worker: Optional[TpuReplicaSpec] = None,
+    master_image: str = "",
+    suspend: bool = False,
+) -> Dict:
+    """Build an ElasticJob manifest (reference elasticjob_types.go:29)."""
+    worker = worker or TpuReplicaSpec()
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": "ElasticJob",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "suspend": suspend,
+            "masterImage": master_image or worker.image,
+            "replicaSpecs": {"worker": worker.to_manifest()},
+        },
+        "status": {"phase": JobPhase.PENDING, "conditions": []},
+    }
+
+
+def scale_plan(
+    job_name: str,
+    namespace: str = "default",
+    worker_replicas: Optional[int] = None,
+    launch_ids: Optional[List[int]] = None,
+    remove_ids: Optional[List[int]] = None,
+    name: str = "",
+) -> Dict:
+    """Build a ScalePlan manifest (reference elasticjob_types.go ScalePlan:
+    the master emits these; the operator/scaler executes them)."""
+    import time
+
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": "ScalePlan",
+        "metadata": {
+            "name": name or f"{job_name}-scale-{int(time.time() * 1000)}",
+            "namespace": namespace,
+            "labels": {"elasticjob-name": job_name},
+        },
+        "spec": {
+            "ownerJob": job_name,
+            "replicaSpecs": (
+                {"worker": {"replicas": worker_replicas}}
+                if worker_replicas is not None else {}
+            ),
+            "launchNodes": list(launch_ids or []),
+            "removeNodes": list(remove_ids or []),
+        },
+        "status": {"phase": "Pending"},
+    }

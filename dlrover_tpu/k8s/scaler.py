@@ -1,0 +1,211 @@
+"""Scalers: execute scale decisions by creating/deleting pods (PodScaler)
+or by emitting ScalePlan CRs for the operator (ElasticJobScaler).
+
+Reference: dlrover/python/master/scaler/pod_scaler.py:84 (``scale``:207,
+``_periodic_create_pod``:441, ``_create_pod``:493,
+``_create_service_for_pod``:665) and scaler/elasticjob_scaler.py. Same
+split here; the queue-and-thread creation pattern is kept (pod creation
+must survive transient API errors without blocking the master's event
+loop), but pods are TPU pod-slice hosts (specs.py).
+"""
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.k8s import crd, specs
+from dlrover_tpu.k8s.api import K8sApi
+
+
+@dataclass
+class ScalePlan:
+    """An in-process scale decision (reference scaler/base ScalePlan)."""
+
+    worker_num: Optional[int] = None          # desired total workers
+    launch_nodes: List[Node] = field(default_factory=list)
+    remove_nodes: List[Node] = field(default_factory=list)
+
+    def empty(self) -> bool:
+        return (
+            self.worker_num is None
+            and not self.launch_nodes
+            and not self.remove_nodes
+        )
+
+
+class Scaler:
+    """Interface the JobManager drives (job_manager.py ``_scaler``)."""
+
+    def scale(self, plan: ScalePlan) -> None:
+        raise NotImplementedError
+
+    def relaunch_node(self, node: Node) -> None:
+        self.scale(ScalePlan(launch_nodes=[node]))
+
+    def remove_node(self, node: Node) -> None:
+        self.scale(ScalePlan(remove_nodes=[node]))
+
+    def stop(self) -> None:
+        pass
+
+
+class PodScaler(Scaler):
+    """Creates/deletes TPU worker pods directly against the API.
+
+    A background thread drains a creation queue with retry (reference
+    ``_periodic_create_pod``:441): transient API failures re-queue the pod
+    instead of losing the node.
+    """
+
+    RETRY_DELAY_S = 3.0
+
+    def __init__(
+        self,
+        api: K8sApi,
+        job_name: str,
+        replica_spec: crd.TpuReplicaSpec,
+        master_addr: str,
+        namespace: str = "default",
+    ):
+        self._api = api
+        self._job = job_name
+        self._spec = replica_spec
+        self._master_addr = master_addr
+        self._namespace = namespace
+        self._queue: "queue.Queue[Node]" = queue.Queue()
+        self._stopped = threading.Event()
+        self._known_replicas = replica_spec.replicas
+        # node ids queued but not yet created: a second scale() must not
+        # re-queue them (the duplicate create would delete-and-recreate the
+        # pod, which the watcher reads as a node failure)
+        self._pending_ids = set()
+        self._pending_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._creation_loop, name="pod-creator", daemon=True
+        )
+        self._thread.start()
+
+    # -- Scaler ------------------------------------------------------------
+
+    def scale(self, plan: ScalePlan) -> None:
+        if plan.worker_num is not None:
+            self._resize(plan.worker_num)
+        for node in plan.launch_nodes:
+            self._enqueue(node)
+        for node in plan.remove_nodes:
+            self._delete_node_pods(node.id)
+
+    def _enqueue(self, node: Node) -> None:
+        with self._pending_lock:
+            if node.id in self._pending_ids:
+                return
+            self._pending_ids.add(node.id)
+        self._queue.put(node)
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    # -- internals ---------------------------------------------------------
+
+    def _resize(self, target: int) -> None:
+        """Grow/shrink to ``target`` workers by diffing live pods."""
+        alive = self._pods_by_node()
+        self._known_replicas = target
+        for node_id in range(target):
+            if node_id not in alive:
+                self._enqueue(Node(id=node_id, rank=node_id))
+        for node_id, pods in alive.items():
+            if node_id >= target:
+                for pod in pods:
+                    self._api.delete_pod(
+                        self._namespace, pod["metadata"]["name"]
+                    )
+
+    def _pods_by_node(self) -> Dict[int, List[Dict]]:
+        out: Dict[int, List[Dict]] = {}
+        for pod in self._api.list_pods(
+            self._namespace,
+            f"{specs.LABEL_JOB}={self._job},{specs.LABEL_TYPE}=worker",
+        ):
+            node_id = specs.pod_node_id(pod)
+            if node_id is not None:
+                out.setdefault(node_id, []).append(pod)
+        return out
+
+    def _delete_node_pods(self, node_id: int) -> None:
+        for pod in self._pods_by_node().get(node_id, []):
+            self._api.delete_pod(self._namespace, pod["metadata"]["name"])
+
+    def _creation_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                node = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if node.id >= self._known_replicas:
+                with self._pending_lock:
+                    self._pending_ids.discard(node.id)
+                continue  # a shrink raced the relaunch — drop it
+            try:
+                self._create_worker_pod(node)
+                with self._pending_lock:
+                    self._pending_ids.discard(node.id)
+            except Exception as e:  # noqa: BLE001 — retry, don't lose nodes
+                logger.warning(
+                    "pod creation for node %s failed (%r) — re-queueing",
+                    node.id, e,
+                )
+                time.sleep(self.RETRY_DELAY_S)
+                self._queue.put(node)
+
+    def _create_worker_pod(self, node: Node) -> None:
+        pod = specs.worker_pod(
+            self._job, node.id, self._spec, self._master_addr,
+            relaunch_count=node.relaunch_count, namespace=self._namespace,
+        )
+        name = pod["metadata"]["name"]
+        # delete stale predecessors only (older generations); the same
+        # generation already existing means this create is a duplicate —
+        # deleting it would read as a node failure to the watcher
+        for old in self._pods_by_node().get(node.id, []):
+            if old["metadata"]["name"] == name:
+                return
+            if specs.pod_generation(old) < node.relaunch_count:
+                self._api.delete_pod(
+                    self._namespace, old["metadata"]["name"]
+                )
+        self._api.create_pod(self._namespace, pod)
+        logger.info("created worker pod %s", name)
+
+
+class ElasticJobScaler(Scaler):
+    """Emits ScalePlan custom resources instead of touching pods — the
+    operator (or an external controller) executes them
+    (reference scaler/elasticjob_scaler.py)."""
+
+    def __init__(self, api: K8sApi, job_name: str,
+                 namespace: str = "default"):
+        self._api = api
+        self._job = job_name
+        self._namespace = namespace
+
+    def scale(self, plan: ScalePlan) -> None:
+        if plan.empty():
+            return
+        manifest = crd.scale_plan(
+            self._job,
+            namespace=self._namespace,
+            worker_replicas=plan.worker_num,
+            launch_ids=[n.id for n in plan.launch_nodes],
+            remove_ids=[n.id for n in plan.remove_nodes],
+        )
+        self._api.create_custom_object(
+            self._namespace, crd.SCALEPLAN_PLURAL, manifest
+        )
+        logger.info(
+            "emitted ScalePlan %s", manifest["metadata"]["name"]
+        )

@@ -1,0 +1,165 @@
+"""Pod/service manifest builders for TPU elastic jobs.
+
+Reference: dlrover/python/master/scaler/pod_scaler.py:493 (``_create_pod``)
+and go/elasticjob/pkg/common/resource.go build GPU worker pods; here the
+worker pod is a **GKE TPU pod-slice host**: ``google.com/tpu`` chip
+requests plus the ``cloud.google.com/gke-tpu-accelerator`` /
+``gke-tpu-topology`` node selectors that make GKE schedule the pod onto
+one host of a TPU slice. Env wiring carries the master address and node
+rank the agent needs (the TPU runtime supplies its own topology env).
+"""
+
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.k8s.crd import TpuReplicaSpec
+
+LABEL_JOB = "elasticjob-name"
+LABEL_TYPE = "replica-type"
+LABEL_RANK = "replica-rank"
+# which relaunch incarnation this pod is — watchers drop events from stale
+# generations (a replaced pod's deletion must not re-fail the node)
+LABEL_GENERATION = "replica-generation"
+
+
+def worker_pod_name(job_name: str, node_id: int, relaunch_count: int = 0) -> str:
+    # relaunch count in the name: a replacement pod must not collide with a
+    # terminating predecessor (reference pod_scaler naming)
+    return f"{job_name}-worker-{node_id}-{relaunch_count}"
+
+
+def master_pod_name(job_name: str) -> str:
+    return f"{job_name}-master"
+
+
+def master_service_name(job_name: str) -> str:
+    return f"{job_name}-master"
+
+
+def worker_pod(
+    job_name: str,
+    node_id: int,
+    spec: TpuReplicaSpec,
+    master_addr: str,
+    relaunch_count: int = 0,
+    namespace: str = "default",
+) -> Dict:
+    env = [
+        {"name": EnvKey.JOB_NAME, "value": job_name},
+        {"name": EnvKey.MASTER_ADDR, "value": master_addr},
+        {"name": EnvKey.NODE_ID, "value": str(node_id)},
+        {"name": EnvKey.NODE_RANK, "value": str(node_id)},
+        {"name": "NODE_RANK", "value": str(node_id)},
+    ]
+    env += [{"name": k, "value": v} for k, v in spec.env.items()]
+    resources = {
+        "requests": {
+            "cpu": str(spec.cpu),
+            "memory": f"{spec.memory_mb}Mi",
+        },
+        "limits": {},
+    }
+    node_selector = {}
+    if spec.chips_per_host > 0:
+        # chips must appear in limits (extended resources require
+        # requests == limits; GKE rejects requests-only TPU asks)
+        resources["limits"]["google.com/tpu"] = str(spec.chips_per_host)
+        resources["requests"]["google.com/tpu"] = str(spec.chips_per_host)
+        node_selector["cloud.google.com/gke-tpu-accelerator"] = (
+            spec.accelerator
+        )
+        if spec.topology:
+            node_selector["cloud.google.com/gke-tpu-topology"] = spec.topology
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": worker_pod_name(job_name, node_id, relaunch_count),
+            "namespace": namespace,
+            "labels": {
+                LABEL_JOB: job_name,
+                LABEL_TYPE: "worker",
+                LABEL_RANK: str(node_id),
+                LABEL_GENERATION: str(relaunch_count),
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",  # relaunch is the master's decision
+            "nodeSelector": node_selector,
+            "containers": [{
+                "name": "worker",
+                "image": spec.image,
+                "command": list(spec.command),
+                "env": env,
+                "resources": resources,
+            }],
+        },
+    }
+
+
+def master_pod(
+    job_name: str,
+    image: str,
+    namespace: str = "default",
+    node_num: int = 1,
+    port: int = 50001,
+    command: Optional[List[str]] = None,
+) -> Dict:
+    """(reference go/elasticjob/pkg/controllers/master.go:53
+    ``ReconcileJobMasterPod``)"""
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": master_pod_name(job_name),
+            "namespace": namespace,
+            "labels": {LABEL_JOB: job_name, LABEL_TYPE: "master"},
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [{
+                "name": "master",
+                "image": image,
+                # the operator owns worker pods (it reconciles spec.replicas
+                # and executes ScalePlans), so ITS master emits ScalePlan
+                # CRs (--crd-scaler) instead of creating pods — one owner
+                "command": command or [
+                    "python", "-m", "dlrover_tpu.master.master",
+                    "--platform", "kubernetes",
+                    "--crd-scaler",
+                    "--job-name", job_name,
+                    "--node-num", str(node_num),
+                    "--port", str(port),
+                ],
+                "ports": [{"containerPort": port}],
+                "env": [{"name": EnvKey.JOB_NAME, "value": job_name}],
+            }],
+        },
+    }
+
+
+def master_service(job_name: str, namespace: str = "default",
+                   port: int = 50001) -> Dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": master_service_name(job_name),
+            "namespace": namespace,
+            "labels": {LABEL_JOB: job_name},
+        },
+        "spec": {
+            "selector": {LABEL_JOB: job_name, LABEL_TYPE: "master"},
+            "ports": [{"port": port, "targetPort": port}],
+        },
+    }
+
+
+def pod_node_id(pod: Dict) -> Optional[int]:
+    rank = pod.get("metadata", {}).get("labels", {}).get(LABEL_RANK)
+    return int(rank) if rank is not None else None
+
+
+def pod_generation(pod: Dict) -> int:
+    gen = pod.get("metadata", {}).get("labels", {}).get(LABEL_GENERATION)
+    return int(gen) if gen is not None else 0

@@ -1,0 +1,171 @@
+"""Watchers: k8s watch streams → JobManager node events.
+
+Reference: dlrover/python/master/watcher/k8s_watcher.py (``PodWatcher``:243,
+``K8sScalePlanWatcher``:323). A thread consumes the API watch stream and
+maps pod phases onto the node status machine; the JobManager reacts exactly
+as it does to agent-reported statuses (one status flow for both signal
+paths — pod events catch failures the agent can't report, e.g. OOM-killed
+hosts and preempted pod-slices).
+"""
+
+import threading
+from typing import Callable, Dict, Optional
+
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.k8s import specs
+from dlrover_tpu.k8s.api import K8sApi, WatchEvent
+
+# pod phase → node status (reference k8s_watcher _convert_pod_event)
+_PHASE_TO_STATUS = {
+    "Pending": NodeStatus.PENDING,
+    "Running": NodeStatus.RUNNING,
+    "Succeeded": NodeStatus.SUCCEEDED,
+    "Failed": NodeStatus.FAILED,
+}
+
+
+def pod_exit_reason(pod: Dict) -> str:
+    """Classify why a pod died (reference _verify_restarting / exit-reason
+    mapping): preemption and OOM matter for the relaunch ladder."""
+    status = pod.get("status", {})
+    reason = (status.get("reason") or "").lower()
+    if "preempt" in reason or "evict" in reason:
+        return NodeExitReason.PREEMPTED
+    for cs in status.get("containerStatuses", []):
+        term = (cs.get("state", {}) or {}).get("terminated") or {}
+        if term.get("reason") == "OOMKilled":
+            return NodeExitReason.OOM
+        if term.get("exitCode") not in (None, 0):
+            # generic crash: relaunchable — FATAL_ERROR (never relaunch)
+            # is reserved for explicitly-reported unretryable failures
+            return NodeExitReason.KILLED
+    return NodeExitReason.UNKNOWN
+
+
+class PodWatcher:
+    """Streams worker-pod events into the job manager."""
+
+    def __init__(
+        self,
+        api: K8sApi,
+        job_name: str,
+        job_manager,
+        namespace: str = "default",
+    ):
+        self._api = api
+        self._job = job_name
+        self._manager = job_manager
+        self._namespace = namespace
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._watch_loop, name="pod-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _watch_loop(self) -> None:
+        selector = f"{specs.LABEL_JOB}={self._job},{specs.LABEL_TYPE}=worker"
+        while not self._stopped.is_set():
+            try:
+                for event in self._api.watch_pods(
+                    self._namespace, selector, timeout_s=5.0
+                ):
+                    if self._stopped.is_set():
+                        return
+                    self._process(event)
+            except Exception:  # noqa: BLE001 — re-list and re-watch
+                logger.exception("pod watch stream failed — retrying")
+                self._stopped.wait(1.0)
+
+    def _process(self, event: WatchEvent) -> None:
+        pod = event.object
+        node_id = specs.pod_node_id(pod)
+        if node_id is None:
+            return
+        # a replaced pod (older generation than the node's current relaunch
+        # incarnation) still emits terminal/deletion events while it drains;
+        # acting on them would re-fail the freshly relaunched node
+        node = self._manager.get_node(node_id)
+        if specs.pod_generation(pod) < node.relaunch_count:
+            return
+        if event.type == WatchEvent.DELETED:
+            # deletion of a running worker = the node is gone (preemption,
+            # scale-down); the manager decides relaunch vs shrink
+            node = self._manager.get_node(node_id)
+            if not NodeStatus.terminal(node.status):
+                self._manager.update_node_status(
+                    node_id, NodeStatus.FAILED,
+                    exit_reason=NodeExitReason.PREEMPTED,
+                )
+            return
+        phase = pod.get("status", {}).get("phase", "Pending")
+        status = _PHASE_TO_STATUS.get(phase)
+        if status is None:
+            return
+        exit_reason = (
+            pod_exit_reason(pod) if status == NodeStatus.FAILED else ""
+        )
+        self._manager.update_node_status(
+            node_id, status, exit_reason=exit_reason
+        )
+
+
+class ScalePlanWatcher:
+    """Watches ScalePlan CRs and hands them to an executor callback —
+    the master side of the operator handshake
+    (reference K8sScalePlanWatcher:323)."""
+
+    def __init__(
+        self,
+        api: K8sApi,
+        job_name: str,
+        on_plan: Callable[[Dict], None],
+        namespace: str = "default",
+    ):
+        self._api = api
+        self._job = job_name
+        self._on_plan = on_plan
+        self._namespace = namespace
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._seen = set()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._watch_loop, name="scaleplan-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _watch_loop(self) -> None:
+        from dlrover_tpu.k8s import crd
+
+        while not self._stopped.is_set():
+            try:
+                for event in self._api.watch_custom_objects(
+                    self._namespace, crd.SCALEPLAN_PLURAL, timeout_s=5.0
+                ):
+                    if self._stopped.is_set():
+                        return
+                    obj = event.object
+                    labels = obj.get("metadata", {}).get("labels", {})
+                    if labels.get("elasticjob-name") != self._job:
+                        continue
+                    name = obj["metadata"]["name"]
+                    if event.type == WatchEvent.ADDED and name not in self._seen:
+                        self._seen.add(name)
+                        try:
+                            self._on_plan(obj)
+                        except Exception:  # noqa: BLE001
+                            logger.exception("scale plan handler failed")
+            except Exception:  # noqa: BLE001
+                logger.exception("scaleplan watch failed — retrying")
+                self._stopped.wait(1.0)

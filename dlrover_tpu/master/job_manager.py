@@ -75,6 +75,11 @@ class JobManager:
 
     # -- lifecycle ---------------------------------------------------------
 
+    def set_scaler(self, scaler) -> None:
+        """Attach the scaler after construction (the k8s master must bind
+        its RPC port first — worker pods need the real address)."""
+        self._scaler = scaler
+
     def start(self) -> None:
         self._job_stage = JobStage.RUNNING
         self._monitor_thread = threading.Thread(

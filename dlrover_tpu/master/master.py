@@ -154,7 +154,68 @@ class LocalJobMaster(JobMaster):
 
 class DistributedJobMaster(JobMaster):
     """Master with cluster node management (reference dist_master.py:98).
-    The scheduler/scaler backend is injected (k8s, GKE TPU, or local)."""
+
+    Composes the k8s plane around the common master: a scaler (direct
+    ``PodScaler`` or CR-emitting ``ElasticJobScaler`` when an operator owns
+    the pods) and a ``PodWatcher`` feeding pod events into the job manager.
+    The ``K8sApi`` backend is injected — ``RealK8sApi`` in-cluster,
+    ``InMemoryK8sApi`` for single-host dev/tests.
+    """
+
+    def __init__(
+        self,
+        api,
+        namespace: str = "default",
+        replica_spec=None,
+        use_crd_scaler: bool = False,
+        worker_master_addr: str = "",
+        **kwargs,
+    ):
+        from dlrover_tpu.k8s.crd import TpuReplicaSpec
+        from dlrover_tpu.k8s.scaler import ElasticJobScaler, PodScaler
+        from dlrover_tpu.k8s.specs import master_service_name
+        from dlrover_tpu.k8s.watcher import PodWatcher
+
+        job_name = kwargs.get("job_name", "local")
+        node_num = kwargs.get("node_num", 1)
+        replica_spec = replica_spec or TpuReplicaSpec(replicas=node_num)
+        # bind the RPC server first: the address injected into worker pods
+        # must carry the REAL bound port, not an assumed one
+        super().__init__(**kwargs)
+        if use_crd_scaler:
+            scaler = ElasticJobScaler(api, job_name, namespace)
+        else:
+            scaler = PodScaler(
+                api, job_name, replica_spec,
+                master_addr=worker_master_addr
+                or f"{master_service_name(job_name)}.{namespace}:"
+                   f"{self.port}",
+                namespace=namespace,
+            )
+        self._scaler = scaler
+        self._node_num = node_num
+        self._use_crd_scaler = use_crd_scaler
+        self.job_manager.set_scaler(scaler)
+        self.pod_watcher = PodWatcher(
+            api, job_name, self.job_manager, namespace
+        )
+
+    def prepare(self) -> None:
+        super().prepare()
+        self.pod_watcher.start()
+        if not self._use_crd_scaler:
+            # standalone (no operator): this master owns the worker pods,
+            # so it must create the initial set (reference
+            # dist_job_manager.start → initial ScalePlan). In CRD mode the
+            # operator already reconciled spec.replicas.
+            from dlrover_tpu.k8s.scaler import ScalePlan
+
+            self._scaler.scale(ScalePlan(worker_num=self._node_num))
+
+    def stop(self) -> None:
+        self.pod_watcher.stop()
+        self._scaler.stop()
+        super().stop()
 
 
 def main(argv=None) -> int:
@@ -167,8 +228,16 @@ def main(argv=None) -> int:
     parser.add_argument("--node-unit", type=int, default=1)
     parser.add_argument("--port-file", default="",
                         help="write the bound port to this file (standalone)")
+    parser.add_argument("--platform", default="local",
+                        choices=["local", "kubernetes"],
+                        help="local (in-proc agents) or kubernetes "
+                             "(pods via the cluster API)")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--crd-scaler", action="store_true",
+                        help="emit ScalePlan CRs instead of creating pods "
+                             "(an operator executes them)")
     args = parser.parse_args(argv)
-    master = LocalJobMaster(
+    common = dict(
         job_name=args.job_name,
         port=args.port,
         node_num=args.node_num,
@@ -176,6 +245,19 @@ def main(argv=None) -> int:
         max_nodes=args.max_nodes,
         node_unit=args.node_unit,
     )
+    if args.platform == "kubernetes":
+        from dlrover_tpu.k8s.api import RealK8sApi
+
+        if not common["port"]:
+            # must match the master Service's targetPort — the operator
+            # launches this process with --port 50001 (k8s/specs.py)
+            common["port"] = 50001
+        master = DistributedJobMaster(
+            RealK8sApi(), namespace=args.namespace,
+            use_crd_scaler=args.crd_scaler, **common,
+        )
+    else:
+        master = LocalJobMaster(**common)
     master.prepare()
     if args.port_file:
         with open(args.port_file, "w") as f:

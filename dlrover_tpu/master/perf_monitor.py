@@ -29,20 +29,27 @@ class PerfMonitor:
         # goodput accounting: accumulated unproductive seconds
         self._fault_started: Optional[float] = None
         self._lost_seconds = 0.0
-        self._last_reset_ts = 0.0
+        self._min_round = -1
 
-    def reset_running_speed_monitor(self) -> None:
+    def reset_running_speed_monitor(self, min_round: Optional[int] = None
+                                    ) -> None:
         """Called on re-rendezvous: speed samples from the old world are void
-        (reference perf_monitor resets on worker count change)."""
+        (reference perf_monitor resets on worker count change).
+        ``min_round`` is the forming rendezvous round — step reports from
+        older rounds are dropped from then on."""
         with self._lock:
             self._records.clear()
-            self._last_reset_ts = time.time()
+            if min_round is not None and min_round > self._min_round:
+                self._min_round = min_round
 
-    def collect_global_step(self, step: int, timestamp: float) -> None:
+    def collect_global_step(self, step: int, timestamp: float,
+                            rdzv_round: int = -1) -> None:
         with self._lock:
-            if timestamp and timestamp < self._last_reset_ts:
+            if 0 <= rdzv_round < self._min_round:
                 # a pre-restart report delivered late (agent retry storm)
-                # must not refresh progress after the world re-formed
+                # must not refresh progress after the world re-formed; the
+                # round token is clock-free — agent and master wall clocks
+                # are never compared
                 return
             if self._records and step <= self._records[-1].step:
                 return

@@ -57,7 +57,9 @@ class MasterServicer:
         )
         rdzv_round = manager.join_rendezvous(meta)
         if self._perf_monitor is not None:
-            self._perf_monitor.reset_running_speed_monitor()
+            self._perf_monitor.reset_running_speed_monitor(
+                min_round=rdzv_round
+            )
         return comm.JoinRendezvousResponse(round=rdzv_round)
 
     def rpc_get_comm_world(
@@ -158,7 +160,8 @@ class MasterServicer:
         action = self._job_manager.report_heartbeat(req.node_id, req.timestamp)
         if req.global_step and self._perf_monitor is not None:
             self._perf_monitor.collect_global_step(
-                req.global_step, req.step_timestamp or time.time()
+                req.global_step, req.step_timestamp or time.time(),
+                rdzv_round=req.rdzv_round,
             )
         if self._diagnosis_master is not None:
             self._diagnosis_master.observe_heartbeat(req)
@@ -176,7 +179,8 @@ class MasterServicer:
     def rpc_report_global_step(self, req: comm.GlobalStep) -> comm.BaseResponse:
         if self._perf_monitor is not None:
             self._perf_monitor.collect_global_step(
-                req.step, req.timestamp or time.time()
+                req.step, req.timestamp or time.time(),
+                rdzv_round=req.rdzv_round,
             )
         return comm.BaseResponse()
 

@@ -24,9 +24,6 @@ NamedSharding turns per-host arrays into one global jax.Array.
 
 import json
 import os
-import queue
-import threading
-import time
 from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -36,8 +33,9 @@ from dlrover_tpu.common.log import logger
 
 
 class ShardingClient:
-    """Pulls (start, end) record-range tasks from the master with one-deep
-    prefetch (reference sharding/client.py:29)."""
+    """Pulls (start, end) record-range tasks from the master
+    (reference sharding/client.py:29); shard granularity
+    (``num_minibatches_per_shard``) amortizes the RPC over minibatches."""
 
     def __init__(
         self,
@@ -64,15 +62,11 @@ class ShardingClient:
             splitter=splitter,
         )
         self._client.setup_dataset(self._params)  # idempotent on the master
-        self._pending: "queue.Queue[comm.TaskMessage]" = queue.Queue(2)
         self._current: Optional[comm.TaskMessage] = None
 
     def fetch_task(self) -> Optional[comm.TaskMessage]:
         """Next shard task, or None when the dataset is exhausted."""
-        try:
-            task = self._pending.get_nowait()
-        except queue.Empty:
-            task = self._client.get_task(self.dataset_name)
+        task = self._client.get_task(self.dataset_name)
         if task is None or task.task_id < 0:
             return None
         self._current = task
@@ -176,7 +170,14 @@ class ElasticDistributedSampler:
         remaining = len(order)
         if self.drop_last:
             remaining -= remaining % self.num_replicas
-        for i in range(self.rank, remaining, self.num_replicas):
+            order = order[:remaining]
+        elif remaining % self.num_replicas:
+            # pad by wrapping (torch DistributedSampler semantics): every
+            # replica MUST yield the same count or an SPMD loop deadlocks
+            # on the ragged collective step
+            pad = self.num_replicas - remaining % self.num_replicas
+            order = np.concatenate([order, order[:pad]])
+        for i in range(self.rank, len(order), self.num_replicas):
             yield int(order[i])
 
     def __len__(self) -> int:

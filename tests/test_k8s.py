@@ -1,0 +1,339 @@
+"""k8s control-plane tests: scaler/watcher/reconciler against the
+in-memory API (reference pattern: PodScaler/watchers tested against a
+mocked k8sClient, SURVEY.md §4.2 — here the fake is the product's own
+local backend, so tests run the real control-plane code)."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.k8s import crd, specs
+from dlrover_tpu.k8s.api import InMemoryK8sApi, WatchEvent
+from dlrover_tpu.k8s.operator import ElasticJobReconciler
+from dlrover_tpu.k8s.scaler import ElasticJobScaler, PodScaler, ScalePlan
+from dlrover_tpu.k8s.watcher import PodWatcher, pod_exit_reason
+from dlrover_tpu.master.job_manager import JobManager
+
+NS = "default"
+
+
+def wait_until(cond, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def api():
+    return InMemoryK8sApi()
+
+
+def worker_spec(n=2):
+    return crd.TpuReplicaSpec(
+        replicas=n, image="img:1", command=["run"],
+        accelerator="tpu-v5-lite-podslice", topology="2x4",
+        chips_per_host=4,
+    )
+
+
+# -- api fake ---------------------------------------------------------------
+
+
+def test_inmemory_api_crud_and_watch(api):
+    events = []
+    import threading
+
+    def consume():
+        for ev in api.watch_pods(NS, "a=b", timeout_s=1.0):
+            events.append(ev)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    api.create_pod(NS, {"metadata": {"name": "p1", "labels": {"a": "b"}}})
+    api.create_pod(NS, {"metadata": {"name": "p2", "labels": {"a": "c"}}})
+    api.patch_pod_status(NS, "p1", {"phase": "Running"})
+    api.delete_pod(NS, "p1")
+    t.join(2.0)
+    assert [e.type for e in events] == [
+        WatchEvent.ADDED, WatchEvent.MODIFIED, WatchEvent.DELETED
+    ]  # p2 filtered by selector
+    assert api.get_pod(NS, "p2")["metadata"]["labels"]["a"] == "c"
+    assert api.list_pods(NS, "a=c")[0]["metadata"]["name"] == "p2"
+
+
+# -- specs ------------------------------------------------------------------
+
+
+def test_worker_pod_spec_tpu_resources():
+    pod = specs.worker_pod("j1", 3, worker_spec(), "10.0.0.1:50001")
+    res = pod["spec"]["containers"][0]["resources"]
+    # extended resources must be in requests AND limits
+    assert res["limits"]["google.com/tpu"] == "4"
+    assert res["requests"]["google.com/tpu"] == "4"
+    sel = pod["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == (
+        "tpu-v5-lite-podslice"
+    )
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+    assert specs.pod_node_id(pod) == 3
+    env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+    assert env["DLROVER_TPU_MASTER_ADDR"] == "10.0.0.1:50001"
+
+
+def test_pod_exit_reason_classification():
+    assert pod_exit_reason(
+        {"status": {"reason": "Preempted"}}
+    ) == NodeExitReason.PREEMPTED
+    assert pod_exit_reason({"status": {"containerStatuses": [
+        {"state": {"terminated": {"reason": "OOMKilled", "exitCode": 137}}}
+    ]}}) == NodeExitReason.OOM
+    assert pod_exit_reason({"status": {"containerStatuses": [
+        {"state": {"terminated": {"exitCode": 1}}}
+    ]}}) == NodeExitReason.KILLED
+
+
+# -- pod scaler -------------------------------------------------------------
+
+
+def test_pod_scaler_resize_and_relaunch(api):
+    scaler = PodScaler(api, "j1", worker_spec(2), "m:1")
+    try:
+        scaler.scale(ScalePlan(worker_num=2))
+        assert wait_until(lambda: len(api.list_pods(NS)) == 2)
+        # relaunch node 1: replacement pod gets a new name
+        node = Node(id=1, rank=1, relaunch_count=1)
+        scaler.relaunch_node(node)
+        assert wait_until(lambda: any(
+            p["metadata"]["name"] == "j1-worker-1-1"
+            for p in api.list_pods(NS)
+        ))
+        assert len(api.list_pods(NS)) == 2  # predecessor deleted
+        # shrink to 1
+        scaler.scale(ScalePlan(worker_num=1))
+        assert wait_until(lambda: len(api.list_pods(NS)) == 1)
+        assert specs.pod_node_id(api.list_pods(NS)[0]) == 0
+    finally:
+        scaler.stop()
+
+
+def test_pod_scaler_retries_on_api_error(api):
+    calls = {"n": 0}
+    real_create = api.create_pod
+
+    def flaky(ns, pod):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("api 500")
+        return real_create(ns, pod)
+
+    api.create_pod = flaky
+    scaler = PodScaler(api, "j1", worker_spec(1), "m:1")
+    scaler.RETRY_DELAY_S = 0.05
+    try:
+        scaler.scale(ScalePlan(launch_nodes=[Node(id=0, rank=0)]))
+        assert wait_until(lambda: len(api.list_pods(NS)) == 1, timeout=5)
+        assert calls["n"] >= 2
+    finally:
+        scaler.stop()
+
+
+def test_elasticjob_scaler_emits_cr(api):
+    scaler = ElasticJobScaler(api, "j2")
+    scaler.scale(ScalePlan(worker_num=4, launch_nodes=[Node(id=3)]))
+    plans = api.list_custom_objects(NS, crd.SCALEPLAN_PLURAL)
+    assert len(plans) == 1
+    assert plans[0]["spec"]["replicaSpecs"]["worker"]["replicas"] == 4
+    assert plans[0]["spec"]["launchNodes"] == [3]
+
+
+# -- watcher → job manager --------------------------------------------------
+
+
+def test_pod_watcher_feeds_job_manager(api):
+    manager = JobManager("j1", node_num=2)
+    watcher = PodWatcher(api, "j1", manager)
+    watcher.start()
+    try:
+        time.sleep(0.05)
+        pod = specs.worker_pod("j1", 0, worker_spec(), "m:1")
+        api.create_pod(NS, pod)
+        api.patch_pod_status(NS, pod["metadata"]["name"],
+                             {"phase": "Running"})
+        assert wait_until(
+            lambda: manager.get_node(0).status == NodeStatus.RUNNING
+        )
+        # OOM kill arrives as a pod Failed phase
+        api.patch_pod_status(NS, pod["metadata"]["name"], {
+            "phase": "Failed",
+            "containerStatuses": [
+                {"state": {"terminated": {"reason": "OOMKilled",
+                                          "exitCode": 137}}}
+            ],
+        })
+        assert wait_until(
+            lambda: manager.get_node(0).exit_reason == NodeExitReason.OOM
+        )
+    finally:
+        watcher.stop()
+
+
+def test_pod_watcher_deletion_of_running_pod_fails_node(api):
+    manager = JobManager("j1", node_num=1)
+    watcher = PodWatcher(api, "j1", manager)
+    watcher.start()
+    try:
+        time.sleep(0.05)
+        pod = specs.worker_pod("j1", 0, worker_spec(), "m:1")
+        api.create_pod(NS, pod)
+        api.patch_pod_status(NS, pod["metadata"]["name"],
+                             {"phase": "Running"})
+        assert wait_until(
+            lambda: manager.get_node(0).status == NodeStatus.RUNNING
+        )
+        api.delete_pod(NS, pod["metadata"]["name"])
+        assert wait_until(
+            lambda: manager.get_node(0).exit_reason
+            == NodeExitReason.PREEMPTED
+        )
+    finally:
+        watcher.stop()
+
+
+# -- reconciler (operator) --------------------------------------------------
+
+
+def test_reconciler_creates_master_and_workers(api):
+    rec = ElasticJobReconciler(api)
+    rec.start()
+    try:
+        api.create_custom_object(
+            NS, crd.ELASTICJOB_PLURAL,
+            crd.elastic_job("j3", worker=worker_spec(2)),
+        )
+        assert wait_until(
+            lambda: api.get_pod(NS, "j3-master") is not None
+        )
+        assert api.get_service(NS, "j3-master") is not None
+        assert wait_until(lambda: len(api.list_pods(
+            NS, f"{specs.LABEL_JOB}=j3,{specs.LABEL_TYPE}=worker"
+        )) == 2)
+        job = api.get_custom_object(NS, crd.ELASTICJOB_PLURAL, "j3")
+        assert job["status"]["phase"] == crd.JobPhase.RUNNING
+    finally:
+        rec.stop()
+
+
+def test_reconciler_suspend_tears_down_pods(api):
+    rec = ElasticJobReconciler(api)
+    rec.start()
+    try:
+        api.create_custom_object(
+            NS, crd.ELASTICJOB_PLURAL,
+            crd.elastic_job("j4", worker=worker_spec(2)),
+        )
+        assert wait_until(lambda: len(api.list_pods(
+            NS, f"{specs.LABEL_JOB}=j4"
+        )) == 3)  # master + 2 workers
+        api.patch_custom_object(
+            NS, crd.ELASTICJOB_PLURAL, "j4", {"spec": {"suspend": True}}
+        )
+        assert wait_until(lambda: len(api.list_pods(
+            NS, f"{specs.LABEL_JOB}=j4"
+        )) == 0)
+        job = api.get_custom_object(NS, crd.ELASTICJOB_PLURAL, "j4")
+        assert job["status"]["phase"] == crd.JobPhase.SUSPENDED
+    finally:
+        rec.stop()
+
+
+def test_reconciler_executes_scaleplan_from_elasticjob_scaler(api):
+    """Master (ElasticJobScaler, CR-only) → reconciler → pods: the full
+    operator handshake."""
+    rec = ElasticJobReconciler(api)
+    rec.start()
+    try:
+        api.create_custom_object(
+            NS, crd.ELASTICJOB_PLURAL,
+            crd.elastic_job("j5", worker=worker_spec(2)),
+        )
+        worker_sel = f"{specs.LABEL_JOB}=j5,{specs.LABEL_TYPE}=worker"
+        assert wait_until(
+            lambda: len(api.list_pods(NS, worker_sel)) == 2
+        )
+        ElasticJobScaler(api, "j5").scale(ScalePlan(worker_num=3))
+        assert wait_until(
+            lambda: len(api.list_pods(NS, worker_sel)) == 3
+        )
+        job = api.get_custom_object(NS, crd.ELASTICJOB_PLURAL, "j5")
+        assert (
+            job["spec"]["replicaSpecs"]["worker"]["replicas"] == 3
+        )
+        plans = api.list_custom_objects(NS, crd.SCALEPLAN_PLURAL)
+        assert wait_until(lambda: all(
+            p.get("status", {}).get("phase") == "Executed"
+            for p in api.list_custom_objects(NS, crd.SCALEPLAN_PLURAL)
+        ))
+        assert plans
+    finally:
+        rec.stop()
+
+
+def test_distributed_master_k8s_wiring(api):
+    """DistributedJobMaster: pod events reach its job manager; node failure
+    drives a replacement pod through its scaler."""
+    from dlrover_tpu.master.master import DistributedJobMaster
+
+    m = DistributedJobMaster(
+        api, job_name="j7", node_num=1, worker_master_addr="m:1",
+    )
+    m.prepare()
+    try:
+        m._scaler.scale(ScalePlan(worker_num=1))
+        assert wait_until(lambda: api.get_pod(NS, "j7-worker-0-0"))
+        api.patch_pod_status(NS, "j7-worker-0-0", {"phase": "Running"})
+        assert wait_until(
+            lambda: m.job_manager.get_node(0).status == NodeStatus.RUNNING
+        )
+        api.patch_pod_status(NS, "j7-worker-0-0", {
+            "phase": "Failed",
+            "containerStatuses": [
+                {"state": {"terminated": {"exitCode": 1}}}
+            ],
+        })
+        assert wait_until(
+            lambda: api.get_pod(NS, "j7-worker-0-1") is not None, timeout=8
+        )
+    finally:
+        m.stop()
+
+
+def test_job_manager_relaunch_through_pod_scaler(api):
+    """Failure → relaunch ladder drives a replacement pod end-to-end."""
+    scaler = PodScaler(api, "j6", worker_spec(1), "m:1")
+    manager = JobManager("j6", node_num=1, scaler=scaler)
+    watcher = PodWatcher(api, "j6", manager)
+    watcher.start()
+    try:
+        time.sleep(0.05)
+        scaler.scale(ScalePlan(worker_num=1))
+        assert wait_until(lambda: len(api.list_pods(NS)) == 1)
+        api.patch_pod_status(NS, "j6-worker-0-0", {
+            "phase": "Failed",
+            "containerStatuses": [
+                {"state": {"terminated": {"exitCode": 1}}}
+            ],
+        })
+        # manager marks failed → relaunch → new pod with relaunch_count=1
+        assert wait_until(lambda: any(
+            p["metadata"]["name"] == "j6-worker-0-1"
+            for p in api.list_pods(NS)
+        ), timeout=8)
+    finally:
+        watcher.stop()
+        scaler.stop()

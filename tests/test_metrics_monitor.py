@@ -59,7 +59,7 @@ class FakeClient:
     def report_resource_stats(self, **kwargs):
         self.resource_reports.append(kwargs)
 
-    def report_global_step(self, step, ts, retries=None):
+    def report_global_step(self, step, ts, retries=None, rdzv_round=-1):
         self.steps.append((step, ts))
 
 
