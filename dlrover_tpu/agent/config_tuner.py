@@ -1,0 +1,83 @@
+"""ParalConfigTuner: ships master-tuned runtime knobs to workers.
+
+Reference: dlrover/python/elastic_agent/config/paral_config_tuner.py:30,70 —
+an agent thread polls the master's ``ParallelConfig`` and rewrites a JSON
+file that the dataloader re-reads between batches
+(:class:`~dlrover_tpu.trainer.data.ElasticDataLoader` ``config_file``).
+The file moves atomically (write + rename) so a reader never sees a torn
+config.
+"""
+
+import json
+import os
+import threading
+from typing import Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import logger
+
+CONFIG_FILE_ENV = "DLROVER_TPU_PARAL_CONFIG_FILE"
+
+
+def default_config_path(job_name: str) -> str:
+    return os.path.join(
+        "/tmp", f"dlrover_tpu_{os.getuid()}_{job_name}", "paral_config.json"
+    )
+
+
+class ParalConfigTuner:
+    def __init__(
+        self,
+        master_client,
+        config_path: str,
+        interval_s: float = 30.0,
+    ):
+        self._client = master_client
+        self.config_path = config_path
+        self._interval_s = interval_s
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_version = -1
+        os.makedirs(os.path.dirname(config_path), exist_ok=True)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="paral-config-tuner", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def poll_once(self) -> bool:
+        """Fetch the config; rewrite the file when the version advanced."""
+        config = self._client.get_parallel_config()
+        if config is None or config.version <= self._last_version:
+            return False
+        self._last_version = config.version
+        self._write(config)
+        return True
+
+    def _write(self, config: comm.ParallelConfig) -> None:
+        payload = {
+            "dataloader_batch_size": config.dataloader_batch_size,
+            "dataloader_version": config.dataloader_version,
+            "grad_accum_steps": config.grad_accum_steps,
+            "version": config.version,
+        }
+        tmp = self.config_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self.config_path)
+        logger.info(
+            "paral config v%s written to %s", config.version, self.config_path
+        )
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self.poll_once()
+            except ConnectionError:
+                continue
+            except Exception:  # noqa: BLE001
+                logger.exception("paral config poll failed")

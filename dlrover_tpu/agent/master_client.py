@@ -198,7 +198,7 @@ class MasterClient:
 
     def report_resource_stats(
         self, cpu_percent: float, mem_used_mb: float,
-        device_util=None, device_mem_mb=None,
+        device_util=None, device_mem_mb=None, device_mem_total_mb=None,
     ) -> None:
         self._client.call(
             "report_resource_stats",
@@ -208,6 +208,7 @@ class MasterClient:
                 mem_used_mb=mem_used_mb,
                 device_util=device_util or {},
                 device_mem_mb=device_mem_mb or {},
+                device_mem_total_mb=device_mem_total_mb or {},
             ),
         )
 
@@ -246,6 +247,12 @@ class MasterClient:
         self._client.call(
             "restore_shard_checkpoint",
             comm.ShardCheckpointResponse(content=content),
+        )
+
+    def get_parallel_config(self) -> comm.ParallelConfig:
+        return self._client.call(
+            "get_parallel_config",
+            comm.ParallelConfigRequest(node_id=self._node_id),
         )
 
     # -- misc --------------------------------------------------------------

@@ -83,6 +83,10 @@ class ResourceMonitor:
                 d: s["hbm_used_mb"] for d, s in devices.items()
                 if "hbm_used_mb" in s
             },
+            device_mem_total_mb={
+                d: s["hbm_total_mb"] for d, s in devices.items()
+                if "hbm_total_mb" in s
+            },
         )
 
     def _loop(self) -> None:

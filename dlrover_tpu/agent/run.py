@@ -65,6 +65,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ckpt_replica", "--ckpt-replica", dest="ckpt_replica",
                    type=int, default=0,
                    help="cross-host checkpoint backup-group size (0=off)")
+    p.add_argument("--auto-tunning", "--auto-tuning", dest="auto_tunning",
+                   action="store_true",
+                   help="poll master-tuned dataloader/grad-accum config")
     p.add_argument("--no-save-at-breakpoint", dest="save_at_breakpoint",
                    action="store_false")
     p.add_argument("entrypoint", help="training script")
@@ -90,6 +93,7 @@ def config_from_args(args) -> ElasticLaunchConfig:
         save_at_breakpoint=args.save_at_breakpoint,
         ckpt_dir=args.ckpt_dir,
         ckpt_replica=args.ckpt_replica,
+        auto_tunning=args.auto_tunning,
         entrypoint=args.entrypoint,
         args=args.args[1:] if args.args[:1] == ["--"] else list(args.args),
     )

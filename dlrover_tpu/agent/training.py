@@ -407,6 +407,20 @@ class ElasticTrainingAgent:
         )
         resource_monitor.start()
         self._training_monitor.start()
+        config_tuner = None
+        if self._config.auto_tunning:
+            from dlrover_tpu.agent.config_tuner import (
+                ParalConfigTuner,
+                default_config_path,
+            )
+
+            config_tuner = ParalConfigTuner(
+                self._client, default_config_path(self._config.job_name)
+            )
+            config_tuner.start()
+            self._config.worker_env.setdefault(
+                "DLROVER_TPU_PARAL_CONFIG_FILE", config_tuner.config_path
+            )
         try:
             self._initialize_workers()
             return self._monitor_loop()
@@ -415,6 +429,8 @@ class ElasticTrainingAgent:
             resource_monitor.stop()
             self._training_monitor.stop()
             self._stop_workers()
+            if config_tuner is not None:
+                config_tuner.stop()
             if self._ckpt_saver is not None:
                 self._ckpt_saver.stop()
             if self._replica_service is not None:

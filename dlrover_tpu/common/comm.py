@@ -338,6 +338,9 @@ class ResourceStats:
     mem_used_mb: float = 0.0
     device_util: Dict[int, float] = field(default_factory=dict)
     device_mem_mb: Dict[int, float] = field(default_factory=dict)
+    # per-device HBM capacity — without it the master cannot compute the
+    # fill fraction the batch-size tuner keys on
+    device_mem_total_mb: Dict[int, float] = field(default_factory=dict)
 
 
 @message

@@ -1,0 +1,123 @@
+"""JobAutoScaler: periodic resource re-planning.
+
+Reference: dlrover/python/master/node/job_auto_scaler.py:58–70 —
+``AllreduceTrainingAutoScaler`` periodically collects runtime stats and
+executes ``ResourcePlan``s through the scaler. The PS variant is a
+non-goal (SURVEY.md §2.7). TPU specifics: resize targets stay node_unit
+multiples (slice shape), and a resize also refreshes the rendezvous
+min/max so the next re-rendezvous cuts the new world.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.resource import (
+    ScalingStats,
+    LocalOptimizer,
+    ResourceOptimizer,
+    ResourcePlan,
+)
+
+
+class JobAutoScaler:
+    def __init__(
+        self,
+        job_manager,
+        perf_monitor,
+        scaler,
+        rdzv_managers: Optional[Dict] = None,
+        optimizer: Optional[ResourceOptimizer] = None,
+        min_nodes: int = 1,
+        max_nodes: int = 1,
+        node_unit: int = 1,
+        interval_s: float = 30.0,
+        straggler_provider=None,
+    ):
+        self._job_manager = job_manager
+        self._perf_monitor = perf_monitor
+        self._scaler = scaler
+        self._rdzv_managers = rdzv_managers or {}
+        self._optimizer = optimizer or LocalOptimizer()
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.node_unit = node_unit
+        self.target_nodes = max_nodes
+        self._interval_s = interval_s
+        self._straggler_provider = straggler_provider or (lambda: [])
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="job-auto-scaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001
+                logger.exception("auto-scaler tick failed")
+
+    # -- one planning round ------------------------------------------------
+
+    def collect_stats(self) -> ScalingStats:
+        now = time.time()
+        running = pending = 0
+        oldest_pending = 0.0
+        for node in self._job_manager.nodes.values():
+            if node.status == NodeStatus.RUNNING:
+                running += 1
+            elif node.status in (NodeStatus.PENDING, NodeStatus.INITIAL):
+                pending += 1
+                oldest_pending = max(oldest_pending, now - node.create_time)
+        return ScalingStats(
+            running_nodes=running,
+            pending_nodes=pending,
+            target_nodes=self.target_nodes,
+            min_nodes=self.min_nodes,
+            max_nodes=self.max_nodes,
+            node_unit=self.node_unit,
+            running_speed=self._perf_monitor.running_speed(),
+            straggler_nodes=list(self._straggler_provider()),
+            oldest_pending_s=oldest_pending,
+        )
+
+    def tick(self) -> Optional[ResourcePlan]:
+        stats = self.collect_stats()
+        plan = self._optimizer.plan(stats)
+        if plan.empty():
+            return None
+        self.execute(plan)
+        return plan
+
+    def execute(self, plan: ResourcePlan) -> None:
+        if plan.node_num is None:
+            return
+        target = max(self.min_nodes, min(self.max_nodes, plan.node_num))
+        if target == self.target_nodes:
+            return
+        logger.info(
+            "auto-scale %s → %s nodes (%s)",
+            self.target_nodes, target, plan.reason,
+        )
+        self.target_nodes = target
+        # the next re-rendezvous must cut a world of the new size
+        for manager in self._rdzv_managers.values():
+            manager.update_rdzv_params(
+                min_nodes=min(self.min_nodes, target), max_nodes=target,
+                node_unit=self.node_unit,
+            )
+        if self._scaler is not None:
+            from dlrover_tpu.k8s.scaler import ScalePlan
+
+            self._scaler.scale(ScalePlan(worker_num=target))

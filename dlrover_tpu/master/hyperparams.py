@@ -1,0 +1,96 @@
+"""Initial/runtime hyperparameter strategy (auto-tuning source).
+
+Reference: dlrover/python/master/hyperparams/simple_strategy_generator.py:40
+— suggests DataLoader/optimizer config from node resources; the agent-side
+tuner (config/paral_config_tuner.py) ships it to workers. TPU translation:
+the knob that matters is the **per-host micro-batch** — sized from HBM
+headroom (grow it while memory allows; shrink it on OOM risk) — with
+grad-accum rebalanced to hold the global batch fixed
+(trainer/elastic.py semantics).
+"""
+
+import threading
+from typing import Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import logger
+
+# stay below this HBM fill fraction after a batch-size change
+_HBM_TARGET_FRAC = 0.85
+# never suggest below this (MXU utilization collapses on tiny batches)
+_MIN_BATCH = 1
+
+
+class SimpleStrategyGenerator:
+    """Produces versioned :class:`ParallelConfig` suggestions.
+
+    Workers poll ``get_paral_config`` (via the agent tuner); a version bump
+    tells them the file changed. Suggestions are *monotonic per observation
+    window*: one step at a time, re-evaluated as new HBM samples arrive.
+    """
+
+    def __init__(self, metric_context=None, global_batch_size: int = 0):
+        self._metrics = metric_context
+        self._global_batch = global_batch_size
+        self._lock = threading.Lock()
+        self._config = comm.ParallelConfig(version=0)
+
+    @property
+    def config(self) -> comm.ParallelConfig:
+        with self._lock:
+            return self._config
+
+    def set_initial(self, batch_size: int, grad_accum: int = 0) -> None:
+        with self._lock:
+            self._config = comm.ParallelConfig(
+                dataloader_batch_size=batch_size,
+                dataloader_version=1,
+                grad_accum_steps=grad_accum,
+                version=1,
+            )
+
+    def _worst_hbm_frac(self) -> Optional[float]:
+        if self._metrics is None:
+            return None
+        worst = None
+        for node_id in self._metrics.node_ids():
+            window = self._metrics.window(node_id, 60.0)
+            for sample in window:
+                for dev in sample.devices:
+                    frac = dev.hbm_used_frac
+                    if frac and (worst is None or frac > worst):
+                        worst = frac
+        return worst
+
+    def observe_and_update(self) -> Optional[comm.ParallelConfig]:
+        """Re-evaluate the micro-batch against HBM headroom. Returns the new
+        config when it changed, else None."""
+        with self._lock:
+            current = self._config
+        if current.dataloader_batch_size <= 0:
+            return None
+        hbm = self._worst_hbm_frac()
+        if hbm is None:
+            return None
+        new_bs = current.dataloader_batch_size
+        if hbm > 0.95:
+            # OOM territory — halve, training dying costs more than MXU
+            new_bs = max(_MIN_BATCH, new_bs // 2)
+        elif hbm < _HBM_TARGET_FRAC / 2:
+            # lots of headroom: doubling the micro-batch halves the number
+            # of grad-accum rounds for the same global batch
+            new_bs = new_bs * 2
+        if new_bs == current.dataloader_batch_size:
+            return None
+        with self._lock:
+            self._config = comm.ParallelConfig(
+                dataloader_batch_size=new_bs,
+                dataloader_version=current.dataloader_version + 1,
+                grad_accum_steps=current.grad_accum_steps,
+                version=current.version + 1,
+            )
+            logger.info(
+                "strategy: micro-batch %s → %s (worst HBM %.0f%%)",
+                current.dataloader_batch_size, new_bs, hbm * 100,
+            )
+            return self._config

@@ -48,10 +48,15 @@ class JobMaster:
         self.perf_monitor = PerfMonitor()
         self.task_manager = TaskManager()
         self.metric_context = JobMetricContext()
+        from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
         from dlrover_tpu.master.stats import JobMetricCollector
 
+        self.strategy_generator = SimpleStrategyGenerator(
+            metric_context=self.metric_context
+        )
         self.metric_collector = JobMetricCollector(
-            self.job_manager, self.perf_monitor
+            self.job_manager, self.perf_monitor,
+            strategy_generator=self.strategy_generator,
         )
         self.kv_store = KVStoreService()
         self.sync_service = SyncService()
@@ -80,6 +85,7 @@ class JobMaster:
             perf_monitor=self.perf_monitor,
             diagnosis_master=diagnosis_master,
             metric_context=self.metric_context,
+            strategy_generator=self.strategy_generator,
         )
         self._server = RPCServer(port=port)
         self._server.register_object(self.servicer)
@@ -199,6 +205,19 @@ class DistributedJobMaster(JobMaster):
         self.pod_watcher = PodWatcher(
             api, job_name, self.job_manager, namespace
         )
+        # periodic resource re-planning (reference job_auto_scaler.py:58)
+        from dlrover_tpu.common.constants import RendezvousName
+        from dlrover_tpu.master.auto_scaler import JobAutoScaler
+
+        net_check = self.rdzv_managers[RendezvousName.NODE_CHECK]
+        self.auto_scaler = JobAutoScaler(
+            self.job_manager, self.perf_monitor, scaler,
+            rdzv_managers=self.rdzv_managers,
+            min_nodes=kwargs.get("min_nodes") or node_num,
+            max_nodes=kwargs.get("max_nodes") or node_num,
+            node_unit=kwargs.get("node_unit", 1),
+            straggler_provider=net_check.get_stragglers,
+        )
 
     def prepare(self) -> None:
         super().prepare()
@@ -211,8 +230,10 @@ class DistributedJobMaster(JobMaster):
             from dlrover_tpu.k8s.scaler import ScalePlan
 
             self._scaler.scale(ScalePlan(worker_num=self._node_num))
+        self.auto_scaler.start()
 
     def stop(self) -> None:
+        self.auto_scaler.stop()
         self.pod_watcher.stop()
         self._scaler.stop()
         super().stop()

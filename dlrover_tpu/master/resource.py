@@ -1,0 +1,149 @@
+"""Resource plans + optimizers.
+
+Reference: dlrover/python/master/resource/optimizer.py:48,134
+(``ResourcePlan``, optimizer ABC), local_optimizer.py:66 (heuristic
+``PSLocalOptimizer``) and brain_optimizer.py:64 (RPC client to the Brain
+service). TPU redesign: the PS-era knobs (per-PS CPU/hot-PS detection) are
+gone — the plan speaks in *hosts of a slice*: worker count bounded to
+``node_unit`` multiples, plus a :class:`ParallelConfig` suggestion
+(micro-batch from HBM headroom, grad-accum from the fixed global batch)
+that the agent-side tuner ships to dataloaders.
+"""
+
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import NodeResource
+
+
+@dataclass
+class ResourcePlan:
+    """(reference optimizer.py:48)"""
+
+    node_num: Optional[int] = None
+    node_resource: Optional[NodeResource] = None
+    paral_config: Optional[comm.ParallelConfig] = None
+    reason: str = ""
+
+    def empty(self) -> bool:
+        return (
+            self.node_num is None
+            and self.node_resource is None
+            and self.paral_config is None
+        )
+
+
+@dataclass
+class ScalingStats:
+    """What optimizers see (collected master-side each tick)."""
+
+    running_nodes: int = 0
+    pending_nodes: int = 0
+    target_nodes: int = 0
+    min_nodes: int = 1
+    max_nodes: int = 1
+    node_unit: int = 1
+    running_speed: float = 0.0          # steps/s (perf monitor)
+    speed_samples: List[float] = field(default_factory=list)
+    straggler_nodes: List[int] = field(default_factory=list)
+    # fraction of HBM used, worst node (None = no telemetry yet)
+    hbm_used_frac: Optional[float] = None
+    oldest_pending_s: float = 0.0
+
+
+class ResourceOptimizer(ABC):
+    """(reference optimizer.py:134)"""
+
+    @abstractmethod
+    def plan(self, stats: ScalingStats) -> ResourcePlan: ...
+
+
+def round_to_unit(n: int, unit: int) -> int:
+    return max(0, (n // max(1, unit)) * max(1, unit))
+
+
+class LocalOptimizer(ResourceOptimizer):
+    """Heuristic in-master optimizer (reference local_optimizer.py:66,
+    re-targeted at allreduce/SPMD TPU jobs):
+
+    - **unschedulable shrink**: a node pending longer than
+      ``pending_timeout_s`` means the cluster can't deliver the asked
+      size — shrink the world to what actually runs (node_unit multiple,
+      never below min) instead of stalling rendezvous forever;
+    - **recovery grow**: when running at reduced size and nothing is
+      pending, probe back toward max (preempted capacity tends to return);
+    - **straggler shrink**: drop diagnosed stragglers when the remaining
+      world still satisfies min (reference --exclude-straggler semantics).
+    """
+
+    def __init__(self, pending_timeout_s: float = 900.0,
+                 grow_cooldown_s: float = 600.0):
+        self.pending_timeout_s = pending_timeout_s
+        self.grow_cooldown_s = grow_cooldown_s
+        self._last_grow = 0.0
+
+    def plan(self, stats: ScalingStats) -> ResourcePlan:
+        unit = stats.node_unit
+        # 1) unschedulable shrink
+        if (
+            stats.pending_nodes > 0
+            and stats.oldest_pending_s > self.pending_timeout_s
+        ):
+            target = round_to_unit(stats.running_nodes, unit)
+            if target >= stats.min_nodes and target < stats.target_nodes:
+                return ResourcePlan(
+                    node_num=target,
+                    reason=(
+                        f"{stats.pending_nodes} node(s) unschedulable for "
+                        f"{stats.oldest_pending_s:.0f}s — shrink to {target}"
+                    ),
+                )
+        # 2) straggler shrink
+        if stats.straggler_nodes:
+            target = round_to_unit(
+                stats.running_nodes - len(stats.straggler_nodes), unit
+            )
+            if target >= stats.min_nodes:
+                return ResourcePlan(
+                    node_num=target,
+                    reason=(
+                        f"excluding stragglers {stats.straggler_nodes} — "
+                        f"shrink to {target}"
+                    ),
+                )
+        # 3) recovery grow
+        now = time.time()
+        if (
+            stats.pending_nodes == 0
+            and stats.target_nodes < stats.max_nodes
+            and now - self._last_grow > self.grow_cooldown_s
+        ):
+            target = min(stats.max_nodes,
+                         round_to_unit(stats.target_nodes + unit, unit))
+            if target > stats.target_nodes:
+                self._last_grow = now
+                return ResourcePlan(
+                    node_num=target,
+                    reason=f"probing recovery grow to {target}",
+                )
+        return ResourcePlan()
+
+
+class BrainOptimizer(ResourceOptimizer):
+    """Client for a cluster-level optimizer service (reference
+    brain_optimizer.py:64 → the Go Brain). Degrades to no-op when the
+    service is unreachable — auto-scaling must never take the job down."""
+
+    def __init__(self, brain_client):
+        self._client = brain_client
+
+    def plan(self, stats: ScalingStats) -> ResourcePlan:
+        try:
+            return self._client.optimize(stats)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("brain optimizer unavailable: %r", e)
+            return ResourcePlan()

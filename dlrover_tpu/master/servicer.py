@@ -31,6 +31,7 @@ class MasterServicer:
         perf_monitor=None,
         diagnosis_master=None,
         metric_context=None,
+        strategy_generator=None,
     ):
         self._job_manager = job_manager
         self._rdzv_managers = rdzv_managers
@@ -40,6 +41,7 @@ class MasterServicer:
         self._perf_monitor = perf_monitor
         self._diagnosis_master = diagnosis_master
         self._metric_context = metric_context
+        self._strategy_generator = strategy_generator
         self._start_time = time.time()
 
     # -- rendezvous --------------------------------------------------------
@@ -209,6 +211,7 @@ class MasterServicer:
                         device_id=d,
                         duty_cycle_pct=req.device_util.get(d),
                         hbm_used_mb=req.device_mem_mb.get(d, 0.0),
+                        hbm_total_mb=req.device_mem_total_mb.get(d, 0.0),
                     )
                     for d in sorted(
                         set(req.device_util) | set(req.device_mem_mb)
@@ -274,6 +277,8 @@ class MasterServicer:
     def rpc_get_parallel_config(
         self, req: comm.ParallelConfigRequest
     ) -> comm.ParallelConfig:
+        if self._strategy_generator is not None:
+            return self._strategy_generator.config
         return comm.ParallelConfig()
 
     def rpc_ping(self, req) -> comm.BaseResponse:

@@ -71,9 +71,11 @@ class JobMetricCollector:
         perf_monitor=None,
         reporter: Optional[StatsReporter] = None,
         interval_s: float = 15.0,
+        strategy_generator=None,
     ):
         self._job_manager = job_manager
         self._perf_monitor = perf_monitor
+        self._strategy_generator = strategy_generator
         self.reporter = reporter or LocalStatsReporter()
         self._interval_s = interval_s
         self._stopped = threading.Event()
@@ -118,5 +120,9 @@ class JobMetricCollector:
         while not self._stopped.wait(self._interval_s):
             try:
                 self.collect_once()
+                if self._strategy_generator is not None:
+                    # auto-tuning rides the same cadence: re-evaluate the
+                    # micro-batch against the freshest HBM telemetry
+                    self._strategy_generator.observe_and_update()
             except Exception:  # noqa: BLE001
                 logger.exception("stats collection failed")

@@ -227,7 +227,10 @@ class ElasticDataLoader:
         self.batch_size = batch_size
         self._sampler = sampler
         self._sharding = sharding_client
-        self._config_file = config_file
+        # agent-forked workers inherit the tuner's file path via env
+        self._config_file = config_file or os.getenv(
+            "DLROVER_TPU_PARAL_CONFIG_FILE"
+        )
         self._config_mtime = 0.0
         self._collate = collate_fn or _default_collate
 

@@ -1,0 +1,212 @@
+"""Auto-scaling tests: optimizer heuristics, JobAutoScaler execution
+through a real PodScaler, strategy generator, and the config-tuner →
+dataloader loop (reference: resource/auto-scaler tests, SURVEY.md §4)."""
+
+import json
+import time
+
+import pytest
+
+from dlrover_tpu.agent.config_tuner import ParalConfigTuner
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.common.metric import NodeMetrics, TpuMetric
+from dlrover_tpu.master.auto_scaler import JobAutoScaler
+from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
+from dlrover_tpu.master.resource import (
+    ScalingStats,
+    LocalOptimizer,
+    ResourcePlan,
+    round_to_unit,
+)
+
+
+def stats(**kw):
+    base = dict(
+        running_nodes=4, pending_nodes=0, target_nodes=4,
+        min_nodes=2, max_nodes=8, node_unit=2,
+        oldest_pending_s=0.0,
+    )
+    base.update(kw)
+    return ScalingStats(**base)
+
+
+# -- optimizer heuristics ---------------------------------------------------
+
+
+def test_round_to_unit():
+    assert round_to_unit(5, 2) == 4
+    assert round_to_unit(4, 4) == 4
+    assert round_to_unit(3, 4) == 0
+    assert round_to_unit(7, 1) == 7
+
+
+def test_unschedulable_shrink():
+    opt = LocalOptimizer(pending_timeout_s=10.0)
+    plan = opt.plan(stats(
+        running_nodes=5, pending_nodes=3, target_nodes=8,
+        oldest_pending_s=60.0,
+    ))
+    assert plan.node_num == 4  # 5 running rounded to unit 2
+
+
+def test_no_shrink_below_min():
+    opt = LocalOptimizer(pending_timeout_s=10.0)
+    plan = opt.plan(stats(
+        running_nodes=1, pending_nodes=7, target_nodes=8,
+        oldest_pending_s=60.0,
+    ))
+    assert plan.empty()  # 0 < min_nodes=2 — keep waiting
+
+
+def test_straggler_shrink():
+    opt = LocalOptimizer()
+    plan = opt.plan(stats(running_nodes=6, target_nodes=6,
+                          straggler_nodes=[5]))
+    assert plan.node_num == 4  # (6-1) rounded down to unit
+
+
+def test_recovery_grow_with_cooldown():
+    opt = LocalOptimizer(grow_cooldown_s=0.0)
+    plan = opt.plan(stats(running_nodes=4, target_nodes=4))
+    assert plan.node_num == 6  # one unit step toward max
+    opt2 = LocalOptimizer(grow_cooldown_s=3600.0)
+    opt2._last_grow = time.time()
+    assert opt2.plan(stats(running_nodes=4, target_nodes=4)).empty()
+
+
+# -- auto scaler ------------------------------------------------------------
+
+
+class RecordingScaler:
+    def __init__(self):
+        self.plans = []
+
+    def scale(self, plan):
+        self.plans.append(plan)
+
+
+class FakeJobManager:
+    def __init__(self, nodes):
+        self.nodes = nodes
+
+
+class FakePerf:
+    def running_speed(self, window=8):
+        return 1.0
+
+
+def make_nodes(running, pending, pending_age_s=0.0):
+    from dlrover_tpu.common.node import Node
+
+    nodes = {}
+    i = 0
+    for _ in range(running):
+        nodes[i] = Node(id=i, status=NodeStatus.RUNNING)
+        i += 1
+    for _ in range(pending):
+        n = Node(id=i, status=NodeStatus.PENDING)
+        n.create_time = time.time() - pending_age_s
+        nodes[i] = n
+        i += 1
+    return nodes
+
+
+def test_auto_scaler_executes_shrink_and_updates_rdzv():
+    from dlrover_tpu.master.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    scaler = RecordingScaler()
+    rdzv = ElasticTrainingRendezvousManager()
+    rdzv.update_rdzv_params(2, 8, node_unit=2)
+    auto = JobAutoScaler(
+        FakeJobManager(make_nodes(running=5, pending=3, pending_age_s=120)),
+        FakePerf(), scaler, rdzv_managers={"training": rdzv},
+        optimizer=LocalOptimizer(pending_timeout_s=10.0),
+        min_nodes=2, max_nodes=8, node_unit=2,
+    )
+    plan = auto.tick()
+    assert plan is not None and plan.node_num == 4
+    assert auto.target_nodes == 4
+    assert scaler.plans[0].worker_num == 4
+    assert rdzv._rdzv_params.max_nodes == 4
+
+
+def test_auto_scaler_clamps_to_bounds():
+    scaler = RecordingScaler()
+    auto = JobAutoScaler(
+        FakeJobManager({}), FakePerf(), scaler,
+        min_nodes=2, max_nodes=4, node_unit=1,
+    )
+    auto.execute(ResourcePlan(node_num=100, reason="x"))
+    assert auto.target_nodes == 4
+    auto.execute(ResourcePlan(node_num=0, reason="x"))
+    assert auto.target_nodes == 2
+
+
+# -- strategy generator -----------------------------------------------------
+
+
+def metrics_ctx(hbm_frac):
+    from dlrover_tpu.common.metric import JobMetricContext
+
+    ctx = JobMetricContext()
+    ctx.add_node_metrics(NodeMetrics(node_id=0, devices=[
+        TpuMetric(device_id=0, hbm_used_mb=hbm_frac * 16000,
+                  hbm_total_mb=16000),
+    ]))
+    return ctx
+
+
+def test_strategy_generator_halves_on_oom_risk():
+    gen = SimpleStrategyGenerator(metric_context=metrics_ctx(0.97))
+    gen.set_initial(batch_size=16)
+    cfg = gen.observe_and_update()
+    assert cfg is not None and cfg.dataloader_batch_size == 8
+    assert cfg.version == 2
+
+
+def test_strategy_generator_grows_on_headroom():
+    gen = SimpleStrategyGenerator(metric_context=metrics_ctx(0.2))
+    gen.set_initial(batch_size=16)
+    cfg = gen.observe_and_update()
+    assert cfg is not None and cfg.dataloader_batch_size == 32
+
+
+def test_strategy_generator_stable_in_band():
+    gen = SimpleStrategyGenerator(metric_context=metrics_ctx(0.6))
+    gen.set_initial(batch_size=16)
+    assert gen.observe_and_update() is None
+
+
+# -- config tuner end-to-end ------------------------------------------------
+
+
+def test_config_tuner_writes_file_and_loader_reloads(tmp_path):
+    from dlrover_tpu.master.master import LocalJobMaster
+    from dlrover_tpu.trainer.data import ElasticDataLoader
+    import numpy as np
+
+    master = LocalJobMaster(job_name="tune", node_num=1)
+    master.prepare()
+    try:
+        master.strategy_generator.set_initial(batch_size=4)
+        client = MasterClient(master.addr, 0)
+        path = str(tmp_path / "paral.json")
+        tuner = ParalConfigTuner(client, path, interval_s=0.05)
+        assert tuner.poll_once()
+        with open(path) as f:
+            assert json.load(f)["dataloader_batch_size"] == 4
+        # version bump → file rewritten
+        master.strategy_generator.set_initial(batch_size=8)
+        master.strategy_generator._config.version = 5
+        assert tuner.poll_once()
+
+        ds = np.arange(64, dtype=np.float32).reshape(64, 1)
+        loader = ElasticDataLoader(ds, batch_size=2, config_file=path)
+        batch = next(iter(loader))
+        assert batch.shape[0] == 8  # picked up the tuned size
+    finally:
+        master.stop()
