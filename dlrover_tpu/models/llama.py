@@ -27,9 +27,11 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from dlrover_tpu.ops.flash_attention import flash_attention
 from dlrover_tpu.parallel.ring_attention import (
     full_causal_attention,
     ring_attention,
+    sharded_flash_attention,
 )
 
 
@@ -47,6 +49,8 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     use_ring_attention: bool = False
+    # None = auto: fused pallas flash kernel on TPU, dense math elsewhere
+    use_flash_attention: Optional[bool] = None
 
     @property
     def head_dim(self) -> int:
@@ -137,6 +141,15 @@ def _rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
+def _flash_shardable(mesh, batch: int, n_heads: int) -> bool:
+    """Whether the short-context flash layout (batch over dp/fsdp, heads
+    over tp, sequence resident) divides the mesh evenly."""
+    dp = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    tp = mesh.shape.get("tp", 1)
+    sp = mesh.shape.get("sp", 1)
+    return sp == 1 and batch % dp == 0 and n_heads % tp == 0
+
+
 def _attention(x, layer, config: LlamaConfig, positions, mesh):
     c = config
     B, S, _ = x.shape
@@ -154,8 +167,16 @@ def _attention(x, layer, config: LlamaConfig, positions, mesh):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # (B,H,S,D)
+    use_flash = c.use_flash_attention
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
     if c.use_ring_attention and mesh is not None and mesh.shape.get("sp", 1) > 1:
-        out = ring_attention(q, k, v, mesh)
+        # honor an explicit kernel opt-out in the ring path too
+        out = ring_attention(q, k, v, mesh, use_pallas=c.use_flash_attention)
+    elif use_flash and mesh is None:
+        out = flash_attention(q, k, v, causal=True)
+    elif use_flash and _flash_shardable(mesh, B, c.n_heads):
+        out = sharded_flash_attention(q, k, v, mesh)
     else:
         out = full_causal_attention(q, k, v)
     out = out.transpose(0, 2, 1, 3).reshape(B, S, c.n_heads * c.head_dim)

@@ -1,0 +1,11 @@
+"""TPU Pallas kernels for the hot ops.
+
+The reference framework has no kernels (SURVEY.md: DLRover is a control
+plane); a from-scratch TPU stack owns its compute path. These kernels are
+MXU/VMEM-tiled pallas implementations used by the models layer:
+
+- :mod:`flash_attention` — blockwise causal attention (forward + backward),
+  the inner kernel of ring attention for long context.
+"""
+
+from dlrover_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,458 @@
+"""Blockwise (flash) attention as a TPU Pallas kernel, forward + backward.
+
+The reference (cyh-ant/dlrover) ships no attention kernel — it orchestrates
+Megatron/DeepSpeed jobs that bring their own (SURVEY.md §5.7). A TPU-native
+stack owns its compute path, so this module supplies the fused attention
+kernel the models layer and the ring-attention long-context layer build on.
+
+Design (MXU/VMEM-first):
+
+- Grid ``(B, H, num_q_blocks, num_k_blocks)`` with the K dimension
+  innermost: TPU grids execute sequentially on a core, so the online-softmax
+  accumulators (running max ``m``, denominator ``l``, unnormalized output
+  ``acc``) live in VMEM scratch and carry across K-block steps — no HBM
+  round-trips inside a Q row.
+- Each step is one ``(block_q, d) @ (d, block_k)`` MXU matmul in f32 plus
+  VPU elementwise (exp / mask / rescale); inputs stay bf16, accumulation
+  f32 (``preferred_element_type``).
+- Causal masking is block-structured: fully-future K blocks are skipped
+  under ``pl.when`` (no FLOPs), the diagonal block applies the triangular
+  mask, past blocks apply only the length mask.
+- Row statistics (``m``/``l``/``lse``) are kept lane-replicated with shape
+  ``(block_q, 128)`` — the VMEM-tileable layout for per-row scalars (same
+  scheme as XLA's reference kernels).
+- The kernel also returns the per-row log-sum-exp, which makes partial
+  results mergeable: ring attention combines per-ring-step partials with a
+  stable logsumexp merge (see parallel/ring_attention.py), and the backward
+  pass recomputes probabilities from ``lse`` instead of storing them.
+- Backward is two kernels — dq (grid K-innermost, dq accumulates in
+  scratch) and dk/dv (grid Q-innermost) — the standard recomputation
+  formulation: ``ds = p * (dp - delta)`` with
+  ``delta = rowsum(do * o) - dlse`` (the ``dlse`` term supports cotangents
+  flowing into the returned lse from the ring merge).
+
+On non-TPU backends (CPU tests) the kernels run in pallas interpret mode.
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces; absent on CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+NEG_INF = float(-1e30)  # avoid -inf arithmetic inside the kernel
+LANES = 128  # lane width for replicated row statistics
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _vmem_spec(block_shape, index_map):
+    if _VMEM is not None:
+        return pl.BlockSpec(block_shape, index_map, memory_space=_VMEM)
+    return pl.BlockSpec(block_shape, index_map)  # pragma: no cover
+
+
+def _vmem_scratch(shape, dtype):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, dtype)
+    return pl.MemoryRef(shape, dtype)  # pragma: no cover
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
+):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    rows = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    cols = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32)  # (block_q, d)
+        k = k_ref[0, 0].astype(jnp.float32)  # (block_k, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k)
+        mask = cols < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, cols <= rows)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:]  # (block_q, LANES), lane-replicated
+        l_prev = l_scr[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # (block_q, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, :1])
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[:] = m_new
+        acc_scr[:] = acc_scr[:] * alpha[:, :1] + jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # skip K blocks entirely in the future of this Q block
+        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_attend)
+    else:
+        _attend()
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_scr[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l[:, :1]).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.where(
+            l == 0.0, NEG_INF, m_scr[:] + jnp.log(safe_l)
+        )
+
+
+def _fwd(
+    q, k, v, *, scale, causal, block_q, block_k, interpret,
+) -> Tuple[jax.Array, jax.Array]:
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq = min(block_q, _round_up(Sq, 8))
+    bk = min(block_k, _round_up(Sk, 8))
+    q_pad = _round_up(Sq, bq) - Sq
+    k_pad = _round_up(Sk, bk) - Sk
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, q_pad), (0, 0))) if q_pad else q
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, k_pad), (0, 0))) if k_pad else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, k_pad), (0, 0))) if k_pad else v
+    nq = qp.shape[2] // bq
+    nk = kp.shape[2] // bk
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        kv_len=Sk,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            _vmem_spec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, bq, LANES), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, nq * bq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, nq * bq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem_scratch((bq, LANES), jnp.float32),
+            _vmem_scratch((bq, LANES), jnp.float32),
+            _vmem_scratch((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return o[:, :, :Sq], lse[:, :, :Sq, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, kv_len: int,
+):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    rows = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    cols = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    def _accum():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        mask = cols < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, cols <= rows)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])
+        p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, :1])
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_accum)
+    else:
+        _accum()
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+    kv_len: int, q_len: int,
+):
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    rows = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    cols = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    def _accum():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        mask = jnp.logical_and(cols < kv_len, rows < q_len)
+        if causal:
+            mask = jnp.logical_and(mask, cols <= rows)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])
+        p = jnp.where(mask, p, 0.0)
+        # dv += p^T @ do
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, :1])
+        # dk += ds^T @ q * scale
+        dk_scr[:] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # skip Q blocks entirely before this K block (no row attends it)
+        pl.when(iq * block_q + block_q - 1 >= ik * block_k)(_accum)
+    else:
+        _accum()
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(
+    q, k, v, o, lse, do, dlse, *, scale, causal, block_q, block_k, interpret,
+):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq = min(block_q, _round_up(Sq, 8))
+    bk = min(block_k, _round_up(Sk, 8))
+    q_pad = _round_up(Sq, bq) - Sq
+    k_pad = _round_up(Sk, bk) - Sk
+
+    # delta_i = rowsum(do_i * o_i) - dlse_i  (f32, one fused
+    # elementwise+reduce at the jnp level — not worth a kernel)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ) - dlse.astype(jnp.float32)
+
+    def padq(x):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, q_pad), (0, 0))) if q_pad else x
+
+    def padk(x):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, k_pad), (0, 0))) if k_pad else x
+
+    def rows_to_lanes(x, fill=0.0):
+        """(B,H,Sq) f32 → (B,H,Sq+pad,LANES) lane-replicated."""
+        if q_pad:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, q_pad)), constant_values=fill)
+        return jnp.broadcast_to(x[..., None], x.shape + (LANES,))
+
+    qp, dop = padq(q), padq(do)
+    kp, vp = padk(k), padk(v)
+    lsep = rows_to_lanes(lse, fill=NEG_INF)
+    deltap = rows_to_lanes(delta)
+    nq = qp.shape[2] // bq
+    nk = kp.shape[2] // bk
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=bq, block_k=bk, kv_len=Sk,
+        ),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            _vmem_spec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            _vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, bq, LANES), lambda b, h, i, j: (b, h, i, 0)),
+            _vmem_spec((1, 1, bq, LANES), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_specs=_vmem_spec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, nq * bq, D), q.dtype),
+        scratch_shapes=[_vmem_scratch((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=bq, block_k=bk, kv_len=Sk, q_len=Sq,
+        ),
+        grid=(B, H, nk, nq),
+        in_specs=[
+            _vmem_spec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            _vmem_spec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            _vmem_spec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, bq, LANES), lambda b, h, j, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, bq, LANES), lambda b, h, j, i: (b, h, i, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+            _vmem_spec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, nk * bk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, nk * bk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            _vmem_scratch((bk, D), jnp.float32),
+            _vmem_scratch((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    return dq[:, :, :Sq], dk[:, :, :Sk], dv[:, :, :Sk]
+
+
+# ---------------------------------------------------------------------------
+# public API (custom_vjp so ring-merge lse cotangents flow)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _fwd(
+        q, k, v, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd(
+        q, k, v, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    do, dlse = g
+    dq, dk, dv = _bwd(
+        q, k, v, o, lse, do, dlse, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q, k, v,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    return_lse: bool = False,
+    interpret: Optional[bool] = None,
+):
+    """Fused blockwise attention. q/k/v: (B, H, S, D); GQA callers repeat
+    KV heads first (XLA fuses the broadcast into the block loads).
+
+    Returns ``o`` (B, H, Sq, D), plus the per-row logsumexp (B, H, Sq) f32
+    when ``return_lse`` — the handle ring attention uses to merge partials.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = _default_interpret()
+    o, lse = _flash(
+        q, k, v, float(scale), bool(causal), int(block_q), int(block_k),
+        bool(interpret),
+    )
+    return (o, lse) if return_lse else o

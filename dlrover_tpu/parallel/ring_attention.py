@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dlrover_tpu.ops.flash_attention import flash_attention
+
 
 def _block_attend(q, k, v, mask, m, l, o, scale):
     """Fold one K/V block into the online-softmax accumulators.
@@ -94,22 +96,130 @@ def _ring_attention_local(q, k, v, axis_name: str, scale: float):
     return (o / l[..., None]).astype(q.dtype)
 
 
+def _merge_partials(o1, lse1, o2, lse2):
+    """Numerically-stable merge of two normalized attention partials.
+
+    o: (B, H, S, D) f32; lse: (B, H, S) f32 (-1e30 ≈ -inf for empty).
+    Standard logsumexp combine — differentiable, so grads flow back into
+    each partial's flash kernel via its lse cotangent.
+    """
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(m <= -1e29, 0.0, m)
+    w1 = jnp.exp(lse1 - m_safe)
+    w2 = jnp.exp(lse2 - m_safe)
+    denom = w1 + w2
+    safe = jnp.where(denom == 0.0, 1.0, denom)
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / safe[..., None]
+    lse = jnp.where(denom == 0.0, -1e30, m_safe + jnp.log(safe))
+    return o, lse
+
+
+def _ring_flash_local(
+    q, k, v, axis_name: str, scale: float, block_q: int, block_k: int,
+):
+    """Ring attention with the pallas flash kernel as the inner block op.
+
+    Same ring schedule as :func:`_ring_attention_local`, but each visiting
+    block runs the fused flash kernel (causal for the diagonal chunk, dense
+    for past chunks) and partials merge by logsumexp — the blockwise
+    formulation of Ring Attention with a hardware inner loop.
+    """
+    sp = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    flash = functools.partial(
+        flash_attention, scale=scale, block_q=block_q, block_k=block_k,
+        return_lse=True,
+    )
+    # step 0: the diagonal chunk (our own K/V) with the triangular mask
+    o0, lse0 = flash(q, k, v, causal=True)
+    o0 = o0.astype(jnp.float32)
+
+    def step(i, carry):
+        o, lse, k_blk, v_blk = carry
+        # rotate first: after i steps the visiting block is ring chunk
+        # (my_idx - i) mod sp
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        src = (my_idx - i) % sp
+
+        def attend(o, lse, k_blk, v_blk):
+            o_b, lse_b = flash(q, k_blk, v_blk, causal=False)
+            return _merge_partials(o, lse, o_b.astype(jnp.float32), lse_b)
+
+        # chunks after ours contribute nothing (causal); cond keeps the
+        # collective schedule identical on every device (ppermute above)
+        o, lse = jax.lax.cond(
+            src < my_idx,
+            attend,
+            lambda o, lse, k_blk, v_blk: (o, lse),
+            o, lse, k_blk, v_blk,
+        )
+        return o, lse, k_blk, v_blk
+
+    o, lse, _, _ = jax.lax.fori_loop(1, sp, step, (o0, lse0, k, v))
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q, k, v,
     mesh: Mesh,
     sp_axis: str = "sp",
     batch_spec=P(("dp", "fsdp"), "tp", "sp", None),
     scale: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+    block_q: int = 128,
+    block_k: int = 128,
 ):
     """Causal attention with the sequence axis sharded over ``sp_axis``.
 
     q/k/v: (B, H, S, D) jax.Arrays (S sharded over sp). Returns same shape/
     sharding. Inside jit, composes with the surrounding GSPMD program via
-    shard_map.
+    shard_map. ``use_pallas`` selects the fused flash inner kernel
+    (default: on TPU backends).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        fn = functools.partial(
+            _ring_flash_local, axis_name=sp_axis, scale=scale,
+            block_q=block_q, block_k=block_k,
+        )
+    else:
+        fn = functools.partial(
+            _ring_attention_local, axis_name=sp_axis, scale=scale
+        )
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(batch_spec, batch_spec, batch_spec),
+        out_specs=batch_spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def sharded_flash_attention(
+    q, k, v,
+    mesh: Mesh,
+    batch_spec=P(("dp", "fsdp"), "tp", None, None),
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """Causal flash attention with batch sharded over dp/fsdp and heads
+    over tp (sequence resident per device — the short-context layout).
+
+    pallas_call has no GSPMD partitioning rule, so calling the kernel on
+    sharded arrays inside jit would force replication; shard_map pins the
+    per-device block the kernel sees. Callers must ensure the batch/head
+    dims divide the mesh axes (see models/llama.py:_attention).
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     fn = functools.partial(
-        _ring_attention_local, axis_name=sp_axis, scale=scale
+        flash_attention, causal=True, scale=scale,
+        block_q=block_q, block_k=block_k,
     )
     return jax.shard_map(
         fn,
