@@ -33,6 +33,7 @@ from dlrover_tpu.parallel.ring_attention import (
     ring_attention,
     sharded_flash_attention,
 )
+from dlrover_tpu.parallel.ulysses import ulysses_attention
 
 
 @dataclass(frozen=True)
@@ -48,7 +49,12 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # long-context strategy when the sp mesh axis is >1:
+    # "ring" = K/V ppermute ring (unbounded S, sp hops);
+    # "ulysses" = head-scatter all-to-all (full S per device; 4 a2a calls
+    #   per attention — q/k/v in, output out — k/v legs unrepeated in GQA)
     use_ring_attention: bool = False
+    sp_attention: str = "ring"
     # None = auto: fused pallas flash kernel on TPU, dense math elsewhere
     use_flash_attention: Optional[bool] = None
 
@@ -161,18 +167,36 @@ def _attention(x, layer, config: LlamaConfig, positions, mesh):
     v = v.reshape(B, S, c.n_kv_heads, c.head_dim)
     q = _rope(q, positions, c.rope_theta)
     k = _rope(k, positions, c.rope_theta)
-    # GQA: repeat kv heads to match q heads
-    rep = c.n_heads // c.n_kv_heads
-    if rep > 1:
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # (B,H,S,D)
+    if c.sp_attention not in ("ring", "ulysses"):
+        raise ValueError(
+            f"unknown sp_attention {c.sp_attention!r}; expected 'ring' or "
+            "'ulysses'"
+        )
     use_flash = c.use_flash_attention
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu"
+    use_ulysses = (
+        c.use_ring_attention and mesh is not None
+        and mesh.shape.get("sp", 1) > 1 and c.sp_attention == "ulysses"
+    )
+    # GQA: repeat kv heads to match q heads — except on the Ulysses path,
+    # which scatters unrepeated K/V (1/rep the all-to-all bytes) and
+    # broadcasts heads device-locally after
+    rep = c.n_heads // c.n_kv_heads
+    if rep > 1 and not use_ulysses:
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     if c.use_ring_attention and mesh is not None and mesh.shape.get("sp", 1) > 1:
-        # honor an explicit kernel opt-out in the ring path too
-        out = ring_attention(q, k, v, mesh, use_pallas=c.use_flash_attention)
+        # honor an explicit kernel opt-out in the sp paths too
+        if use_ulysses:
+            out = ulysses_attention(
+                q, k, v, mesh, use_pallas=c.use_flash_attention
+            )
+        else:
+            out = ring_attention(
+                q, k, v, mesh, use_pallas=c.use_flash_attention
+            )
     elif use_flash and mesh is None:
         out = flash_attention(q, k, v, causal=True)
     elif use_flash and _flash_shardable(mesh, B, c.n_heads):
