@@ -49,14 +49,23 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    # long-context strategy when the sp mesh axis is >1:
+    # long-context strategy applied when the sp mesh axis is >1:
+    # None = no sequence-parallel attention;
     # "ring" = K/V ppermute ring (unbounded S, sp hops);
     # "ulysses" = head-scatter all-to-all (full S per device; 4 a2a calls
     #   per attention — q/k/v in, output out — k/v legs unrepeated in GQA)
+    sp_attention: Optional[str] = None
+    # legacy alias: True ≡ sp_attention="ring" (when sp_attention is None)
     use_ring_attention: bool = False
-    sp_attention: str = "ring"
     # None = auto: fused pallas flash kernel on TPU, dense math elsewhere
     use_flash_attention: Optional[bool] = None
+
+    @property
+    def sp_strategy(self) -> Optional[str]:
+        """Effective sp strategy after the legacy-alias fold."""
+        if self.sp_attention is not None:
+            return self.sp_attention
+        return "ring" if self.use_ring_attention else None
 
     @property
     def head_dim(self) -> int:
@@ -76,16 +85,24 @@ class LlamaConfig:
         )
 
 
+def attention_param_axes() -> Dict:
+    """Per-layer attention-block logical axes — shared by every model
+    family that reuses the Llama attention blocks (e.g. models/moe.py)."""
+    return {
+        "attn_norm": ("layers", "norm"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+    }
+
+
 def param_logical_axes(config: LlamaConfig) -> Dict:
     """Logical sharding axes per param (see parallel/sharding.py rules)."""
     return {
         "tok_embed": ("vocab", "embed"),
         "layers": {
-            "attn_norm": ("layers", "norm"),
-            "wq": ("layers", "embed", "heads"),
-            "wk": ("layers", "embed", "kv_heads"),
-            "wv": ("layers", "embed", "kv_heads"),
-            "wo": ("layers", "heads", "embed"),
+            **attention_param_axes(),
             "ffn_norm": ("layers", "norm"),
             "w1": ("layers", "embed", "mlp"),
             "w3": ("layers", "embed", "mlp"),
@@ -96,34 +113,46 @@ def param_logical_axes(config: LlamaConfig) -> Dict:
     }
 
 
-def init_params(config: LlamaConfig, key) -> Dict:
-    """He-style init, params in config.dtype (bf16)."""
+def dense_init(key, shape, fan_in, dtype):
+    """He-style dense init shared across model families."""
+    return (jax.random.normal(key, shape, dtype=jnp.float32)
+            * (fan_in ** -0.5)).astype(dtype)
+
+
+def init_attention_params(config, key) -> Dict:
+    """Stacked (L, …) attention-block params for any config exposing
+    n_layers/dim/n_heads/n_kv_heads/head_dim/dtype."""
     c = config
-    keys = jax.random.split(key, 8)
-    dt = c.dtype
-
-    def dense(k, shape, fan_in):
-        return (jax.random.normal(k, shape, dtype=jnp.float32)
-                * (fan_in ** -0.5)).astype(dt)
-
+    keys = jax.random.split(key, 4)
     L = c.n_layers
     q_dim = c.n_heads * c.head_dim
     kv_dim = c.n_kv_heads * c.head_dim
     return {
-        "tok_embed": dense(keys[0], (c.vocab_size, c.dim), c.dim),
+        "attn_norm": jnp.ones((L, c.dim), dtype=c.dtype),
+        "wq": dense_init(keys[0], (L, c.dim, q_dim), c.dim, c.dtype),
+        "wk": dense_init(keys[1], (L, c.dim, kv_dim), c.dim, c.dtype),
+        "wv": dense_init(keys[2], (L, c.dim, kv_dim), c.dim, c.dtype),
+        "wo": dense_init(keys[3], (L, q_dim, c.dim), q_dim, c.dtype),
+    }
+
+
+def init_params(config: LlamaConfig, key) -> Dict:
+    """He-style init, params in config.dtype (bf16)."""
+    c = config
+    keys = jax.random.split(key, 5)
+    dt = c.dtype
+    L = c.n_layers
+    return {
+        "tok_embed": dense_init(keys[0], (c.vocab_size, c.dim), c.dim, dt),
         "layers": {
-            "attn_norm": jnp.ones((L, c.dim), dtype=dt),
-            "wq": dense(keys[1], (L, c.dim, q_dim), c.dim),
-            "wk": dense(keys[2], (L, c.dim, kv_dim), c.dim),
-            "wv": dense(keys[3], (L, c.dim, kv_dim), c.dim),
-            "wo": dense(keys[4], (L, q_dim, c.dim), q_dim),
+            **init_attention_params(c, keys[1]),
             "ffn_norm": jnp.ones((L, c.dim), dtype=dt),
-            "w1": dense(keys[5], (L, c.dim, c.ffn_dim), c.dim),
-            "w3": dense(keys[6], (L, c.dim, c.ffn_dim), c.dim),
-            "w2": dense(keys[7], (L, c.ffn_dim, c.dim), c.ffn_dim),
+            "w1": dense_init(keys[2], (L, c.dim, c.ffn_dim), c.dim, dt),
+            "w3": dense_init(keys[3], (L, c.dim, c.ffn_dim), c.dim, dt),
+            "w2": dense_init(keys[4], (L, c.ffn_dim, c.dim), c.ffn_dim, dt),
         },
         "final_norm": jnp.ones((c.dim,), dtype=dt),
-        "lm_head": dense(keys[0], (c.dim, c.vocab_size), c.dim),
+        "lm_head": dense_init(keys[0], (c.dim, c.vocab_size), c.dim, dt),
     }
 
 
@@ -168,28 +197,29 @@ def _attention(x, layer, config: LlamaConfig, positions, mesh):
     q = _rope(q, positions, c.rope_theta)
     k = _rope(k, positions, c.rope_theta)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # (B,H,S,D)
-    if c.sp_attention not in ("ring", "ulysses"):
+    strategy = c.sp_strategy
+    if strategy not in (None, "ring", "ulysses"):
         raise ValueError(
-            f"unknown sp_attention {c.sp_attention!r}; expected 'ring' or "
+            f"unknown sp_attention {strategy!r}; expected None, 'ring' or "
             "'ulysses'"
         )
     use_flash = c.use_flash_attention
     if use_flash is None:
         use_flash = jax.default_backend() == "tpu"
-    use_ulysses = (
-        c.use_ring_attention and mesh is not None
-        and mesh.shape.get("sp", 1) > 1 and c.sp_attention == "ulysses"
+    use_sp = (
+        strategy is not None and mesh is not None
+        and mesh.shape.get("sp", 1) > 1
     )
     # GQA: repeat kv heads to match q heads — except on the Ulysses path,
     # which scatters unrepeated K/V (1/rep the all-to-all bytes) and
     # broadcasts heads device-locally after
     rep = c.n_heads // c.n_kv_heads
-    if rep > 1 and not use_ulysses:
+    if rep > 1 and not (use_sp and strategy == "ulysses"):
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
-    if c.use_ring_attention and mesh is not None and mesh.shape.get("sp", 1) > 1:
+    if use_sp:
         # honor an explicit kernel opt-out in the sp paths too
-        if use_ulysses:
+        if strategy == "ulysses":
             out = ulysses_attention(
                 q, k, v, mesh, use_pallas=c.use_flash_attention
             )
@@ -205,6 +235,11 @@ def _attention(x, layer, config: LlamaConfig, positions, mesh):
         out = full_causal_attention(q, k, v)
     out = out.transpose(0, 2, 1, 3).reshape(B, S, c.n_heads * c.head_dim)
     return jnp.einsum("bsh,hd->bsd", out, layer["wo"])
+
+
+# public names for model families composing these blocks (models/moe.py)
+attention_block = _attention
+rms_norm = _rms_norm
 
 
 def _mlp(x, layer):

@@ -1,0 +1,265 @@
+"""Mixtral-class sparse Mixture-of-Experts decoder, TPU-first.
+
+The reference delegates MoE entirely to Megatron/DeepSpeed (SURVEY.md §2.7:
+EP "absent — delegated to frameworks"); a from-scratch TPU stack owns it.
+Design for the MXU/GSPMD:
+
+- **einsum dispatch/combine** (GShard-style): routing becomes two dense
+  einsums against a (tokens, experts, capacity) one-hot tensor — static
+  shapes, no gather/scatter, XLA shards it cleanly. Capacity-dropped
+  tokens fall through the residual connection (standard Switch behavior);
+- **expert-axis sharding**: every expert tensor carries a leading
+  ``expert`` logical axis → the ``ep`` mesh axis (parallel/sharding.py
+  DEFAULT_RULES), so expert FFNs compute where their weights live and
+  GSPMD inserts the token all-to-alls;
+- **top-k routing with renormalized gates** (Mixtral) + Switch-style
+  load-balancing auxiliary loss, both in f32;
+- attention/norms/RoPE are the Llama blocks (models/llama.py) unchanged —
+  ring/Ulysses long-context paths compose with MoE layers;
+- scanned layers, bf16 params, remat: same compile-time story as llama.
+
+Checkpoint shards fall out of the ``NamedSharding`` on each leaf — the
+engine needs no MoE-specific code (ckpt shard = mesh coords incl. ep).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import llama as _llama
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336          # per-expert FFN width (Mixtral 8x7B)
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25  # expert slots = g/E · top_k · this
+    # routing group size (GShard num_groups dual): tokens route within
+    # fixed-size groups so the (g, E, C) dispatch tensor stays O(g²) per
+    # group instead of O(T²) over the whole batch. None = one sequence
+    # per group (g = S), the standard choice.
+    route_group_size: Optional[int] = None
+    router_aux_weight: float = 0.01
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # same semantics as LlamaConfig: None | "ring" | "ulysses"
+    sp_attention: Optional[str] = None
+    use_ring_attention: bool = False  # legacy alias for sp_attention="ring"
+    use_flash_attention: Optional[bool] = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def sp_strategy(self) -> Optional[str]:
+        if self.sp_attention is not None:
+            return self.sp_attention
+        return "ring" if self.use_ring_attention else None
+
+    @staticmethod
+    def mixtral8x7b() -> "MoEConfig":
+        """Mixtral-8x7B shapes — 46.7B params, 12.9B active."""
+        return MoEConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "MoEConfig":
+        """CI-sized config: 4 experts, top-2."""
+        return MoEConfig(
+            vocab_size=vocab_size, dim=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, ffn_dim=96, n_experts=4, top_k=2,
+            max_seq_len=128, remat=False,
+        )
+
+
+def param_logical_axes(config: MoEConfig) -> Dict:
+    """Logical sharding axes per param (parallel/sharding.py rules;
+    ``expert`` → ep mesh axis)."""
+    return {
+        "tok_embed": ("vocab", "embed"),
+        "layers": {
+            **_llama.attention_param_axes(),
+            "ffn_norm": ("layers", "norm"),
+            "router": ("layers", "embed", None),
+            "w1": ("layers", "expert", "embed", "mlp"),
+            "w3": ("layers", "expert", "embed", "mlp"),
+            "w2": ("layers", "expert", "mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(config: MoEConfig, key) -> Dict:
+    c = config
+    keys = jax.random.split(key, 7)
+    dt = c.dtype
+    dense = _llama.dense_init
+    L, E = c.n_layers, c.n_experts
+    return {
+        "tok_embed": dense(keys[0], (c.vocab_size, c.dim), c.dim, dt),
+        "layers": {
+            **_llama.init_attention_params(c, keys[1]),
+            "ffn_norm": jnp.ones((L, c.dim), dtype=dt),
+            # router stays f32: tiny, and routing decisions are precision-
+            # sensitive (standard MoE practice)
+            "router": jax.random.normal(
+                keys[2], (L, c.dim, E), dtype=jnp.float32) * (c.dim ** -0.5),
+            "w1": dense(keys[3], (L, E, c.dim, c.ffn_dim), c.dim, dt),
+            "w3": dense(keys[4], (L, E, c.dim, c.ffn_dim), c.dim, dt),
+            "w2": dense(keys[5], (L, E, c.ffn_dim, c.dim), c.ffn_dim, dt),
+        },
+        "final_norm": jnp.ones((c.dim,), dtype=dt),
+        "lm_head": dense(keys[6], (c.dim, c.vocab_size), c.dim, dt),
+    }
+
+
+def _group_size(config: MoEConfig, batch: int, seq: int) -> int:
+    """Routing group size: config override or one sequence per group."""
+    g = config.route_group_size or seq
+    if (batch * seq) % g != 0:
+        raise ValueError(
+            f"route_group_size {g} must divide token count {batch * seq}"
+        )
+    return g
+
+
+def expert_capacity(config: MoEConfig, batch: int, seq: int) -> int:
+    """Static per-expert token slots *per routing group*."""
+    c = config
+    g = _group_size(c, batch, seq)
+    cap = int(g * c.top_k * c.capacity_factor / c.n_experts)
+    return max(c.top_k, cap)
+
+
+def _route(x_grouped, router, config: MoEConfig, capacity: int):
+    """Top-k routing with capacity → dispatch/combine tensors + aux loss.
+
+    x_grouped: (G, g, D) — G routing groups of g tokens; capacity is
+    per-expert *per group*, so the dispatch tensor is (G, g, E, C) with
+    C ∝ g (bounded per group, not O(total²)). Returns dispatch 0/1,
+    combine f32 gate weights, aux scalar. Choice-major priority within a
+    group: every token's first choice claims capacity before any token's
+    second choice (GShard order).
+    """
+    c = config
+    G, g = x_grouped.shape[0], x_grouped.shape[1]
+    E, k = c.n_experts, c.top_k
+    logits = jnp.einsum(
+        "gtd,de->gte", x_grouped.astype(jnp.float32), router
+    )
+    probs = jax.nn.softmax(logits, axis=-1)               # (G, g, E) f32
+    topv, topi = jax.lax.top_k(probs, k)                  # (G, g, k)
+    gates = topv / jnp.clip(topv.sum(-1, keepdims=True), 1e-9)  # renorm
+
+    masks = jax.nn.one_hot(topi, E, dtype=jnp.float32)    # (G, g, k, E)
+    cm = masks.transpose(0, 2, 1, 3)                      # (G, k, g, E)
+    positions = (
+        jnp.cumsum(cm.reshape(G, k * g, E), axis=1).reshape(G, k, g, E) - 1.0
+    )
+    keep = (positions < capacity) * cm                    # (G, k, g, E)
+    pos_in_expert = (positions * cm).sum(-1).astype(jnp.int32)  # (G, k, g)
+    slot = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
+    # (G, k, g, E, C): expert one-hot × slot one-hot, overflow dropped
+    oh = keep[..., None] * slot[:, :, :, None, :]
+    dispatch = oh.sum(1)                                  # (G, g, E, C)
+    gates_km = gates.transpose(0, 2, 1)                   # (G, k, g)
+    combine = (oh * gates_km[..., None, None]).sum(1)     # (G, g, E, C)
+
+    # load-balancing loss over ALL k choices (ST-MoE/Mixtral style): a
+    # router dumping second choices on one expert is penalized too.
+    # E · Σ_e (choice fraction · mean router prob), averaged over groups
+    frac = masks.mean(axis=(1, 2))                        # (G, E)
+    aux = E * jnp.mean(jnp.sum(frac * probs.mean(axis=1), axis=-1))
+    return dispatch, combine, aux
+
+
+def _moe_ffn(x, layer, config: MoEConfig):
+    """Sparse expert FFN. x: (B, S, D) → (B, S, D), aux scalar."""
+    c = config
+    B, S, D = x.shape
+    capacity = expert_capacity(c, B, S)
+    g = _group_size(c, B, S)
+    x_grouped = x.reshape(B * S // g, g, D)
+    dispatch, combine, aux = _route(x_grouped, layer["router"], c, capacity)
+    # dispatch/compute/combine — three einsums, expert axis sharded over ep
+    expert_in = jnp.einsum(
+        "gtec,gtd->gecd", dispatch.astype(x.dtype), x_grouped
+    )
+    gate = jax.nn.silu(jnp.einsum("gecd,edf->gecf", expert_in, layer["w1"]))
+    up = jnp.einsum("gecd,edf->gecf", expert_in, layer["w3"])
+    expert_out = jnp.einsum("gecf,efd->gecd", gate * up, layer["w2"])
+    out = jnp.einsum(
+        "gtec,gecd->gtd", combine.astype(x.dtype), expert_out
+    )
+    return out.reshape(B, S, D), aux
+
+
+def forward(
+    params: Dict,
+    tokens,
+    config: MoEConfig,
+    mesh=None,
+) -> Tuple[Any, Any]:
+    """tokens (B, S) int32 → (logits (B, S, vocab) f32, aux loss scalar)."""
+    c = config
+    B, S = tokens.shape
+    x = params["tok_embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+
+    def layer_fn(carry, layer):
+        h, aux_sum = carry
+        h = h + _llama.attention_block(
+            _llama.rms_norm(h, layer["attn_norm"], c.norm_eps),
+            layer, c, positions, mesh,
+        )
+        ffn_out, aux = _moe_ffn(
+            _llama.rms_norm(h, layer["ffn_norm"], c.norm_eps), layer, c
+        )
+        return (h + ffn_out, aux_sum + aux), None
+
+    scan_fn = layer_fn
+    if c.remat:
+        scan_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+    (x, aux_sum), _ = jax.lax.scan(
+        scan_fn, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    )
+    x = _llama.rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+    return logits, aux_sum / c.n_layers
+
+
+def next_token_loss(params, tokens, config: MoEConfig, mesh=None):
+    """Causal LM loss + router load-balancing aux term."""
+    logits, aux = forward(params, tokens[:, :-1], config, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean() + config.router_aux_weight * aux
+
+
+def num_params(config: MoEConfig) -> Tuple[int, int]:
+    """(total, active-per-token) parameter counts."""
+    c = config
+    q_dim, kv_dim = c.n_heads * c.head_dim, c.n_kv_heads * c.head_dim
+    attn = 2 * c.dim + c.dim * q_dim + 2 * c.dim * kv_dim + q_dim * c.dim
+    expert = 3 * c.dim * c.ffn_dim
+    router = c.dim * c.n_experts
+    shared = c.vocab_size * c.dim * 2 + c.dim
+    total = shared + c.n_layers * (attn + router + c.n_experts * expert)
+    active = shared + c.n_layers * (attn + router + c.top_k * expert)
+    return total, active

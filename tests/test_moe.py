@@ -1,0 +1,135 @@
+"""MoE model + expert-parallel tests on the virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.models import moe
+from dlrover_tpu.parallel.mesh import build_mesh, plan_mesh
+from dlrover_tpu.parallel.sharding import batch_sharding, shard_tree
+
+
+def _tiny(dtype=jnp.float32, **kw):
+    base = moe.MoEConfig.tiny().__dict__
+    base.update(dtype=dtype, **kw)
+    return moe.MoEConfig(**base)
+
+
+class TestRouting:
+    def test_dispatch_combine_shapes_and_mass(self):
+        c = _tiny()
+        G, g, D = 2, 32, c.dim
+        x = jax.random.normal(jax.random.PRNGKey(0), (G, g, D))
+        router = jax.random.normal(jax.random.PRNGKey(1), (D, c.n_experts))
+        cap = moe.expert_capacity(c, G, g)
+        dispatch, combine, aux = moe._route(x, router, c, cap)
+        assert dispatch.shape == (G, g, c.n_experts, cap)
+        # each token occupies at most top_k slots, each slot ≤ 1 token
+        assert float(dispatch.sum(axis=(2, 3)).max()) <= c.top_k
+        assert float(dispatch.sum(axis=1).max()) <= 1.0 + 1e-6
+        # combine weights for a fully-dispatched token sum to ~1
+        per_tok = combine.sum(axis=(2, 3))
+        full = dispatch.sum(axis=(2, 3)) == c.top_k
+        np.testing.assert_allclose(
+            np.asarray(per_tok)[np.asarray(full)], 1.0, atol=1e-5
+        )
+        assert float(aux) > 0.0
+
+    def test_capacity_drops_overflow(self):
+        c = _tiny(capacity_factor=0.25)
+        g = 64
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, g, c.dim))
+        router = jnp.zeros((c.dim, c.n_experts))  # uniform: argmax ties
+        cap = moe.expert_capacity(c, 1, g)
+        dispatch, _, _ = moe._route(x, router, c, cap)
+        assert float(dispatch.sum(axis=1).max()) <= 1.0 + 1e-6
+        assert float(dispatch.sum()) <= c.n_experts * cap + 1e-6
+
+    def test_group_size_bounds_capacity(self):
+        # capacity depends on the group size, not the total token count
+        c = _tiny(route_group_size=32)
+        assert moe.expert_capacity(c, 8, 128) == moe.expert_capacity(c, 1, 32)
+        with pytest.raises(ValueError, match="divide"):
+            moe.expert_capacity(c, 1, 33)
+
+
+class TestMoEModel:
+    def test_forward_and_loss_finite(self):
+        c = _tiny()
+        params = moe.init_params(c, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 33), 0, c.vocab_size
+        )
+        logits, aux = moe.forward(params, tokens[:, :-1], c)
+        assert logits.shape == (2, 32, c.vocab_size)
+        loss = moe.next_token_loss(params, tokens, c)
+        assert bool(jnp.isfinite(loss)) and bool(jnp.isfinite(aux))
+
+    def test_num_params_mixtral_scale(self):
+        total, active = moe.num_params(moe.MoEConfig.mixtral8x7b())
+        assert 45e9 < total < 48e9
+        assert 12e9 < active < 14e9
+
+    def test_train_step_learns(self):
+        c = _tiny()
+        params = moe.init_params(c, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 17), 0, c.vocab_size
+        )
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(params)
+        step = jax.jit(
+            lambda p, s, t: _update(p, s, t, c, opt)
+        )
+        l0 = None
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            l0 = l0 if l0 is not None else float(loss)
+        assert float(loss) < l0
+
+
+def _update(params, opt_state, tokens, c, opt):
+    loss, grads = jax.value_and_grad(moe.next_token_loss)(params, tokens, c)
+    updates, opt_state = opt.update(grads, opt_state)
+    return optax.apply_updates(params, updates), opt_state, loss
+
+
+class TestExpertParallel:
+    def test_ep_sharded_matches_unsharded(self):
+        c = _tiny()
+        mesh = build_mesh(plan_mesh(8, ep=4))  # ep=4, fsdp=2
+        params = moe.init_params(c, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 32), 0, c.vocab_size
+        )
+        ref, _ = moe.forward(params, tokens, c)
+        sharded = shard_tree(mesh, params, moe.param_logical_axes(c))
+        tok_s = jax.device_put(
+            tokens, NamedSharding(mesh, P(("dp", "fsdp"), None))
+        )
+        out, _ = jax.jit(lambda p, t: moe.forward(p, t, c, mesh))(
+            sharded, tok_s
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3
+        )
+
+    def test_ep_with_sp_ring(self):
+        c = _tiny(use_ring_attention=True)
+        mesh = build_mesh(plan_mesh(8, ep=2, sp=2))
+        params = moe.init_params(c, jax.random.PRNGKey(0))
+        sharded = shard_tree(mesh, params, moe.param_logical_axes(c))
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, c.vocab_size),
+            NamedSharding(mesh, P(("dp", "fsdp"), None)),
+        )
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p, t: moe.next_token_loss(p, t, c, mesh)
+        ))(sharded, tokens)
+        assert bool(jnp.isfinite(loss))
+        assert all(
+            bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads)
+        )
