@@ -48,6 +48,10 @@ class ElasticLaunchConfig:
     # cross-host in-memory checkpoint redundancy: backup-group size
     # (reference flash_checkpoint/replica.py; 0/1 disables)
     ckpt_replica: int = 0
+    # start the tpu_timer observability plane: workers patch the PJRT table
+    # and serve per-rank metrics; the agent runs the per-host aggregation
+    # daemon on :18889 (reference xpu_timer_launch LD_PRELOAD + daemon)
+    tpu_timer: bool = False
 
     def auto_configure_params(self) -> None:
         """Fill topology-dependent defaults from the environment

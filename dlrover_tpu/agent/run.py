@@ -70,6 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="poll master-tuned dataloader/grad-accum config")
     p.add_argument("--no-save-at-breakpoint", dest="save_at_breakpoint",
                    action="store_false")
+    p.add_argument("--tpu-timer", dest="tpu_timer", action="store_true",
+                   help="enable the native profiler plane: workers patch "
+                        "the PJRT table, agent aggregates on :18889")
     p.add_argument("entrypoint", help="training script")
     p.add_argument("args", nargs=argparse.REMAINDER)
     return p
@@ -94,6 +97,7 @@ def config_from_args(args) -> ElasticLaunchConfig:
         ckpt_dir=args.ckpt_dir,
         ckpt_replica=args.ckpt_replica,
         auto_tunning=args.auto_tunning,
+        tpu_timer=args.tpu_timer,
         entrypoint=args.entrypoint,
         args=args.args[1:] if args.args[:1] == ["--"] else list(args.args),
     )

@@ -233,6 +233,8 @@ class ElasticTrainingAgent:
             EnvKey.REPLICA_GROUP: str(self._config.ckpt_replica),
             "DLROVER_TPU_IPC_SOCKET": self._ipc_server.path,
         })
+        if self._config.tpu_timer:
+            env["TPU_TIMER_ENABLE"] = "1"
         return env
 
     def _initialize_workers(self) -> None:
@@ -407,6 +409,16 @@ class ElasticTrainingAgent:
         )
         resource_monitor.start()
         self._training_monitor.start()
+        timer_daemon = None
+        if self._config.tpu_timer:
+            # per-host metrics aggregator; the diagnosis TpuTimerCollector
+            # scrapes it on :18889 (reference starts xpu_timer_daemon from
+            # the launch wrapper)
+            from dlrover_tpu.observability.timeline import start_daemon
+
+            timer_daemon = start_daemon(
+                n_workers=self._config.nproc_per_node
+            )
         config_tuner = None
         if self._config.auto_tunning:
             from dlrover_tpu.agent.config_tuner import (
@@ -435,6 +447,8 @@ class ElasticTrainingAgent:
                 self._ckpt_saver.stop()
             if self._replica_service is not None:
                 self._replica_service.stop()
+            if timer_daemon is not None:
+                timer_daemon.kill()
             self._ipc_server.stop()
 
     def _monitor_loop(self) -> int:

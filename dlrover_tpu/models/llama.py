@@ -36,8 +36,25 @@ from dlrover_tpu.parallel.ring_attention import (
 from dlrover_tpu.parallel.ulysses import ulysses_attention
 
 
+class AttentionConfigMixin:
+    """Shared attention-config surface for decoder configs (LlamaConfig,
+    moe.MoEConfig): the sp-strategy legacy-alias fold and head_dim. One copy
+    so sp semantics can't drift between model families."""
+
+    @property
+    def sp_strategy(self) -> Optional[str]:
+        """Effective sp strategy after the legacy-alias fold."""
+        if self.sp_attention is not None:
+            return self.sp_attention
+        return "ring" if self.use_ring_attention else None
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
 @dataclass(frozen=True)
-class LlamaConfig:
+class LlamaConfig(AttentionConfigMixin):
     vocab_size: int = 32000
     dim: int = 4096
     n_layers: int = 32
@@ -59,17 +76,6 @@ class LlamaConfig:
     use_ring_attention: bool = False
     # None = auto: fused pallas flash kernel on TPU, dense math elsewhere
     use_flash_attention: Optional[bool] = None
-
-    @property
-    def sp_strategy(self) -> Optional[str]:
-        """Effective sp strategy after the legacy-alias fold."""
-        if self.sp_attention is not None:
-            return self.sp_attention
-        return "ring" if self.use_ring_attention else None
-
-    @property
-    def head_dim(self) -> int:
-        return self.dim // self.n_heads
 
     @staticmethod
     def llama7b() -> "LlamaConfig":

@@ -32,7 +32,7 @@ from dlrover_tpu.models import llama as _llama
 
 
 @dataclass(frozen=True)
-class MoEConfig:
+class MoEConfig(_llama.AttentionConfigMixin):
     vocab_size: int = 32000
     dim: int = 4096
     n_layers: int = 32
@@ -57,16 +57,6 @@ class MoEConfig:
     sp_attention: Optional[str] = None
     use_ring_attention: bool = False  # legacy alias for sp_attention="ring"
     use_flash_attention: Optional[bool] = None
-
-    @property
-    def head_dim(self) -> int:
-        return self.dim // self.n_heads
-
-    @property
-    def sp_strategy(self) -> Optional[str]:
-        if self.sp_attention is not None:
-            return self.sp_attention
-        return "ring" if self.use_ring_attention else None
 
     @staticmethod
     def mixtral8x7b() -> "MoEConfig":

@@ -1,0 +1,10 @@
+"""Observability plane: ctypes bindings for the native tpu_timer engine
+(tpu_timer/), timeline tooling, and the agent-side metrics scrape.
+
+TPU redesign of the reference xpu_timer stack (xpu_timer/: LD_PRELOAD CUDA
+hook + brpc daemon + py tools) — see tpu_timer/README.md for the mapping.
+"""
+
+from dlrover_tpu.observability.tpu_timer import TpuTimer, find_library
+
+__all__ = ["TpuTimer", "find_library"]

@@ -1,0 +1,91 @@
+"""Timeline tooling: merge per-worker trace rings into one perfetto-loadable
+chrome trace, and helpers to run the per-host aggregation daemon.
+
+Reference: xpu_timer's timeline pipeline (py_xpu_timer/py_xpu_timer/
+dump_timeline.py + gen_trace_timeline.py → perfetto). The TPU engine already
+emits chrome-trace JSON natively (/trace, tpu_timer/src/engine.cc traceJson),
+so "generation" here is just fetch + merge — one process per rank, one track
+per event kind (mm/coll/memory).
+"""
+
+import json
+import os
+import subprocess
+import urllib.request
+from typing import List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.tpu_timer import (
+    DAEMON_PORT,
+    DEFAULT_WORKER_PORT_BASE,
+)
+
+
+def fetch_trace(port: int, host: str = "127.0.0.1",
+                timeout: float = 3.0) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/trace", timeout=timeout
+        ) as r:
+            return json.loads(r.read().decode())
+    except Exception as e:  # noqa: BLE001 — endpoint may simply be down
+        logger.debug("trace fetch :%s failed: %s", port, e)
+        return None
+
+
+def merge_timelines(
+    out_path: str,
+    ports: Optional[List[int]] = None,
+    n_workers: int = 8,
+    host: str = "127.0.0.1",
+) -> int:
+    """Fetch every worker's /trace and write one chrome trace file.
+
+    Returns the number of workers that contributed. Load in
+    ui.perfetto.dev or chrome://tracing.
+    """
+    ports = ports or [DEFAULT_WORKER_PORT_BASE + i for i in range(n_workers)]
+    events, found = [], 0
+    for port in ports:
+        tr = fetch_trace(port, host)
+        if tr is None:
+            continue
+        found += 1
+        events.extend(tr.get("traceEvents", []))
+        rank = port - ports[0]
+        events.append({
+            "ph": "M", "pid": rank, "name": "process_name",
+            "args": {"name": f"rank{rank}"},
+        })
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return found
+
+
+def find_daemon_binary() -> Optional[str]:
+    cand = os.environ.get("TPU_TIMER_DAEMON_PATH")
+    if cand and os.path.exists(cand):
+        return cand
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    cand = os.path.join(here, "tpu_timer", "build", "tpu_timer_daemon")
+    return cand if os.path.exists(cand) else None
+
+
+def start_daemon(
+    listen_port: int = DAEMON_PORT,
+    base_port: int = DEFAULT_WORKER_PORT_BASE,
+    n_workers: int = 8,
+) -> Optional[subprocess.Popen]:
+    """Start the per-host aggregator (reference xpu_timer_daemon analogue);
+    returns the process handle or None when the binary isn't built."""
+    binary = find_daemon_binary()
+    if not binary:
+        logger.info("tpu_timer_daemon not built; skipping")
+        return None
+    proc = subprocess.Popen(
+        [binary, str(listen_port), str(base_port), str(n_workers)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    logger.info("tpu_timer_daemon pid=%s on :%s", proc.pid, listen_port)
+    return proc

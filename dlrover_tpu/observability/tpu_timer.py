@@ -1,0 +1,256 @@
+"""ctypes bindings for the native tpu_timer engine (tpu_timer/build/
+libtpu_timer.so) plus the worker-side integration hooks.
+
+Reference mapping (no code copied; behavior parity):
+
+- ``xpu_timer_launch`` LD_PRELOAD wrapper (reference py_xpu_timer/bin/
+  xpu_timer_launch) → :meth:`TpuTimer.install`: on TPU there is no launch
+  symbol to preload, so the worker calls ``install()`` *after* jax backend
+  init and the native library patches the live PJRT api table in place
+  (tpu_timer/src/pjrt_patch.cc).
+- python GC + dataloader tracing (reference server/python_plugin.cc,
+  py_tracing_loader.cc) → :meth:`TpuTimer.enable_gc_hook` /
+  :meth:`TpuTimer.count_dataloader_batch` feeding the
+  XPU_TIMER_COMMON_{GC_COUNT,DATA_LOADER_COUNT} gauges.
+- ``DumpStringStacktrace`` (gdb + py-spy, reference
+  server/hosting_service_server_client.cc:74–96) → ``faulthandler`` armed on
+  SIGUSR1: the native hang watchdog (or the daemon's /dump_stack) raises the
+  signal and every python thread's stack lands in
+  ``/tmp/tpu_timer_pystack_<pid>.txt``.
+"""
+
+import ctypes
+import faulthandler
+import gc
+import os
+import signal
+import time
+from typing import Optional
+
+from dlrover_tpu.common.log import logger
+
+ENV_LIB = "TPU_TIMER_LIB"
+ENV_PORT = "TPU_TIMER_PORT"
+DEFAULT_WORKER_PORT_BASE = 18900
+DAEMON_PORT = 18889
+
+KIND_MM = 0
+KIND_COLL = 1
+KIND_MEMORY = 2
+
+
+def find_library() -> Optional[str]:
+    """Locate libtpu_timer.so: $TPU_TIMER_LIB, then the in-repo build."""
+    cand = os.environ.get(ENV_LIB)
+    if cand and os.path.exists(cand):
+        return cand
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    cand = os.path.join(here, "tpu_timer", "build", "libtpu_timer.so")
+    return cand if os.path.exists(cand) else None
+
+
+def find_libtpu() -> Optional[str]:
+    """Path of the PJRT TPU plugin jax loaded (for the api-table patch)."""
+    try:
+        import libtpu  # type: ignore
+
+        for name in ("get_library_path",):
+            fn = getattr(libtpu, name, None)
+            if fn:
+                return fn()
+        d = os.path.dirname(libtpu.__file__)
+        p = os.path.join(d, "libtpu.so")
+        if os.path.exists(p):
+            return p
+    except ImportError:
+        pass
+    return os.environ.get("TPU_LIBRARY_PATH")
+
+
+class TpuTimer:
+    """One per worker process. Wraps the native engine; safe no-op when the
+    native library isn't built (every method guards on ``available``)."""
+
+    def __init__(self, lib_path: Optional[str] = None):
+        self._lib = None
+        path = lib_path or find_library()
+        if path:
+            try:
+                self._lib = ctypes.CDLL(path)
+                self._lib.tt_prometheus.restype = ctypes.c_int
+                self._lib.tt_begin.restype = ctypes.c_uint64
+                self._lib.tt_begin.argtypes = [ctypes.c_int, ctypes.c_char_p]
+                self._lib.tt_end.argtypes = [ctypes.c_uint64, ctypes.c_double]
+                self._lib.tt_record.argtypes = [
+                    ctypes.c_int, ctypes.c_char_p, ctypes.c_double,
+                    ctypes.c_double,
+                ]
+                self._lib.tt_set_gauge.argtypes = [
+                    ctypes.c_char_p, ctypes.c_double]
+                self._lib.tt_inc_counter.argtypes = [
+                    ctypes.c_char_p, ctypes.c_double]
+                self._lib.tt_set_hang_timeout.argtypes = [ctypes.c_double]
+            except OSError as e:
+                logger.warning("tpu_timer native lib load failed: %s", e)
+                self._lib = None
+        self._gc_t0 = 0.0
+
+    @property
+    def available(self) -> bool:
+        return self._lib is not None
+
+    # -- lifecycle ----------------------------------------------------------
+    def install(
+        self,
+        rank: int = 0,
+        world_size: int = 1,
+        local_rank: int = 0,
+        port: Optional[int] = None,
+        patch_pjrt: bool = True,
+        hang_timeout_s: Optional[float] = None,
+        stack_dump_signal: int = signal.SIGUSR1,
+    ) -> bool:
+        """Start the engine + metrics endpoint; patch the live PJRT table.
+
+        Call after the jax backend exists (first `jax.devices()`), from the
+        worker process. Port defaults to base+local_rank so the per-host
+        daemon can scrape every worker.
+        """
+        if not self._lib:
+            return False
+        if port is None:
+            base = int(os.environ.get(ENV_PORT, DEFAULT_WORKER_PORT_BASE))
+            port = base + local_rank
+        if hang_timeout_s is not None:
+            self._lib.tt_set_hang_timeout(float(hang_timeout_s))
+        if stack_dump_signal:
+            path = f"/tmp/tpu_timer_pystack_{os.getpid()}.txt"
+            self._stack_file = open(path, "w")
+            faulthandler.register(
+                stack_dump_signal, file=self._stack_file, all_threads=True
+            )
+            self._lib.tt_set_hang_signal(int(stack_dump_signal))
+        self._lib.tt_init(int(rank), int(world_size), int(local_rank),
+                          int(port))
+        if patch_pjrt:
+            plugin = find_libtpu()
+            if plugin:
+                # Force PJRT client creation first so RTLD_NOLOAD finds the
+                # plugin jax actually mapped and we patch the *live* table —
+                # patching before backend init could be clobbered by it.
+                try:
+                    import jax
+
+                    jax.devices()
+                except Exception as e:  # noqa: BLE001 — no backend, no patch
+                    logger.warning(
+                        "tpu_timer: jax backend init failed (%s); "
+                        "skipping PJRT patch", e)
+                    return True
+                rc = self._lib.tt_patch_pjrt(plugin.encode())
+                if rc == 0:
+                    logger.info("tpu_timer: patched PJRT table of %s", plugin)
+                else:
+                    logger.warning(
+                        "tpu_timer: PJRT patch failed rc=%s (plugin %s)",
+                        rc, plugin)
+            else:
+                logger.info("tpu_timer: no TPU plugin found; host-side "
+                            "spans only (CPU/dev mode)")
+        return True
+
+    def shutdown(self) -> None:
+        if self._lib:
+            self._lib.tt_shutdown()
+
+    # -- recording ----------------------------------------------------------
+    def record(self, kind: int, name: str, dur_us: float,
+               payload: float = 0.0) -> None:
+        if self._lib:
+            self._lib.tt_record(kind, name.encode(), float(dur_us),
+                                float(payload))
+
+    def begin(self, kind: int, name: str) -> int:
+        return self._lib.tt_begin(kind, name.encode()) if self._lib else 0
+
+    def end(self, token: int, payload: float = 0.0) -> None:
+        if self._lib and token:
+            self._lib.tt_end(token, float(payload))
+
+    class _Span:
+        def __init__(self, timer: "TpuTimer", kind: int, name: str,
+                     payload: float):
+            self._t, self._kind, self._name = timer, kind, name
+            self._payload = payload
+            self._tok = 0
+
+        def __enter__(self):
+            self._tok = self._t.begin(self._kind, self._name)
+            return self
+
+        def __exit__(self, *exc):
+            self._t.end(self._tok, self._payload)
+            return False
+
+    def span(self, name: str, kind: int = KIND_MM,
+             payload: float = 0.0) -> "_Span":
+        """``with timer.span("train_step", payload=flops):`` — feeds the MM
+        latency family + hang watchdog; payload lets FLOPS be derived."""
+        return TpuTimer._Span(self, kind, name, payload)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        if self._lib:
+            self._lib.tt_set_gauge(name.encode(), float(value))
+
+    # -- python-plane tracing (GC / dataloader) -----------------------------
+    def enable_gc_hook(self) -> None:
+        """Count GC pauses into XPU_TIMER_COMMON_GC_COUNT (reference python
+        tracing plugin traces GC; server/python_plugin.cc)."""
+        if not self._lib:
+            return
+
+        def _cb(phase, info):
+            if phase == "start":
+                self._gc_t0 = time.monotonic()
+            elif phase == "stop":
+                self._lib.tt_inc_counter(b"GC_COUNT", 1.0)
+                dur_us = (time.monotonic() - self._gc_t0) * 1e6
+                self._lib.tt_record(KIND_MM, b"py_gc", dur_us, 0.0)
+
+        gc.callbacks.append(_cb)
+
+    def count_dataloader_batch(self, n: int = 1) -> None:
+        if self._lib:
+            self._lib.tt_inc_counter(b"DATA_LOADER_COUNT", float(n))
+
+    # -- readout ------------------------------------------------------------
+    def prometheus_text(self) -> str:
+        if not self._lib:
+            return ""
+        n = self._lib.tt_prometheus(None, 0)
+        buf = ctypes.create_string_buffer(n + 1)
+        self._lib.tt_prometheus(buf, n + 1)
+        return buf.value.decode()
+
+    def dump_trace(self, path: str) -> bool:
+        return bool(self._lib) and \
+            self._lib.tt_dump_trace(path.encode()) == 0
+
+    def hang_detected(self) -> bool:
+        return bool(self._lib) and self._lib.tt_hang_detected() == 1
+
+    def pjrt_patched(self) -> bool:
+        return bool(self._lib) and self._lib.tt_pjrt_patched() == 1
+
+
+_global_timer: Optional[TpuTimer] = None
+
+
+def get_timer() -> TpuTimer:
+    """Process-wide singleton (mirrors the reference's GpuTimerManager
+    singleton, xpu_timer/common/manager.h:106)."""
+    global _global_timer
+    if _global_timer is None:
+        _global_timer = TpuTimer()
+    return _global_timer

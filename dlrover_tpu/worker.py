@@ -111,6 +111,19 @@ def init(initialize_jax_distributed: bool = True) -> WorkerContext:
             int(os.getenv(EnvKey.NODE_ID, "0")),
             int(os.getenv(EnvKey.NODE_RANK, "0")),
         )
+    if os.getenv("TPU_TIMER_ENABLE"):
+        # agent opted this job into the observability plane: start the
+        # native engine, serve per-rank metrics, patch the live PJRT table
+        # (tpu_timer/; the reference reaches this point via LD_PRELOAD)
+        from dlrover_tpu.observability import TpuTimer
+
+        timer = TpuTimer()
+        timer.install(
+            rank=rank,
+            world_size=world_size,
+            local_rank=int(os.getenv(EnvKey.LOCAL_RANK, "0")),
+        )
+        timer.enable_gc_hook()
     return WorkerContext(
         rank=rank,
         world_size=world_size,

@@ -9,7 +9,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.models import moe
 from dlrover_tpu.parallel.mesh import build_mesh, plan_mesh
-from dlrover_tpu.parallel.sharding import batch_sharding, shard_tree
+from dlrover_tpu.parallel.sharding import shard_tree
 
 
 def _tiny(dtype=jnp.float32, **kw):

@@ -1,0 +1,134 @@
+// tpu_timer — TPU-native observability engine.
+//
+// TPU redesign of the reference xpu_timer (reference: xpu_timer/xpu_timer/
+// common/manager.h:106, common/constant.h:43–75, nvidia/hook.cc:54,93).
+// The reference intercepts individual CUDA kernel launches and times them
+// with CUDA events; on TPU the unit of execution XLA exposes is the compiled
+// *module* (one PJRT_LoadedExecutable_Execute per jitted step), and host
+// blocking happens in PJRT_Event_Await / buffer transfers.  So this engine
+// aggregates at the PJRT boundary — module dispatch latency, host-blocked
+// await time, H2D/D2H transfer bytes — which is both the honest TPU analogue
+// of per-kernel timing and exactly where device hangs become host-visible.
+//
+// Gauge families keep the reference's names so dashboards and the agent-side
+// hang detection port unchanged:
+//   XPU_TIMER_MM_KERNEL_{AVG,MAX,P99,MIN}_LATENCY / _FLOPS     (compute)
+//   XPU_TIMER_COLL_KERNEL_{AVG,MAX,P99,MIN}_LATENCY / _BANDWIDTH (collectives)
+//   XPU_TIMER_MEMORY_COUNTER                                    (transfers)
+//   XPU_TIMER_COMMON_{HANG,START_DUMP,END_DUMP,GC_COUNT,DATA_LOADER_COUNT,
+//                     POOL_QUEUE_SIZE,WORK_QUEUE_SIZE}
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace tpu_timer {
+
+enum KernelKind : int {
+  kMatmul = 0,  // compute modules (the MXU work)
+  kColl = 1,    // collective / multi-device modules
+  kMemory = 2,  // host<->device transfers
+};
+
+struct TraceEvent {
+  int64_t ts_us;   // wall-clock start, us since epoch
+  int64_t dur_us;  // duration
+  int32_t name_id;
+  int8_t kind;
+};
+
+// Sliding-window stats over the last kWindow durations of one kernel name.
+struct KernelStats {
+  static constexpr int kWindow = 512;
+  std::vector<double> window;  // ring of recent durations (us)
+  int next = 0;
+  bool full = false;
+  uint64_t count = 0;
+  double total_us = 0;
+  double payload_rate = 0;  // FLOPS (mm) or bytes/s (coll), from last record
+  double total_payload = 0;
+
+  void add(double dur_us, double payload);
+  // avg/max/p99/min over the window (us).
+  void summarize(double* avg, double* mx, double* p99, double* mn) const;
+};
+
+struct InflightOp {
+  std::string name;
+  int kind;
+  int64_t start_us;
+};
+
+class Engine {
+ public:
+  static Engine& instance();
+
+  // port > 0 starts the HTTP metrics server on that port; port == 0 disables.
+  void init(int rank, int world_size, int local_rank, int port);
+  void shutdown();
+
+  void record(int kind, const std::string& name, double dur_us,
+              double payload);
+  // Begin/end bracket feeding both stats and the hang watchdog.
+  uint64_t begin(int kind, const std::string& name);
+  void end(uint64_t token, double payload);
+
+  void setGauge(const std::string& name, double v);
+  void incCounter(const std::string& name, double v);
+
+  void setHangTimeout(double seconds) { hang_timeout_s_ = seconds; }
+  // Signal raised in-process on hang (0 = none). The Python side registers a
+  // faulthandler on it, giving the reference's DumpStringStacktrace behavior
+  // (gdb+py-spy; hosting_service_server_client.cc:74–96) without a debugger.
+  void setHangSignal(int sig) { hang_signal_ = sig; }
+  typedef void (*HangCallback)(const char* inflight_name, double stuck_s);
+  void setHangCallback(HangCallback cb) { hang_cb_ = cb; }
+
+  std::string prometheusText();
+  std::string traceJson();  // chrome-trace "traceEvents" JSON
+  bool dumpTrace(const std::string& path);
+
+  int rank() const { return rank_; }
+  int port() const { return port_; }
+  bool hangDetected() const { return hang_detected_.load(); }
+
+ private:
+  Engine() = default;
+  void watchdogLoop();
+  void httpLoop();
+  int32_t internName(const std::string& name);
+
+  std::mutex mu_;
+  std::unordered_map<std::string, KernelStats> stats_[3];
+  std::map<std::string, double> gauges_;     // common gauges
+  std::map<std::string, double> counters_;   // monotonic counters
+  std::vector<TraceEvent> trace_;
+  size_t trace_cap_ = 65536;
+  size_t trace_next_ = 0;
+  bool trace_full_ = false;
+  std::vector<std::string> names_;
+  std::unordered_map<std::string, int32_t> name_ids_;
+  std::unordered_map<uint64_t, InflightOp> inflight_;
+  std::atomic<uint64_t> next_token_{1};
+
+  int rank_ = 0;
+  int world_size_ = 1;
+  int local_rank_ = 0;
+  int port_ = 0;
+  int server_fd_ = -1;
+  double hang_timeout_s_ = 300.0;
+  int hang_signal_ = 0;
+  HangCallback hang_cb_ = nullptr;
+  std::atomic<bool> hang_detected_{false};
+  std::atomic<bool> stopped_{false};
+  std::atomic<bool> started_{false};
+};
+
+int64_t NowUs();
+
+}  // namespace tpu_timer

@@ -1,0 +1,71 @@
+// extern "C" surface for ctypes (the Python binding layer,
+// dlrover_tpu/observability/tpu_timer.py). Replaces the reference's
+// LD_PRELOAD symbol interception + brpc RPC pair (xpu_timer/nvidia/hook.cc,
+// server/hosting_service_server_client.cc) with an explicit in-process API:
+// on TPU there is no per-kernel symbol to hook, so the worker links the
+// engine directly and the PJRT patcher (pjrt_patch.cc) supplies the
+// device-boundary events.
+
+#include <string.h>
+
+#include "tpu_timer/engine.h"
+
+using tpu_timer::Engine;
+
+extern "C" {
+
+void tt_init(int rank, int world_size, int local_rank, int port) {
+  Engine::instance().init(rank, world_size, local_rank, port);
+}
+
+void tt_shutdown() { Engine::instance().shutdown(); }
+
+void tt_record(int kind, const char* name, double dur_us, double payload) {
+  Engine::instance().record(kind, name ? name : "?", dur_us, payload);
+}
+
+unsigned long long tt_begin(int kind, const char* name) {
+  return Engine::instance().begin(kind, name ? name : "?");
+}
+
+void tt_end(unsigned long long token, double payload) {
+  Engine::instance().end(token, payload);
+}
+
+void tt_set_gauge(const char* name, double v) {
+  Engine::instance().setGauge(name, v);
+}
+
+void tt_inc_counter(const char* name, double v) {
+  Engine::instance().incCounter(name, v);
+}
+
+void tt_set_hang_timeout(double seconds) {
+  Engine::instance().setHangTimeout(seconds);
+}
+
+void tt_set_hang_signal(int sig) { Engine::instance().setHangSignal(sig); }
+
+void tt_set_hang_callback(void (*cb)(const char*, double)) {
+  Engine::instance().setHangCallback(cb);
+}
+
+int tt_hang_detected() { return Engine::instance().hangDetected() ? 1 : 0; }
+
+// Copies the Prometheus exposition text into buf; returns the full length
+// (call with cap=0 to size the buffer).
+int tt_prometheus(char* buf, int cap) {
+  std::string s = Engine::instance().prometheusText();
+  if (buf && cap > 0) {
+    int n = (int)s.size() < cap - 1 ? (int)s.size() : cap - 1;
+    memcpy(buf, s.data(), n);
+    buf[n] = 0;
+  }
+  return (int)s.size();
+}
+
+int tt_dump_trace(const char* path) {
+  return Engine::instance().dumpTrace(path) ? 0 : -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,137 @@
+// tpu_timer_daemon — per-host aggregator, the counterpart of the reference's
+// brpc xpu_timer_daemon (xpu_timer/server/server.cc; RPCs RegisterPrometheus /
+// DumpStringStacktrace / DumpKernelTrace, protos/hosting_service.proto:241–249).
+//
+// Workers each serve /metrics on base_port+local_rank (engine.cc httpLoop);
+// this daemon scrapes them and re-serves one merged Prometheus page, so the
+// agent/k8s scrape config needs a single target per host:
+//   GET /metrics     → concatenation of every live worker's gauges
+//   GET /workers     → JSON health of each worker endpoint
+//   GET /dump_stack  → SIGUSR1 to every worker pid (python faulthandler dump —
+//                      the py-spy/gdb analogue of DumpStringStacktrace)
+//   GET /healthz
+// Usage: tpu_timer_daemon <listen_port> <base_port> <n_workers>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <string>
+#include <vector>
+
+namespace {
+
+// One-shot HTTP GET to 127.0.0.1:port. Returns body or "" on error.
+std::string HttpGet(int port, const char* path) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return "";
+  struct timeval tv = {2, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (connect(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return "";
+  }
+  char req[256];
+  snprintf(req, sizeof(req), "GET %s HTTP/1.0\r\n\r\n", path);
+  if (write(fd, req, strlen(req)) < 0) {
+    close(fd);
+    return "";
+  }
+  std::string resp;
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof(buf))) > 0) resp.append(buf, n);
+  close(fd);
+  size_t p = resp.find("\r\n\r\n");
+  return p == std::string::npos ? "" : resp.substr(p + 4);
+}
+
+int PidFromHealthz(const std::string& body) {
+  size_t p = body.find("\"pid\":");
+  return p == std::string::npos ? -1 : atoi(body.c_str() + p + 6);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int listen_port = argc > 1 ? atoi(argv[1]) : 18889;
+  int base_port = argc > 2 ? atoi(argv[2]) : 18900;
+  int n_workers = argc > 3 ? atoi(argv[3]) : 8;
+  signal(SIGPIPE, SIG_IGN);
+
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)listen_port);
+  if (bind(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, 16) != 0) {
+    perror("tpu_timer_daemon bind");
+    return 1;
+  }
+  fprintf(stderr, "tpu_timer_daemon on :%d scraping :%d..:%d\n", listen_port,
+          base_port, base_port + n_workers - 1);
+
+  for (;;) {
+    int cfd = accept(fd, nullptr, nullptr);
+    if (cfd < 0) continue;
+    char req[1024];
+    ssize_t n = read(cfd, req, sizeof(req) - 1);
+    std::string body, ctype = "text/plain";
+    int status = 200;
+    if (n > 0) {
+      req[n] = 0;
+      if (strncmp(req, "GET /metrics", 12) == 0) {
+        for (int i = 0; i < n_workers; i++)
+          body += HttpGet(base_port + i, "/metrics");
+      } else if (strncmp(req, "GET /workers", 12) == 0) {
+        body = "[";
+        for (int i = 0; i < n_workers; i++) {
+          std::string h = HttpGet(base_port + i, "/healthz");
+          if (i) body += ",";
+          body += h.empty() ? "null" : h;
+        }
+        body += "]";
+        ctype = "application/json";
+      } else if (strncmp(req, "GET /dump_stack", 15) == 0) {
+        int sent = 0;
+        for (int i = 0; i < n_workers; i++) {
+          int pid = PidFromHealthz(HttpGet(base_port + i, "/healthz"));
+          if (pid > 0 && kill(pid, SIGUSR1) == 0) sent++;
+        }
+        char buf[64];
+        snprintf(buf, sizeof(buf), "{\"signalled\":%d}", sent);
+        body = buf;
+        ctype = "application/json";
+      } else if (strncmp(req, "GET /healthz", 12) == 0) {
+        body = "ok";
+      } else {
+        status = 404;
+        body = "not found\n";
+      }
+    }
+    char hdr[256];
+    snprintf(hdr, sizeof(hdr),
+             "HTTP/1.0 %d %s\r\nContent-Type: %s\r\nContent-Length: "
+             "%zu\r\nConnection: close\r\n\r\n",
+             status, status == 200 ? "OK" : "Not Found", ctype.c_str(),
+             body.size());
+    (void)!write(cfd, hdr, strlen(hdr));
+    (void)!write(cfd, body.data(), body.size());
+    close(cfd);
+  }
+}

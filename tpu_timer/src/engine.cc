@@ -1,0 +1,335 @@
+// Engine implementation. See include/tpu_timer/engine.h for design notes.
+//
+// Concurrency model: recording happens at PJRT-call granularity (one jitted
+// module dispatch ≈ one training step, plus transfers) — tens to hundreds of
+// events per second, not the reference's per-CUDA-kernel millions — so a
+// single mutex is far below noise (<1 us per record vs ms-scale steps), and
+// we skip the reference's lock-free queue + pooled-event machinery
+// (xpu_timer/common/manager.h:106) entirely.
+
+#include "tpu_timer/engine.h"
+
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+#include <thread>
+
+namespace tpu_timer {
+
+int64_t NowUs() {
+  struct timeval tv;
+  gettimeofday(&tv, nullptr);
+  return int64_t(tv.tv_sec) * 1000000 + tv.tv_usec;
+}
+
+void KernelStats::add(double dur_us, double payload) {
+  if (window.empty()) window.resize(kWindow, 0.0);
+  window[next] = dur_us;
+  next = (next + 1) % kWindow;
+  if (next == 0) full = true;
+  count++;
+  total_us += dur_us;
+  total_payload += payload;
+  if (dur_us > 0 && payload > 0) payload_rate = payload / (dur_us * 1e-6);
+}
+
+void KernelStats::summarize(double* avg, double* mx, double* p99,
+                            double* mn) const {
+  int n = full ? kWindow : next;
+  if (n == 0) {
+    *avg = *mx = *p99 = *mn = 0;
+    return;
+  }
+  std::vector<double> sorted(window.begin(), window.begin() + n);
+  std::sort(sorted.begin(), sorted.end());
+  double sum = 0;
+  for (double d : sorted) sum += d;
+  *avg = sum / n;
+  *mn = sorted.front();
+  *mx = sorted.back();
+  *p99 = sorted[std::min(n - 1, (int)(0.99 * n))];
+}
+
+Engine& Engine::instance() {
+  static Engine* e = new Engine();
+  return *e;
+}
+
+void Engine::init(int rank, int world_size, int local_rank, int port) {
+  bool expected = false;
+  if (!started_.compare_exchange_strong(expected, true)) return;
+  rank_ = rank;
+  world_size_ = world_size;
+  local_rank_ = local_rank;
+  port_ = port;
+  if (const char* cap = getenv("TPU_TIMER_TRACE_CAP"))
+    trace_cap_ = std::max(1024L, atol(cap));
+  if (const char* t = getenv("TPU_TIMER_HANG_TIMEOUT"))
+    hang_timeout_s_ = atof(t);
+  stopped_.store(false);
+  setGauge("HANG", 0);  // present from the first scrape, not the first tick
+  std::thread(&Engine::watchdogLoop, this).detach();
+  if (port_ > 0) std::thread(&Engine::httpLoop, this).detach();
+}
+
+void Engine::shutdown() {
+  stopped_.store(true);
+  if (server_fd_ >= 0) {
+    ::shutdown(server_fd_, SHUT_RDWR);
+    close(server_fd_);
+    server_fd_ = -1;
+  }
+  started_.store(false);
+}
+
+int32_t Engine::internName(const std::string& name) {
+  auto it = name_ids_.find(name);
+  if (it != name_ids_.end()) return it->second;
+  int32_t id = (int32_t)names_.size();
+  names_.push_back(name);
+  name_ids_[name] = id;
+  return id;
+}
+
+void Engine::record(int kind, const std::string& name, double dur_us,
+                    double payload) {
+  if (kind < 0 || kind > 2) return;
+  std::lock_guard<std::mutex> g(mu_);
+  stats_[kind][name].add(dur_us, payload);
+  if (trace_.empty()) trace_.resize(trace_cap_);
+  TraceEvent& ev = trace_[trace_next_];
+  ev.ts_us = NowUs() - (int64_t)dur_us;
+  ev.dur_us = (int64_t)dur_us;
+  ev.name_id = internName(name);
+  ev.kind = (int8_t)kind;
+  trace_next_ = (trace_next_ + 1) % trace_cap_;
+  if (trace_next_ == 0) trace_full_ = true;
+}
+
+uint64_t Engine::begin(int kind, const std::string& name) {
+  uint64_t token = next_token_.fetch_add(1);
+  std::lock_guard<std::mutex> g(mu_);
+  inflight_[token] = InflightOp{name, kind, NowUs()};
+  return token;
+}
+
+void Engine::end(uint64_t token, double payload) {
+  InflightOp op;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = inflight_.find(token);
+    if (it == inflight_.end()) return;
+    op = it->second;
+    inflight_.erase(it);
+  }
+  double dur_us = (double)(NowUs() - op.start_us);
+  record(op.kind, op.name, dur_us, payload);
+}
+
+void Engine::setGauge(const std::string& name, double v) {
+  std::lock_guard<std::mutex> g(mu_);
+  gauges_[name] = v;
+}
+
+void Engine::incCounter(const std::string& name, double v) {
+  std::lock_guard<std::mutex> g(mu_);
+  counters_[name] += v;
+}
+
+void Engine::watchdogLoop() {
+  // Reference behavior (manager.cc doHang:389–414): on a stuck operator,
+  // push HANG/START_DUMP gauges, dump stacks once, END_DUMP with the dump
+  // latency, optionally exit if XPU_TIMER_HANG_KILL.
+  bool dumped = false;
+  while (!stopped_.load()) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+    std::string stuck_name;
+    double stuck_s = 0;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      int64_t now = NowUs();
+      for (auto& kv : inflight_) {
+        double s = (now - kv.second.start_us) * 1e-6;
+        if (s > hang_timeout_s_ && s > stuck_s) {
+          stuck_s = s;
+          stuck_name = kv.second.name;
+        }
+      }
+    }
+    if (stuck_name.empty()) {
+      hang_detected_.store(false);
+      setGauge("HANG", 0);
+      continue;
+    }
+    hang_detected_.store(true);
+    setGauge("HANG", 1);
+    if (!dumped) {
+      dumped = true;
+      setGauge("START_DUMP", 1);
+      int64_t t0 = NowUs();
+      char path[256];
+      snprintf(path, sizeof(path), "/tmp/tpu_timer_hang_%d.txt", getpid());
+      std::ofstream f(path);
+      f << "rank " << rank_ << " hang: op '" << stuck_name << "' in flight "
+        << stuck_s << "s (timeout " << hang_timeout_s_ << "s)\n";
+      f.close();
+      if (hang_cb_) hang_cb_(stuck_name.c_str(), stuck_s);
+      // SIGUSR-based python stack dump: the launcher registers faulthandler
+      // on this signal, so raising it writes all python thread stacks —
+      // the py-spy analogue with zero dependencies.
+      if (hang_signal_ > 0) raise(hang_signal_);
+      setGauge("END_DUMP", (NowUs() - t0) * 1e-6);
+      if (getenv("TPU_TIMER_HANG_KILL")) _exit(17);
+    }
+  }
+}
+
+namespace {
+struct Family {
+  const char* prefix;
+  const char* payload_name;  // FLOPS / BANDWIDTH / null
+  int kind;
+};
+const Family kFamilies[] = {
+    {"XPU_TIMER_MM_KERNEL_", "FLOPS", kMatmul},
+    {"XPU_TIMER_COLL_KERNEL_", "BANDWIDTH", kColl},
+};
+}  // namespace
+
+std::string Engine::prometheusText() {
+  std::ostringstream out;
+  std::lock_guard<std::mutex> g(mu_);
+  char labels[128];
+  for (const Family& fam : kFamilies) {
+    for (auto& kv : stats_[fam.kind]) {
+      double avg, mx, p99, mn;
+      kv.second.summarize(&avg, &mx, &p99, &mn);
+      snprintf(labels, sizeof(labels), "{kernel=\"%s\",rank=\"%d\"}",
+               kv.first.c_str(), rank_);
+      out << fam.prefix << "AVG_LATENCY" << labels << " " << avg << "\n";
+      out << fam.prefix << "MAX_LATENCY" << labels << " " << mx << "\n";
+      out << fam.prefix << "P99_LATENCY" << labels << " " << p99 << "\n";
+      out << fam.prefix << "MIN_LATENCY" << labels << " " << mn << "\n";
+      out << fam.prefix << fam.payload_name << labels << " "
+          << kv.second.payload_rate << "\n";
+      out << fam.prefix << "COUNT" << labels << " " << kv.second.count << "\n";
+    }
+  }
+  for (auto& kv : stats_[kMemory]) {
+    snprintf(labels, sizeof(labels), "{kernel=\"%s\",rank=\"%d\"}",
+             kv.first.c_str(), rank_);
+    out << "XPU_TIMER_MEMORY_COUNTER" << labels << " " << kv.second.count
+        << "\n";
+    out << "XPU_TIMER_MEMORY_BYTES" << labels << " "
+        << kv.second.total_payload << "\n";
+  }
+  snprintf(labels, sizeof(labels), "{rank=\"%d\"}", rank_);
+  for (auto& kv : gauges_)
+    out << "XPU_TIMER_COMMON_" << kv.first << labels << " " << kv.second
+        << "\n";
+  for (auto& kv : counters_)
+    out << "XPU_TIMER_COMMON_" << kv.first << labels << " " << kv.second
+        << "\n";
+  out << "XPU_TIMER_COMMON_PID" << labels << " " << getpid() << "\n";
+  return out.str();
+}
+
+std::string Engine::traceJson() {
+  std::ostringstream out;
+  std::lock_guard<std::mutex> g(mu_);
+  static const char* kKindName[] = {"mm", "coll", "memory"};
+  out << "{\"traceEvents\":[";
+  size_t n = trace_full_ ? trace_cap_ : trace_next_;
+  bool first = true;
+  for (size_t i = 0; i < n; i++) {
+    const TraceEvent& ev = trace_[i];
+    if (!first) out << ",";
+    first = false;
+    out << "{\"name\":\"" << names_[ev.name_id] << "\",\"cat\":\""
+        << kKindName[(int)ev.kind] << "\",\"ph\":\"X\",\"ts\":" << ev.ts_us
+        << ",\"dur\":" << ev.dur_us << ",\"pid\":" << rank_
+        << ",\"tid\":" << (int)ev.kind << "}";
+  }
+  out << "]}";
+  return out.str();
+}
+
+bool Engine::dumpTrace(const std::string& path) {
+  std::ofstream f(path);
+  if (!f.good()) return false;
+  f << traceJson();
+  return f.good();
+}
+
+// ---------------------------------------------------------------------------
+// Minimal HTTP/1.0 server: GET /metrics (Prometheus text), /trace (chrome
+// trace JSON), /healthz. Replaces the reference's brpc daemon surface
+// (xpu_timer/server/server.cc, hosting_service.proto:241–249) with no deps.
+// ---------------------------------------------------------------------------
+void Engine::httpLoop() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port_);
+  if (bind(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, 16) != 0) {
+    close(fd);
+    return;
+  }
+  server_fd_ = fd;
+  while (!stopped_.load()) {
+    int cfd = accept(fd, nullptr, nullptr);
+    if (cfd < 0) {
+      if (stopped_.load()) break;
+      continue;
+    }
+    char req[1024];
+    ssize_t n = read(cfd, req, sizeof(req) - 1);
+    std::string body, ctype = "text/plain";
+    int status = 200;
+    if (n > 0) {
+      req[n] = 0;
+      if (strncmp(req, "GET /metrics", 12) == 0) {
+        body = prometheusText();
+      } else if (strncmp(req, "GET /trace", 10) == 0) {
+        body = traceJson();
+        ctype = "application/json";
+      } else if (strncmp(req, "GET /healthz", 12) == 0) {
+        char buf[128];
+        snprintf(buf, sizeof(buf),
+                 "{\"pid\":%d,\"rank\":%d,\"world_size\":%d,\"hang\":%d}",
+                 getpid(), rank_, world_size_, hang_detected_.load() ? 1 : 0);
+        body = buf;
+        ctype = "application/json";
+      } else {
+        status = 404;
+        body = "not found\n";
+      }
+    }
+    char hdr[256];
+    snprintf(hdr, sizeof(hdr),
+             "HTTP/1.0 %d %s\r\nContent-Type: %s\r\nContent-Length: "
+             "%zu\r\nConnection: close\r\n\r\n",
+             status, status == 200 ? "OK" : "Not Found", ctype.c_str(),
+             body.size());
+    (void)!write(cfd, hdr, strlen(hdr));
+    (void)!write(cfd, body.data(), body.size());
+    close(cfd);
+  }
+}
+
+}  // namespace tpu_timer

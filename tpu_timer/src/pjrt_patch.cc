@@ -1,0 +1,213 @@
+// PJRT api-table patcher — the TPU-native replacement for the reference's
+// CUDA symbol interception (xpu_timer/nvidia/hook.cc:54,93 overrides
+// cudaLaunchKernel/cublas via LD_PRELOAD).
+//
+// On TPU there are no per-kernel launch symbols: jax loads libtpu as a PJRT
+// plugin (dlopen + dlsym("GetPjrtApi")) and every jitted module runs through
+// the function-pointer table that GetPjrtApi returns — a static struct inside
+// the plugin.  So instead of LD_PRELOAD we re-open the already-loaded plugin
+// (RTLD_NOLOAD), fetch the SAME table jax is using, and swap selected entries
+// for timing wrappers *after* jax initializes.  This is strictly more robust
+// than symbol interposition (no dlsym-of-dlsym games, works regardless of
+// link order) and captures exactly the host-visible device boundary:
+//   - LoadedExecutable_Execute  → compute/"mm" family (one event per jitted
+//     module dispatch; module name from PJRT_Executable_Name)
+//   - Event_Await               → host blocked on device ("coll" family —
+//     on TPU, collective stalls surface as await time) + hang watchdog
+//   - Buffer_ToHostBuffer / Client_BufferFromHostBuffer → memory family
+//
+// Append-only PJRT ABI rules (pjrt_c_api.h:86–113) mean field offsets never
+// move; we guard each patch with offsetof(...) < api->struct_size so running
+// against an older plugin simply skips fields it doesn't have.
+
+#ifdef TT_HAVE_PJRT
+
+#include <dlfcn.h>
+#include <stddef.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include "tpu_timer/engine.h"
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+using tpu_timer::Engine;
+using tpu_timer::kColl;
+using tpu_timer::kMatmul;
+using tpu_timer::kMemory;
+
+struct Originals {
+  const PJRT_Api* api = nullptr;
+  PJRT_LoadedExecutable_Execute* execute = nullptr;
+  PJRT_Event_Await* event_await = nullptr;
+  PJRT_Buffer_ToHostBuffer* to_host = nullptr;
+  PJRT_Client_BufferFromHostBuffer* from_host = nullptr;
+};
+Originals g_orig;
+std::mutex g_name_mu;
+std::unordered_map<PJRT_LoadedExecutable*, std::string> g_names;
+
+// Resolve a human-readable module name for a loaded executable, cached by
+// handle. Uses the *original* table entries so lookups aren't re-timed.
+std::string ExecutableName(PJRT_LoadedExecutable* le) {
+  {
+    std::lock_guard<std::mutex> g(g_name_mu);
+    auto it = g_names.find(le);
+    if (it != g_names.end()) return it->second;
+  }
+  std::string name = "pjrt_module";
+  const PJRT_Api* api = g_orig.api;
+  if (api->PJRT_LoadedExecutable_GetExecutable && api->PJRT_Executable_Name) {
+    PJRT_LoadedExecutable_GetExecutable_Args ga;
+    memset(&ga, 0, sizeof(ga));
+    ga.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    ga.loaded_executable = le;
+    PJRT_Error* err = api->PJRT_LoadedExecutable_GetExecutable(&ga);
+    if (!err && ga.executable) {
+      PJRT_Executable_Name_Args na;
+      memset(&na, 0, sizeof(na));
+      na.struct_size = PJRT_Executable_Name_Args_STRUCT_SIZE;
+      na.executable = ga.executable;
+      err = api->PJRT_Executable_Name(&na);
+      if (!err && na.executable_name && na.executable_name_size > 0)
+        name.assign(na.executable_name, na.executable_name_size);
+      if (err && api->PJRT_Error_Destroy) {
+        PJRT_Error_Destroy_Args da;
+        memset(&da, 0, sizeof(da));
+        da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+        da.error = err;
+        api->PJRT_Error_Destroy(&da);
+      }
+      if (api->PJRT_Executable_Destroy) {
+        PJRT_Executable_Destroy_Args dd;
+        memset(&dd, 0, sizeof(dd));
+        dd.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+        dd.executable = ga.executable;
+        api->PJRT_Executable_Destroy(&dd);
+      }
+    } else if (err && api->PJRT_Error_Destroy) {
+      PJRT_Error_Destroy_Args da;
+      memset(&da, 0, sizeof(da));
+      da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      da.error = err;
+      api->PJRT_Error_Destroy(&da);
+    }
+  }
+  std::lock_guard<std::mutex> g(g_name_mu);
+  g_names[le] = name;
+  return name;
+}
+
+PJRT_Error* WrapExecute(PJRT_LoadedExecutable_Execute_Args* args) {
+  std::string name = ExecutableName(args->executable);
+  uint64_t tok = Engine::instance().begin(kMatmul, name);
+  PJRT_Error* err = g_orig.execute(args);
+  Engine::instance().end(tok, 0);
+  return err;
+}
+
+PJRT_Error* WrapEventAwait(PJRT_Event_Await_Args* args) {
+  uint64_t tok = Engine::instance().begin(kColl, "event_await");
+  PJRT_Error* err = g_orig.event_await(args);
+  Engine::instance().end(tok, 0);
+  return err;
+}
+
+PJRT_Error* WrapToHost(PJRT_Buffer_ToHostBuffer_Args* args) {
+  // dst == nullptr is a size query, not a transfer.
+  if (!args->dst) return g_orig.to_host(args);
+  double bytes = (double)args->dst_size;
+  uint64_t tok = Engine::instance().begin(kMemory, "d2h");
+  PJRT_Error* err = g_orig.to_host(args);
+  Engine::instance().end(tok, bytes);
+  return err;
+}
+
+PJRT_Error* WrapFromHost(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  double elems = 1;
+  for (size_t i = 0; i < args->num_dims; i++) elems *= (double)args->dims[i];
+  uint64_t tok = Engine::instance().begin(kMemory, "h2d");
+  PJRT_Error* err = g_orig.from_host(args);
+  Engine::instance().end(tok, elems);  // element count; dtype width unknown
+  return err;
+}
+
+// The api table lives in the plugin's .data (writable); some toolchains put
+// const statics in .rodata, so flip the pages writable first just in case.
+void MakeWritable(void* addr, size_t len) {
+  long pg = sysconf(_SC_PAGESIZE);
+  uintptr_t start = (uintptr_t)addr & ~(uintptr_t)(pg - 1);
+  uintptr_t end = ((uintptr_t)addr + len + pg - 1) & ~(uintptr_t)(pg - 1);
+  mprotect((void*)start, end - start, PROT_READ | PROT_WRITE);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Patch the PJRT api table of `plugin_path` (e.g. the libtpu .so jax already
+// loaded). Returns 0 on success, negative on failure. Idempotent.
+int tt_patch_pjrt(const char* plugin_path) {
+  if (g_orig.api) return 0;
+  if (!plugin_path) return -1;
+  // RTLD_NOLOAD first: grab the copy jax already mapped. Fall back to a
+  // fresh load (tests drive a standalone fake plugin).
+  void* h = dlopen(plugin_path, RTLD_NOW | RTLD_NOLOAD);
+  if (!h) h = dlopen(plugin_path, RTLD_NOW | RTLD_GLOBAL);
+  if (!h) return -2;
+  typedef const PJRT_Api* (*GetPjrtApiFn)();
+  GetPjrtApiFn get_api = (GetPjrtApiFn)dlsym(h, "GetPjrtApi");
+  if (!get_api) return -3;
+  PJRT_Api* api = const_cast<PJRT_Api*>(get_api());
+  if (!api) return -4;
+  g_orig.api = api;
+  MakeWritable(api, sizeof(PJRT_Api));
+#define TT_PATCH(field, saved, wrapper)                                \
+  do {                                                                 \
+    if (offsetof(PJRT_Api, field) + sizeof(void*) <= api->struct_size && \
+        api->field) {                                                  \
+      g_orig.saved = api->field;                                       \
+      api->field = wrapper;                                            \
+    }                                                                  \
+  } while (0)
+  TT_PATCH(PJRT_LoadedExecutable_Execute, execute, WrapExecute);
+  TT_PATCH(PJRT_Event_Await, event_await, WrapEventAwait);
+  TT_PATCH(PJRT_Buffer_ToHostBuffer, to_host, WrapToHost);
+  TT_PATCH(PJRT_Client_BufferFromHostBuffer, from_host, WrapFromHost);
+#undef TT_PATCH
+  return 0;
+}
+
+// Restore original entries (tests; graceful shutdown).
+int tt_unpatch_pjrt() {
+  PJRT_Api* api = const_cast<PJRT_Api*>(g_orig.api);
+  if (!api) return -1;
+  if (g_orig.execute) api->PJRT_LoadedExecutable_Execute = g_orig.execute;
+  if (g_orig.event_await) api->PJRT_Event_Await = g_orig.event_await;
+  if (g_orig.to_host) api->PJRT_Buffer_ToHostBuffer = g_orig.to_host;
+  if (g_orig.from_host)
+    api->PJRT_Client_BufferFromHostBuffer = g_orig.from_host;
+  g_orig = Originals();
+  return 0;
+}
+
+int tt_pjrt_patched() { return g_orig.api ? 1 : 0; }
+
+}  // extern "C"
+
+#else  // !TT_HAVE_PJRT
+
+extern "C" {
+int tt_patch_pjrt(const char*) { return -100; }
+int tt_unpatch_pjrt() { return -100; }
+int tt_pjrt_patched() { return 0; }
+}
+
+#endif

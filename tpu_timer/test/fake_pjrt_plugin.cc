@@ -1,0 +1,112 @@
+// Minimal fake PJRT plugin for testing the api-table patcher without a TPU.
+// Exposes GetPjrtApi like a real plugin plus fake_* helpers that drive calls
+// THROUGH the (possibly patched) table, mimicking how jax dispatches.
+// Mirrors the reference's test trick of mocking the intercepted layer
+// (xpu_timer/test/, MOCK_ERR_RANK in node-check) rather than needing hardware.
+
+#include <string.h>
+#include <unistd.h>
+
+#include <cstdint>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+PJRT_Api g_api;
+int g_execute_calls = 0;
+int g_await_calls = 0;
+int64_t g_exec_sleep_us = 2000;
+
+PJRT_Error* FakeExecute(PJRT_LoadedExecutable_Execute_Args* args) {
+  (void)args;
+  g_execute_calls++;
+  usleep(g_exec_sleep_us);
+  return nullptr;
+}
+
+PJRT_Error* FakeEventAwait(PJRT_Event_Await_Args* args) {
+  (void)args;
+  g_await_calls++;
+  usleep(1000);
+  return nullptr;
+}
+
+PJRT_Error* FakeGetExecutable(PJRT_LoadedExecutable_GetExecutable_Args* args) {
+  args->executable = (PJRT_Executable*)0x1;  // opaque token
+  return nullptr;
+}
+
+PJRT_Error* FakeName(PJRT_Executable_Name_Args* args) {
+  static const char kName[] = "jit_fake_train_step";
+  args->executable_name = kName;
+  args->executable_name_size = sizeof(kName) - 1;
+  return nullptr;
+}
+
+PJRT_Error* FakeExecutableDestroy(PJRT_Executable_Destroy_Args*) {
+  return nullptr;
+}
+
+PJRT_Error* FakeToHost(PJRT_Buffer_ToHostBuffer_Args* args) {
+  (void)args;
+  usleep(500);
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+const PJRT_Api* GetPjrtApi() {
+  memset(&g_api, 0, sizeof(g_api));
+  g_api.struct_size = PJRT_Api_STRUCT_SIZE;
+  g_api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+  g_api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  g_api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  g_api.PJRT_LoadedExecutable_Execute = FakeExecute;
+  g_api.PJRT_Event_Await = FakeEventAwait;
+  g_api.PJRT_LoadedExecutable_GetExecutable = FakeGetExecutable;
+  g_api.PJRT_Executable_Name = FakeName;
+  g_api.PJRT_Executable_Destroy = FakeExecutableDestroy;
+  g_api.PJRT_Buffer_ToHostBuffer = FakeToHost;
+  return &g_api;
+}
+
+// --- test drivers: call through the live table like jax would ---
+
+int fake_run_execute() {
+  PJRT_LoadedExecutable_Execute_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  args.executable = (PJRT_LoadedExecutable*)0x2;
+  PJRT_Error* err = g_api.PJRT_LoadedExecutable_Execute(&args);
+  return err ? -1 : 0;
+}
+
+int fake_run_await() {
+  PJRT_Event_Await_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  args.event = (PJRT_Event*)0x3;
+  PJRT_Error* err = g_api.PJRT_Event_Await(&args);
+  return err ? -1 : 0;
+}
+
+int fake_run_to_host(int bytes) {
+  static char buf[1 << 20];
+  PJRT_Buffer_ToHostBuffer_Args args;
+  memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  args.src = (PJRT_Buffer*)0x4;
+  args.dst = buf;
+  args.dst_size = (size_t)bytes;
+  PJRT_Error* err = g_api.PJRT_Buffer_ToHostBuffer(&args);
+  return err ? -1 : 0;
+}
+
+void fake_set_exec_sleep_us(long us) { g_exec_sleep_us = us; }
+int fake_execute_calls() { return g_execute_calls; }
+int fake_await_calls() { return g_await_calls; }
+
+}  // extern "C"
