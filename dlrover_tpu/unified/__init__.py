@@ -1,0 +1,28 @@
+"""Unified multi-role DL/RL job runtime (reference: dlrover/python/unified/).
+
+The reference's newer subsystem runs multi-role jobs (SPMD training + MPMD
+RL pipelines: actor/rollout/reference/reward/critic) as Ray actors under a
+Ray-hosted master. The TPU rebuild keeps the same user surface — fluent
+``DLJobBuilder``/``RLJobBuilder`` → ``DLJob`` → submit — and the same
+internal split (execution graph → placement → scheduler → failover), but
+runs workloads as plain OS processes driven over pipes:
+
+- no Ray in the stack: TPU pods schedule by host; a "bundle" is a host with
+  its chips, and the process backend maps vertices onto hosts directly
+  (scheduler.py). A Ray backend can slot in behind the same ActorBackend ABC.
+- SPMD roles get jax.distributed bootstrap env from the same agent/master
+  machinery as L2/L3; MPMD roles are pure control-plane processes.
+"""
+
+from dlrover_tpu.unified.api import DLJob, DLJobBuilder, RLJobBuilder
+from dlrover_tpu.unified.graph import ExecutionGraph, ExecutionVertex
+from dlrover_tpu.unified.master import UnifiedMaster
+
+__all__ = [
+    "DLJob",
+    "DLJobBuilder",
+    "RLJobBuilder",
+    "ExecutionGraph",
+    "ExecutionVertex",
+    "UnifiedMaster",
+]

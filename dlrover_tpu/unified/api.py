@@ -1,0 +1,257 @@
+"""Fluent job-definition API (reference unified/api/base.py:526 DLJobBuilder,
+api/rl.py:23 RLJobBuilder).
+
+Example (mirrors the reference's PPO shape):
+
+    job = (RLJobBuilder()
+           .node_num(2).device_per_node(4)
+           .config({"lr": 1e-5})
+           .actor("my.mod", "ActorWorkload").num(4).per_node(2).end()
+           .rollout("my.mod", "RolloutWorkload").num(2).end()
+           .reward("my.mod", "RewardWorkload").num(1).end()
+           .trainer("my.mod", "PPOTrainer")
+           .collocate("actor", "rollout")
+           .build())
+    result = job.submit()
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+from dlrover_tpu.common.log import logger
+
+TRAINER_ROLE = "trainer"
+
+
+class InvalidDLConfiguration(ValueError):
+    """Validation failure (reference common/exception.py)."""
+
+
+@dataclass
+class RoleConfig:
+    """One workload role (reference DLRoleConfig/DLWorkloadRole)."""
+
+    role: str
+    module_name: str
+    class_name: str
+    num: int = 1                      # total instances (world size)
+    per_node: int = 0                 # instances per host; 0 = pack freely
+    env: Dict[str, str] = field(default_factory=dict)
+    resource: Dict[str, float] = field(default_factory=dict)  # e.g. {"tpu": 1}
+    sub_stage: List[str] = field(default_factory=list)
+    # SPMD roles get jax.distributed bootstrap env; MPMD roles don't
+    spmd: bool = True
+
+
+@dataclass
+class TrainerConfig:
+    """The driver running the task stream (reference DLTrainerConfig)."""
+
+    module_name: str
+    class_name: str
+    user_defined: bool = True
+
+
+class RoleBuilder:
+    """Per-role chained config; ``.end()`` returns the job builder."""
+
+    def __init__(self, parent: "DLJobBuilder", cfg: RoleConfig):
+        self._parent = parent
+        self._cfg = cfg
+
+    def num(self, n: int) -> "RoleBuilder":
+        self._cfg.num = n
+        return self
+
+    def per_node(self, n: int) -> "RoleBuilder":
+        self._cfg.per_node = n
+        return self
+
+    def env(self, env: Dict[str, str]) -> "RoleBuilder":
+        self._cfg.env.update(env)
+        return self
+
+    def resource(self, **res: float) -> "RoleBuilder":
+        self._cfg.resource.update(res)
+        return self
+
+    def sub_stage(self, stages: List[str]) -> "RoleBuilder":
+        self._cfg.sub_stage = list(stages)
+        return self
+
+    def mpmd(self) -> "RoleBuilder":
+        """Mark as a control-plane role (no jax.distributed bootstrap)."""
+        self._cfg.spmd = False
+        return self
+
+    def end(self) -> "DLJobBuilder":
+        return self._parent
+
+
+@dataclass
+class DLJob:
+    """Validated job spec (reference DLJob, api/base.py)."""
+
+    dl_type: str
+    node_num: int
+    device_per_node: int
+    device_type: str
+    config: Dict[str, Any]
+    env: Dict[str, str]
+    roles: Dict[str, RoleConfig]
+    trainer: Optional[TrainerConfig]
+    collocations: List[Set[str]]
+
+    def submit(self, job_name: str = "unified", backend: str = "process",
+               timeout_s: float = 300.0) -> int:
+        """Run to completion under an in-proc UnifiedMaster (reference
+        driver/main.py submits to a Ray-actor master). Returns exit code."""
+        from dlrover_tpu.unified.master import UnifiedMaster
+
+        master = UnifiedMaster(self, job_name=job_name, backend=backend)
+        return master.run(timeout_s=timeout_s)
+
+
+class DLJobBuilder:
+    """(reference api/base.py:526)"""
+
+    def __init__(self):
+        self._dl_type = "DL"
+        self._node_num = 1
+        self._device_per_node = 1
+        self._device_type = "TPU"
+        self._config: Dict[str, Any] = {}
+        self._env: Dict[str, str] = {}
+        self._roles: Dict[str, RoleConfig] = {}
+        self._trainer: Optional[TrainerConfig] = None
+        self._collocations: List[Set[str]] = []
+
+    # -- chained setters ----------------------------------------------------
+    def node_num(self, n: int) -> "DLJobBuilder":
+        self._node_num = n
+        return self
+
+    def device_per_node(self, n: int) -> "DLJobBuilder":
+        self._device_per_node = n
+        return self
+
+    def device_type(self, t: str) -> "DLJobBuilder":
+        self._device_type = t
+        return self
+
+    def config(self, cfg: Dict[str, Any]) -> "DLJobBuilder":
+        self._config = dict(cfg)
+        return self
+
+    def global_env(self, env: Dict[str, str]) -> "DLJobBuilder":
+        self._env.update(env)
+        return self
+
+    def workload(self, role: str, module_name: str,
+                 class_name: str) -> RoleBuilder:
+        cfg = RoleConfig(role=role, module_name=module_name,
+                         class_name=class_name)
+        self._roles[role] = cfg
+        return RoleBuilder(self, cfg)
+
+    def trainer(self, module_name: str, class_name: str) -> "DLJobBuilder":
+        self._trainer = TrainerConfig(module_name, class_name)
+        return self
+
+    def collocate(self, *roles: str) -> "DLJobBuilder":
+        """Pin these roles to the same hosts (reference
+        with_collocation; placement groups → shared bundles)."""
+        self._collocations.append(set(roles))
+        return self
+
+    # -- build --------------------------------------------------------------
+    def validate(self) -> bool:
+        ok = True
+        if self._node_num < 1:
+            logger.error("'node_num' must be > 0")
+            ok = False
+        if self._device_per_node < 1:
+            logger.error("'device_per_node' must be > 0")
+            ok = False
+        if self._device_type not in ("TPU", "CPU"):
+            logger.error("'device_type' must be TPU or CPU")
+            ok = False
+        if not self._roles:
+            logger.error("at least one workload role required")
+            ok = False
+        if self._trainer is None and self._dl_type == "RL":
+            logger.error("'trainer' must be set for an RL task stream")
+            ok = False
+        for col in self._collocations:
+            unknown = col - set(self._roles)
+            if unknown:
+                logger.error("collocation references undefined roles %s",
+                             unknown)
+                ok = False
+                continue
+            per_node_sum = 0
+            for role in col:
+                cfg = self._roles[role]
+                per_node = cfg.per_node or cfg.num
+                per_node_sum += per_node
+            if per_node_sum > self._device_per_node:
+                logger.error(
+                    "collocation %s needs %s processes/node but the node "
+                    "has %s devices", col, per_node_sum,
+                    self._device_per_node)
+                ok = False
+        for cfg in self._roles.values():
+            if cfg.num < 1:
+                logger.error("role %s: num must be > 0", cfg.role)
+                ok = False
+            if cfg.per_node and cfg.num % cfg.per_node != 0:
+                logger.error("role %s: num %s not divisible by per_node %s",
+                             cfg.role, cfg.num, cfg.per_node)
+                ok = False
+        return ok
+
+    def build(self) -> DLJob:
+        if not self.validate():
+            raise InvalidDLConfiguration()
+        return DLJob(
+            dl_type=self._dl_type,
+            node_num=self._node_num,
+            device_per_node=self._device_per_node,
+            device_type=self._device_type,
+            config=self._config,
+            env=self._env,
+            roles=dict(self._roles),
+            trainer=self._trainer,
+            collocations=list(self._collocations),
+        )
+
+
+class RLJobBuilder(DLJobBuilder):
+    """RL roles sugar (reference api/rl.py:23). Rollout/reward/reference are
+    MPMD by default (inference services); actor/critic train SPMD."""
+
+    ACTOR = "actor"
+    ROLLOUT = "rollout"
+    REFERENCE = "reference"
+    REWARD = "reward"
+    CRITIC = "critic"
+    ROLES = [ACTOR, ROLLOUT, REFERENCE, REWARD, CRITIC]
+
+    def __init__(self):
+        super().__init__()
+        self._dl_type = "RL"
+
+    def actor(self, module_name: str, class_name: str) -> RoleBuilder:
+        return self.workload(self.ACTOR, module_name, class_name)
+
+    def rollout(self, module_name: str, class_name: str) -> RoleBuilder:
+        return self.workload(self.ROLLOUT, module_name, class_name).mpmd()
+
+    def reference(self, module_name: str, class_name: str) -> RoleBuilder:
+        return self.workload(self.REFERENCE, module_name, class_name).mpmd()
+
+    def reward(self, module_name: str, class_name: str) -> RoleBuilder:
+        return self.workload(self.REWARD, module_name, class_name).mpmd()
+
+    def critic(self, module_name: str, class_name: str) -> RoleBuilder:
+        return self.workload(self.CRITIC, module_name, class_name)

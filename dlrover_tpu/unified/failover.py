@@ -1,0 +1,49 @@
+"""Failover coordination for multi-role jobs (reference unified/master/
+mpmd/failover.py:24 MPMDFailoverCoordinator + elastic sub-master restarts).
+
+Recovery ladder per failed vertex (mirrors the L1/L2 ladder, SURVEY §5.3):
+1. MPMD role (inference-ish service, independent instances) → restart just
+   that actor;
+2. SPMD role (jax.distributed group; the XLA world is static) → restart the
+   whole role group together;
+3. restart budget exhausted → JobAbort.
+"""
+
+from typing import Dict
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.unified.graph import ExecutionVertex
+from dlrover_tpu.unified.scheduler import ProcessScheduler
+
+
+class JobAbortError(RuntimeError):
+    """Restart budget exhausted (reference JobAbortionAction)."""
+
+
+class FailoverCoordinator:
+    def __init__(self, scheduler: ProcessScheduler, max_restarts: int = 3):
+        self._scheduler = scheduler
+        self._max_restarts = max_restarts
+        self._restarts: Dict[str, int] = {}  # per role
+
+    def restart_count(self, role: str) -> int:
+        return self._restarts.get(role, 0)
+
+    def handle_failure(self, vertex: ExecutionVertex) -> None:
+        role = vertex.role
+        used = self._restarts.get(role, 0)
+        if used >= self._max_restarts:
+            raise JobAbortError(
+                f"role {role} exceeded {self._max_restarts} restarts"
+            )
+        self._restarts[role] = used + 1
+        if vertex.spmd and vertex.world_size > 1:
+            logger.warning(
+                "failover: SPMD member %s died; restarting role group %s "
+                "(%s/%s)", vertex.name, role, used + 1, self._max_restarts)
+            self._scheduler.restart_role(role)
+        else:
+            logger.warning(
+                "failover: restarting actor %s (%s/%s)",
+                vertex.name, used + 1, self._max_restarts)
+            self._scheduler.restart(vertex.name)

@@ -1,0 +1,78 @@
+"""Execution graph: job spec → one vertex per workload instance
+(reference unified/master/graph.py — DLExecutionVertex:102,
+DLExecutionGraph, get_vertex_name:32)."""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.unified.api import DLJob, RoleConfig
+
+
+def vertex_name(role: str, world_size: int, rank: int) -> str:
+    """(reference graph.py:32 — role_worldsize-rank scheme)"""
+    return f"{role}_{world_size}-{rank}"
+
+
+@dataclass
+class ExecutionVertex:
+    role: str
+    rank: int
+    world_size: int
+    local_rank: int
+    local_world_size: int
+    module_name: str
+    class_name: str
+    spmd: bool
+    env: Dict[str, str] = field(default_factory=dict)
+    resource: Dict[str, float] = field(default_factory=dict)
+    # placement output: which host this vertex runs on (bundle = host)
+    node_index: int = -1
+    restart_count: int = 0
+
+    @property
+    def name(self) -> str:
+        return vertex_name(self.role, self.world_size, self.rank)
+
+
+class ExecutionGraph:
+    """Per-role vertex lists + flat lookup (reference DLExecutionGraph)."""
+
+    def __init__(self, job: DLJob):
+        self.job = job
+        self.role_vertices: Dict[str, List[ExecutionVertex]] = {}
+        for role, cfg in job.roles.items():
+            self.role_vertices[role] = self._expand(cfg)
+
+    @staticmethod
+    def _expand(cfg: RoleConfig) -> List[ExecutionVertex]:
+        # local_rank/local_world_size here are provisional; placement
+        # overwrites them from actual host assignment (free packing can
+        # split a role unevenly — placement.py _assign_local_ranks)
+        local_ws = cfg.per_node or cfg.num
+        out = []
+        for rank in range(cfg.num):
+            out.append(ExecutionVertex(
+                role=cfg.role,
+                rank=rank,
+                world_size=cfg.num,
+                local_rank=rank % local_ws,
+                local_world_size=local_ws,
+                module_name=cfg.module_name,
+                class_name=cfg.class_name,
+                spmd=cfg.spmd,
+                env=dict(cfg.env),
+                resource=dict(cfg.resource),
+            ))
+        return out
+
+    def vertices(self) -> List[ExecutionVertex]:
+        return [v for vs in self.role_vertices.values() for v in vs]
+
+    def by_name(self, name: str) -> Optional[ExecutionVertex]:
+        for v in self.vertices():
+            if v.name == name:
+                return v
+        return None
+
+    def roles(self) -> List[str]:
+        return list(self.role_vertices)

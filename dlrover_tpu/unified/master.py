@@ -1,0 +1,140 @@
+"""UnifiedMaster: composes graph → placement → scheduler → failover and
+drives the job (reference unified/master/master.py:40 BaseMaster, a Ray
+actor; here an in-proc object the submitting process runs — the control
+plane needs no accelerator, so a plain process is the TPU-native choice).
+
+Two stream shapes (reference DLStreamType):
+- task stream (RL): a user Trainer drives role groups; the master retries
+  ``fit`` through the failover ladder.
+- data/SPMD stream (no trainer): every role's ``run()`` is broadcast; the
+  master watches for deaths and applies the same ladder until all runs
+  return.
+"""
+
+import importlib
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.unified.api import DLJob
+from dlrover_tpu.unified.failover import FailoverCoordinator, JobAbortError
+from dlrover_tpu.unified.graph import ExecutionGraph
+from dlrover_tpu.unified.placement import HostFillPlacement
+from dlrover_tpu.unified.scheduler import (
+    ActorDiedError,
+    ProcessScheduler,
+    RoleGroup,
+)
+
+
+class UnifiedMaster:
+    def __init__(self, job: DLJob, job_name: str = "unified",
+                 backend: str = "process", max_restarts: int = 3,
+                 start_method: str = "fork"):
+        if backend != "process":
+            raise ValueError(f"unknown backend {backend!r} "
+                             "(ray backend: not in this build)")
+        self.job = job
+        self.job_name = job_name
+        self.graph = ExecutionGraph(job)
+        self.placement = HostFillPlacement(self.graph)
+        self.scheduler = ProcessScheduler(
+            self.graph, job_name, start_method=start_method
+        )
+        self.failover = FailoverCoordinator(self.scheduler, max_restarts)
+
+    # -- setup --------------------------------------------------------------
+    def _inject_spmd_env(self) -> None:
+        """Reserve a jax.distributed coordinator per SPMD role group
+        (single-host build: loopback + free port; the k8s path would put
+        group-rank-0's pod IP here)."""
+        from dlrover_tpu.common.rpc import find_free_port
+
+        for role, cfg in self.job.roles.items():
+            if cfg.spmd and cfg.num > 1:
+                coord = f"127.0.0.1:{find_free_port('127.0.0.1')}"
+                for v in self.graph.role_vertices[role]:
+                    v.env.setdefault("DLROVER_TPU_COORDINATOR", coord)
+
+    def role_groups(self) -> Dict[str, RoleGroup]:
+        return {r: self.scheduler.role_group(r) for r in self.graph.roles()}
+
+    # -- run ----------------------------------------------------------------
+    def run(self, timeout_s: float = 300.0) -> int:
+        self.placement.allocate()
+        self._inject_spmd_env()
+        self.scheduler.schedule()
+        try:
+            if self.job.trainer is not None:
+                return self._run_task_stream(timeout_s)
+            return self._run_broadcast(timeout_s)
+        except JobAbortError as e:
+            logger.error("job aborted: %s", e)
+            return 1
+        finally:
+            self.scheduler.cleanup()
+
+    def _build_trainer(self):
+        tc = self.job.trainer
+        cls = getattr(importlib.import_module(tc.module_name), tc.class_name)
+        return cls(self.role_groups(), self.job.config)
+
+    def _run_task_stream(self, timeout_s: float) -> int:
+        trainer = self._build_trainer()
+        deadline = time.time() + timeout_s
+        inited = False
+        while True:
+            try:
+                # init() broadcasts over role groups too — an actor death
+                # there must ride the same failover ladder as fit()
+                if not inited:
+                    trainer.init()
+                    inited = True
+                trainer.fit()
+                return 0
+            except ActorDiedError as e:
+                if time.time() > deadline:
+                    logger.error("task stream timed out during failover")
+                    return 1
+                vertex = self.graph.by_name(e.vertex_name)
+                if vertex is None:
+                    raise
+                self.failover.handle_failure(vertex)
+                # role groups resolve handles lazily — trainer retries as-is
+
+    def _run_broadcast(self, timeout_s: float) -> int:
+        """No trainer: broadcast ``run()`` to every actor, ride out deaths
+        with the failover ladder until every instance has returned."""
+        pool = self.scheduler._pool  # shared, cleaned up by scheduler
+        deadline = time.time() + timeout_s
+        pending = {v.name for v in self.graph.vertices()}
+        while pending:
+            if time.time() > deadline:
+                logger.error("broadcast stream timed out; pending=%s",
+                             sorted(pending))
+                return 1
+            futs = {
+                name: pool.submit(
+                    self.scheduler.handles[name].call, "run",
+                    timeout=max(1.0, deadline - time.time()),
+                )
+                for name in list(pending)
+            }
+            failed: Optional[str] = None
+            for name, fut in futs.items():
+                try:
+                    fut.result()
+                    pending.discard(name)
+                except ActorDiedError:
+                    failed = name
+                except Exception as e:  # noqa: BLE001 — workload raised
+                    logger.error("%s.run raised: %s", name, e)
+                    return 1
+            if failed is not None:
+                vertex = self.graph.by_name(failed)
+                self.failover.handle_failure(vertex)
+                if vertex.spmd and vertex.world_size > 1:
+                    # whole group restarted → group re-runs
+                    for v in self.graph.role_vertices[vertex.role]:
+                        pending.add(v.name)
+        return 0

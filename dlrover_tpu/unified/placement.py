@@ -1,0 +1,112 @@
+"""Placement: assign graph vertices to hosts (reference unified/master/
+placement.py — SingleBundlePerNodePlacement:87, SingleGroupPerNodePlacement
+:161 over Ray placement groups).
+
+TPU redesign: there are no Ray bundles — a TPU pod slice gives you hosts
+with a fixed chip count, so a "bundle" *is* a host. Placement fills
+``vertex.node_index`` subject to:
+
+- per-role ``per_node`` packing (reference bundle-per-node strategy);
+- collocation sets sharing hosts (reference SingleGroupPerNodePlacement
+  groups collocated roles into one bundle);
+- host capacity = ``device_per_node`` processes (one process per chip for
+  SPMD roles — TPU chips are single-process, unlike CUDA MPS).
+"""
+
+from typing import Dict, List
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.unified.graph import ExecutionGraph
+
+
+class PlacementError(RuntimeError):
+    pass
+
+
+class HostFillPlacement:
+    """Round-robin fill honoring per_node (reference
+    SingleBundlePerNodePlacement semantics without the PG machinery)."""
+
+    def __init__(self, graph: ExecutionGraph):
+        self.graph = graph
+
+    def allocate(self) -> Dict[int, List[str]]:
+        job = self.graph.job
+        capacity = [job.device_per_node] * job.node_num
+        assignment: Dict[int, List[str]] = {
+            i: [] for i in range(job.node_num)
+        }
+
+        # Collocated roles first: their instances must share hosts, so the
+        # g-th instance group of each collocated set lands on the same host.
+        placed_roles = set()
+        for col in job.collocations:
+            roles = sorted(col)
+            groups = max(
+                (job.roles[r].num + (job.roles[r].per_node
+                                     or job.roles[r].num) - 1)
+                // (job.roles[r].per_node or job.roles[r].num)
+                for r in roles
+            )
+            placed_roles.update(roles)
+            for g in range(groups):
+                # need = what THIS group actually has left to place (roles
+                # fully placed in earlier groups contribute 0)
+                chunks = []
+                for r in roles:
+                    per = job.roles[r].per_node or job.roles[r].num
+                    chunk = self.graph.role_vertices[r][
+                        g * per:(g + 1) * per]
+                    if chunk:
+                        chunks.append(chunk)
+                need = sum(len(c) for c in chunks)
+                if need == 0:
+                    continue
+                host = self._pick_host(capacity, need=need)
+                for chunk in chunks:
+                    for v in chunk:
+                        v.node_index = host
+                        assignment[host].append(v.name)
+                        capacity[host] -= 1
+
+        # Remaining roles: per_node chunks stay together; per_node=0 packs
+        # freely, one instance at a time (api.py RoleConfig contract).
+        for role, verts in self.graph.role_vertices.items():
+            if role in placed_roles:
+                continue
+            per = self.graph.job.roles[role].per_node or 1
+            for start in range(0, len(verts), per):
+                chunk = verts[start:start + per]
+                host = self._pick_host(capacity, need=len(chunk))
+                for v in chunk:
+                    v.node_index = host
+                    assignment[host].append(v.name)
+                    capacity[host] -= 1
+        self._assign_local_ranks()
+        logger.info("placement: %s", {
+            h: names for h, names in assignment.items() if names
+        })
+        return assignment
+
+    def _assign_local_ranks(self) -> None:
+        """Local rank/world-size are a *placement* outcome (instances of a
+        role sharing a host), not derivable from per_node alone — free
+        packing can split a role across hosts unevenly."""
+        for verts in self.graph.role_vertices.values():
+            by_host: Dict[int, List] = {}
+            for v in verts:
+                by_host.setdefault(v.node_index, []).append(v)
+            for host_verts in by_host.values():
+                for i, v in enumerate(sorted(host_verts,
+                                             key=lambda x: x.rank)):
+                    v.local_rank = i
+                    v.local_world_size = len(host_verts)
+
+    @staticmethod
+    def _pick_host(capacity: List[int], need: int) -> int:
+        for i, c in enumerate(capacity):
+            if c >= need:
+                return i
+        raise PlacementError(
+            f"no host with capacity {need} (remaining {capacity})"
+        )

@@ -1,0 +1,37 @@
+"""Trainer base: the user-defined driver of an RL/DL task stream
+(reference unified/trainer/trainer.py:343 BaseTrainer — runs inside the
+Ray master; here it runs inside UnifiedMaster's process).
+
+The trainer sees one :class:`RoleGroup` per workload role and drives the
+pipeline (e.g. PPO: rollout.generate → reward.score → actor.update).
+Failover is wrapped around the trainer's calls by the master: an
+ActorDiedError triggers the coordinator ladder, then ``fit`` is retried.
+"""
+
+from typing import Any, Dict
+
+from dlrover_tpu.unified.scheduler import RoleGroup
+
+
+class BaseTrainer:
+    """(reference BaseTrainer; RG_* role-group attributes)"""
+
+    def __init__(self, role_groups: Dict[str, RoleGroup],
+                 config: Dict[str, Any]):
+        self.role_groups = role_groups
+        self.config = config
+        for role, group in role_groups.items():
+            setattr(self, f"RG_{role.upper()}", group)
+
+    def group(self, role: str) -> RoleGroup:
+        return self.role_groups[role]
+
+    # -- lifecycle the master drives ----------------------------------------
+    def init(self) -> None:
+        """One-time setup (broadcast model init, connect roles, …)."""
+
+    def fit(self) -> None:
+        """The task stream. Must be re-entrant: after failover the master
+        calls it again, so derive progress from workload state (e.g. an
+        epoch counter held by the actors), not trainer locals."""
+        raise NotImplementedError

@@ -1,0 +1,104 @@
+"""Workload base classes users extend (reference unified/trainer/
+workload.py — BaseWorkload:92, trainer_invocation decorator:31).
+
+A workload instance runs in its own OS process (the reference uses a Ray
+actor). The scheduler calls public methods over a pipe; return values go
+back pickled. SPMD roles can bootstrap jax.distributed from the env the
+master injected (coordinator address per role group)."""
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+
+@dataclass
+class WorkloadContext:
+    """Identity + config the master hands each instance (reference
+    BaseWorkload properties :149–196)."""
+
+    name: str
+    role: str
+    rank: int
+    world_size: int
+    local_rank: int
+    local_world_size: int
+    node_index: int
+    job_name: str
+    config: Dict[str, Any] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    restart_count: int = 0
+
+
+class BaseWorkload:
+    """Extend and add public methods; the trainer invokes them by name
+    through RoleGroup. Lifecycle: __init__ → setup() → (calls…) →
+    teardown()."""
+
+    def __init__(self, ctx: WorkloadContext):
+        self.ctx = ctx
+        self.create_time = time.time()
+
+    # -- identity sugar (reference properties) ------------------------------
+    @property
+    def name(self) -> str:
+        return self.ctx.name
+
+    @property
+    def role(self) -> str:
+        return self.ctx.role
+
+    @property
+    def rank(self) -> int:
+        return self.ctx.rank
+
+    @property
+    def world_size(self) -> int:
+        return self.ctx.world_size
+
+    @property
+    def local_rank(self) -> int:
+        return self.ctx.local_rank
+
+    @property
+    def config(self) -> Dict[str, Any]:
+        return self.ctx.config
+
+    # -- lifecycle ----------------------------------------------------------
+    def setup(self) -> None:
+        """Runs in the actor process before any method call."""
+
+    def teardown(self) -> None:
+        """Runs before the actor process exits."""
+
+    def ping(self) -> float:
+        """Health probe (reference BaseWorkload.ping:254)."""
+        return time.time()
+
+    def get_runtime_info(self) -> Dict[str, Any]:
+        """(reference get_runtime_info:260)"""
+        return {
+            "name": self.name,
+            "role": self.role,
+            "rank": self.rank,
+            "pid": os.getpid(),
+            "create_time": self.create_time,
+            "restart_count": self.ctx.restart_count,
+        }
+
+    # -- SPMD helper --------------------------------------------------------
+    def setup_jax_distributed(self) -> None:
+        """Bootstrap jax.distributed from the env the master injected for
+        this role group (coordinator = group rank-0's host + reserved port).
+        The TPU analogue of the reference's torch master_addr/port plumbing
+        (BaseWorkload.torch_master_addr:177)."""
+        coordinator = self.ctx.env.get("DLROVER_TPU_COORDINATOR", "")
+        if not coordinator or self.world_size <= 1:
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=self.world_size,
+            process_id=self.rank,
+        )

@@ -1,0 +1,295 @@
+"""Unified multi-role runtime: builder validation, graph/placement,
+process-actor scheduler, role groups, failover ladder, and an end-to-end
+toy PPO task stream (reference unified/tests/: api, master, trainer,
+integration_test.py)."""
+
+import os
+import time
+
+import pytest
+
+from dlrover_tpu.unified.api import (
+    DLJobBuilder,
+    InvalidDLConfiguration,
+    RLJobBuilder,
+)
+from dlrover_tpu.unified.failover import FailoverCoordinator, JobAbortError
+from dlrover_tpu.unified.graph import ExecutionGraph
+from dlrover_tpu.unified.master import UnifiedMaster
+from dlrover_tpu.unified.placement import HostFillPlacement, PlacementError
+from dlrover_tpu.unified.scheduler import (
+    ActorCallError,
+    ActorDiedError,
+    ProcessScheduler,
+)
+from dlrover_tpu.unified.trainer import BaseTrainer
+from dlrover_tpu.unified.workload import BaseWorkload
+
+MOD = "test_unified"
+
+
+# --- toy workloads (run in forked actor processes) -------------------------
+
+class Counter(BaseWorkload):
+    def setup(self):
+        self.n = 0
+
+    def bump(self, k=1):
+        self.n += k
+        return self.n
+
+    def whoami(self):
+        return (self.role, self.rank, self.world_size, os.getpid())
+
+    def crash(self):
+        os._exit(13)
+
+    def boom(self):
+        raise ValueError("intentional")
+
+    def run(self):
+        return f"ran-{self.name}"
+
+
+class Rollout(Counter):
+    def generate(self, prompt):
+        return f"{prompt}+gen{self.rank}"
+
+
+class Reward(Counter):
+    def score(self, samples):
+        return {s: len(s) for s in samples}
+
+
+class Actor(Counter):
+    def update(self, scores):
+        self.n += sum(scores.values())
+        return self.n
+
+
+class PPOTrainer(BaseTrainer):
+    def init(self):
+        self.inited = True
+        self._crashed_once = False
+
+    def fit(self):
+        # re-entrant: a failover retry re-enters here (trainer.py contract)
+        if self.config.get("inject_crash") and not self._crashed_once:
+            self._crashed_once = True
+            self.group("rollout").call_rank(0, "crash")
+        samples = self.group("rollout").call("generate", "p")
+        scores = self.group("reward").call_rank(0, "score", samples)
+        totals = self.group("actor").call("update", scores)
+        self.result = totals
+        return totals
+
+
+class FailsInit(BaseWorkload):
+    def setup(self):
+        raise RuntimeError("bad init")
+
+
+# --- builder / graph / placement -------------------------------------------
+
+def _toy_job(inject_crash=False, num_rollout=2):
+    return (
+        RLJobBuilder()
+        .node_num(2)
+        .device_per_node(4)
+        .config({"inject_crash": inject_crash})
+        .actor(MOD, "Actor").num(2).end()
+        .rollout(MOD, "Rollout").num(num_rollout).end()
+        .reward(MOD, "Reward").num(1).end()
+        .trainer(MOD, "PPOTrainer")
+        .build()
+    )
+
+
+def test_builder_validation():
+    with pytest.raises(InvalidDLConfiguration):
+        DLJobBuilder().build()  # no roles
+    b = DLJobBuilder().node_num(0)
+    b.workload("w", MOD, "Counter")
+    with pytest.raises(InvalidDLConfiguration):
+        b.build()  # bad node_num
+    b = DLJobBuilder()
+    b.workload("w", MOD, "Counter").num(3).per_node(2)
+    with pytest.raises(InvalidDLConfiguration):
+        b.build()  # 3 % 2 != 0
+    # collocation over capacity
+    b = DLJobBuilder().node_num(1).device_per_node(2)
+    b.workload("a", MOD, "Counter").num(2).per_node(2)
+    b.workload("b", MOD, "Counter").num(1)
+    b.collocate("a", "b")
+    with pytest.raises(InvalidDLConfiguration):
+        b.build()
+
+
+def test_rl_builder_marks_inference_roles_mpmd():
+    job = _toy_job()
+    assert job.roles["rollout"].spmd is False
+    assert job.roles["reward"].spmd is False
+    assert job.roles["actor"].spmd is True
+    assert job.trainer.class_name == "PPOTrainer"
+
+
+def test_graph_expansion_and_names():
+    g = ExecutionGraph(_toy_job())
+    assert len(g.vertices()) == 5
+    actors = g.role_vertices["actor"]
+    assert [v.rank for v in actors] == [0, 1]
+    assert actors[1].name == "actor_2-1"
+    assert g.by_name("rollout_2-0").role == "rollout"
+
+
+def test_placement_collocation_and_capacity():
+    b = DLJobBuilder().node_num(2).device_per_node(4)
+    b.workload("a", MOD, "Counter").num(4).per_node(2)
+    b.workload("b", MOD, "Counter").num(2).per_node(1)
+    b.collocate("a", "b")
+    g = ExecutionGraph(b.build())
+    HostFillPlacement(g).allocate()
+    # group k of a (2 instances) shares a host with instance k of b
+    for k in range(2):
+        hosts_a = {v.node_index
+                   for v in g.role_vertices["a"][2 * k:2 * k + 2]}
+        assert hosts_a == {g.role_vertices["b"][k].node_index}
+    # over capacity → placement error
+    b = DLJobBuilder().node_num(1).device_per_node(2)
+    b.workload("big", MOD, "Counter").num(4).per_node(4)
+    with pytest.raises(PlacementError):
+        HostFillPlacement(ExecutionGraph(b.build())).allocate()
+
+
+def test_placement_free_packing_spans_hosts():
+    """per_node=0 means pack freely: 5 instances spread over 2x4 hosts
+    instead of demanding one host fit all 5."""
+    b = DLJobBuilder().node_num(2).device_per_node(4)
+    b.workload("w", MOD, "Counter").num(5)
+    g = ExecutionGraph(b.build())
+    HostFillPlacement(g).allocate()
+    hosts = [v.node_index for v in g.role_vertices["w"]]
+    assert sorted(set(hosts)) == [0, 1]
+    # local ranks reflect actual host grouping
+    by_host = {}
+    for v in g.role_vertices["w"]:
+        by_host.setdefault(v.node_index, []).append(v)
+    for vs in by_host.values():
+        assert sorted(v.local_rank for v in vs) == list(range(len(vs)))
+        assert all(v.local_world_size == len(vs) for v in vs)
+
+
+def test_placement_collocation_uneven_groups():
+    """A collocated role fully placed in early groups contributes 0 to
+    later groups' capacity need (regression: spurious PlacementError)."""
+    b = DLJobBuilder().node_num(2).device_per_node(3)
+    b.workload("x", MOD, "Counter").num(1)
+    b.workload("a", MOD, "Counter").num(4).per_node(2)
+    b.workload("b", MOD, "Counter").num(1)
+    b.collocate("x")
+    b.collocate("a", "b")
+    g = ExecutionGraph(b.build())
+    HostFillPlacement(g).allocate()   # must not raise
+    assert all(v.node_index >= 0 for v in g.vertices())
+
+
+# --- scheduler / actors -----------------------------------------------------
+
+@pytest.fixture
+def sched():
+    g = ExecutionGraph(_toy_job())
+    HostFillPlacement(g).allocate()
+    s = ProcessScheduler(g, "t")
+    s.schedule(ready_timeout_s=30)
+    yield s
+    s.cleanup()
+
+
+def test_actor_calls_state_and_groups(sched):
+    rg = sched.role_group("actor")
+    assert rg.call("bump") == [1, 1]
+    assert rg.call("bump", 5) == [6, 6]           # state persists per actor
+    infos = rg.call("whoami")
+    assert [i[1] for i in infos] == [0, 1]
+    assert len({i[3] for i in infos}) == 2        # distinct processes
+    with pytest.raises(ActorCallError, match="intentional"):
+        sched.role_group("reward").call("boom")
+    # an exception does not kill the actor
+    assert sched.role_group("reward").call("ping")
+
+
+def test_actor_death_detection_and_restart(sched):
+    rg = sched.role_group("rollout")
+    pid0 = rg.call_rank(0, "whoami")[3]
+    with pytest.raises(ActorDiedError):
+        rg.call_rank(0, "crash")
+    fo = FailoverCoordinator(sched, max_restarts=2)
+    dead = sched.dead_vertices()
+    assert [v.name for v in dead] == ["rollout_2-0"]
+    fo.handle_failure(dead[0])
+    who = rg.call_rank(0, "whoami")
+    assert who[3] != pid0                          # fresh process
+    assert rg.call_rank(0, "bump") == 1            # state reset
+    assert sched.graph.by_name("rollout_2-0").restart_count == 1
+    # budget exhaustion
+    fo2 = FailoverCoordinator(sched, max_restarts=0)
+    with pytest.raises(JobAbortError):
+        fo2.handle_failure(sched.graph.by_name("rollout_2-0"))
+
+
+def test_spmd_group_restart(sched):
+    """An SPMD member death restarts the whole role group (static XLA
+    world)."""
+    rg = sched.role_group("actor")
+    pids = [i[3] for i in rg.call("whoami")]
+    with pytest.raises(ActorDiedError):
+        rg.call_rank(1, "crash")
+    FailoverCoordinator(sched).handle_failure(
+        sched.graph.by_name("actor_2-1"))
+    new_pids = [i[3] for i in rg.call("whoami")]
+    assert set(new_pids).isdisjoint(pids)          # both members respawned
+
+
+def test_call_timeout_kills_actor(sched):
+    """A timed-out call poisons the pipe, so the handle kills the actor —
+    a later caller must see death, never the stale buffered response."""
+    rg = sched.role_group("reward")
+    h = rg.handles[0]
+    with pytest.raises(ActorDiedError, match="timed out"):
+        h.call("run", timeout=0.0)  # any call with an instant timeout
+    h.proc.join(timeout=5)
+    assert not h.alive
+    # failover brings a fresh actor that answers correctly
+    FailoverCoordinator(sched).handle_failure(h.vertex)
+    assert rg.call_rank(0, "bump") == 1
+
+
+def test_init_failure_surfaces():
+    b = DLJobBuilder()
+    b.workload("bad", MOD, "FailsInit")
+    g = ExecutionGraph(b.build())
+    HostFillPlacement(g).allocate()
+    s = ProcessScheduler(g, "t")
+    with pytest.raises(ActorDiedError, match="bad init"):
+        s.schedule(ready_timeout_s=20)
+    s.cleanup()
+
+
+# --- end-to-end -------------------------------------------------------------
+
+def test_e2e_task_stream():
+    assert _toy_job().submit(timeout_s=120) == 0
+
+
+def test_e2e_task_stream_with_failover():
+    """Trainer crashes a rollout actor mid-fit; the master restarts it and
+    retries fit to completion."""
+    t0 = time.time()
+    assert _toy_job(inject_crash=True).submit(timeout_s=120) == 0
+    assert time.time() - t0 < 110
+
+
+def test_e2e_broadcast_stream():
+    b = DLJobBuilder().node_num(1).device_per_node(4)
+    b.workload("w", MOD, "Counter").num(3).mpmd()
+    assert b.build().submit(timeout_s=60) == 0
