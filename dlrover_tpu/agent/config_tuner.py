@@ -63,6 +63,7 @@ class ParalConfigTuner:
             "dataloader_batch_size": config.dataloader_batch_size,
             "dataloader_version": config.dataloader_version,
             "grad_accum_steps": config.grad_accum_steps,
+            "micro_batch_scale": config.micro_batch_scale,
             "version": config.version,
         }
         tmp = self.config_path + ".tmp"

@@ -1,0 +1,19 @@
+"""Brain: cluster-level resource-optimization service.
+
+Reference: dlrover/go/brain (15.2k LoC Go) — gRPC service with RPCs
+``persist_metrics`` / ``optimize`` / ``get_job_metrics``
+(dlrover/proto/brain.proto:196–199), an optimizer plugin tree
+(pkg/optimizer/implementation/) and a MySQL datastore (pkg/datastore/).
+
+TPU rebuild: same three-RPC surface over the framework's typed RPC
+transport, the optimizer plugins re-targeted at TPU knobs (slice host
+count, micro-batch/grad-accum from HBM headroom) instead of PS CPU/memory
+sizing, and a sqlite datastore (stdlib, durable, zero-ops) standing in for
+MySQL — the reference keeps cross-job history so *new* jobs start with
+resources that worked for similar past jobs; that is the property kept.
+"""
+
+from dlrover_tpu.brain.datastore import MetricsStore
+from dlrover_tpu.brain.service import BrainClient, BrainService
+
+__all__ = ["MetricsStore", "BrainClient", "BrainService"]

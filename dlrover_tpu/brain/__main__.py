@@ -1,0 +1,30 @@
+"""Standalone Brain daemon: ``python -m dlrover_tpu.brain [--port N]
+[--db PATH]`` (reference: the Go Brain server cmd, dlrover/go/brain)."""
+
+import argparse
+import threading
+
+from dlrover_tpu.brain.datastore import MetricsStore
+from dlrover_tpu.brain.service import BrainService
+
+
+def main() -> int:
+    p = argparse.ArgumentParser("dlrover-tpu-brain")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8501)
+    p.add_argument("--db", default="/tmp/dlrover_tpu_brain.db",
+                   help="sqlite path (:memory: for ephemeral)")
+    args = p.parse_args()
+    service = BrainService(store=MetricsStore(args.db))
+    service.serve(args.host, args.port)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        service.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,123 @@
+"""Job-metrics datastore (reference dlrover/go/brain/pkg/datastore/ over
+MySQL; here sqlite3 — durable file or in-memory, stdlib-only).
+
+Schema: one row per job, append-only metric samples per job. The optimize
+path reads (a) a job's own recent samples, (b) completed *similar* jobs'
+final shapes for cold-start sizing (reference
+optimize_job_ps_cold_create_resource.go keys history by job name)."""
+
+import json
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class JobRecord:
+    uuid: str
+    name: str
+    scenario: str = ""
+    status: str = "running"          # running | completed | failed
+    created_at: float = 0.0
+    final_nodes: int = 0             # world it completed with
+    config: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class MetricSample:
+    job_uuid: str
+    kind: str                        # speed | resource | event | oom ...
+    payload: Dict[str, Any]
+    ts: float = 0.0
+
+
+class MetricsStore:
+    def __init__(self, path: str = ":memory:"):
+        # one connection guarded by a lock: the service is low-QPS control
+        # plane (reference persists per 30 s per job)
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._mu = threading.Lock()
+        with self._mu:
+            self._db.executescript("""
+                CREATE TABLE IF NOT EXISTS jobs (
+                    uuid TEXT PRIMARY KEY, name TEXT, scenario TEXT,
+                    status TEXT, created_at REAL, final_nodes INTEGER,
+                    config TEXT);
+                CREATE TABLE IF NOT EXISTS metrics (
+                    job_uuid TEXT, kind TEXT, ts REAL, payload TEXT);
+                CREATE INDEX IF NOT EXISTS metrics_job
+                    ON metrics (job_uuid, kind, ts);
+            """)
+            self._db.commit()
+
+    # -- jobs ---------------------------------------------------------------
+    def upsert_job(self, job: JobRecord) -> None:
+        if not job.created_at:
+            job.created_at = time.time()
+        with self._mu:
+            self._db.execute(
+                "INSERT INTO jobs VALUES (?,?,?,?,?,?,?) "
+                "ON CONFLICT(uuid) DO UPDATE SET status=excluded.status, "
+                "final_nodes=excluded.final_nodes, config=excluded.config",
+                (job.uuid, job.name, job.scenario, job.status,
+                 job.created_at, job.final_nodes, json.dumps(job.config)),
+            )
+            self._db.commit()
+
+    def get_job(self, uuid: str) -> Optional[JobRecord]:
+        with self._mu:
+            row = self._db.execute(
+                "SELECT uuid,name,scenario,status,created_at,final_nodes,"
+                "config FROM jobs WHERE uuid=?", (uuid,)).fetchone()
+        if row is None:
+            return None
+        return JobRecord(uuid=row[0], name=row[1], scenario=row[2],
+                         status=row[3], created_at=row[4],
+                         final_nodes=row[5], config=json.loads(row[6]))
+
+    def similar_completed_jobs(self, name: str,
+                               limit: int = 10) -> List[JobRecord]:
+        """Completed jobs sharing the name stem (reference keys history by
+        job name with trailing run-ids stripped)."""
+        stem = name.rstrip("0123456789-_") or name
+        with self._mu:
+            rows = self._db.execute(
+                "SELECT uuid,name,scenario,status,created_at,final_nodes,"
+                "config FROM jobs WHERE status='completed' AND name LIKE ? "
+                "ORDER BY created_at DESC LIMIT ?",
+                (stem + "%", limit)).fetchall()
+        return [JobRecord(uuid=r[0], name=r[1], scenario=r[2], status=r[3],
+                          created_at=r[4], final_nodes=r[5],
+                          config=json.loads(r[6])) for r in rows]
+
+    # -- metrics ------------------------------------------------------------
+    def persist(self, sample: MetricSample) -> None:
+        if not sample.ts:
+            sample.ts = time.time()
+        with self._mu:
+            self._db.execute(
+                "INSERT INTO metrics VALUES (?,?,?,?)",
+                (sample.job_uuid, sample.kind, sample.ts,
+                 json.dumps(sample.payload)),
+            )
+            self._db.commit()
+
+    def query(self, job_uuid: str, kind: Optional[str] = None,
+              limit: int = 100) -> List[MetricSample]:
+        q = "SELECT job_uuid,kind,ts,payload FROM metrics WHERE job_uuid=?"
+        args: List[Any] = [job_uuid]
+        if kind is not None:
+            q += " AND kind=?"
+            args.append(kind)
+        q += " ORDER BY ts DESC LIMIT ?"
+        args.append(limit)
+        with self._mu:
+            rows = self._db.execute(q, args).fetchall()
+        return [MetricSample(job_uuid=r[0], kind=r[1], ts=r[2],
+                             payload=json.loads(r[3])) for r in rows]
+
+    def close(self) -> None:
+        with self._mu:
+            self._db.close()

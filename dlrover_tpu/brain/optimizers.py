@@ -1,0 +1,196 @@
+"""Brain optimizer plugins (reference dlrover/go/brain/pkg/optimizer/
+implementation/ — optimizer tree + optalgorithm/*.go).
+
+The reference's algorithms size PS/worker CPU & memory from runtime and
+historical metrics. TPU jobs have different knobs, so each plugin is
+re-derived for the slice model:
+
+| reference algorithm | TPU plugin | knob |
+|---|---|---|
+| job_ps_cold_create / worker_create  | ColdCreate | host count from similar completed jobs |
+| job_ps_init_adjust                  | InitAdjust | micro-batch / grad-accum from HBM headroom |
+| job_worker_resource (running)       | RunningScale | host count from scaling-efficiency of speed history |
+| worker_create_oom                   | OomGuard | micro-batch shrink on OOM events |
+
+Plugins run as a chain per phase (reference optprocessor); the first
+non-empty plan wins for its phase.
+"""
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from dlrover_tpu.brain.datastore import MetricsStore
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.resource import (
+    ResourcePlan,
+    ScalingStats,
+    round_to_unit,
+)
+
+
+@dataclass
+class OptimizeContext:
+    job_uuid: str
+    job_name: str
+    phase: str                       # create | init | running
+    stats: ScalingStats
+    store: MetricsStore
+
+
+class BrainPlugin:
+    name = "base"
+    phases = ("running",)
+
+    def optimize(self, ctx: OptimizeContext) -> ResourcePlan:
+        raise NotImplementedError
+
+
+class ColdCreate(BrainPlugin):
+    """Size a brand-new job from history: median final host count of
+    completed jobs with the same name stem (reference
+    optimize_job_ps_cold_create_resource.go)."""
+
+    name = "cold_create"
+    phases = ("create",)
+
+    def optimize(self, ctx: OptimizeContext) -> ResourcePlan:
+        similar = ctx.store.similar_completed_jobs(ctx.job_name)
+        sizes = sorted(j.final_nodes for j in similar if j.final_nodes > 0)
+        if not sizes:
+            return ResourcePlan()
+        median = sizes[len(sizes) // 2]
+        target = min(ctx.stats.max_nodes,
+                     max(ctx.stats.min_nodes,
+                         round_to_unit(median, ctx.stats.node_unit)
+                         or ctx.stats.node_unit))
+        return ResourcePlan(
+            node_num=target,
+            reason=f"cold-start from {len(sizes)} similar jobs "
+                   f"(median {median})",
+        )
+
+
+class InitAdjust(BrainPlugin):
+    """First telemetry arrived: right-size micro-batch to HBM headroom
+    (reference optimize_job_ps_init_adjust_resource.go adjusts the initial
+    guess once real usage is known). Keeps global batch fixed — grad accum
+    absorbs the change (ElasticTrainer contract, trainer.py:307)."""
+
+    name = "init_adjust"
+    # HBM-headroom adjustment is valid whenever telemetry exists, so it is
+    # reachable from the running phase too (the wired client path sends
+    # create|running; "init" kept for explicit callers)
+    phases = ("init", "running")
+    # bf16 activations: stay under ~90%; below 55% there's room to double
+    HIGH, LOW = 0.90, 0.55
+
+    def optimize(self, ctx: OptimizeContext) -> ResourcePlan:
+        frac = ctx.stats.hbm_used_frac
+        if frac is None:
+            return ResourcePlan()
+        paral = comm.ParallelConfig()
+        if frac > self.HIGH:
+            paral.micro_batch_scale = 0.5
+            reason = f"HBM {frac:.0%} > {self.HIGH:.0%}: halve micro-batch"
+        elif frac < self.LOW:
+            paral.micro_batch_scale = 2.0
+            reason = f"HBM {frac:.0%} < {self.LOW:.0%}: double micro-batch"
+        else:
+            return ResourcePlan()
+        return ResourcePlan(paral_config=paral, reason=reason)
+
+
+class RunningScale(BrainPlugin):
+    """Scale the world from measured scaling efficiency: persisted speed
+    samples at different world sizes estimate marginal throughput per
+    host; scale back when the last grow bought <60% of linear (reference
+    job_worker_resource_optimizer.go grows/shrinks workers from runtime
+    throughput)."""
+
+    name = "running_scale"
+    phases = ("running",)
+    MIN_EFFICIENCY = 0.6
+
+    def optimize(self, ctx: OptimizeContext) -> ResourcePlan:
+        samples = ctx.store.query(ctx.job_uuid, kind="speed", limit=200)
+        # bucket: world size → best steps/s seen
+        best = {}
+        for s in samples:
+            w = int(s.payload.get("nodes", 0))
+            v = float(s.payload.get("steps_per_s", 0.0))
+            if w > 0 and v > 0:
+                best[w] = max(best.get(w, 0.0), v)
+        if len(best) < 2:
+            return ResourcePlan()
+        ws = sorted(best)
+        w_prev, w_cur = ws[-2], ws[-1]
+        if w_cur <= w_prev:
+            return ResourcePlan()
+        linear_gain = best[w_prev] * (w_cur / w_prev) - best[w_prev]
+        real_gain = best[w_cur] - best[w_prev]
+        if linear_gain <= 0:
+            return ResourcePlan()
+        eff = real_gain / linear_gain
+        if eff < self.MIN_EFFICIENCY:
+            target = max(ctx.stats.min_nodes,
+                         round_to_unit(w_prev, ctx.stats.node_unit)
+                         or w_prev)
+            if target < ctx.stats.target_nodes:
+                return ResourcePlan(
+                    node_num=target,
+                    reason=f"scaling efficiency {eff:.0%} < "
+                           f"{self.MIN_EFFICIENCY:.0%} at {w_cur} hosts: "
+                           f"shrink to {target}",
+                )
+        return ResourcePlan()
+
+
+class OomGuard(BrainPlugin):
+    """OOM events recorded for this job → shrink micro-batch before the
+    crash loop burns the restart budget (reference
+    optimize_job_worker_create_oom_resource.go bumps memory on OOM)."""
+
+    name = "oom_guard"
+    phases = ("init", "running")
+    # only react to OOMs in the last half hour — a single ancient event
+    # must not shadow the other running-phase plugins forever (the chain
+    # is first-win)
+    WINDOW_S = 1800.0
+
+    def optimize(self, ctx: OptimizeContext) -> ResourcePlan:
+        cutoff = time.time() - self.WINDOW_S
+        ooms = [s for s in ctx.store.query(ctx.job_uuid, kind="oom", limit=5)
+                if s.ts >= cutoff]
+        if not ooms:
+            return ResourcePlan()
+        paral = comm.ParallelConfig()
+        paral.micro_batch_scale = 0.5
+        return ResourcePlan(
+            paral_config=paral,
+            reason=f"{len(ooms)} OOM event(s): halve micro-batch",
+        )
+
+
+DEFAULT_PLUGINS: List[BrainPlugin] = [
+    ColdCreate(), OomGuard(), InitAdjust(), RunningScale(),
+]
+
+
+class OptimizerChain:
+    """Phase-filtered first-win chain (reference optprocessor pipeline)."""
+
+    def __init__(self, plugins: Optional[List[BrainPlugin]] = None):
+        self._plugins = plugins if plugins is not None else DEFAULT_PLUGINS
+
+    def optimize(self, ctx: OptimizeContext) -> ResourcePlan:
+        for plugin in self._plugins:
+            if ctx.phase not in plugin.phases:
+                continue
+            plan = plugin.optimize(ctx)
+            if not plan.empty():
+                logger.info("brain[%s] %s: %s", ctx.phase, plugin.name,
+                            plan.reason)
+                return plan
+        return ResourcePlan()

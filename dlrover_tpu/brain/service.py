@@ -1,0 +1,145 @@
+"""Brain service + client over the framework RPC transport.
+
+Reference surface: dlrover/proto/brain.proto:196–199 —
+``persist_metrics(JobMetrics)``, ``optimize(OptimizeRequest)``,
+``get_job_metrics(JobMetricsRequest)`` — served by the Go Brain
+(pkg/server); the master's BrainResoureOptimizer
+(master/resource/brain_optimizer.py:64) is its client. Here the same three
+methods ride :class:`~dlrover_tpu.common.rpc.RPCServer` and the client
+plugs straight into the master's :class:`BrainOptimizer` wrapper
+(master/resource.py:136): ``BrainClient.optimize(stats)`` → ResourcePlan.
+"""
+
+from dataclasses import field
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.brain.datastore import JobRecord, MetricSample, MetricsStore
+from dlrover_tpu.brain.optimizers import OptimizeContext, OptimizerChain
+from dlrover_tpu.common.comm import message
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import NodeResource
+from dlrover_tpu.common.rpc import RPCClient, RPCServer
+from dlrover_tpu.master.resource import ResourcePlan, ScalingStats
+
+# Register the payload types crossing the wire with the msgpack type
+# registry (comm.py @message): the RPC envelope refuses plain dataclasses.
+message(ScalingStats)
+message(ResourcePlan)
+message(MetricSample)
+message(NodeResource)
+
+
+@message
+class PersistMetricsRequest:
+    job_uuid: str
+    job_name: str = ""
+    kind: str = "speed"
+    payload: Dict[str, Any] = field(default_factory=dict)
+    # job lifecycle piggyback: set to mark completion/failure with the
+    # final world size (feeds ColdCreate history)
+    job_status: str = ""
+    final_nodes: int = 0
+
+
+@message
+class OptimizeRequest:
+    job_uuid: str
+    job_name: str = ""
+    phase: str = "running"           # create | init | running
+    stats: Optional[ScalingStats] = None
+
+
+@message
+class JobMetricsRequest:
+    job_uuid: str
+    kind: Optional[str] = None
+    limit: int = 100
+
+
+class BrainService:
+    """In-proc service; expose with :meth:`serve` (standalone daemon) or
+    mount on an existing RPCServer via :meth:`register`."""
+
+    def __init__(self, store: Optional[MetricsStore] = None,
+                 chain: Optional[OptimizerChain] = None):
+        self.store = store or MetricsStore()
+        self.chain = chain or OptimizerChain()
+        self._server: Optional[RPCServer] = None
+
+    # -- the three reference RPCs ------------------------------------------
+    def persist_metrics(self, req: PersistMetricsRequest) -> bool:
+        job = self.store.get_job(req.job_uuid)
+        if job is None:
+            job = JobRecord(uuid=req.job_uuid, name=req.job_name)
+            self.store.upsert_job(job)
+        if req.job_status:
+            job.status = req.job_status
+            if req.final_nodes:
+                job.final_nodes = req.final_nodes
+            self.store.upsert_job(job)
+        if req.payload:
+            self.store.persist(MetricSample(
+                job_uuid=req.job_uuid, kind=req.kind, payload=req.payload))
+        return True
+
+    def optimize(self, req: OptimizeRequest) -> ResourcePlan:
+        stats = req.stats or ScalingStats()
+        ctx = OptimizeContext(
+            job_uuid=req.job_uuid, job_name=req.job_name,
+            phase=req.phase, stats=stats, store=self.store,
+        )
+        return self.chain.optimize(ctx)
+
+    def get_job_metrics(self, req: JobMetricsRequest) -> List[MetricSample]:
+        return self.store.query(req.job_uuid, req.kind, req.limit)
+
+    # -- hosting ------------------------------------------------------------
+    def register(self, server: RPCServer) -> None:
+        server.register("brain_persist_metrics", self.persist_metrics)
+        server.register("brain_optimize", self.optimize)
+        server.register("brain_get_job_metrics", self.get_job_metrics)
+
+    def serve(self, host: str = "0.0.0.0", port: int = 0) -> RPCServer:
+        self._server = RPCServer(host, port)
+        self.register(self._server)
+        self._server.start()
+        logger.info("brain service on :%s", self._server.port)
+        return self._server
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop()
+        self.store.close()
+
+
+class BrainClient:
+    """Typed client. ``optimize(stats)`` matches what the master's
+    BrainOptimizer wrapper calls (master/resource.py:144); the job identity
+    is bound at construction."""
+
+    def __init__(self, addr: str, job_uuid: str, job_name: str = "",
+                 timeout_s: float = 10.0):
+        self._rpc = RPCClient(addr, timeout_s=timeout_s, retries=1)
+        self._job_uuid = job_uuid
+        self._job_name = job_name
+
+    def report_metric(self, kind: str, payload: Dict[str, Any]) -> None:
+        self._rpc.call("brain_persist_metrics", PersistMetricsRequest(
+            job_uuid=self._job_uuid, job_name=self._job_name,
+            kind=kind, payload=payload))
+
+    def report_job_status(self, status: str, final_nodes: int = 0) -> None:
+        self._rpc.call("brain_persist_metrics", PersistMetricsRequest(
+            job_uuid=self._job_uuid, job_name=self._job_name,
+            job_status=status, final_nodes=final_nodes))
+
+    def optimize(self, stats: ScalingStats,
+                 phase: str = "running") -> ResourcePlan:
+        return self._rpc.call("brain_optimize", OptimizeRequest(
+            job_uuid=self._job_uuid, job_name=self._job_name,
+            phase=phase, stats=stats))
+
+    def job_metrics(self, kind: Optional[str] = None,
+                    limit: int = 100) -> List[MetricSample]:
+        return self._rpc.call("brain_get_job_metrics", JobMetricsRequest(
+            job_uuid=self._job_uuid, kind=kind, limit=limit))

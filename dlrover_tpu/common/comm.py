@@ -21,8 +21,11 @@ _REGISTRY: Dict[str, Type] = {}
 
 
 def message(cls):
-    """Class decorator: register a dataclass as a wire message."""
-    cls = dataclass(cls)
+    """Class decorator: register a dataclass as a wire message. Also usable
+    as a plain call on an existing dataclass (re-applying @dataclass would
+    mangle default_factory fields)."""
+    if not dataclasses.is_dataclass(cls):
+        cls = dataclass(cls)
     _REGISTRY[cls.__name__] = cls
     return cls
 
@@ -367,6 +370,10 @@ class ParallelConfig:
     dataloader_batch_size: int = 0
     dataloader_version: int = 0
     grad_accum_steps: int = 0
+    # multiplicative micro-batch adjustment from HBM headroom/OOM telemetry
+    # (Brain InitAdjust/OomGuard); grad-accum absorbs it to keep the global
+    # batch fixed
+    micro_batch_scale: float = 1.0
     version: int = 0
 
 

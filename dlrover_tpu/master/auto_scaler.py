@@ -35,6 +35,9 @@ class JobAutoScaler:
         node_unit: int = 1,
         interval_s: float = 30.0,
         straggler_provider=None,
+        metrics_sink=None,
+        strategy_generator=None,
+        hbm_provider=None,
     ):
         self._job_manager = job_manager
         self._perf_monitor = perf_monitor
@@ -47,6 +50,13 @@ class JobAutoScaler:
         self.target_nodes = max_nodes
         self._interval_s = interval_s
         self._straggler_provider = straggler_provider or (lambda: [])
+        # optional per-tick stats export (e.g. BrainClient.report_metric —
+        # feeds the cluster-level history the Brain optimizers learn from)
+        self._metrics_sink = metrics_sink
+        # paral-config plans flow through the strategy generator → servicer
+        # → agent tuner file (the live ParallelConfig path)
+        self._strategy_generator = strategy_generator
+        self._hbm_provider = hbm_provider or (lambda: None)
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -89,11 +99,18 @@ class JobAutoScaler:
             node_unit=self.node_unit,
             running_speed=self._perf_monitor.running_speed(),
             straggler_nodes=list(self._straggler_provider()),
+            hbm_used_frac=self._hbm_provider(),
             oldest_pending_s=oldest_pending,
         )
 
     def tick(self) -> Optional[ResourcePlan]:
         stats = self.collect_stats()
+        if self._metrics_sink is not None:
+            try:
+                self._metrics_sink(stats)
+            except Exception:  # noqa: BLE001 — telemetry must not scale
+                logger.warning("auto-scaler metrics sink failed",
+                               exc_info=True)
         plan = self._optimizer.plan(stats)
         if plan.empty():
             return None
@@ -101,6 +118,10 @@ class JobAutoScaler:
         return plan
 
     def execute(self, plan: ResourcePlan) -> None:
+        if plan.paral_config is not None and self._strategy_generator:
+            scale = plan.paral_config.micro_batch_scale
+            if scale and scale != 1.0:
+                self._strategy_generator.apply_scale(scale, plan.reason)
         if plan.node_num is None:
             return
         target = max(self.min_nodes, min(self.max_nodes, plan.node_num))

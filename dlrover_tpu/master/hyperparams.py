@@ -49,6 +49,38 @@ class SimpleStrategyGenerator:
                 version=1,
             )
 
+    def apply_scale(self, scale: float, reason: str = "") -> None:
+        """Apply a relative micro-batch adjustment (Brain InitAdjust /
+        OomGuard plans, brain/optimizers.py). With a known absolute batch
+        size the scale folds into it; before one is set, the factor rides
+        ParallelConfig.micro_batch_scale so workers apply it relatively.
+        Either way the version bump makes the tuner re-ship the file."""
+        if scale == 1.0:
+            return
+        with self._lock:
+            current = self._config
+            if current.dataloader_batch_size > 0:
+                new_bs = max(_MIN_BATCH,
+                             int(current.dataloader_batch_size * scale))
+                self._config = comm.ParallelConfig(
+                    dataloader_batch_size=new_bs,
+                    dataloader_version=current.dataloader_version + 1,
+                    grad_accum_steps=current.grad_accum_steps,
+                    micro_batch_scale=1.0,
+                    version=current.version + 1,
+                )
+            else:
+                self._config = comm.ParallelConfig(
+                    micro_batch_scale=current.micro_batch_scale * scale,
+                    dataloader_version=current.dataloader_version,
+                    version=current.version + 1,
+                )
+            logger.info("strategy: micro-batch scale %s applied (%s)",
+                        scale, reason)
+
+    def worst_hbm_frac(self) -> Optional[float]:
+        return self._worst_hbm_frac()
+
     def _worst_hbm_frac(self) -> Optional[float]:
         if self._metrics is None:
             return None

@@ -210,13 +210,39 @@ class DistributedJobMaster(JobMaster):
         from dlrover_tpu.master.auto_scaler import JobAutoScaler
 
         net_check = self.rdzv_managers[RendezvousName.NODE_CHECK]
+        # cluster-level Brain service (reference BrainResoureOptimizer,
+        # master/resource/brain_optimizer.py:64): when configured, it plans
+        # from cross-job history and receives this job's runtime stats;
+        # otherwise the in-master LocalOptimizer heuristics run
+        optimizer = None
+        metrics_sink = None
+        brain_addr = kwargs.get("brain_addr", "")
+        if brain_addr:
+            from dlrover_tpu.brain.service import BrainClient
+            from dlrover_tpu.master.resource import BrainOptimizer
+
+            brain_client = BrainClient(
+                brain_addr, job_uuid=job_name, job_name=job_name
+            )
+            optimizer = BrainOptimizer(brain_client)
+
+            def metrics_sink(stats):
+                brain_client.report_metric("speed", {
+                    "nodes": stats.running_nodes,
+                    "steps_per_s": stats.running_speed,
+                })
+
         self.auto_scaler = JobAutoScaler(
             self.job_manager, self.perf_monitor, scaler,
             rdzv_managers=self.rdzv_managers,
+            optimizer=optimizer,
             min_nodes=kwargs.get("min_nodes") or node_num,
             max_nodes=kwargs.get("max_nodes") or node_num,
             node_unit=kwargs.get("node_unit", 1),
             straggler_provider=net_check.get_stragglers,
+            metrics_sink=metrics_sink,
+            strategy_generator=self.strategy_generator,
+            hbm_provider=self.strategy_generator.worst_hbm_frac,
         )
 
     def prepare(self) -> None:

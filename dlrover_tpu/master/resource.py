@@ -142,8 +142,15 @@ class BrainOptimizer(ResourceOptimizer):
         self._client = brain_client
 
     def plan(self, stats: ScalingStats) -> ResourcePlan:
+        # phase routing (reference: Brain optimizer config keys per job
+        # stage): nothing running yet → cold-create sizing from history;
+        # otherwise runtime plugins (HBM adjust / OOM guard / efficiency
+        # scale — brain/optimizers.py phases)
+        phase = "create" if (
+            stats.running_nodes == 0 and stats.running_speed == 0
+        ) else "running"
         try:
-            return self._client.optimize(stats)
+            return self._client.optimize(stats, phase=phase)
         except Exception as e:  # noqa: BLE001
             logger.warning("brain optimizer unavailable: %r", e)
             return ResourcePlan()

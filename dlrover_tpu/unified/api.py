@@ -182,7 +182,15 @@ class DLJobBuilder:
         if self._trainer is None and self._dl_type == "RL":
             logger.error("'trainer' must be set for an RL task stream")
             ok = False
+        seen_collocated: Set[str] = set()
         for col in self._collocations:
+            overlap = col & seen_collocated
+            if overlap:
+                logger.error(
+                    "roles %s appear in more than one collocation set — "
+                    "a role can only be pinned to one host group", overlap)
+                ok = False
+            seen_collocated |= col
             unknown = col - set(self._roles)
             if unknown:
                 logger.error("collocation references undefined roles %s",

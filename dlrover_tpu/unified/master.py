@@ -63,12 +63,15 @@ class UnifiedMaster:
     def run(self, timeout_s: float = 300.0) -> int:
         self.placement.allocate()
         self._inject_spmd_env()
-        self.scheduler.schedule()
         try:
+            # inside the try: a partially-started fleet (one actor's
+            # setup() raises) must still be torn down, and submit() is
+            # documented to return an exit code, not leak the exception
+            self.scheduler.schedule()
             if self.job.trainer is not None:
                 return self._run_task_stream(timeout_s)
             return self._run_broadcast(timeout_s)
-        except JobAbortError as e:
+        except (JobAbortError, ActorDiedError) as e:
             logger.error("job aborted: %s", e)
             return 1
         finally:

@@ -138,8 +138,40 @@ class RoleGroup:
         return len(self.handles)
 
     def call(self, method: str, *args, **kwargs) -> List[Any]:
+        handles = self.handles
         futs = [self._pool.submit(h.call, method, *args, **kwargs)
-                for h in self.handles]
+                for h in handles]
+        # SPMD hazard: if one member dies mid-collective, the survivors
+        # block forever inside the collective and their futures never
+        # resolve — so on the first observed death in an SPMD group, kill
+        # the rest (their recv then raises) and surface ActorDiedError for
+        # the failover ladder. MPMD members are independent: let them
+        # finish, then re-raise.
+        spmd = handles and handles[0].vertex.spmd \
+            and handles[0].vertex.world_size > 1
+        if spmd:
+            pending = set(futs)
+            died: Optional[ActorDiedError] = None
+            while pending and died is None:
+                for f in list(pending):
+                    if not f.done():
+                        continue
+                    pending.discard(f)
+                    exc = f.exception()
+                    if isinstance(exc, ActorDiedError):
+                        died = exc
+                if pending and died is None:
+                    time.sleep(0.05)
+            if died is not None:
+                for h in handles:
+                    if h.alive:
+                        h.kill()
+                for f in pending:
+                    try:
+                        f.result()
+                    except Exception:  # noqa: BLE001 — already failing over
+                        pass
+                raise died
         return [f.result() for f in futs]
 
     def call_rank(self, rank: int, method: str, *args, **kwargs) -> Any:
@@ -161,7 +193,12 @@ class ProcessScheduler:
         self.job_name = job_name
         self._mp = mp.get_context(start_method)
         self.handles: Dict[str, ActorHandle] = {}
-        self._pool = ThreadPoolExecutor(max_workers=32)
+        # must cover a full-fleet broadcast: a role-group call over N SPMD
+        # actors needs N concurrent in-flight calls or the collective
+        # inside them deadlocks behind the pool queue
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(32, 2 * len(graph.vertices()))
+        )
 
     def schedule(self, ready_timeout_s: float = 60.0) -> None:
         """Spawn every vertex and wait for readiness (reference

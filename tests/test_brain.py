@@ -1,0 +1,274 @@
+"""Brain resource-optimization service: datastore, optimizer plugins,
+RPC service/client, and integration with the master's BrainOptimizer
+wrapper (reference dlrover/go/brain — datastore + optimizer plugin tree +
+persist_metrics/optimize/get_job_metrics RPCs)."""
+
+import os
+
+import pytest
+
+from dlrover_tpu.brain.datastore import JobRecord, MetricSample, MetricsStore
+from dlrover_tpu.brain.optimizers import (
+    ColdCreate,
+    InitAdjust,
+    OomGuard,
+    OptimizeContext,
+    OptimizerChain,
+    RunningScale,
+)
+from dlrover_tpu.brain.service import (
+    BrainClient,
+    BrainService,
+    PersistMetricsRequest,
+)
+from dlrover_tpu.master.resource import BrainOptimizer, ScalingStats
+
+
+def _ctx(store, phase="running", job="j1", name="llama-7b-42", **stats):
+    defaults = dict(min_nodes=1, max_nodes=32, node_unit=4, target_nodes=8)
+    defaults.update(stats)
+    return OptimizeContext(
+        job_uuid=job, job_name=name, phase=phase,
+        stats=ScalingStats(**defaults), store=store,
+    )
+
+
+# --- datastore ---------------------------------------------------------------
+
+def test_store_jobs_metrics_roundtrip(tmp_path):
+    path = os.path.join(tmp_path, "brain.db")
+    store = MetricsStore(path)
+    store.upsert_job(JobRecord(uuid="a", name="llama-7b-001"))
+    store.persist(MetricSample(job_uuid="a", kind="speed",
+                               payload={"nodes": 4, "steps_per_s": 2.0}))
+    store.close()
+    # durable: reopen and read back
+    store = MetricsStore(path)
+    assert store.get_job("a").name == "llama-7b-001"
+    got = store.query("a", "speed")
+    assert got[0].payload["steps_per_s"] == 2.0
+    # completion update feeds history
+    job = store.get_job("a")
+    job.status, job.final_nodes = "completed", 16
+    store.upsert_job(job)
+    sim = store.similar_completed_jobs("llama-7b-002")
+    assert [j.final_nodes for j in sim] == [16]
+    store.close()
+
+
+# --- plugins -----------------------------------------------------------------
+
+def test_cold_create_uses_history_median():
+    store = MetricsStore()
+    for i, n in enumerate([8, 16, 24]):
+        store.upsert_job(JobRecord(
+            uuid=f"h{i}", name=f"llama-7b-{i}", status="completed",
+            final_nodes=n))
+    plan = ColdCreate().optimize(_ctx(store, phase="create"))
+    assert plan.node_num == 16
+    # no history → empty plan
+    assert ColdCreate().optimize(
+        _ctx(store, phase="create", name="bert")).empty()
+
+
+def test_cold_create_respects_bounds_and_unit():
+    store = MetricsStore()
+    store.upsert_job(JobRecord(uuid="h", name="llama-7b-0",
+                               status="completed", final_nodes=100))
+    plan = ColdCreate().optimize(_ctx(store, phase="create", max_nodes=8))
+    assert plan.node_num == 8
+
+
+def test_init_adjust_from_hbm():
+    store = MetricsStore()
+    high = InitAdjust().optimize(_ctx(store, phase="init",
+                                      hbm_used_frac=0.95))
+    assert high.paral_config.micro_batch_scale == 0.5
+    low = InitAdjust().optimize(_ctx(store, phase="init",
+                                     hbm_used_frac=0.30))
+    assert low.paral_config.micro_batch_scale == 2.0
+    mid = InitAdjust().optimize(_ctx(store, phase="init",
+                                     hbm_used_frac=0.70))
+    assert mid.empty()
+    assert InitAdjust().optimize(_ctx(store, phase="init")).empty()
+
+
+def test_running_scale_shrinks_on_poor_efficiency():
+    store = MetricsStore()
+    # 8→16 hosts bought only 10% more throughput (eff = 0.1 < 0.6)
+    for nodes, sps in [(8, 10.0), (16, 11.0)]:
+        store.persist(MetricSample(job_uuid="j1", kind="speed",
+                                   payload={"nodes": nodes,
+                                            "steps_per_s": sps}))
+    plan = RunningScale().optimize(_ctx(store, target_nodes=16))
+    assert plan.node_num == 8
+    # near-linear scaling → no change
+    store2 = MetricsStore()
+    for nodes, sps in [(8, 10.0), (16, 19.0)]:
+        store2.persist(MetricSample(job_uuid="j1", kind="speed",
+                                    payload={"nodes": nodes,
+                                             "steps_per_s": sps}))
+    assert RunningScale().optimize(_ctx(store2, target_nodes=16)).empty()
+
+
+def test_oom_guard():
+    store = MetricsStore()
+    assert OomGuard().optimize(_ctx(store)).empty()
+    store.persist(MetricSample(job_uuid="j1", kind="oom",
+                               payload={"node": 3}))
+    plan = OomGuard().optimize(_ctx(store))
+    assert plan.paral_config.micro_batch_scale == 0.5
+
+
+def test_oom_guard_ignores_stale_events():
+    """An OOM outside the recency window must not shadow the rest of the
+    running-phase chain forever (the chain is first-win)."""
+    import time as _t
+
+    store = MetricsStore()
+    store.persist(MetricSample(job_uuid="j1", kind="oom", payload={},
+                               ts=_t.time() - 7200))
+    assert OomGuard().optimize(_ctx(store)).empty()
+
+
+def test_init_adjust_reachable_from_running_phase():
+    """The wired master path only sends create|running; HBM adjustment
+    must fire from 'running' (regression: dead 'init'-only phase)."""
+    store = MetricsStore()
+    plan = InitAdjust().optimize(_ctx(store, phase="running",
+                                      hbm_used_frac=0.97))
+    assert plan.paral_config.micro_batch_scale == 0.5
+
+
+def test_paral_plan_flows_to_strategy_generator_and_tuner_file(tmp_path):
+    """End of the micro-batch pipe: Brain plan → JobAutoScaler.execute →
+    SimpleStrategyGenerator version bump → agent tuner file payload."""
+    import json
+
+    from dlrover_tpu.common import comm
+    from dlrover_tpu.master.auto_scaler import JobAutoScaler
+    from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
+    from dlrover_tpu.master.resource import ResourcePlan
+
+    gen = SimpleStrategyGenerator()
+    gen.set_initial(batch_size=16, grad_accum=2)
+
+    class _JM:
+        nodes = {}
+
+    class _PM:
+        def running_speed(self):
+            return 0.0
+
+    scaler = JobAutoScaler(_JM(), _PM(), scaler=None,
+                           strategy_generator=gen)
+    paral = comm.ParallelConfig()
+    paral.micro_batch_scale = 0.5
+    scaler.execute(ResourcePlan(paral_config=paral, reason="oom"))
+    assert gen.config.dataloader_batch_size == 8
+    assert gen.config.version == 2
+
+    # the agent tuner serializes the full config including the scale field
+    from dlrover_tpu.agent.config_tuner import ParalConfigTuner
+
+    class _Client:
+        def get_parallel_config(self):
+            return gen.config
+
+    path = os.path.join(tmp_path, "paral.json")
+    tuner = ParalConfigTuner(_Client(), path)
+    assert tuner.poll_once()
+    payload = json.load(open(path))
+    assert payload["dataloader_batch_size"] == 8
+    assert "micro_batch_scale" in payload
+
+
+def test_chain_phase_filtering_first_win():
+    store = MetricsStore()
+    store.upsert_job(JobRecord(uuid="h", name="llama-7b-0",
+                               status="completed", final_nodes=8))
+    store.persist(MetricSample(job_uuid="j1", kind="oom", payload={}))
+    chain = OptimizerChain()
+    # create phase: ColdCreate wins, OomGuard (init/running) filtered out
+    plan = chain.optimize(_ctx(store, phase="create"))
+    assert plan.node_num == 8 and plan.paral_config is None
+    # init phase: OomGuard wins over InitAdjust (registered first)
+    plan = chain.optimize(_ctx(store, phase="init", hbm_used_frac=0.2))
+    assert "OOM" in plan.reason
+
+
+# --- service over RPC --------------------------------------------------------
+
+@pytest.fixture
+def brain():
+    svc = BrainService()
+    server = svc.serve(host="127.0.0.1")
+    yield svc, f"127.0.0.1:{server.port}"
+    svc.stop()
+
+
+def test_service_rpc_roundtrip(brain):
+    svc, addr = brain
+    client = BrainClient(addr, job_uuid="job-x", job_name="gpt-13b-7")
+    client.report_metric("speed", {"nodes": 4, "steps_per_s": 1.5})
+    client.report_metric("speed", {"nodes": 8, "steps_per_s": 1.6})
+    got = client.job_metrics("speed")
+    assert len(got) == 2
+    plan = client.optimize(ScalingStats(
+        min_nodes=1, max_nodes=32, node_unit=1, target_nodes=8))
+    assert plan.node_num == 4          # poor efficiency → shrink
+    client.report_job_status("completed", final_nodes=8)
+    # new job cold-starts from that history
+    c2 = BrainClient(addr, job_uuid="job-y", job_name="gpt-13b-8")
+    plan = c2.optimize(ScalingStats(min_nodes=1, max_nodes=32, node_unit=1),
+                       phase="create")
+    assert plan.node_num == 8
+
+
+def test_auto_scaler_brain_integration(brain):
+    """JobAutoScaler with a Brain optimizer + metrics sink: ticks feed the
+    datastore; once history shows poor scaling efficiency the plan shrinks
+    the rendezvous target (the full master wiring, master.py brain_addr)."""
+    from dlrover_tpu.master.auto_scaler import JobAutoScaler
+
+    _, addr = brain
+    client = BrainClient(addr, job_uuid="asj", job_name="as-1")
+
+    class _JM:
+        nodes = {}
+
+    class _PM:
+        def running_speed(self):
+            return 1.0
+
+    sink_calls = []
+
+    def sink(stats):
+        sink_calls.append(stats)
+        client.report_metric("speed", {
+            "nodes": stats.running_nodes, "steps_per_s": stats.running_speed,
+        })
+
+    scaler_obj = JobAutoScaler(
+        _JM(), _PM(), scaler=None,
+        optimizer=BrainOptimizer(client),
+        min_nodes=1, max_nodes=16, node_unit=1,
+        metrics_sink=sink,
+    )
+    # seed history: 4→8 hosts bought almost nothing
+    client.report_metric("speed", {"nodes": 4, "steps_per_s": 10.0})
+    client.report_metric("speed", {"nodes": 8, "steps_per_s": 10.5})
+    plan = scaler_obj.tick()
+    assert sink_calls, "metrics sink not invoked"
+    assert plan is not None and scaler_obj.target_nodes == 4
+
+
+def test_master_brain_optimizer_wrapper(brain):
+    """The master-side BrainOptimizer (resource.py:136) rides the client;
+    service down degrades to an empty plan, never an exception."""
+    _, addr = brain
+    client = BrainClient(addr, job_uuid="job-z", job_name="t5")
+    opt = BrainOptimizer(client)
+    assert opt.plan(ScalingStats()).empty()
+    dead = BrainOptimizer(BrainClient("127.0.0.1:1", job_uuid="x"))
+    assert dead.plan(ScalingStats()).empty()

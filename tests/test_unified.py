@@ -44,6 +44,12 @@ class Counter(BaseWorkload):
     def crash(self):
         os._exit(13)
 
+    def crash_or_block(self):
+        # rank 1 dies; the others block like survivors of a dead collective
+        if self.rank == 1:
+            os._exit(13)
+        time.sleep(120)
+
     def boom(self):
         raise ValueError("intentional")
 
@@ -235,6 +241,41 @@ def test_actor_death_detection_and_restart(sched):
     fo2 = FailoverCoordinator(sched, max_restarts=0)
     with pytest.raises(JobAbortError):
         fo2.handle_failure(sched.graph.by_name("rollout_2-0"))
+
+
+def test_spmd_death_mid_collective_unblocks_group(sched):
+    """One SPMD member dies while the rest block in a 'collective': the
+    group call must surface ActorDiedError promptly (killing the stuck
+    survivors) instead of hanging until the survivors' sleep ends."""
+    rg = sched.role_group("actor")   # spmd role, world_size=2
+    t0 = time.time()
+    with pytest.raises(ActorDiedError):
+        rg.call("crash_or_block")
+    assert time.time() - t0 < 30     # far below the 120 s block
+    assert all(not h.alive for h in rg.handles)
+
+
+def test_collocation_overlap_rejected():
+    b = DLJobBuilder().node_num(2).device_per_node(8)
+    for r in ("a", "b", "c"):
+        b.workload(r, MOD, "Counter").num(2)
+    b.collocate("a", "b").collocate("b", "c")
+    with pytest.raises(InvalidDLConfiguration):
+        b.build()
+
+
+def test_submit_returns_code_on_init_failure():
+    """A workload whose setup() raises must surface as exit code 1 from
+    submit() with the rest of the fleet torn down, not as an exception."""
+    import multiprocessing
+
+    before = len(multiprocessing.active_children())
+    b = DLJobBuilder().node_num(1).device_per_node(4)
+    b.workload("ok", MOD, "Counter").num(2).mpmd()
+    b.workload("bad", MOD, "FailsInit").num(1)
+    assert b.build().submit(timeout_s=60) == 1
+    time.sleep(0.5)
+    assert len(multiprocessing.active_children()) <= before
 
 
 def test_spmd_group_restart(sched):
