@@ -57,6 +57,11 @@ class JobAutoScaler:
         # → agent tuner file (the live ParallelConfig path)
         self._strategy_generator = strategy_generator
         self._hbm_provider = hbm_provider or (lambda: None)
+        # plan sources (Brain OomGuard/InitAdjust) re-emit the same
+        # multiplicative plan every tick until fresh telemetry lands;
+        # without a cooldown execute() would compound 0.5^ticks
+        self.paral_cooldown_s = 300.0
+        self._last_paral_apply = 0.0
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -120,7 +125,11 @@ class JobAutoScaler:
     def execute(self, plan: ResourcePlan) -> None:
         if plan.paral_config is not None and self._strategy_generator:
             scale = plan.paral_config.micro_batch_scale
-            if scale and scale != 1.0:
+            now = time.time()
+            if (scale and scale != 1.0
+                    and now - self._last_paral_apply
+                    >= self.paral_cooldown_s):
+                self._last_paral_apply = now
                 self._strategy_generator.apply_scale(scale, plan.reason)
         if plan.node_num is None:
             return

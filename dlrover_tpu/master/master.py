@@ -217,13 +217,23 @@ class DistributedJobMaster(JobMaster):
         optimizer = None
         metrics_sink = None
         brain_addr = kwargs.get("brain_addr", "")
+        self._brain_client = None
         if brain_addr:
+            import uuid as _uuid
+
             from dlrover_tpu.brain.service import BrainClient
             from dlrover_tpu.master.resource import BrainOptimizer
 
+            # uuid unique per run: re-runs under the same job name must not
+            # inherit a previous run's speed buckets (RunningScale would
+            # shrink the fresh job from stale history); the *name* is what
+            # links runs for ColdCreate's cross-job sizing
             brain_client = BrainClient(
-                brain_addr, job_uuid=job_name, job_name=job_name
+                brain_addr,
+                job_uuid=f"{job_name}-{_uuid.uuid4().hex[:8]}",
+                job_name=job_name,
             )
+            self._brain_client = brain_client
             optimizer = BrainOptimizer(brain_client)
 
             def metrics_sink(stats):
@@ -258,7 +268,17 @@ class DistributedJobMaster(JobMaster):
             self._scaler.scale(ScalePlan(worker_num=self._node_num))
         self.auto_scaler.start()
 
-    def stop(self) -> None:
+    def stop(self, job_status: str = "completed") -> None:
+        if self._brain_client is not None:
+            # close the loop for ColdCreate: record how this run ended and
+            # at what size, so the next same-named job cold-starts from it
+            try:
+                self._brain_client.report_job_status(
+                    job_status, final_nodes=self.auto_scaler.target_nodes
+                )
+            except Exception:  # noqa: BLE001 — shutdown must not fail
+                logger.warning("brain completion report failed",
+                               exc_info=True)
         self.auto_scaler.stop()
         self.pod_watcher.stop()
         self._scaler.stop()

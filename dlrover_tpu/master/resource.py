@@ -140,15 +140,16 @@ class BrainOptimizer(ResourceOptimizer):
 
     def __init__(self, brain_client):
         self._client = brain_client
+        self._ever_ran = False
 
     def plan(self, stats: ScalingStats) -> ResourcePlan:
         # phase routing (reference: Brain optimizer config keys per job
-        # stage): nothing running yet → cold-create sizing from history;
-        # otherwise runtime plugins (HBM adjust / OOM guard / efficiency
-        # scale — brain/optimizers.py phases)
-        phase = "create" if (
-            stats.running_nodes == 0 and stats.running_speed == 0
-        ) else "running"
+        # stage): cold-create sizing only before the job has EVER run —
+        # a mid-job full-fleet restart also shows running_nodes==0, and
+        # re-sizing a recovering job from history would shrink it
+        if stats.running_nodes > 0 or stats.running_speed > 0:
+            self._ever_ran = True
+        phase = "running" if self._ever_ran else "create"
         try:
             return self._client.optimize(stats, phase=phase)
         except Exception as e:  # noqa: BLE001

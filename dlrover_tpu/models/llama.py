@@ -295,6 +295,93 @@ def next_token_loss(params, tokens, config: LlamaConfig, mesh=None):
     return nll.mean()
 
 
+def forward_pp(
+    params: Dict,
+    tokens,
+    config: LlamaConfig,
+    mesh,
+    n_microbatches: int = 0,
+):
+    """Pipeline-parallel forward over the mesh's ``pp`` axis
+    (parallel/pipeline.py — shard_map + ppermute GPipe schedule).
+
+    Stage layout: the cheap, replicable ends (embedding lookup, final
+    norm + lm_head) run outside the pipeline on every pp rank — only the
+    transformer blocks, where the FLOPs and parameters are, get staged.
+    That keeps the pipelined state a single uniform ``(b, S, D)``
+    activation (no int-token first hop, no special first/last stage) at
+    the cost of replicating <1% of compute. Backward is autodiff through
+    the schedule. Defaults M = 4·pp for a <20% fill/drain bubble.
+    """
+    from dlrover_tpu.parallel.pipeline import (
+        microbatch,
+        pipeline_apply,
+        stack_stages,
+        unmicrobatch,
+    )
+
+    c = config
+    S_pp = mesh.shape["pp"]
+    if S_pp <= 1:
+        return forward(params, tokens, config, mesh)
+    B, S = tokens.shape
+    M = n_microbatches
+    if not M:
+        # largest divisor of B not exceeding 4·pp (bubble target) — an
+        # arbitrary min(B, 4·pp) need not divide B
+        M = 1
+        for d in range(min(B, 4 * S_pp), 0, -1):
+            if B % d == 0:
+                M = d
+                break
+    x = params["tok_embed"][tokens]
+
+    def layer_fn(h, layer):
+        # positions from the *local* activation shape: inside the pipeline
+        # body the batch dim is the per-(dp,fsdp)-rank shard, not B/M
+        positions = jnp.broadcast_to(
+            jnp.arange(h.shape[1])[None, :], h.shape[:2]
+        )
+        h = h + _attention(
+            _rms_norm(h, layer["attn_norm"], c.norm_eps),
+            layer, c, positions, None,
+        )
+        h = h + _mlp(_rms_norm(h, layer["ffn_norm"], c.norm_eps), layer)
+        return h, None
+
+    scan_fn = layer_fn
+    if c.remat:
+        scan_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+
+    def stage_fn(layer_group, h):
+        h, _ = jax.lax.scan(scan_fn, h, layer_group)
+        return h
+
+    stages = stack_stages(params["layers"], S_pp)
+    ym = pipeline_apply(
+        stage_fn, stages, microbatch(x, M), mesh,
+        axis="pp", checkpoint_ticks=not c.remat,
+        batch_axes=("dp", "fsdp"),
+    )
+    y = unmicrobatch(ym)
+    y = _rms_norm(y, params["final_norm"], c.norm_eps)
+    return jnp.einsum(
+        "bsd,dv->bsv", y, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+
+
+def next_token_loss_pp(params, tokens, config: LlamaConfig, mesh,
+                       n_microbatches: int = 0):
+    """Causal LM loss through the pipeline-parallel forward."""
+    logits = forward_pp(params, tokens[:, :-1], config, mesh,
+                        n_microbatches)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
 def num_params(config: LlamaConfig) -> int:
     c = config
     q_dim, kv_dim = c.n_heads * c.head_dim, c.n_kv_heads * c.head_dim

@@ -232,6 +232,7 @@ class ElasticDataLoader:
             "DLROVER_TPU_PARAL_CONFIG_FILE"
         )
         self._config_mtime = 0.0
+        self._base_batch_size = self.batch_size
         self._collate = collate_fn or _default_collate
 
     # -- auto-tuning hook --------------------------------------------------
@@ -247,6 +248,14 @@ class ElasticDataLoader:
             with open(self._config_file, encoding="utf-8") as f:
                 cfg = json.load(f)
             new_bs = int(cfg.get("dataloader_batch_size", 0))
+            if new_bs <= 0:
+                # relative plan (Brain OomGuard/InitAdjust before an
+                # absolute size is known): the master accumulates the
+                # factor (hyperparams.apply_scale), so apply it to the
+                # *original* batch size — idempotent across reloads.
+                scale = float(cfg.get("micro_batch_scale", 1.0))
+                if scale != 1.0:
+                    new_bs = max(1, int(round(self._base_batch_size * scale)))
             if new_bs > 0 and new_bs != self.batch_size:
                 logger.info(
                     "dataloader batch size %s → %s (auto-tuner)",

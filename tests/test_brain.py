@@ -263,6 +263,78 @@ def test_auto_scaler_brain_integration(brain):
     assert plan is not None and scaler_obj.target_nodes == 4
 
 
+def test_paral_plan_cooldown_prevents_compounding(tmp_path):
+    """The same 0.5-scale plan re-emitted every tick must apply once per
+    cooldown window, not compound to batch size 1."""
+    from dlrover_tpu.common import comm
+    from dlrover_tpu.master.auto_scaler import JobAutoScaler
+    from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
+    from dlrover_tpu.master.resource import ResourcePlan
+
+    gen = SimpleStrategyGenerator()
+    gen.set_initial(batch_size=256)
+
+    class _JM:
+        nodes = {}
+
+    class _PM:
+        def running_speed(self):
+            return 0.0
+
+    scaler = JobAutoScaler(_JM(), _PM(), scaler=None,
+                           strategy_generator=gen)
+    paral = comm.ParallelConfig()
+    paral.micro_batch_scale = 0.5
+    for _ in range(8):
+        scaler.execute(ResourcePlan(paral_config=paral, reason="oom"))
+    assert gen.config.dataloader_batch_size == 128     # applied exactly once
+
+
+def test_dataloader_applies_relative_scale(tmp_path):
+    """micro_batch_scale with no absolute size reaches the worker: the
+    dataloader rescales from its ORIGINAL batch size (master accumulates
+    the factor, so applying to the current size would double-count)."""
+    import json
+    import time
+
+    from dlrover_tpu.trainer.data import ElasticDataLoader
+
+    path = os.path.join(tmp_path, "paral.json")
+    loader = ElasticDataLoader(list(range(64)), batch_size=16,
+                               config_file=path)
+
+    def write(scale, version):
+        json.dump({"dataloader_batch_size": 0, "micro_batch_scale": scale,
+                   "version": version}, open(path, "w"))
+        os.utime(path, (time.time() + version, time.time() + version))
+
+    write(0.5, 1)
+    loader._maybe_reload_config()
+    assert loader.batch_size == 8
+    write(0.25, 2)          # cumulative factor from the master
+    loader._maybe_reload_config()
+    assert loader.batch_size == 4                      # 16·0.25, not 8·0.25
+
+
+def test_brain_optimizer_phase_lifecycle(brain):
+    """'create' only before the job ever ran: a full-fleet restart
+    (running_nodes back to 0) must not re-route to cold-create sizing."""
+    _, addr = brain
+    client = BrainClient(addr, job_uuid="ph1", job_name="phase-1")
+    client.report_job_status("completed", final_nodes=4)  # history for stem
+    c2 = BrainClient(addr, job_uuid="ph2", job_name="phase-2")
+    opt = BrainOptimizer(c2)
+    # before first run: cold-create fires from history
+    plan = opt.plan(ScalingStats(min_nodes=1, max_nodes=32, node_unit=1))
+    assert plan.node_num == 4
+    # job runs, then fully restarts: no cold-create re-sizing
+    opt.plan(ScalingStats(running_nodes=8, running_speed=1.0,
+                          min_nodes=1, max_nodes=32))
+    plan = opt.plan(ScalingStats(running_nodes=0, running_speed=0.0,
+                                 min_nodes=1, max_nodes=32))
+    assert plan.node_num is None
+
+
 def test_master_brain_optimizer_wrapper(brain):
     """The master-side BrainOptimizer (resource.py:136) rides the client;
     service down degrades to an empty plan, never an exception."""
