@@ -21,7 +21,11 @@ class MasterClient:
 
     def __init__(self, master_addr: str, node_id: int = 0,
                  node_rank: Optional[int] = None):
-        self._client = RPCClient(master_addr)
+        # transport by scheme: http://host:port → HTTP (reference
+        # HttpMasterClient, master_client.py:579), bare host:port → TCP
+        from dlrover_tpu.common.http_server import make_rpc_client
+
+        self._client = make_rpc_client(master_addr)
         self._node_id = node_id
         self._node_rank = node_id if node_rank is None else node_rank
 
@@ -39,6 +43,9 @@ class MasterClient:
         self, rdzv_name: str, node_rank: int, local_world_size: int,
         host: str = "", free_port: int = 0, node_unit: int = 1,
     ) -> int:
+        from dlrover_tpu.master.net_topology import local_topology_attrs
+
+        slice_id, tpu_worker_id = local_topology_attrs()
         resp = self._client.call(
             "join_rendezvous",
             comm.JoinRendezvousRequest(
@@ -49,6 +56,8 @@ class MasterClient:
                 node_unit=node_unit,
                 host=host,
                 free_port=free_port,
+                slice_id=slice_id,
+                tpu_worker_id=tpu_worker_id,
             ),
         )
         return resp.round

@@ -101,11 +101,19 @@ def assign_worker_ranks(
     world: Dict[int, NodeMeta], node_rank: int
 ) -> Tuple[int, int]:
     """Compute (base_global_rank, world_size) from the cut world
-    (reference ``_assign_worker_ranks``:791 — rank order follows node rank)."""
+    (reference ``_assign_worker_ranks``:791). Rank order follows the
+    master's topology-stamped ``comm_rank`` when present (slice-contiguous,
+    torus order — master/net_topology.py), node-rank order otherwise."""
     world_size = sum(m.local_world_size for m in world.values())
-    base_rank = sum(
-        world[r].local_world_size for r in sorted(world) if r < node_rank
-    )
+    if all(m.comm_rank >= 0 for m in world.values()):
+        order = sorted(world, key=lambda r: world[r].comm_rank)
+    else:
+        order = sorted(world)
+    base_rank = 0
+    for r in order:
+        if r == node_rank:
+            break
+        base_rank += world[r].local_world_size
     return base_rank, world_size
 
 

@@ -143,3 +143,10 @@ class BrainClient:
                     limit: int = 100) -> List[MetricSample]:
         return self._rpc.call("brain_get_job_metrics", JobMetricsRequest(
             job_uuid=self._job_uuid, kind=kind, limit=limit))
+
+    def ever_ran(self) -> bool:
+        """True if this job uuid has recorded any live speed sample —
+        survives master restarts, unlike in-process flags (used for
+        create-vs-running phase routing, master/resource.py)."""
+        samples = self.job_metrics(kind="speed", limit=20)
+        return any(s.payload.get("nodes", 0) > 0 for s in samples)

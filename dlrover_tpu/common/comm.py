@@ -108,6 +108,15 @@ class NodeMeta:
     # TPU topology info from the metadata/env (chips per host etc.)
     num_devices: int = 0
     free_port: int = 0
+    # multi-slice topology: which pod slice this host belongs to and its
+    # position within the slice's ICI torus (master/net_topology.py uses
+    # these to order comm ranks so dp rings ride ICI, DCN only at slice
+    # boundaries — the TPU dual of the reference's asw/psw sort)
+    slice_id: str = ""
+    tpu_worker_id: int = -1
+    # topology-assigned communication rank (stamped by the rendezvous
+    # manager at world-cut; -1 = unassigned, fall back to node_rank order)
+    comm_rank: int = -1
 
 
 @message
@@ -119,6 +128,8 @@ class JoinRendezvousRequest:
     node_unit: int = 1
     host: str = ""
     free_port: int = 0
+    slice_id: str = ""
+    tpu_worker_id: int = -1
 
 
 @message

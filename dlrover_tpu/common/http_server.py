@@ -1,0 +1,170 @@
+"""HTTP alternative transport for the master RPC surface.
+
+Reference: dlrover/python/common/http_server.py:32,68 (tornado server) +
+servicer.py:881 (``HttpMasterServicer``) + master_client.py:579
+(``HttpMasterClient``) — DLRover lets jobs choose gRPC or HTTP per env
+(useful where the binary TCP port is awkward to expose: proxies, probes,
+debugging with curl). Same here: the identical method registry served over
+``POST /rpc`` with the msgpack envelope as the body, plus ``GET /healthz``
+for k8s probes, on Python's stdlib ThreadingHTTPServer (no tornado dep).
+The TCP transport (common/rpc.py) stays the default — it's
+connection-reusing and has exactly-once dedup; HTTP is one-shot
+request/response, which every master method tolerates (agents retry, and
+handlers are idempotent or cheap to replay).
+
+Client counterpart: :class:`HttpRPCClient`, drop-in for
+:class:`~dlrover_tpu.common.rpc.RPCClient`; ``make_rpc_client`` picks the
+transport from the address scheme (reference build_master_client:681 picks
+grpc/http/ray the same way).
+"""
+
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+import msgpack
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RPCError
+
+
+class HTTPTransportServer:
+    """Serves an RPC method registry over HTTP. Share a registry with an
+    RPCServer to expose both transports at once."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self.registry: Dict[str, Callable[[Any], Any]] = {}
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    body = b"ok"
+                    self.send_response(200)
+                else:
+                    body = b"not found"
+                    self.send_response(404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/rpc":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    frame = msgpack.unpackb(self.rfile.read(n), raw=False)
+                    method = frame.get("m", "")
+                    handler = outer.registry.get(method)
+                    if handler is None:
+                        resp = {"ok": False,
+                                "err": f"unknown rpc method {method!r}"}
+                    else:
+                        result = handler(comm.deserialize(frame.get("p", b"")))
+                        resp = {"ok": True, "p": comm.serialize(result)}
+                except Exception as e:  # noqa: BLE001 — report to caller
+                    logger.exception("http rpc failed")
+                    resp = {"ok": False, "err": repr(e)}
+                body = msgpack.packb(resp, use_bin_type=True)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/msgpack")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def register(self, method: str, handler: Callable[[Any], Any]) -> None:
+        self.registry[method] = handler
+
+    def register_object(self, obj: Any, prefix: str = "rpc_") -> None:
+        """Mount every ``rpc_*`` method like RPCServer.register_object."""
+        for name in dir(obj):
+            if name.startswith(prefix):
+                self.registry[name[len(prefix):]] = getattr(obj, name)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-rpc", daemon=True
+        )
+        self._thread.start()
+        logger.info("http rpc transport on :%s", self.port)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class HttpRPCClient:
+    """Drop-in for rpc.RPCClient over the HTTP transport."""
+
+    def __init__(self, addr: str, timeout_s: float = 330.0,
+                 retries: int = 30):
+        if addr.startswith("http://"):
+            addr = addr[len("http://"):]
+        self._addr = addr.rstrip("/")
+        self._timeout_s = timeout_s
+        self._retries = retries
+
+    @property
+    def addr(self) -> str:
+        return f"http://{self._addr}"
+
+    def call(self, method: str, request: Any = None) -> Any:
+        frame = msgpack.packb(
+            {"m": method, "p": comm.serialize(request)}, use_bin_type=True
+        )
+        last: Optional[Exception] = None
+        for attempt in range(self._retries):
+            try:
+                req = urllib.request.Request(
+                    f"http://{self._addr}/rpc", data=frame,
+                    headers={"Content-Type": "application/msgpack"},
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self._timeout_s
+                ) as r:
+                    resp = msgpack.unpackb(r.read(), raw=False)
+                if not resp.get("ok"):
+                    raise RPCError(resp.get("err", "unknown error"))
+                return comm.deserialize(resp.get("p", b""))
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                last = e
+                if attempt + 1 < self._retries:
+                    import time
+
+                    time.sleep(min(5.0, 0.1 * (2 ** min(attempt, 5))))
+        raise ConnectionError(
+            f"http rpc to {self._addr} failed after {self._retries} "
+            f"attempts: {last!r}"
+        )
+
+    def try_call(self, method: str, request: Any = None) -> Any:
+        try:
+            return self.call(method, request)
+        except (ConnectionError, RPCError):
+            return None
+
+
+def make_rpc_client(addr: str, **kwargs):
+    """Transport from the address scheme: ``http://host:port`` → HTTP,
+    bare ``host:port`` → the binary TCP transport."""
+    if addr.startswith("http://"):
+        return HttpRPCClient(addr, **kwargs)
+    from dlrover_tpu.common.rpc import RPCClient
+
+    return RPCClient(addr, **kwargs)

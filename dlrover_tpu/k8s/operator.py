@@ -101,6 +101,7 @@ class ElasticJobReconciler:
                 name, spec.get("masterImage", worker.image),
                 namespace=self._namespace,
                 node_num=worker.replicas, port=self._master_port,
+                job_uid=job.get("metadata", {}).get("uid", ""),
             ))
             logger.info("reconcile %s: created master pod", name)
         if self._api.get_service(

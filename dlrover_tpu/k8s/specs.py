@@ -104,6 +104,7 @@ def master_pod(
     node_num: int = 1,
     port: int = 50001,
     command: Optional[List[str]] = None,
+    job_uid: str = "",
 ) -> Dict:
     """(reference go/elasticjob/pkg/controllers/master.go:53
     ``ReconcileJobMasterPod``)"""
@@ -132,7 +133,12 @@ def master_pod(
                     "--port", str(port),
                 ],
                 "ports": [{"containerPort": port}],
-                "env": [{"name": EnvKey.JOB_NAME, "value": job_name}],
+                # job_uid (the ElasticJob CR uid) gives a RESTARTED master
+                # of the same job instance a stable Brain identity
+                "env": [{"name": EnvKey.JOB_NAME, "value": job_name}] + (
+                    [{"name": "DLROVER_TPU_JOB_UID", "value": job_uid}]
+                    if job_uid else []
+                ),
             }],
         },
     }

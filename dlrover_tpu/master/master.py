@@ -8,6 +8,7 @@ node management against a cluster scheduler.
 """
 
 import argparse
+import os
 import threading
 import time
 from typing import Dict, Optional
@@ -89,6 +90,21 @@ class JobMaster:
         )
         self._server = RPCServer(port=port)
         self._server.register_object(self.servicer)
+        # optional HTTP transport mirroring the same servicer (reference
+        # HttpMasterServicer, servicer.py:881): DLROVER_TPU_HTTP_PORT=0
+        # picks a free port, unset disables
+        self._http_server = None
+        http_port = os.getenv("DLROVER_TPU_HTTP_PORT")
+        if http_port:  # unset OR empty (un-templated manifest) disables
+            from dlrover_tpu.common.http_server import HTTPTransportServer
+
+            try:
+                self._http_server = HTTPTransportServer(port=int(http_port))
+                self._http_server.register_object(self.servicer)
+            except ValueError:
+                logger.warning(
+                    "DLROVER_TPU_HTTP_PORT=%r is not a port; http "
+                    "transport disabled", http_port)
         # a dead node's in-flight data shards go straight back on the queue
         # (reference TaskRescheduleCallback, node/event_callback.py)
         from dlrover_tpu.common.constants import NodeStatus as _NS
@@ -114,6 +130,8 @@ class JobMaster:
             MasterEvent.JOB_START, job=self.job_name
         )
         self._server.start()
+        if self._http_server is not None:
+            self._http_server.start()
         self.job_manager.start()
         self.task_manager.start()
         self.metric_collector.start()
@@ -123,12 +141,17 @@ class JobMaster:
             "master for job %s serving on port %s", self.job_name, self.port
         )
 
-    def stop(self) -> None:
+    def stop(self, job_status: str = "completed") -> None:
+        # job_status is consumed by subclasses reporting run outcomes
+        # (DistributedJobMaster → Brain); the base teardown ignores it
+        del job_status
         self.job_manager.stop()
         self.task_manager.stop()
         self.metric_collector.stop()
         if self.diagnosis_master is not None:
             self.diagnosis_master.stop()
+        if self._http_server is not None:
+            self._http_server.stop()
         self._server.stop()
 
     def run(self, poll_s: float = 1.0) -> int:
@@ -146,11 +169,17 @@ class JobMaster:
                     return 1
                 time.sleep(poll_s)
         finally:
+            final_stage = self.job_manager.job_stage
             get_emitter("master").instant(
                 MasterEvent.JOB_FINISH,
-                job=self.job_name, stage=self.job_manager.job_stage,
+                job=self.job_name, stage=final_stage,
             )
-            self.stop()
+            # outcome flows to subclasses (Brain completion report must not
+            # record crashed runs as 'completed' cold-start history)
+            self.stop(
+                "completed" if final_stage == JobStage.SUCCEEDED
+                else "failed"
+            )
 
 
 class LocalJobMaster(JobMaster):
@@ -224,13 +253,19 @@ class DistributedJobMaster(JobMaster):
             from dlrover_tpu.brain.service import BrainClient
             from dlrover_tpu.master.resource import BrainOptimizer
 
-            # uuid unique per run: re-runs under the same job name must not
-            # inherit a previous run's speed buckets (RunningScale would
-            # shrink the fresh job from stale history); the *name* is what
-            # links runs for ColdCreate's cross-job sizing
+            # uuid unique per job *instance*: re-runs under the same job
+            # name must not inherit a previous run's speed buckets
+            # (RunningScale would shrink the fresh job from stale history),
+            # but a *restarted master of the same job* must keep the uuid so
+            # phase routing sees the job already ran. The operator provides
+            # the stable instance id (k8s CR uid) via DLROVER_TPU_JOB_UID;
+            # without one, fall back to a random per-process suffix.
+            instance = os.getenv(
+                "DLROVER_TPU_JOB_UID", _uuid.uuid4().hex[:8]
+            )
             brain_client = BrainClient(
                 brain_addr,
-                job_uuid=f"{job_name}-{_uuid.uuid4().hex[:8]}",
+                job_uuid=f"{job_name}-{instance}",
                 job_name=job_name,
             )
             self._brain_client = brain_client

@@ -162,6 +162,15 @@ class RendezvousManager(ABC):
             return False
         ranks = sorted(self._waiting_nodes.keys())[:world_size]
         self._rdzv_nodes = {r: self._waiting_nodes[r] for r in ranks}
+        # topology-aware comm order: slice-contiguous, torus order within
+        # a slice (net_topology.py; the reference's asw/psw DpTopologySorter
+        # dual) — agents assign worker ranks by comm_rank
+        from dlrover_tpu.master.net_topology import (
+            TpuSliceTopologySorter,
+            stamp_comm_ranks,
+        )
+
+        stamp_comm_ranks(self._rdzv_nodes, TpuSliceTopologySorter())
         self._latest_rdzv_nodes = ranks
         for r in ranks:
             del self._waiting_nodes[r]
@@ -182,10 +191,17 @@ class RendezvousManager(ABC):
         """Return (round, group, world). Empty world ⇒ not ready, poll again."""
 
     def coordinator_addr(self) -> str:
-        """jax.distributed coordinator = lowest-rank node of the cut world."""
+        """jax.distributed coordinator = comm-rank-0 node of the cut
+        world (topology order when stamped, node-rank order otherwise)."""
         if not self._rdzv_nodes:
             return ""
-        rank0 = min(self._rdzv_nodes)
+        rank0 = min(
+            self._rdzv_nodes,
+            key=lambda r: (
+                self._rdzv_nodes[r].comm_rank
+                if self._rdzv_nodes[r].comm_rank >= 0 else r
+            ),
+        )
         meta = self._rdzv_nodes[rank0]
         host = meta.host or "127.0.0.1"
         return f"{host}:{meta.free_port}"

@@ -146,9 +146,17 @@ class BrainOptimizer(ResourceOptimizer):
         # phase routing (reference: Brain optimizer config keys per job
         # stage): cold-create sizing only before the job has EVER run —
         # a mid-job full-fleet restart also shows running_nodes==0, and
-        # re-sizing a recovering job from history would shrink it
+        # re-sizing a recovering job from history would shrink it. The
+        # "ever ran" fact is backed by the Brain's own datastore (speed
+        # samples under this job's uuid), so it survives master restarts
+        # when the uuid is stable (DLROVER_TPU_JOB_UID).
         if stats.running_nodes > 0 or stats.running_speed > 0:
             self._ever_ran = True
+        if not self._ever_ran:
+            try:
+                self._ever_ran = self._client.ever_ran()
+            except Exception:  # noqa: BLE001 — offline brain ⇒ no history
+                pass
         phase = "running" if self._ever_ran else "create"
         try:
             return self._client.optimize(stats, phase=phase)

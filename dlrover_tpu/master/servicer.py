@@ -56,6 +56,8 @@ class MasterServicer:
             host=req.host,
             local_world_size=req.local_world_size,
             free_port=req.free_port,
+            slice_id=req.slice_id,
+            tpu_worker_id=req.tpu_worker_id,
         )
         rdzv_round = manager.join_rendezvous(meta)
         if self._perf_monitor is not None:

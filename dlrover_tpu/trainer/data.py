@@ -248,14 +248,14 @@ class ElasticDataLoader:
             with open(self._config_file, encoding="utf-8") as f:
                 cfg = json.load(f)
             new_bs = int(cfg.get("dataloader_batch_size", 0))
-            if new_bs <= 0:
+            if new_bs <= 0 and "micro_batch_scale" in cfg:
                 # relative plan (Brain OomGuard/InitAdjust before an
                 # absolute size is known): the master accumulates the
                 # factor (hyperparams.apply_scale), so apply it to the
-                # *original* batch size — idempotent across reloads.
+                # *original* batch size — idempotent across reloads, and
+                # a factor back at 1.0 restores the base size.
                 scale = float(cfg.get("micro_batch_scale", 1.0))
-                if scale != 1.0:
-                    new_bs = max(1, int(round(self._base_batch_size * scale)))
+                new_bs = max(1, int(round(self._base_batch_size * scale)))
             if new_bs > 0 and new_bs != self.batch_size:
                 logger.info(
                     "dataloader batch size %s → %s (auto-tuner)",

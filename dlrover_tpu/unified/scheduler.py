@@ -90,6 +90,9 @@ class ActorHandle:
                                          f"(call {method} timed out)")
                 status, payload = self._conn.recv()
             except (EOFError, BrokenPipeError, ConnectionResetError) as e:
+                # reap before raising so alive/dead_vertices is settled the
+                # moment the caller sees the death
+                self.proc.join(timeout=5)
                 raise ActorDiedError(self.vertex.name, f"({e!r})") from e
             if status == "err":
                 raise ActorCallError(

@@ -316,6 +316,58 @@ def test_dataloader_applies_relative_scale(tmp_path):
     assert loader.batch_size == 4                      # 16·0.25, not 8·0.25
 
 
+def test_dataloader_scale_back_to_one_restores_base(tmp_path):
+    """A cumulative factor returning to 1.0 restores the original batch
+    size (regression: != 1.0 guard left it stuck at the shrunken size)."""
+    import json
+    import time
+
+    from dlrover_tpu.trainer.data import ElasticDataLoader
+
+    path = os.path.join(tmp_path, "paral.json")
+    loader = ElasticDataLoader(list(range(64)), batch_size=16,
+                               config_file=path)
+
+    def write(scale, version):
+        json.dump({"dataloader_batch_size": 0, "micro_batch_scale": scale,
+                   "version": version}, open(path, "w"))
+        os.utime(path, (time.time() + version, time.time() + version))
+
+    write(0.5, 1)
+    loader._maybe_reload_config()
+    assert loader.batch_size == 8
+    write(1.0, 2)          # 0.5 · 2.0 accumulated back to 1.0
+    loader._maybe_reload_config()
+    assert loader.batch_size == 16
+
+
+def test_brain_phase_survives_optimizer_restart(brain):
+    """A rebuilt BrainOptimizer (master restart) for a job that already
+    ran must NOT re-enter cold-create — the ever-ran fact is read back
+    from the datastore under the stable job uuid."""
+    _, addr = brain
+    client = BrainClient(addr, job_uuid="stable-uid", job_name="sj-1")
+    # seed history for the name stem AND live samples for this uuid
+    seed = BrainClient(addr, job_uuid="old", job_name="sj-0")
+    seed.report_job_status("completed", final_nodes=4)
+    client.report_metric("speed", {"nodes": 16, "steps_per_s": 2.0})
+    fresh = BrainOptimizer(client)       # in-memory flag is False
+    plan = fresh.plan(ScalingStats(running_nodes=0, running_speed=0.0,
+                                   min_nodes=1, max_nodes=32))
+    assert plan.node_num is None         # no cold-create re-size
+
+
+def test_master_http_port_garbage_disables(monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_HTTP_PORT", "")
+    from dlrover_tpu.master.master import LocalJobMaster
+
+    m = LocalJobMaster(job_name="hp1", node_num=1)
+    assert m._http_server is None
+    monkeypatch.setenv("DLROVER_TPU_HTTP_PORT", "nope")
+    m2 = LocalJobMaster(job_name="hp2", node_num=1)
+    assert m2._http_server is None
+
+
 def test_brain_optimizer_phase_lifecycle(brain):
     """'create' only before the job ever ran: a full-fleet restart
     (running_nodes back to 0) must not re-route to cold-create sizing."""

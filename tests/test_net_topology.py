@@ -1,0 +1,77 @@
+"""Topology-aware comm-rank ordering (master/net_topology.py — the TPU
+slice/torus dual of the reference's asw/psw DpTopologySorter,
+net_topology.py:53): slice-contiguous ordering, torus order within a
+slice, rendezvous stamping, and the agent's rank assignment honoring it."""
+
+from dlrover_tpu.agent.training import assign_worker_ranks
+from dlrover_tpu.common import comm
+from dlrover_tpu.master.net_topology import (
+    NodeRankSorter,
+    TpuSliceTopologySorter,
+    local_topology_attrs,
+    stamp_comm_ranks,
+)
+from dlrover_tpu.master.rdzv_manager import ElasticTrainingRendezvousManager
+
+
+def _meta(rank, slice_id="", worker=-1, lws=1):
+    return comm.NodeMeta(
+        node_id=rank, node_rank=rank, host=f"10.0.0.{rank}",
+        local_world_size=lws, free_port=1000 + rank,
+        slice_id=slice_id, tpu_worker_id=worker,
+    )
+
+
+def test_sorter_keeps_slices_contiguous_and_torus_ordered():
+    # join order interleaves slices; worker ids are scrambled within slices
+    world = {
+        0: _meta(0, "slice-a", worker=1),
+        1: _meta(1, "slice-b", worker=0),
+        2: _meta(2, "slice-a", worker=0),
+        3: _meta(3, "slice-b", worker=1),
+    }
+    order = TpuSliceTopologySorter().sort(world)
+    # slice-a first (contains the lowest node rank), torus order inside
+    assert order == [2, 0, 1, 3]
+
+
+def test_sorter_without_topology_degenerates_to_node_rank():
+    world = {2: _meta(2), 0: _meta(0), 1: _meta(1)}
+    assert TpuSliceTopologySorter().sort(world) == [0, 1, 2]
+    assert NodeRankSorter().sort(world) == [0, 1, 2]
+
+
+def test_stamp_and_agent_rank_assignment():
+    world = {
+        0: _meta(0, "s0", worker=1, lws=4),
+        1: _meta(1, "s0", worker=0, lws=4),
+        2: _meta(2, "s1", worker=0, lws=4),
+    }
+    stamp_comm_ranks(world, TpuSliceTopologySorter())
+    assert [world[r].comm_rank for r in (1, 0, 2)] == [0, 1, 2]
+    # agent: node 1 leads (worker 0 of slice 0), node 0 follows
+    assert assign_worker_ranks(world, 1) == (0, 12)
+    assert assign_worker_ranks(world, 0) == (4, 12)
+    assert assign_worker_ranks(world, 2) == (8, 12)
+
+
+def test_rendezvous_stamps_comm_ranks_and_coordinator():
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=2, max_nodes=2)
+    mgr.join_rendezvous(_meta(0, "s0", worker=1))
+    mgr.join_rendezvous(_meta(1, "s0", worker=0))
+    _, _, world = mgr.get_comm_world(0)
+    assert world and world[1].comm_rank == 0 and world[0].comm_rank == 1
+    # coordinator is the comm-rank-0 host, not the lowest node rank
+    assert mgr.coordinator_addr() == "10.0.0.1:1001"
+
+
+def test_local_topology_attrs_from_env(monkeypatch):
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    monkeypatch.delenv("MEGASCALE_SLICE_ID", raising=False)
+    assert local_topology_attrs() == ("", -1)
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "3")
+    monkeypatch.setenv("TPU_WORKER_ID", "7")
+    assert local_topology_attrs() == ("3", 7)
+    monkeypatch.setenv("TPU_WORKER_ID", "junk")
+    assert local_topology_attrs() == ("3", -1)

@@ -53,6 +53,10 @@ class Counter(BaseWorkload):
     def boom(self):
         raise ValueError("intentional")
 
+    def nap(self, seconds):
+        time.sleep(seconds)
+        return "rested"
+
     def run(self):
         return f"ran-{self.name}"
 
@@ -297,7 +301,7 @@ def test_call_timeout_kills_actor(sched):
     rg = sched.role_group("reward")
     h = rg.handles[0]
     with pytest.raises(ActorDiedError, match="timed out"):
-        h.call("run", timeout=0.0)  # any call with an instant timeout
+        h.call("nap", 30, timeout=0.2)
     h.proc.join(timeout=5)
     assert not h.alive
     # failover brings a fresh actor that answers correctly
