@@ -351,6 +351,14 @@ class AsyncCheckpointSaver:
                 if lock is not None:
                     lock.release()
             meta = shm.read_meta()
+            if meta is None and not workers_dead:
+                # the worker's async drain may still be landing the frame
+                # (engine.py save_to_memory holds the frame lock until the
+                # shm write completes) — wait for it, then re-read
+                lock = self._frame_lock(shm)
+                if lock is not None and lock.acquire(timeout=10.0):
+                    lock.release()
+                    meta = shm.read_meta()
             if meta is None:
                 continue
             step = meta["step"]

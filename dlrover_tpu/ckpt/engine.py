@@ -24,6 +24,7 @@ disagree) — the reference does the same with a gloo allgather
 
 import os
 import queue
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -129,6 +130,13 @@ class CheckpointEngine:
             replica_manager = self._replica_manager_from_env()
         self._replicas = replica_manager
         self._latest_step = -1
+        self._drain_thread: Optional[threading.Thread] = None
+        self._drain_ok = False
+        # donation safety (see _plan_state): snapshot shards on-device
+        # before the async drain unless explicitly disabled
+        self._device_snapshot = os.getenv(
+            "DLROVER_TPU_CKPT_DEVICE_SNAPSHOT", "1"
+        ) != "0"
 
     def _replica_manager_from_env(self):
         """Workers under an agent with ``--ckpt-replica`` build their push
@@ -146,10 +154,27 @@ class CheckpointEngine:
 
     # -- save --------------------------------------------------------------
 
-    def save_to_memory(self, step: int, state) -> bool:
-        """Snapshot ``state`` into shm. Returns False if skipped (agent busy
-        persisting the previous snapshot — reference engine.py:340 skips
-        rather than blocks)."""
+    def save_to_memory(self, step: int, state, blocking: bool = False,
+                       _on_drained=None) -> bool:
+        """Snapshot ``state`` into shm. Returns False if skipped (previous
+        snapshot still draining, or agent busy persisting — reference
+        engine.py:340 skips rather than blocks).
+
+        TPU-first async split: the *training pause* is only the planning
+        pass + ``copy_to_host_async`` dispatch (device DMA engines run the
+        D2H alongside the next step's compute); a background thread drains
+        the transfers into the shm frame and publishes the snapshot. jax
+        arrays are immutable, so the captured ``state`` stays valid while
+        training races ahead — the cost is those buffers staying alive in
+        HBM until the drain finishes. ``blocking=True`` restores the
+        synchronous reference behavior (used by breakpoint saves where the
+        process is about to exit)."""
+        if self._drain_thread is not None and self._drain_thread.is_alive():
+            logger.info(
+                "step %s: skip memory save, previous snapshot draining",
+                step,
+            )
+            return False
         if self._save_lock is not None:
             if not self._save_lock.acquire(blocking=False):
                 logger.info(
@@ -158,56 +183,131 @@ class CheckpointEngine:
                 )
                 return False
         try:
-            self._write_state_to_shm(step, state)
-            self._latest_step = step
-            if self._replicas is not None:
-                # overlaps with training; reference replica.py:116 blocks on
-                # a gloo allgather here instead
-                self._replicas.backup_async(self._shm, self.local_rank)
+            meta, pending = self._plan_state(step, state)
             if self._meta_dict is not None:
+                # register the frame identity BEFORE the async drain: the
+                # agent discovers shm segments through this dict, and a
+                # breakpoint save must be able to find the frame and wait
+                # on its lock even if we die mid-drain (it reads the step
+                # from the shm meta itself, so identity is all it needs)
                 self._meta_dict.set(
                     f"{self.node_rank}:{self.local_rank}",
                     {
                         "shm": self._shm.name,
-                        "step": step,
                         "ts": time.time(),
                         "persisted": False,
                     },
                 )
-            if self._master is not None:
-                try:
-                    self._master.kv_set(
-                        f"ckpt/{self.job_name}/shm_step/{self.rank}",
-                        str(step).encode(),
-                    )
-                except ConnectionError:
-                    pass
-            return True
-        finally:
+        except Exception:
             if self._save_lock is not None:
                 self._save_lock.release()
+            raise
 
-    def save_to_storage(self, step: int, state, path: str = "") -> bool:
-        """Memory save + ask the agent to persist asynchronously."""
-        saved = self.save_to_memory(step, state)
-        if not saved:
-            return False
-        path = path or self.ckpt_dir
-        if self._event_queue is not None:
-            self._event_queue.put(CheckpointEvent.save(step, path))
+        def _drain():
+            try:
+                buffers = [np.asarray(data) for _, data in pending]
+                self._shm.write_frame(meta, buffers)
+                self._latest_step = step
+                self._drain_ok = True
+                if self._replicas is not None:
+                    # overlaps with training; reference replica.py:116
+                    # blocks on a gloo allgather here instead
+                    self._replicas.backup_async(self._shm, self.local_rank)
+                if self._meta_dict is not None:
+                    self._meta_dict.set(
+                        f"{self.node_rank}:{self.local_rank}",
+                        {
+                            "shm": self._shm.name,
+                            "step": step,
+                            "ts": time.time(),
+                            "persisted": False,
+                        },
+                    )
+                if self._master is not None:
+                    try:
+                        self._master.kv_set(
+                            f"ckpt/{self.job_name}/shm_step/{self.rank}",
+                            str(step).encode(),
+                        )
+                    except ConnectionError:
+                        pass
+                if _on_drained is not None:
+                    _on_drained()
+            except Exception:  # noqa: BLE001 — a lost snapshot must be LOUD
+                self._drain_ok = False
+                logger.error(
+                    "checkpoint drain for step %s failed — snapshot lost, "
+                    "previous frame (step %s) still intact",
+                    step, self._latest_step, exc_info=True,
+                )
+                if blocking:
+                    raise
+            finally:
+                if self._save_lock is not None:
+                    self._save_lock.release()
+
+        self._drain_ok = False  # set True by a successful drain
+        if blocking:
+            _drain()
         else:
-            # no agent (bare worker): persist synchronously
-            from dlrover_tpu.ckpt.ckpt_saver import persist_shm_frame
-
-            persist_shm_frame(self._shm, path, step)
+            self._drain_thread = threading.Thread(
+                target=_drain, name="ckpt-drain", daemon=True
+            )
+            self._drain_thread.start()
         return True
 
-    def _write_state_to_shm(self, step: int, state) -> None:
+    def wait_drained(self, timeout_s: Optional[float] = None) -> bool:
+        """Block until the in-flight snapshot (if any) lands; returns False
+        on timeout OR if the drain failed (the snapshot was lost)."""
+        t = self._drain_thread
+        if t is not None:
+            t.join(timeout_s)
+            if t.is_alive():
+                return False
+        return self._drain_ok or self._drain_thread is None
+
+    def save_to_storage(self, step: int, state, path: str = "") -> bool:
+        """Memory save + ask the agent to persist asynchronously (the
+        persist request rides the drain thread so the agent never reads a
+        half-written frame)."""
+        path = path or self.ckpt_dir
+
+        def _request_persist():
+            if self._event_queue is not None:
+                self._event_queue.put(CheckpointEvent.save(step, path))
+            else:
+                # no agent (bare worker): persist in the drain thread
+                from dlrover_tpu.ckpt.ckpt_saver import persist_shm_frame
+
+                persist_shm_frame(self._shm, path, step)
+
+        # bare workers (no agent) persist in-process: stay synchronous so
+        # "save returned" keeps meaning "bytes durable", as before; with an
+        # agent the persist is its job and only the drain rides our thread
+        return self.save_to_memory(
+            step, state, blocking=not self._has_agent,
+            _on_drained=_request_persist,
+        )
+
+    def _plan_state(self, step: int, state) -> Tuple[Dict, List]:
+        """Planning pass: build frame metadata and dispatch async work for
+        every owned shard. Returns (meta, pending) — no blocking work.
+
+        Donation safety: the standard train step donates its state
+        (trainer/elastic.py jit donate_argnums), which DELETES the old
+        device buffers when the next step dispatches — while our drain
+        thread may still be reading them. So by default each shard is
+        snapshotted on-device first (``jnp.copy``, an async HBM→HBM DMA
+        enqueued before the next step's execution, so it reads the
+        pre-donation bytes) and the drain reads the private copy. Costs one
+        transient state copy in HBM until the drain frees it; disable via
+        DLROVER_TPU_CKPT_DEVICE_SNAPSHOT=0 when the training loop is known
+        not to donate."""
         import jax
+        import jax.numpy as jnp
 
         named, _ = _tree_flatten_with_names(state)
         leaves_meta: List[Dict] = []
-        buffers: List[np.ndarray] = []
         offset = 0
         pending: List[Tuple[Dict, Any]] = []
         for path, leaf in named:
@@ -224,28 +324,33 @@ class CheckpointEngine:
                         "shards": [],
                     })
                     continue
+                datas = []
                 for s in shards:
-                    # start async D2H for overlap; drained below
+                    data = s.data
+                    if self._device_snapshot:
+                        data = jnp.copy(data)
+                    # start async D2H for overlap; drained later
                     try:
-                        s.data.copy_to_host_async()
+                        data.copy_to_host_async()
                     except Exception:  # noqa: BLE001 — CPU backend no-op
                         pass
+                    datas.append(data)
                 shard_metas = []
-                for s in shards:
+                for s, data in zip(shards, datas):
                     start = [
                         (sl.start or 0) for sl in s.index
                     ] if s.index else [0] * leaf.ndim
                     pending.append((
                         {
                             "offset": offset,
-                            "nbytes": int(s.data.nbytes),
-                            "lshape": list(s.data.shape),
+                            "nbytes": int(data.nbytes),
+                            "lshape": list(data.shape),
                             "start": start,
                         },
-                        s.data,
+                        data,
                     ))
                     shard_metas.append(pending[-1][0])
-                    offset += int(s.data.nbytes)
+                    offset += int(data.nbytes)
                 leaves_meta.append({
                     "path": path, "kind": "array",
                     "dtype": str(leaf.dtype),
@@ -275,8 +380,6 @@ class CheckpointEngine:
                 leaves_meta.append({
                     "path": path, "kind": "value", "value": leaf,
                 })
-        for _, data in pending:
-            buffers.append(np.asarray(data))
         meta = {
             "step": step,
             "ts": time.time(),
@@ -287,7 +390,7 @@ class CheckpointEngine:
             "world_size": self.world_size,
             "leaves": leaves_meta,
         }
-        self._shm.write_frame(meta, buffers)
+        return meta, pending
 
     # -- load --------------------------------------------------------------
 
@@ -339,6 +442,8 @@ class CheckpointEngine:
 
         Returns (state, step); step == -1 when nothing was restored.
         """
+        # an in-flight async snapshot must land before we read the frame
+        self.wait_drained()
         if self._replicas is not None:
             # a relaunched node's shm is empty — pull own frame from a
             # backup-group peer first (replica.py restore semantics)

@@ -68,6 +68,30 @@ def test_engine_roundtrip_no_agent(tmp_path, mesh):
     assert restored["params"]["w"].sharding == state["params"]["w"].sharding
 
 
+def test_async_save_survives_donation(tmp_path, mesh):
+    """The standard train step donates its state (jit donate_argnums),
+    deleting the old device buffers right after a save dispatch — the
+    on-device snapshot (engine.py _plan_state) must keep the async drain
+    valid, and a drain failure must be visible via wait_drained."""
+    engine = CheckpointEngine(
+        str(tmp_path), job_name=JOB, node_rank=0, local_rank=0,
+        ipc_socket="/nonexistent", world_size=1, rank=0,
+    )
+    state = make_state(mesh)
+    expected = np.asarray(state["params"]["w"]).copy()
+    assert engine.save_to_memory(5, state)
+    # donation: delete every device buffer immediately after dispatch
+    for leaf in jax.tree.leaves(state):
+        if hasattr(leaf, "delete"):
+            leaf.delete()
+    assert engine.wait_drained(60), "drain lost the snapshot"
+    restored, step = engine.load(make_state(mesh))
+    assert step == 5
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), expected
+    )
+
+
 def test_replicated_array_saved_once(tmp_path, mesh):
     engine = CheckpointEngine(
         str(tmp_path), job_name=JOB, node_rank=0, local_rank=0,
@@ -75,6 +99,7 @@ def test_replicated_array_saved_once(tmp_path, mesh):
     )
     state = make_state(mesh)
     engine.save_to_memory(1, state)
+    assert engine.wait_drained(60)   # async contract: frame lands in shm
     shm = SharedMemoryHandler(shm_name(JOB, 0, 0))
     meta = shm.read_meta()
     b_leaf = next(l for l in meta["leaves"] if "'b'" in l["path"])

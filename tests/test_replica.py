@@ -178,6 +178,7 @@ def test_engine_restore_via_replica(master, tmp_path):
             replica_manager=m0,
         )
         assert engine.save_to_memory(11, state)
+        assert engine.wait_drained(60)   # backup starts from the drain
         m0.wait_backup()
 
         # pod relaunch: local shm gone, new engine + manager (no local svc
