@@ -612,6 +612,13 @@ def _assemble_jax_array(gshape, dtype, sharding, leaf_meta, reader):
         return read_region
 
     read_region = global_chunks()
+    # A target leaf that was never mesh-sharded (optax counts, scalars…)
+    # carries a SingleDeviceSharding. Committing the restored value to that
+    # process-local device would give each process a DIFFERENT placement
+    # and jit rejects the mix ("incompatible devices"); returning it
+    # uncommitted lets jit replicate it consistently, matching the
+    # pre-restore behavior of optimizer.init outputs.
+    single_device = isinstance(sharding, jax.sharding.SingleDeviceSharding)
     if not gshape:
         # scalar array
         saved = leaf_meta["shards"]
@@ -620,7 +627,18 @@ def _assemble_jax_array(gshape, dtype, sharding, leaf_meta, reader):
             value = np.frombuffer(data, dtype=dtype).reshape(())
         else:
             value = np.zeros((), dtype=dtype)
+        if single_device:
+            import jax.numpy as jnp
+
+            return jnp.asarray(value)
         return jax.device_put(value, sharding)
+
+    if single_device:
+        import jax.numpy as jnp
+
+        return jnp.asarray(read_region(
+            tuple(slice(0, g) for g in gshape)
+        ))
 
     device_arrays = []
     for d_idx in sharding.addressable_devices_indices_map(gshape).items():

@@ -116,3 +116,25 @@ def with_batch_constraint(x):
     return jax.lax.with_sharding_constraint(
         x, P(("dp", "fsdp"), "sp")
     )
+
+
+def global_batch_from_local(mesh, local_batch, spec: Optional[P] = None):
+    """Assemble the global input batch from this process's host-local
+    shard (the multi-host data path: each host's loader yields
+    ``global_batch / num_processes`` rows; the result is one global
+    ``jax.Array`` sharded over the data axes, ready for a pjit step).
+
+    The torchrun analogue is DistributedSampler + an implicitly-local
+    tensor; jax needs the explicit local→global assembly
+    (``jax.make_array_from_process_local_data``). Single-process: plain
+    device_put with the same sharding.
+    """
+    import jax
+    import numpy as np
+
+    spec = spec if spec is not None else P(("dp", "fsdp"))
+    sharding = NamedSharding(mesh, spec)
+    local = np.asarray(local_batch)
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(sharding, local)

@@ -128,6 +128,12 @@ class ActorWorkload(BaseWorkload):
 
         return jax.tree.map(np.asarray, self.params)
 
+    def load_weights(self, tree):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, tree)
+
     def steps(self):
         return self.updates_done
 
@@ -138,25 +144,42 @@ class PPOTrainer(BaseTrainer):
     def init(self):
         self.target_iters = int(self.config.get("iters", 2))
 
+    @staticmethod
+    def _average(trees):
+        import numpy as np
+
+        import jax
+
+        return jax.tree.map(
+            lambda *leaves: np.mean(np.stack(leaves), axis=0), *trees
+        )
+
     def fit(self):
         actor, rollout, reward = (
             self.group("actor"), self.group("rollout"), self.group("reward"))
         # re-entrancy: resume from the actors' own progress counter
         start = min(actor.call("steps"))
         for it in range(start, self.target_iters):
+            # sync at the TOP of the loop: after a failover a respawned
+            # rollout (fresh init) must sample from the live policy, not
+            # its own re-initialized weights
+            weights = self._average(actor.call("export_weights"))
+            actor.call("load_weights", weights)
+            rollout.call("load_weights", weights)
             batches = rollout.call("generate", 2)
             scores = reward.call_rank(0, "score", batches)
             flat_samples = [row for b in batches for row in b]
             flat_rewards = [r for s in scores for r in s]
             n = len(actor)
             per = max(1, len(flat_samples) // n)
+            # data-parallel actors by parameter averaging: each learner
+            # updates on its sample shard; the averaged weights re-broadcast
+            # next iteration keep the replicas consistent
             mean_r = actor.call_per_rank("update", [
                 (flat_samples[i * per:(i + 1) * per],
                  flat_rewards[i * per:(i + 1) * per])
                 for i in range(n)
             ])
-            weights = actor.call_rank(0, "export_weights")
-            rollout.call("load_weights", weights)
             print(f"iter {it}: mean reward {sum(mean_r) / len(mean_r):.3f}",
                   flush=True)
 

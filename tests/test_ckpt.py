@@ -68,6 +68,33 @@ def test_engine_roundtrip_no_agent(tmp_path, mesh):
     assert restored["params"]["w"].sharding == state["params"]["w"].sharding
 
 
+def test_unsharded_leaves_restore_uncommitted(tmp_path, mesh):
+    """Leaves the target never mesh-sharded (optax counts, step scalars)
+    must come back UNCOMMITTED: committing them to a process-local device
+    makes multi-process jit reject the state ('incompatible devices') on
+    the first post-restore step."""
+    engine = CheckpointEngine(
+        str(tmp_path), job_name=JOB, node_rank=0, local_rank=0,
+        ipc_socket="/nonexistent", world_size=1, rank=0,
+    )
+    state = make_state(mesh)
+    # the optax-style leaves: scalar count + small unsharded vector, both
+    # plain jnp arrays with SingleDeviceSharding
+    state["count"] = jnp.zeros((), jnp.int32) + 7
+    state["mu"] = jnp.arange(4, dtype=jnp.float32)
+    assert engine.save_to_memory(2, state)
+    target = make_state(mesh)
+    target["count"] = jnp.zeros((), jnp.int32)
+    target["mu"] = jnp.zeros(4, jnp.float32)
+    restored, step = engine.load(target)
+    assert step == 2
+    assert restored["count"]._committed is False
+    assert restored["mu"]._committed is False
+    assert int(restored["count"]) == 7
+    np.testing.assert_array_equal(np.asarray(restored["mu"]),
+                                  np.arange(4, dtype=np.float32))
+
+
 def test_async_save_survives_donation(tmp_path, mesh):
     """The standard train step donates its state (jit donate_argnums),
     deleting the old device buffers right after a save dispatch — the
