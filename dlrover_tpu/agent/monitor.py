@@ -41,6 +41,27 @@ def collect_device_stats() -> Dict[int, Dict[str, float]]:
     return {}
 
 
+def device_stats_from_ipc(ipc_server) -> Dict[int, Dict[str, float]]:
+    """Merge the ``hbm/<local_rank>`` entries workers publish through the
+    SharedDict (worker.publish_step) into the per-device stats dict the
+    ResourceMonitor reports — the agent-safe way to get HBM telemetry
+    without touching jax itself."""
+    stats: Dict[int, Dict[str, float]] = {}
+    try:
+        metrics = dict(ipc_server.local_dict(TRAINING_METRICS_DICT))
+    except Exception:  # noqa: BLE001 — IPC down = no telemetry
+        return stats
+    for key, value in metrics.items():
+        if not isinstance(key, str) or not key.startswith("hbm/"):
+            continue
+        for device_id, mem in dict(value).items():
+            stats[int(device_id)] = {
+                "hbm_used_mb": float(mem.get("hbm_used_mb", 0.0)),
+                "hbm_total_mb": float(mem.get("hbm_total_mb", 0.0)),
+            }
+    return stats
+
+
 class ResourceMonitor:
     """Report host+device usage to the master periodically
     (reference resource.py:86)."""

@@ -404,11 +404,19 @@ class ElasticTrainingAgent:
         self._hb_thread.start()
         # periodic host-usage reports + worker-published step forwarding
         # (reference monitor/resource.py:86, monitor/training.py:40)
-        from dlrover_tpu.agent.monitor import ResourceMonitor, TrainingMonitor
+        from dlrover_tpu.agent.monitor import (
+            ResourceMonitor,
+            TrainingMonitor,
+            device_stats_from_ipc,
+        )
         from dlrover_tpu.common.config import get_context
 
         resource_monitor = ResourceMonitor(
-            self._client, interval_s=get_context().resource_report_interval_s
+            self._client, interval_s=get_context().resource_report_interval_s,
+            # HBM telemetry the workers publish over the IPC dict — the
+            # master's micro-batch tuner and stall diagnosis feed on it
+            extra_device_stats=lambda: device_stats_from_ipc(
+                self._ipc_server),
         )
         self._training_monitor = TrainingMonitor(
             self._ipc_server, self._client,

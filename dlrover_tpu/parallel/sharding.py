@@ -137,4 +137,10 @@ def global_batch_from_local(mesh, local_batch, spec: Optional[P] = None):
     local = np.asarray(local_batch)
     if jax.process_count() == 1:
         return jax.device_put(local, sharding)
-    return jax.make_array_from_process_local_data(sharding, local)
+    # explicit global shape: every host contributes local rows along dim 0
+    # (never rely on inference — a misconfigured world would silently
+    # assemble a wrong-sized batch)
+    global_shape = (local.shape[0] * jax.process_count(),) + local.shape[1:]
+    return jax.make_array_from_process_local_data(
+        sharding, local, global_shape
+    )

@@ -64,7 +64,12 @@ class WorkerContext:
         agent's TrainingMonitor forwards it to the master — reference
         monitor/training.py:40 reads a metrics file instead). Cheaper than
         :meth:`report_step` (unix socket, no cross-host RPC) and also feeds
-        the agent's own hang bookkeeping."""
+        the agent's own hang bookkeeping.
+
+        Every ~15 s the publish also carries this worker's device HBM
+        stats (the agent process must not touch jax — the worker owns the
+        chips); the agent's ResourceMonitor forwards them to the master,
+        where they drive micro-batch auto-tuning and stall diagnosis."""
         if not self.ipc_socket:
             return
         from dlrover_tpu.agent.monitor import TRAINING_METRICS_DICT
@@ -74,10 +79,38 @@ class WorkerContext:
             self._metrics_dict = SharedDict(
                 TRAINING_METRICS_DICT, self.ipc_socket
             )
+            self._last_hbm_publish = 0.0
+        payload = {"step": step, "ts": time.time()}
+        now = time.time()
+        if now - self._last_hbm_publish > 15.0:
+            self._last_hbm_publish = now
+            hbm = self._collect_hbm()
+            if hbm:
+                payload[f"hbm/{self.local_rank}"] = hbm
         try:
-            self._metrics_dict.update({"step": step, "ts": time.time()})
+            self._metrics_dict.update(payload)
         except OSError:
             pass
+
+    @staticmethod
+    def _collect_hbm() -> dict:
+        """Per-local-device {id: {hbm_used_mb, hbm_total_mb}} from PJRT
+        memory stats; {} when the backend doesn't expose them (CPU)."""
+        try:
+            import jax
+
+            out = {}
+            for d in jax.local_devices():
+                stats = d.memory_stats()
+                if not stats:
+                    continue
+                out[d.id] = {
+                    "hbm_used_mb": stats.get("bytes_in_use", 0) / (1 << 20),
+                    "hbm_total_mb": stats.get("bytes_limit", 0) / (1 << 20),
+                }
+            return out
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            return {}
 
 
 def init(initialize_jax_distributed: bool = True) -> WorkerContext:

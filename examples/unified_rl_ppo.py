@@ -128,11 +128,15 @@ class ActorWorkload(BaseWorkload):
 
         return jax.tree.map(np.asarray, self.params)
 
-    def load_weights(self, tree):
+    def load_weights(self, tree, steps=None):
         import jax
         import jax.numpy as jnp
 
         self.params = jax.tree.map(jnp.asarray, tree)
+        if steps is not None:
+            # failover re-sync: a respawned learner adopts the surviving
+            # policy's progress along with its weights
+            self.updates_done = steps
 
     def steps(self):
         return self.updates_done
@@ -157,14 +161,24 @@ class PPOTrainer(BaseTrainer):
     def fit(self):
         actor, rollout, reward = (
             self.group("actor"), self.group("rollout"), self.group("reward"))
-        # re-entrancy: resume from the actors' own progress counter
-        start = min(actor.call("steps"))
+        # re-entrancy: resume from the surviving actors' progress (a
+        # respawned actor reads 0; its weights AND counter re-sync below)
+        start = max(actor.call("steps"))
         for it in range(start, self.target_iters):
             # sync at the TOP of the loop: after a failover a respawned
             # rollout (fresh init) must sample from the live policy, not
-            # its own re-initialized weights
-            weights = self._average(actor.call("export_weights"))
-            actor.call("load_weights", weights)
+            # its own re-initialized weights. If the ACTORS disagree on
+            # progress (one was respawned with fresh random init), take the
+            # most-trained survivor's weights instead of averaging random
+            # init into the policy; average only between equals (normal
+            # parameter-averaging DP).
+            steps = actor.call("steps")
+            if min(steps) != max(steps):
+                weights = actor.call_rank(
+                    steps.index(max(steps)), "export_weights")
+            else:
+                weights = self._average(actor.call("export_weights"))
+            actor.call("load_weights", weights, max(steps))
             rollout.call("load_weights", weights)
             batches = rollout.call("generate", 2)
             scores = reward.call_rank(0, "score", batches)

@@ -165,6 +165,54 @@ def test_worker_training_span_emits_goodput_events(tmp_path, monkeypatch):
         reset_emitter()
 
 
+def test_hbm_telemetry_worker_to_strategy_generator(tmp_path):
+    """The full HBM feed: worker publishes device memory over IPC →
+    agent merges it into the resource report → master metric context →
+    strategy generator's worst_hbm_frac (micro-batch auto-tuning input)."""
+    from dlrover_tpu.agent.monitor import (
+        ResourceMonitor,
+        device_stats_from_ipc,
+    )
+    from dlrover_tpu.common.metric import JobMetricContext
+    from dlrover_tpu.common.multi_process import LocalIPCServer, SharedDict
+    from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
+
+    sock = str(tmp_path / "ipc.sock")
+    server = LocalIPCServer(sock)
+    server.start()
+    try:
+        # worker side: publish_step's hbm payload (shape per worker.py)
+        d = SharedDict(TRAINING_METRICS_DICT, sock)
+        d.update({"step": 5, "hbm/0": {
+            0: {"hbm_used_mb": 12288.0, "hbm_total_mb": 16384.0},
+        }})
+        stats = device_stats_from_ipc(server)
+        assert stats[0]["hbm_used_mb"] == 12288.0
+
+        # agent side: report carries the device memory dicts
+        client = FakeClient()
+        mon = ResourceMonitor(
+            client, extra_device_stats=lambda: device_stats_from_ipc(server)
+        )
+        mon.report_once()
+        kw = client.resource_reports[-1]
+        assert kw["device_mem_mb"] == {0: 12288.0}
+        assert kw["device_mem_total_mb"] == {0: 16384.0}
+
+        # master side: servicer-shaped ingestion → worst_hbm_frac
+        from dlrover_tpu.common.metric import NodeMetrics, TpuMetric
+
+        mctx = JobMetricContext()
+        mctx.add_node_metrics(NodeMetrics(node_id=0, devices=[
+            TpuMetric(device_id=0, hbm_used_mb=12288.0,
+                      hbm_total_mb=16384.0),
+        ]))
+        gen = SimpleStrategyGenerator(metric_context=mctx)
+        assert gen.worst_hbm_frac() == 0.75
+    finally:
+        server.stop()
+
+
 def test_worker_publish_step_roundtrip(tmp_path):
     """Worker publish_step → agent IPC dict → TrainingMonitor, over the
     real unix-socket server."""
