@@ -20,6 +20,9 @@ from typing import Callable, Dict, List, Optional
 from dlrover_tpu.common.log import logger
 
 TRAINING_METRICS_DICT = "training_metrics"
+# SharedDict key prefix for worker-published device memory
+# (worker.publish_step writes f"{HBM_KEY_PREFIX}{local_rank}")
+HBM_KEY_PREFIX = "hbm/"
 
 
 def collect_host_usage() -> Dict[str, float]:
@@ -52,13 +55,18 @@ def device_stats_from_ipc(ipc_server) -> Dict[int, Dict[str, float]]:
     except Exception:  # noqa: BLE001 — IPC down = no telemetry
         return stats
     for key, value in metrics.items():
-        if not isinstance(key, str) or not key.startswith("hbm/"):
+        if not isinstance(key, str) or not key.startswith(HBM_KEY_PREFIX):
             continue
-        for device_id, mem in dict(value).items():
-            stats[int(device_id)] = {
-                "hbm_used_mb": float(mem.get("hbm_used_mb", 0.0)),
-                "hbm_total_mb": float(mem.get("hbm_total_mb", 0.0)),
-            }
+        try:
+            for device_id, mem in dict(value).items():
+                stats[int(device_id)] = {
+                    "hbm_used_mb": float(mem.get("hbm_used_mb", 0.0)),
+                    "hbm_total_mb": float(mem.get("hbm_total_mb", 0.0)),
+                }
+        except (TypeError, ValueError, AttributeError):
+            # one malformed entry (version skew across a rolling restart)
+            # must not take down the whole resource report
+            logger.warning("ignoring malformed device-memory entry %r", key)
     return stats
 
 

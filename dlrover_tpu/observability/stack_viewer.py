@@ -1,0 +1,103 @@
+"""Stack aggregation → flamegraph-folded output.
+
+Reference: xpu_timer's stack tooling (py_xpu_timer/py_xpu_timer/
+stack_viewer.py renders flamegraphs from gdb/py-spy dumps driven by
+``DumpStringStacktrace``). The TPU plane's dump source is python's
+``faulthandler`` armed on SIGUSR1 (TpuTimer.install): the daemon's
+``/dump_stack`` (or the hang watchdog) signals every worker, and each
+appends all-thread stacks to ``/tmp/tpu_timer_pystack_<pid>.txt``.
+
+This module parses those dumps and folds them into the standard
+``caller;callee N`` format any flamegraph renderer consumes
+(flamegraph.pl, speedscope, perfetto). Repeated dumps aggregate into a
+poor-man's sampling profile — ``sample`` drives N rounds through the
+daemon.
+"""
+
+import glob
+import os
+import re
+import time
+import urllib.request
+from collections import Counter
+from typing import Dict, Iterable, List
+
+_THREAD_RE = re.compile(r"^(Current thread|Thread) (0x[0-9a-f]+)")
+_FRAME_RE = re.compile(r'^\s+File "([^"]+)", line (\d+) in (.+)$')
+
+
+def parse_faulthandler_dump(text: str) -> List[List[str]]:
+    """One dump → list of stacks, each root-first as ``file:func`` frames.
+    (faulthandler prints most-recent-call-first; we reverse.)"""
+    stacks: List[List[str]] = []
+    current: List[str] = []
+    in_thread = False
+    for line in text.splitlines():
+        if _THREAD_RE.match(line):
+            if current:
+                stacks.append(list(reversed(current)))
+            current = []
+            in_thread = True
+            continue
+        m = _FRAME_RE.match(line)
+        if m and in_thread:
+            filename, _lineno, func = m.groups()
+            current.append(f"{os.path.basename(filename)}:{func}")
+        elif current and not m:
+            stacks.append(list(reversed(current)))
+            current = []
+            in_thread = False
+    if current:
+        stacks.append(list(reversed(current)))
+    return stacks
+
+
+def fold_stacks(dumps: Iterable[str]) -> Dict[str, int]:
+    """Aggregate many dumps into folded-stack counts."""
+    counts: Counter = Counter()
+    for text in dumps:
+        for stack in parse_faulthandler_dump(text):
+            if stack:
+                counts[";".join(stack)] += 1
+    return dict(counts)
+
+
+def write_folded(counts: Dict[str, int], out_path: str) -> None:
+    """``stack 12`` lines, hottest first — feed to flamegraph.pl or
+    paste into speedscope."""
+    with open(out_path, "w", encoding="utf-8") as f:
+        for stack, n in sorted(counts.items(), key=lambda kv: -kv[1]):
+            f.write(f"{stack} {n}\n")
+
+
+def collapse_dump_files(pattern: str = "/tmp/tpu_timer_pystack_*.txt",
+                        out_path: str = "/tmp/tpu_timer_stacks.folded",
+                        ) -> Dict[str, int]:
+    """Fold every worker's dump file into one profile."""
+    dumps = []
+    for path in glob.glob(pattern):
+        try:
+            with open(path, encoding="utf-8") as f:
+                dumps.append(f.read())
+        except OSError:
+            continue
+    counts = fold_stacks(dumps)
+    if counts:
+        write_folded(counts, out_path)
+    return counts
+
+
+def sample(daemon_port: int = 18889, rounds: int = 20,
+           interval_s: float = 0.5,
+           out_path: str = "/tmp/tpu_timer_stacks.folded") -> Dict[str, int]:
+    """Drive the daemon's /dump_stack repeatedly, then fold — a sampling
+    profile of every worker's python threads with zero dependencies."""
+    for _ in range(rounds):
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{daemon_port}/dump_stack", timeout=3
+            ).read()
+        except Exception:  # noqa: BLE001 — daemon may not be up yet
+            pass
+        time.sleep(interval_s)
+    return collapse_dump_files(out_path=out_path)

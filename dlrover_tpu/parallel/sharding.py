@@ -137,10 +137,24 @@ def global_batch_from_local(mesh, local_batch, spec: Optional[P] = None):
     local = np.asarray(local_batch)
     if jax.process_count() == 1:
         return jax.device_put(local, sharding)
-    # explicit global shape: every host contributes local rows along dim 0
-    # (never rely on inference — a misconfigured world would silently
-    # assemble a wrong-sized batch)
-    global_shape = (local.shape[0] * jax.process_count(),) + local.shape[1:]
-    return jax.make_array_from_process_local_data(
-        sharding, local, global_shape
-    )
+    # let jax derive the global shape from the sharding: the scale factor
+    # is how many processes hold DISTINCT batch shards, which is NOT always
+    # process_count (model axes spanning hosts — e.g. sp across hosts —
+    # make some hosts batch-replicas that must feed identical rows)
+    out = jax.make_array_from_process_local_data(sharding, local)
+    # ...but never return a silently mis-sized batch: with pure data
+    # parallelism across all processes the global rows must be local×procs
+    batch_axes = spec[0] if spec else None
+    axes = ((batch_axes,) if isinstance(batch_axes, str) else
+            tuple(batch_axes or ()))
+    shards = 1
+    for a in axes:
+        shards *= mesh.shape.get(a, 1)
+    if shards >= jax.process_count() and \
+            out.shape[0] != local.shape[0] * jax.process_count():
+        raise ValueError(
+            f"global batch came out {out.shape[0]} rows from "
+            f"{local.shape[0]} local × {jax.process_count()} processes — "
+            "check the mesh/world configuration"
+        )
+    return out

@@ -72,7 +72,10 @@ class WorkerContext:
         where they drive micro-batch auto-tuning and stall diagnosis."""
         if not self.ipc_socket:
             return
-        from dlrover_tpu.agent.monitor import TRAINING_METRICS_DICT
+        from dlrover_tpu.agent.monitor import (
+            HBM_KEY_PREFIX,
+            TRAINING_METRICS_DICT,
+        )
         from dlrover_tpu.common.multi_process import SharedDict
 
         if not hasattr(self, "_metrics_dict"):
@@ -86,7 +89,7 @@ class WorkerContext:
             self._last_hbm_publish = now
             hbm = self._collect_hbm()
             if hbm:
-                payload[f"hbm/{self.local_rank}"] = hbm
+                payload[f"{HBM_KEY_PREFIX}{self.local_rank}"] = hbm
         try:
             self._metrics_dict.update(payload)
         except OSError:

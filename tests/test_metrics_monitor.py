@@ -188,6 +188,10 @@ def test_hbm_telemetry_worker_to_strategy_generator(tmp_path):
         }})
         stats = device_stats_from_ipc(server)
         assert stats[0]["hbm_used_mb"] == 12288.0
+        # a malformed entry (agent/worker version skew) is skipped, not fatal
+        d.update({"hbm/1": "garbage"})
+        stats = device_stats_from_ipc(server)
+        assert stats[0]["hbm_used_mb"] == 12288.0
 
         # agent side: report carries the device memory dicts
         client = FakeClient()

@@ -240,6 +240,59 @@ def test_daemon_aggregates_and_dumps(engine_proc_port):
         proc.wait()
 
 
+def test_stack_viewer_folding():
+    """faulthandler dump → root-first folded stacks, aggregated across
+    dumps and written hottest-first (flamegraph.pl input format)."""
+    import sys
+    sys.path.insert(0, REPO)
+    from dlrover_tpu.observability.stack_viewer import (
+        fold_stacks,
+        parse_faulthandler_dump,
+        write_folded,
+    )
+
+    dump = '''Current thread 0x00007f01 (most recent call first):
+  File "/app/train.py", line 10 in step
+  File "/app/train.py", line 50 in loop
+  File "/app/main.py", line 5 in main
+Thread 0x00007f02 (most recent call first):
+  File "/usr/lib/python3.12/threading.py", line 300 in wait
+  File "/app/io.py", line 7 in reader
+'''
+    stacks = parse_faulthandler_dump(dump)
+    assert stacks[0] == ["main.py:main", "train.py:loop", "train.py:step"]
+    assert stacks[1] == ["io.py:reader", "threading.py:wait"]
+    counts = fold_stacks([dump, dump, dump])
+    assert counts["main.py:main;train.py:loop;train.py:step"] == 3
+    out = "/tmp/tt_test_folded.txt"
+    write_folded(counts, out)
+    first = open(out).readline()
+    assert first.endswith(" 3\n")
+
+
+def test_stack_viewer_real_faulthandler_dump():
+    """Round-trip against an actual faulthandler dump (format drift
+    guard)."""
+    import subprocess
+    import sys
+    code = (
+        "import faulthandler, sys\n"
+        "def inner():\n"
+        "    faulthandler.dump_traceback(file=sys.stderr)\n"
+        "def outer():\n"
+        "    inner()\n"
+        "outer()\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True)
+    sys.path.insert(0, REPO)
+    from dlrover_tpu.observability.stack_viewer import parse_faulthandler_dump
+
+    stacks = parse_faulthandler_dump(r.stderr)
+    flat = [";".join(s) for s in stacks]
+    assert any("<string>:outer;<string>:inner" in s for s in flat), flat
+
+
 def test_timeline_merge(engine_proc_port):
     import sys
     sys.path.insert(0, REPO)
