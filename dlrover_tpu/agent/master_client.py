@@ -273,10 +273,12 @@ class MasterClient:
         return resp.status, resp.reason
 
     def ping(self) -> bool:
+        # one-shot explicitly: the default retry budget (~minutes of
+        # backoff) must not apply to a liveness probe
         try:
-            self._client.try_call("ping", comm.BaseRequest())
+            self._client.call("ping", comm.BaseRequest(), retries=1)
             return True
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, RuntimeError):
             return False
 
     # -- singleton wiring (worker processes build from env) ----------------

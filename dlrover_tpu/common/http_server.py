@@ -124,12 +124,14 @@ class HttpRPCClient:
     def addr(self) -> str:
         return f"http://{self._addr}"
 
-    def call(self, method: str, request: Any = None) -> Any:
+    def call(self, method: str, request: Any = None,
+             retries: Optional[int] = None) -> Any:
+        retries = self._retries if retries is None else retries
         frame = msgpack.packb(
             {"m": method, "p": comm.serialize(request)}, use_bin_type=True
         )
         last: Optional[Exception] = None
-        for attempt in range(self._retries):
+        for attempt in range(retries):
             try:
                 req = urllib.request.Request(
                     f"http://{self._addr}/rpc", data=frame,
@@ -144,12 +146,12 @@ class HttpRPCClient:
                 return comm.deserialize(resp.get("p", b""))
             except (urllib.error.URLError, ConnectionError, OSError) as e:
                 last = e
-                if attempt + 1 < self._retries:
+                if attempt + 1 < retries:
                     import time
 
                     time.sleep(min(5.0, 0.1 * (2 ** min(attempt, 5))))
         raise ConnectionError(
-            f"http rpc to {self._addr} failed after {self._retries} "
+            f"http rpc to {self._addr} failed after {retries} "
             f"attempts: {last!r}"
         )
 

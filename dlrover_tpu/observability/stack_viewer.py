@@ -70,14 +70,31 @@ def write_folded(counts: Dict[str, int], out_path: str) -> None:
             f.write(f"{stack} {n}\n")
 
 
+def snapshot_offsets(pattern: str = "/tmp/tpu_timer_pystack_*.txt",
+                     ) -> Dict[str, int]:
+    """Current byte offsets of the dump files — scope a later fold to
+    content appended after this point (stale files from dead PIDs and
+    earlier hang dumps must not skew a fresh sampling profile)."""
+    return {p: os.path.getsize(p) for p in glob.glob(pattern)}
+
+
 def collapse_dump_files(pattern: str = "/tmp/tpu_timer_pystack_*.txt",
                         out_path: str = "/tmp/tpu_timer_stacks.folded",
+                        offsets: Dict[str, int] = None,
                         ) -> Dict[str, int]:
-    """Fold every worker's dump file into one profile."""
+    """Fold worker dump files into one profile; with ``offsets`` (from
+    :func:`snapshot_offsets`) only content appended since is counted."""
     dumps = []
     for path in glob.glob(pattern):
         try:
             with open(path, encoding="utf-8") as f:
+                if offsets is not None:
+                    if path not in offsets:
+                        # file predates the sampling window entirely? no —
+                        # a NEW file appearing mid-window is fresh content
+                        pass
+                    else:
+                        f.seek(offsets[path])
                 dumps.append(f.read())
         except OSError:
             continue
@@ -91,7 +108,9 @@ def sample(daemon_port: int = 18889, rounds: int = 20,
            interval_s: float = 0.5,
            out_path: str = "/tmp/tpu_timer_stacks.folded") -> Dict[str, int]:
     """Drive the daemon's /dump_stack repeatedly, then fold — a sampling
-    profile of every worker's python threads with zero dependencies."""
+    profile of every worker's python threads with zero dependencies.
+    Only stacks dumped during THIS run are counted."""
+    offsets = snapshot_offsets()
     for _ in range(rounds):
         try:
             urllib.request.urlopen(
@@ -100,4 +119,4 @@ def sample(daemon_port: int = 18889, rounds: int = 20,
         except Exception:  # noqa: BLE001 — daemon may not be up yet
             pass
         time.sleep(interval_s)
-    return collapse_dump_files(out_path=out_path)
+    return collapse_dump_files(out_path=out_path, offsets=offsets)

@@ -141,20 +141,7 @@ def global_batch_from_local(mesh, local_batch, spec: Optional[P] = None):
     # is how many processes hold DISTINCT batch shards, which is NOT always
     # process_count (model axes spanning hosts — e.g. sp across hosts —
     # make some hosts batch-replicas that must feed identical rows)
-    out = jax.make_array_from_process_local_data(sharding, local)
-    # ...but never return a silently mis-sized batch: with pure data
-    # parallelism across all processes the global rows must be local×procs
-    batch_axes = spec[0] if spec else None
-    axes = ((batch_axes,) if isinstance(batch_axes, str) else
-            tuple(batch_axes or ()))
-    shards = 1
-    for a in axes:
-        shards *= mesh.shape.get(a, 1)
-    if shards >= jax.process_count() and \
-            out.shape[0] != local.shape[0] * jax.process_count():
-        raise ValueError(
-            f"global batch came out {out.shape[0]} rows from "
-            f"{local.shape[0]} local × {jax.process_count()} processes — "
-            "check the mesh/world configuration"
-        )
-    return out
+    # a genuinely mis-sized feed fails loudly at the next reshape/jit, so
+    # no extra guard here — any shard-count heuristic mis-fires on meshes
+    # where model axes span hosts (some processes are batch replicas)
+    return jax.make_array_from_process_local_data(sharding, local)

@@ -125,6 +125,7 @@ class DLJobBuilder:
         self._roles: Dict[str, RoleConfig] = {}
         self._trainer: Optional[TrainerConfig] = None
         self._collocations: List[Set[str]] = []
+        self._elastic_cfg: Dict[str, Any] = {}
 
     # -- chained setters ----------------------------------------------------
     def node_num(self, n: int) -> "DLJobBuilder":
@@ -156,6 +157,29 @@ class DLJobBuilder:
 
     def trainer(self, module_name: str, class_name: str) -> "DLJobBuilder":
         self._trainer = TrainerConfig(module_name, class_name)
+        return self
+
+    def elastic_training(self, *cmd: str, nproc_per_node: int = 1,
+                         max_restarts: int = 3,
+                         ckpt_dir: str = "") -> "DLJobBuilder":
+        """DL stream: run ``cmd`` under full L1/L2 elastic training as a
+        unified role — one instance per host, instance 0 hosting the job
+        master, every instance an elastic agent (reference internal
+        ELASTIC_ROLE + elastic sub-master, unified/master/elastic/)."""
+        from dlrover_tpu.unified.elastic import ELASTIC_ROLE
+
+        self.workload(
+            ELASTIC_ROLE, "dlrover_tpu.unified.elastic",
+            "ElasticTrainingWorkload",
+        ).per_node(1).mpmd()   # exactly one agent per host
+        # merged into the job config at build() so .config() ordering
+        # doesn't matter
+        self._elastic_cfg = {
+            "elastic_cmd": list(cmd),
+            "nproc_per_node": nproc_per_node,
+            "max_restarts": max_restarts,
+            "ckpt_dir": ckpt_dir,
+        }
         return self
 
     def collocate(self, *roles: str) -> "DLJobBuilder":
@@ -221,12 +245,18 @@ class DLJobBuilder:
     def build(self) -> DLJob:
         if not self.validate():
             raise InvalidDLConfiguration()
+        if self._elastic_cfg:
+            from dlrover_tpu.unified.elastic import ELASTIC_ROLE
+
+            # the elastic role's instance count follows node_num even when
+            # node_num() was called after elastic_training()
+            self._roles[ELASTIC_ROLE].num = self._node_num
         return DLJob(
             dl_type=self._dl_type,
             node_num=self._node_num,
             device_per_node=self._device_per_node,
             device_type=self._device_type,
-            config=self._config,
+            config={**self._elastic_cfg, **self._config},
             env=self._env,
             roles=dict(self._roles),
             trainer=self._trainer,

@@ -1,0 +1,96 @@
+"""Elastic-training stream for unified jobs: run L1/L2 elastic training
+as a unified role.
+
+Reference: unified/master/elastic/ (master.py:46, job_manager.py,
+executor.py) — the unified Ray master embeds an *elastic sub-master*
+reusing the L1 managers, and ``DLJobBuilder`` jobs whose stream is plain
+DL training use the internal ELASTIC_ROLE whose workloads run the user's
+command under the elastic agent.
+
+Here the same composition from our own pieces: each role instance is one
+"host" — instance 0 also hosts the in-proc :class:`LocalJobMaster`
+(node_num = role world size) and every instance runs an
+:class:`ElasticTrainingAgent` against it, which rendezvouses, forks the
+user's workers, monitors, and restarts. The unified failover ladder stays
+above it: if a whole instance dies, the scheduler respawns it and the
+rendezvous re-forms — two nested elasticity levels, like the reference's
+MPMD failover around the elastic sub-master.
+"""
+
+import os
+import time
+from typing import List
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.unified.workload import BaseWorkload
+
+ELASTIC_ROLE = "elastic"
+MASTER_ADDR_ENV = "DLROVER_TPU_UNIFIED_ELASTIC_MASTER"
+
+
+class ElasticTrainingWorkload(BaseWorkload):
+    """One per host. config keys (set by DLJobBuilder.elastic_training):
+    ``elastic_cmd`` (the training script argv), ``nproc_per_node``,
+    ``max_restarts``, optional ``ckpt_dir``."""
+
+    def setup(self) -> None:
+        self._master = None
+        if self.rank == 0:
+            # instance 0 hosts the job master for the whole elastic role
+            from dlrover_tpu.master.master import LocalJobMaster
+
+            addr = self.ctx.env.get(MASTER_ADDR_ENV, "")
+            port = int(addr.rsplit(":", 1)[1]) if addr else 0
+            self._master = LocalJobMaster(
+                job_name=f"{self.ctx.job_name}-elastic",
+                port=port,
+                node_num=self.world_size,
+            )
+            self._master.prepare()
+            logger.info("elastic sub-master on :%s", self._master.port)
+
+    def run(self) -> int:
+        """Blocks until the elastic training job completes on this host."""
+        from dlrover_tpu.agent.config import ElasticLaunchConfig
+        from dlrover_tpu.agent.training import ElasticTrainingAgent
+
+        addr = self.ctx.env.get(MASTER_ADDR_ENV, "")
+        if not addr and self._master is not None:
+            addr = f"127.0.0.1:{self._master.port}"
+        # non-rank-0 instances wait for the master to come up
+        deadline = time.time() + 60
+        cmd: List[str] = list(self.config.get("elastic_cmd", []))
+        if not cmd:
+            raise ValueError("elastic_training role without a command")
+        config = ElasticLaunchConfig(
+            min_nodes=self.world_size,
+            max_nodes=self.world_size,
+            nproc_per_node=int(self.config.get("nproc_per_node", 1)),
+            node_rank=self.rank,
+            node_id=self.rank,
+            job_name=f"{self.ctx.job_name}-elastic",
+            master_addr=addr,
+            max_restarts=int(self.config.get("max_restarts", 3)),
+            ckpt_dir=str(self.config.get("ckpt_dir", "")),
+            entrypoint=cmd[0],
+            args=cmd[1:],
+        )
+        config.auto_configure_params()
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(addr, node_id=self.rank,
+                              node_rank=self.rank)
+        while time.time() < deadline:
+            if client.ping():
+                break
+            time.sleep(0.5)
+        agent = ElasticTrainingAgent(config, client)
+        rc = agent.run()
+        if rc != 0:
+            raise RuntimeError(f"elastic agent on host {self.rank} "
+                               f"exited rc={rc}")
+        return rc
+
+    def teardown(self) -> None:
+        if self._master is not None:
+            self._master.stop()

@@ -55,6 +55,14 @@ class UnifiedMaster:
                 coord = f"127.0.0.1:{find_free_port('127.0.0.1')}"
                 for v in self.graph.role_vertices[role]:
                     v.env.setdefault("DLROVER_TPU_COORDINATOR", coord)
+        # elastic-training stream: every instance must agree on where
+        # instance 0 hosts the elastic sub-master (unified/elastic.py)
+        from dlrover_tpu.unified.elastic import ELASTIC_ROLE, MASTER_ADDR_ENV
+
+        if ELASTIC_ROLE in self.job.roles:
+            addr = f"127.0.0.1:{find_free_port('127.0.0.1')}"
+            for v in self.graph.role_vertices[ELASTIC_ROLE]:
+                v.env.setdefault(MASTER_ADDR_ENV, addr)
 
     def role_groups(self) -> Dict[str, RoleGroup]:
         return {r: self.scheduler.role_group(r) for r in self.graph.roles()}

@@ -69,19 +69,30 @@ class HostFillPlacement:
                         assignment[host].append(v.name)
                         capacity[host] -= 1
 
-        # Remaining roles: per_node chunks stay together; per_node=0 packs
-        # freely, one instance at a time (api.py RoleConfig contract).
+        # Remaining roles: per_node chunks stay together AND per_node caps
+        # how many instances of the role share one host (the reference's
+        # bundle-per-node semantic — an elastic agent role with per_node=1
+        # must spread across hosts, not first-fit onto one); per_node=0
+        # packs freely, one instance at a time.
         for role, verts in self.graph.role_vertices.items():
             if role in placed_roles:
                 continue
-            per = self.graph.job.roles[role].per_node or 1
-            for start in range(0, len(verts), per):
-                chunk = verts[start:start + per]
-                host = self._pick_host(capacity, need=len(chunk))
+            per = self.graph.job.roles[role].per_node
+            role_on_host: Dict[int, int] = {}
+            for start in range(0, len(verts), per or 1):
+                chunk = verts[start:start + (per or 1)]
+                host = self._pick_host(
+                    capacity, need=len(chunk),
+                    blocked=(
+                        {h for h, n in role_on_host.items()
+                         if n + len(chunk) > per} if per else None
+                    ),
+                )
                 for v in chunk:
                     v.node_index = host
                     assignment[host].append(v.name)
                     capacity[host] -= 1
+                role_on_host[host] = role_on_host.get(host, 0) + len(chunk)
         self._assign_local_ranks()
         logger.info("placement: %s", {
             h: names for h, names in assignment.items() if names
@@ -103,10 +114,12 @@ class HostFillPlacement:
                     v.local_world_size = len(host_verts)
 
     @staticmethod
-    def _pick_host(capacity: List[int], need: int) -> int:
+    def _pick_host(capacity: List[int], need: int,
+                   blocked=None) -> int:
         for i, c in enumerate(capacity):
-            if c >= need:
+            if c >= need and (blocked is None or i not in blocked):
                 return i
         raise PlacementError(
-            f"no host with capacity {need} (remaining {capacity})"
+            f"no host with capacity {need} (remaining {capacity}, "
+            f"blocked {sorted(blocked) if blocked else []})"
         )

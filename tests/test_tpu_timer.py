@@ -293,6 +293,31 @@ def test_stack_viewer_real_faulthandler_dump():
     assert any("<string>:outer;<string>:inner" in s for s in flat), flat
 
 
+def test_stack_viewer_offset_scoping(tmp_path):
+    """Folding with snapshot offsets counts only content appended after
+    the snapshot — stale dumps must not skew a fresh profile."""
+    import sys
+    sys.path.insert(0, REPO)
+    from dlrover_tpu.observability.stack_viewer import (
+        collapse_dump_files,
+        snapshot_offsets,
+    )
+
+    dump = ('Current thread 0x1 (most recent call first):\n'
+            '  File "/a/old.py", line 1 in stale\n')
+    fresh = ('Current thread 0x1 (most recent call first):\n'
+             '  File "/a/new.py", line 1 in fresh\n')
+    path = tmp_path / "tpu_timer_pystack_1.txt"
+    path.write_text(dump)
+    pattern = str(tmp_path / "tpu_timer_pystack_*.txt")
+    offsets = snapshot_offsets(pattern)
+    with open(path, "a") as f:
+        f.write(fresh)
+    counts = collapse_dump_files(
+        pattern, out_path=str(tmp_path / "out.folded"), offsets=offsets)
+    assert counts == {"new.py:fresh": 1}
+
+
 def test_timeline_merge(engine_proc_port):
     import sys
     sys.path.insert(0, REPO)

@@ -189,6 +189,23 @@ def test_placement_free_packing_spans_hosts():
         assert all(v.local_world_size == len(vs) for v in vs)
 
 
+def test_placement_per_node_caps_instances_per_host():
+    """per_node bounds how many instances of a role share one host — an
+    elastic-agent role with per_node=1 must spread, not first-fit pile up
+    on host 0."""
+    b = DLJobBuilder().node_num(2).device_per_node(4)
+    b.workload("agent", MOD, "Counter").num(2).per_node(1)
+    g = ExecutionGraph(b.build())
+    HostFillPlacement(g).allocate()
+    hosts = [v.node_index for v in g.role_vertices["agent"]]
+    assert sorted(hosts) == [0, 1]
+    # infeasible cap → placement error, not silent stacking
+    b = DLJobBuilder().node_num(1).device_per_node(8)
+    b.workload("agent", MOD, "Counter").num(2).per_node(1)
+    with pytest.raises(PlacementError):
+        HostFillPlacement(ExecutionGraph(b.build())).allocate()
+
+
 def test_placement_collocation_uneven_groups():
     """A collocated role fully placed in early groups contributes 0 to
     later groups' capacity need (regression: spurious PlacementError)."""
@@ -332,6 +349,28 @@ def test_e2e_task_stream_with_failover():
     t0 = time.time()
     assert _toy_job(inject_crash=True).submit(timeout_s=120) == 0
     assert time.time() - t0 < 110
+
+
+def test_e2e_elastic_training_stream(tmp_path):
+    """The DL stream (reference ELASTIC_ROLE + elastic sub-master): a
+    unified job whose role runs full L1/L2 elastic training — instance 0
+    hosts the job master, the agent rendezvouses and forks real workers."""
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from dlrover_tpu import worker\n"
+        "ctx = worker.init(initialize_jax_distributed=False)\n"
+        f"open('{tmp_path}/done_' + str(ctx.rank), 'w').write('ok')\n"
+    )
+    b = DLJobBuilder().node_num(1).device_per_node(4)
+    b.elastic_training(str(script), nproc_per_node=2, max_restarts=1)
+    job = b.build()
+    assert job.roles["elastic"].num == 1
+    assert job.config["nproc_per_node"] == 2
+    assert job.submit(timeout_s=240) == 0
+    assert (tmp_path / "done_0").exists()
+    assert (tmp_path / "done_1").exists()
 
 
 def test_e2e_broadcast_stream():
