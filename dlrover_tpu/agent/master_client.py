@@ -272,6 +272,12 @@ class MasterClient:
         )
         return resp.status, resp.reason
 
+    def get_run_config(self) -> Dict:
+        """Master-pushed launcher overrides (reference ElasticRunConfig
+        fetch, elastic_run.py:404)."""
+        resp = self._client.call("get_run_config", comm.BaseRequest())
+        return resp.data or {}
+
     def ping(self) -> bool:
         # one-shot explicitly: the default retry budget (~minutes of
         # backoff) must not apply to a liveness probe

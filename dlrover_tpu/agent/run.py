@@ -143,6 +143,29 @@ def _run_network_check(config: ElasticLaunchConfig,
     return run_node_check(config, client)
 
 
+def _apply_master_run_config(client: MasterClient,
+                             config: ElasticLaunchConfig) -> None:
+    """Merge master-pushed launcher overrides (reference merges the
+    master's ElasticRunConfig into the torchrun args, elastic_run.py:
+    404–443) — the platform's central switch for e.g. forcing
+    --network-check on every agent of a job. Unknown keys are ignored."""
+    try:
+        resp = client.get_run_config()
+    except (ConnectionError, OSError, RuntimeError):
+        # RuntimeError covers RPCError from an older master without this
+        # method — version skew must not stop the agent
+        return
+    if not resp:
+        return
+    for key, value in resp.items():
+        if hasattr(config, key):
+            setattr(config, key, value)
+            logger.info("master-pushed run config: %s=%r", key, value)
+        else:
+            logger.warning("master-pushed run config key %r unknown — "
+                           "ignored (version skew?)", key)
+
+
 def run(config: ElasticLaunchConfig) -> int:
     master = None
     if config.master_addr == "":
@@ -152,6 +175,7 @@ def run(config: ElasticLaunchConfig) -> int:
         config.master_addr, config.node_id, config.node_rank
     )
     try:
+        _apply_master_run_config(client, config)
         wait_pre_check(client)
         if config.network_check:
             ok = _run_network_check(config, client)

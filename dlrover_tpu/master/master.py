@@ -214,6 +214,8 @@ class DistributedJobMaster(JobMaster):
         job_name = kwargs.get("job_name", "local")
         node_num = kwargs.get("node_num", 1)
         replica_spec = replica_spec or TpuReplicaSpec(replicas=node_num)
+        # brain_addr is ours, not the base master's — pop before forwarding
+        brain_addr = kwargs.pop("brain_addr", "")
         # bind the RPC server first: the address injected into worker pods
         # must carry the REAL bound port, not an assumed one
         super().__init__(**kwargs)
@@ -245,7 +247,6 @@ class DistributedJobMaster(JobMaster):
         # otherwise the in-master LocalOptimizer heuristics run
         optimizer = None
         metrics_sink = None
-        brain_addr = kwargs.get("brain_addr", "")
         self._brain_client = None
         if brain_addr:
             import uuid as _uuid
@@ -338,6 +339,10 @@ def main(argv=None) -> int:
     parser.add_argument("--crd-scaler", action="store_true",
                         help="emit ScalePlan CRs instead of creating pods "
                              "(an operator executes them)")
+    parser.add_argument("--brain-addr", default="",
+                        help="cluster Brain service host:port — plans from "
+                             "cross-job history instead of local heuristics"
+                             " (k8s platform only)")
     args = parser.parse_args(argv)
     common = dict(
         job_name=args.job_name,
@@ -356,7 +361,8 @@ def main(argv=None) -> int:
             common["port"] = 50001
         master = DistributedJobMaster(
             RealK8sApi(), namespace=args.namespace,
-            use_crd_scaler=args.crd_scaler, **common,
+            use_crd_scaler=args.crd_scaler,
+            brain_addr=args.brain_addr, **common,
         )
     else:
         master = LocalJobMaster(**common)

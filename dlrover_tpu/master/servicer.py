@@ -283,5 +283,23 @@ class MasterServicer:
             return self._strategy_generator.config
         return comm.ParallelConfig()
 
+    def rpc_get_run_config(self, req) -> comm.BaseResponse:
+        """Master-pushed launcher overrides (reference ElasticRunConfig,
+        elastic_run.py:404–443 — lets the platform centrally force e.g.
+        --network-check or checkpoint settings for every agent of a job).
+        Source: DLROVER_TPU_RUN_CONFIG env on the master, a JSON object of
+        ElasticLaunchConfig field overrides."""
+        import json
+        import os
+
+        raw = os.getenv("DLROVER_TPU_RUN_CONFIG", "")
+        overrides = {}
+        if raw:
+            try:
+                overrides = json.loads(raw)
+            except ValueError:
+                logger.warning("bad DLROVER_TPU_RUN_CONFIG %r ignored", raw)
+        return comm.BaseResponse(data=overrides)
+
     def rpc_ping(self, req) -> comm.BaseResponse:
         return comm.BaseResponse(data={"uptime": time.time() - self._start_time})

@@ -75,7 +75,13 @@ def snapshot_offsets(pattern: str = "/tmp/tpu_timer_pystack_*.txt",
     """Current byte offsets of the dump files — scope a later fold to
     content appended after this point (stale files from dead PIDs and
     earlier hang dumps must not skew a fresh sampling profile)."""
-    return {p: os.path.getsize(p) for p in glob.glob(pattern)}
+    offsets: Dict[str, int] = {}
+    for p in glob.glob(pattern):
+        try:
+            offsets[p] = os.path.getsize(p)
+        except OSError:  # deleted between glob and stat
+            continue
+    return offsets
 
 
 def collapse_dump_files(pattern: str = "/tmp/tpu_timer_pystack_*.txt",
@@ -89,12 +95,9 @@ def collapse_dump_files(pattern: str = "/tmp/tpu_timer_pystack_*.txt",
         try:
             with open(path, encoding="utf-8") as f:
                 if offsets is not None:
-                    if path not in offsets:
-                        # file predates the sampling window entirely? no —
-                        # a NEW file appearing mid-window is fresh content
-                        pass
-                    else:
-                        f.seek(offsets[path])
+                    # files absent from the snapshot appeared mid-window:
+                    # everything in them is fresh (offset 0)
+                    f.seek(offsets.get(path, 0))
                 dumps.append(f.read())
         except OSError:
             continue

@@ -80,10 +80,19 @@ class ElasticTrainingWorkload(BaseWorkload):
 
         client = MasterClient(addr, node_id=self.rank,
                               node_rank=self.rank)
+        up = False
         while time.time() < deadline:
             if client.ping():
+                up = True
                 break
             time.sleep(0.5)
+        if not up:
+            # fail attributably instead of burning the agent's RPC retry
+            # budget against a sub-master that never came up
+            raise RuntimeError(
+                f"elastic sub-master at {addr} unreachable after 60s "
+                f"(instance 0 may have failed setup)"
+            )
         agent = ElasticTrainingAgent(config, client)
         rc = agent.run()
         if rc != 0:

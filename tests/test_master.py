@@ -212,3 +212,34 @@ def test_task_recovery_on_node_death(master):
         rows += t.shard.end - t.shard.start
         c1.report_task_result("d4", t.task_id)
     assert rows == 6
+
+
+def test_master_pushed_run_config(monkeypatch):
+    """Launcher overrides pushed by the master (reference ElasticRunConfig
+    merge, elastic_run.py:404): known keys apply, unknown keys are ignored,
+    and no env means no changes."""
+    from dlrover_tpu.agent.config import ElasticLaunchConfig
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.agent.run import _apply_master_run_config
+    from dlrover_tpu.master.master import LocalJobMaster
+
+    monkeypatch.setenv(
+        "DLROVER_TPU_RUN_CONFIG",
+        '{"network_check": true, "ckpt_replica": 2, "bogus_key": 1}',
+    )
+    m = LocalJobMaster(job_name="runcfg", node_num=1)
+    m.prepare()
+    try:
+        client = MasterClient(m.addr, 0)
+        cfg = ElasticLaunchConfig(entrypoint="x")
+        _apply_master_run_config(client, cfg)
+        assert cfg.network_check is True
+        assert cfg.ckpt_replica == 2
+        assert not hasattr(cfg, "bogus_key")
+        # no overrides → untouched
+        monkeypatch.delenv("DLROVER_TPU_RUN_CONFIG")
+        cfg2 = ElasticLaunchConfig(entrypoint="x")
+        _apply_master_run_config(client, cfg2)
+        assert cfg2.network_check is False
+    finally:
+        m.stop()
