@@ -1,20 +1,30 @@
-"""Benchmark: Flash Checkpoint blocking time vs synchronous disk save.
+"""Benchmarks: training MFU + flash-attention kernel + Flash Checkpoint.
 
-The reference's headline checkpoint number is blocking-time reduction —
-~10× vs an NVMe SSD for GPT-2-xl-class state (BASELINE.md, reference
-docs/blogs/flash_checkpoint.md:360–383). This bench builds a GPT-2-xl-scale
-bf16 state on the real chip, then measures:
+Prints ONE JSON line. Headline metric = model FLOPs utilization (MFU) of
+the jitted Llama train step on the real chip — the axis the reference
+stack exists to maximize (its goodput pitch, README.md:55-57, presumes
+the underlying step is fast). ``vs_baseline`` normalizes by 40% MFU, the
+commonly-cited "good" bar for dense-transformer training (the scaling
+book's rule of thumb); >1.0 clears it. ``detail`` carries:
 
-- ``t_block``  — what training waits on with Flash Checkpoint: device→host
-  copy into the shm frame (the agent persists asynchronously);
-- ``t_sync``   — what training would wait on with a classic synchronous
-  save: the same bytes serialized straight to disk + fsync;
-- ``t_restore``— restore from the shm frame back onto the device.
+- ``train``: tokens/s, step time, params — MFU accounting is the
+  conservative 6*N*T (attention FLOPs excluded, so the true utilization
+  is slightly higher than reported);
+- ``attn``: pallas flash-attention vs dense-causal forward+backward at
+  the train shapes (ops/flash_attention.py vs the naive path);
+- ``ckpt``: the reference's headline numbers — Flash Checkpoint blocking
+  time vs synchronous disk save (~10x claim, reference
+  docs/blogs/flash_checkpoint.md:360-383) and shm restore time (its
+  "seconds vs minutes" restore claim, README.md:85-89).
 
-Prints ONE JSON line: metric = blocking-time speedup (t_sync / t_block);
-``vs_baseline`` normalizes by the reference's ~10× claim (>1.0 beats it).
+Sizes are env-overridable (BENCH_DIM, BENCH_LAYERS, BENCH_SEQ,
+BENCH_BATCH, BENCH_STEPS, BENCH_PEAK_TFLOPS); defaults fit a ~1B-param
+model in one v5e's HBM with remat on — big enough that the MXU, not
+dispatch overhead, is what's measured.
 """
 
+import functools
+import gc
 import json
 import os
 import sys
@@ -22,10 +32,173 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# bf16 peak TFLOP/s per chip by device kind (public spec sheets)
+_PEAK_TFLOPS = {
+    "v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
+    "v4": 275.0, "v3": 123.0, "v6": 918.0, "trillium": 918.0,
+}
 
-def main() -> None:
+
+def _peak_tflops(device) -> float:
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in _PEAK_TFLOPS.items():
+        if key in kind:
+            return peak
+    return 0.0  # unknown (CPU smoke runs): MFU reported as 0
+
+
+# Timing discipline: on the remote-tunnel TPU backend ``block_until_ready``
+# returns before execution finishes, so every measurement here chains its
+# iterations in one ``lax.scan`` (sequential by data dependency), forces
+# completion with a scalar fetch, and subtracts the measured fetch
+# round-trip (RTT ~0.4s through the dev tunnel).
+
+
+def _fetch_rtt() -> float:
+    """Warmed scalar dispatch+fetch round-trip."""
     import jax
     import jax.numpy as jnp
+
+    probe = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+    _ = float(probe(jnp.ones((8,), jnp.float32)))  # compile
+    t0 = time.perf_counter()
+    for _ in range(3):
+        _ = float(probe(jnp.ones((8,), jnp.float32)))
+    return (time.perf_counter() - t0) / 3
+
+
+def bench_train() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.models import llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    dim = int(os.environ.get("BENCH_DIM", "2048" if on_tpu else "256"))
+    layers = int(os.environ.get("BENCH_LAYERS", "16" if on_tpu else "2"))
+    seq = int(os.environ.get("BENCH_SEQ", "2048" if on_tpu else "256"))
+    batch = int(os.environ.get("BENCH_BATCH", "4" if on_tpu else "2"))
+    steps = int(os.environ.get("BENCH_STEPS", "8" if on_tpu else "2"))
+    heads = max(1, dim // 128)
+    config = llama.LlamaConfig(
+        vocab_size=32000, dim=dim, n_layers=layers, n_heads=heads,
+        n_kv_heads=max(1, heads // 2), ffn_dim=int(2.75 * dim) // 256 * 256,
+        max_seq_len=seq, remat=True,
+    )
+    n_params = llama.num_params(config)
+
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+    # +1 so the causal loss sees exactly ``seq`` positions
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, config.vocab_size
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def run(p, s, t):
+        def body(carry, _):
+            p, s = carry
+            loss, grads = jax.value_and_grad(
+                lambda q: llama.next_token_loss(q, t, config)
+            )(p)
+            updates, s = opt.update(grads, s, p)
+            return (optax.apply_updates(p, updates), s), loss
+
+        (p, s), losses = jax.lax.scan(body, (p, s), None, length=steps)
+        return p, s, losses[-1]
+
+    # compile + warmup (donated inputs are consumed — reuse the outputs)
+    params, opt_state, loss = run(params, opt_state, tokens)
+    _ = float(loss)
+    rtt = _fetch_rtt()
+
+    t0 = time.perf_counter()
+    params, opt_state, loss = run(params, opt_state, tokens)
+    final_loss = float(loss)  # forces the whole scan chain
+    step_s = max(1e-9, time.perf_counter() - t0 - rtt) / steps
+
+    device = jax.devices()[0]
+    peak = _peak_tflops(device)
+    tokens_per_step = batch * seq
+    flops_per_step = 6.0 * n_params * tokens_per_step
+    mfu = (flops_per_step / step_s) / (peak * 1e12) if peak else 0.0
+    result = {
+        "params_b": round(n_params / 1e9, 3),
+        "seq": seq, "batch": batch,
+        "step_s": round(step_s, 4),
+        "loss": round(final_loss, 3),
+        "fetch_rtt_s": round(rtt, 3),
+        "tokens_per_s": round(tokens_per_step / step_s, 1),
+        "model_tflops_per_s": round(flops_per_step / step_s / 1e12, 2),
+        "peak_tflops": peak,
+        "mfu_pct": round(100.0 * mfu, 2),
+        "flops_accounting": "6*N*T (attention extra excluded)",
+        "device": str(device),
+    }
+    del params, opt_state, loss
+    gc.collect()
+    return result
+
+
+def bench_attention() -> dict:
+    """Pallas flash kernel vs dense causal attention, forward+backward."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.ops.flash_attention import flash_attention
+    from dlrover_tpu.parallel.ring_attention import full_causal_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        return {"skipped": "pallas kernel needs TPU"}
+    B, H, S, D = 4, 16, 2048, 128
+    iters = int(os.environ.get("BENCH_ATTN_ITERS", "50"))
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (
+        jax.random.normal(kk, (B, H, S, D), dtype=jnp.bfloat16) for kk in ks
+    )
+    rtt = _fetch_rtt()
+
+    def timed(fn):
+        vgrad = jax.value_and_grad(
+            lambda a: fn(a, k, v).astype(jnp.float32).mean()
+        )
+
+        @jax.jit
+        def loop(a):
+            def body(a, _):
+                loss, da = vgrad(a)
+                # data dependency chains the iterations sequentially
+                return a + (1e-6 * loss).astype(a.dtype) * da, loss
+
+            a, losses = jax.lax.scan(body, a, None, length=iters)
+            return losses[-1]
+
+        _ = float(loop(q))  # compile + warmup
+        t0 = time.perf_counter()
+        _ = float(loop(q))
+        return max(1e-9, time.perf_counter() - t0 - rtt) / iters
+
+    t_flash = timed(lambda a, b, c: flash_attention(a, b, c, causal=True))
+    t_naive = timed(full_causal_attention)
+    return {
+        "shape_bhsd": [B, H, S, D],
+        "iters": iters,
+        "flash_fwdbwd_ms": round(1e3 * t_flash, 3),
+        "naive_fwdbwd_ms": round(1e3 * t_naive, 3),
+        "flash_speedup": round(t_naive / t_flash, 2),
+    }
+
+
+def bench_ckpt() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
     from dlrover_tpu.ckpt.engine import CheckpointEngine
     from dlrover_tpu.ckpt.shm_handler import shm_name
@@ -33,16 +206,17 @@ def main() -> None:
     from dlrover_tpu.models import llama
 
     job = f"bench{os.getpid()}"
-    ckpt_dir = os.environ.get("BENCH_CKPT_DIR", f"/tmp/dlrtpu_bench_{os.getpid()}")
+    ckpt_dir = os.environ.get(
+        "BENCH_CKPT_DIR", f"/tmp/dlrtpu_bench_{os.getpid()}"
+    )
     os.makedirs(ckpt_dir, exist_ok=True)
 
-    # Default ~0.5 GB of bf16 state: big enough that the blocking-time ratio
-    # is transfer-dominated (what the reference measures), small enough to
-    # finish under the dev tunnel whose host↔device link moves ~20 MB/s
-    # (real v5e PCIe/DMA does GB/s — same ratio, scaled). Override via env:
-    # BENCH_DIM=1600 BENCH_LAYERS=48 reproduces GPT-2-xl scale on real pods.
-    dim = int(os.environ.get("BENCH_DIM", "1024"))
-    layers = int(os.environ.get("BENCH_LAYERS", "8"))
+    # ~0.5 GB of bf16 state: big enough that the blocking-time ratio is
+    # transfer-dominated (what the reference measures), small enough to
+    # finish under the dev tunnel (~15 MB/s D2H). BENCH_CKPT_DIM=1600
+    # BENCH_CKPT_LAYERS=48 reproduces GPT-2-xl scale on real pods.
+    dim = int(os.environ.get("BENCH_CKPT_DIM", "1024"))
+    layers = int(os.environ.get("BENCH_CKPT_LAYERS", "8"))
     config = llama.LlamaConfig(
         vocab_size=50304, dim=dim, n_layers=layers,
         n_heads=max(1, dim // 64), n_kv_heads=max(1, dim // 64),
@@ -87,19 +261,44 @@ def main() -> None:
     host_state = jax.device_get(params)
     t0 = time.perf_counter()
     with open(sync_path, "wb") as f:
-        import numpy as np
-
         for leaf in jax.tree.leaves(host_state):
             f.write(np.ascontiguousarray(leaf).view(np.uint8).tobytes())
         f.flush()
         os.fsync(f.fileno())
     t_sync = time.perf_counter() - t0
 
-    # restore from shm back onto the device
+    # measure the tunnel's H2D link rate: restore can't beat
+    # bytes/link_rate no matter how it's scheduled. First put+fetch warms
+    # the index-op compile; the second is the measurement.
+    rtt = _fetch_rtt()
+    probe_mb = 64
+    h2d_mbps = 0.0
+    for _ in range(2):
+        probe = np.random.randn(probe_mb * 131072).astype(np.float32)
+        t0 = time.perf_counter()
+        d = jax.device_put(probe)
+        _ = float(d[0])
+        h2d_mbps = probe_mb / max(1e-9, time.perf_counter() - t0 - rtt)
+        del d, probe
+
+    def force_fetch(tree) -> float:
+        """One chained fetch that forces every leaf's transfer
+        (block_until_ready returns early on the tunnel backend)."""
+        return float(jnp.sum(jnp.stack([
+            x.ravel()[0].astype(jnp.float32)
+            for x in jax.tree.leaves(tree)
+        ])))
+
+    # warm the fetch chain's op compiles on identically-shaped arrays so
+    # the timed region below measures transfers, not compilation
+    force_fetch(params)
+
+    # restore from shm back onto the device (threaded shm-read + H2D,
+    # engine.py _assemble)
     t0 = time.perf_counter()
     restored, step = engine.load(params)
-    jax.block_until_ready(restored)
-    t_restore = time.perf_counter() - t0
+    force_fetch(restored)
+    t_restore = max(0.0, time.perf_counter() - t0 - rtt)
     if step != 1:
         raise RuntimeError(f"restored step {step} != 1")
     # honesty check: the async-drained snapshot restores bit-exact
@@ -109,27 +308,46 @@ def main() -> None:
         raise RuntimeError("restored state mismatch")
 
     speedup = t_sync / t_block if t_block > 0 else float("inf")
-    result = {
-        "metric": "flash_ckpt_blocking_speedup_vs_sync_disk",
-        "value": round(speedup, 2),
-        "unit": "x",
-        "vs_baseline": round(speedup / 10.0, 3),
-        "detail": {
-            "state_gb": round(nbytes / 1e9, 2),
-            "t_block_s": round(t_block, 4),
-            "t_drain_s": round(t_drain, 3),
-            "t_sync_s": round(t_sync, 3),
-            "t_restore_s": round(t_restore, 3),
-            "device": str(jax.devices()[0]),
-        },
+    floor_s = (nbytes / 1e6) / h2d_mbps
+    out = {
+        "state_gb": round(nbytes / 1e9, 2),
+        "t_block_s": round(t_block, 4),
+        "t_drain_s": round(t_drain, 3),
+        "t_sync_s": round(t_sync, 3),
+        "t_restore_s": round(t_restore, 3),
+        # dev-tunnel context: restore is H2D-bound; the link floor is what
+        # an ideal scheduler would hit (real v5e DMA moves GB/s, where the
+        # same path restores this state in <1s)
+        "h2d_link_mbps": round(h2d_mbps, 1),
+        "t_restore_link_floor_s": round(floor_s, 3),
+        "restore_link_efficiency": round(floor_s / max(t_restore, 1e-9), 3),
+        "blocking_speedup_vs_sync_disk": round(speedup, 2),
+        "vs_reference_10x_claim": round(speedup / 10.0, 3),
     }
-    print(json.dumps(result))
 
     # cleanup
     unlink_shared_memory(shm_name(job, 0, 0))
     import shutil
 
     shutil.rmtree(ckpt_dir, ignore_errors=True)
+    del params, restored, host_state
+    gc.collect()
+    return out
+
+
+def main() -> None:
+    train = bench_train()
+    attn = bench_attention()
+    ckpt = bench_ckpt()
+    result = {
+        "metric": "llama_train_mfu_bf16",
+        "value": train["mfu_pct"],
+        "unit": "%",
+        # 40% MFU = the commonly-cited good bar for dense LLM training
+        "vs_baseline": round(train["mfu_pct"] / 40.0, 3),
+        "detail": {"train": train, "attn": attn, "ckpt": ckpt},
+    }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -66,6 +66,10 @@ class LlamaConfig(AttentionConfigMixin):
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # remat policy: "dots" saves matmul outputs and recomputes only the
+    # cheap elementwise/attention-softmax work in backward (~5% FLOPs
+    # overhead vs ~33% for full per-layer remat); None = save nothing
+    remat_policy: Optional[str] = "dots"
     # long-context strategy applied when the sp mesh axis is >1:
     # None = no sequence-parallel attention;
     # "ring" = K/V ppermute ring (unbounded S, sp hops);
@@ -248,6 +252,16 @@ attention_block = _attention
 rms_norm = _rms_norm
 
 
+def _remat_policy(config):
+    """Map the config's remat_policy name to a jax.checkpoint policy."""
+    name = getattr(config, "remat_policy", None)
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name is None:
+        return None
+    raise ValueError(f"unknown remat_policy {name!r}")
+
+
 def _mlp(x, layer):
     gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, layer["w1"]))
     up = jnp.einsum("bsd,df->bsf", x, layer["w3"])
@@ -276,7 +290,9 @@ def forward(
 
     scan_fn = layer_fn
     if c.remat:
-        scan_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+        scan_fn = jax.checkpoint(
+            layer_fn, prevent_cse=False, policy=_remat_policy(c),
+        )
     x, _ = jax.lax.scan(scan_fn, x, params["layers"])
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
     logits = jnp.einsum(
@@ -351,7 +367,9 @@ def forward_pp(
 
     scan_fn = layer_fn
     if c.remat:
-        scan_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+        scan_fn = jax.checkpoint(
+            layer_fn, prevent_cse=False, policy=_remat_policy(c),
+        )
 
     def stage_fn(layer_group, h):
         h, _ = jax.lax.scan(scan_fn, h, layer_group)

@@ -53,6 +53,8 @@ class MoEConfig(_llama.AttentionConfigMixin):
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # same semantics as LlamaConfig: "dots" | None
+    remat_policy: Optional[str] = "dots"
     # same semantics as LlamaConfig: None | "ring" | "ulysses"
     sp_attention: Optional[str] = None
     use_ring_attention: bool = False  # legacy alias for sp_attention="ring"
@@ -221,7 +223,10 @@ def forward(
 
     scan_fn = layer_fn
     if c.remat:
-        scan_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+        scan_fn = jax.checkpoint(
+            layer_fn, prevent_cse=False,
+            policy=_llama._remat_policy(c),
+        )
     (x, aux_sum), _ = jax.lax.scan(
         scan_fn, (x, jnp.zeros((), jnp.float32)), params["layers"]
     )

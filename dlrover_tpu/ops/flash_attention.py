@@ -436,13 +436,19 @@ def flash_attention(
     q, k, v,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     return_lse: bool = False,
     interpret: Optional[bool] = None,
 ):
     """Fused blockwise attention. q/k/v: (B, H, S, D); GQA callers repeat
     KV heads first (XLA fuses the broadcast into the block loads).
+
+    Default blocks are empirically tuned on v5e (fwd+bwd at B4 H16 S2048
+    D128: 512×1024 is 3.3× the fused-dense XLA path and within 10% of the
+    best measured combo; 128×128 was 6× slower — grid-overhead-bound).
+    Blocks are clamped to the sequence length, so short-S callers are
+    unaffected.
 
     Returns ``o`` (B, H, Sq, D), plus the per-row logsumexp (B, H, Sq) f32
     when ``return_lse`` — the handle ring attention uses to merge partials.

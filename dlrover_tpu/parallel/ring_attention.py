@@ -169,8 +169,8 @@ def ring_attention(
     batch_spec=P(("dp", "fsdp"), "tp", "sp", None),
     scale: Optional[float] = None,
     use_pallas: Optional[bool] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
 ):
     """Causal attention with the sequence axis sharded over ``sp_axis``.
 
@@ -205,8 +205,8 @@ def sharded_flash_attention(
     mesh: Mesh,
     batch_spec=P(("dp", "fsdp"), "tp", None, None),
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
 ):
     """Causal flash attention with batch sharded over dp/fsdp and heads
     over tp (sequence resident per device — the short-context layout).

@@ -97,8 +97,8 @@ def ulysses_attention(
     batch_spec=P(("dp", "fsdp"), "tp", "sp", None),
     scale: Optional[float] = None,
     use_pallas: Optional[bool] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
 ):
     """Causal attention with S sharded over ``sp_axis``, computed by
     head-scatter/seq-gather all-to-all (DeepSpeed-Ulysses style).

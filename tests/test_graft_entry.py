@@ -33,6 +33,10 @@ def test_bench_smoke_cpu(tmp_path):
         "JAX_PLATFORMS": "cpu",
         "BENCH_DIM": "128",
         "BENCH_LAYERS": "2",
+        "BENCH_SEQ": "128",
+        "BENCH_STEPS": "2",
+        "BENCH_CKPT_DIM": "256",
+        "BENCH_CKPT_LAYERS": "2",
         "BENCH_CKPT_DIR": str(tmp_path / "bench"),
     })
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -45,4 +49,8 @@ def test_bench_smoke_cpu(tmp_path):
     line = proc.stdout.strip().splitlines()[-1]
     result = json.loads(line)
     assert {"metric", "value", "unit", "vs_baseline"} <= set(result)
-    assert result["value"] > 0
+    # headline MFU is 0 on CPU (no published peak); the sub-benches must
+    # still carry real numbers
+    assert result["value"] >= 0
+    assert result["detail"]["train"]["tokens_per_s"] > 0
+    assert result["detail"]["ckpt"]["blocking_speedup_vs_sync_disk"] > 0
