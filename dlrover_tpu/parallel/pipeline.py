@@ -130,19 +130,17 @@ def pipeline_apply(
         )
         return ybuf[None]  # (1, M, ...) per stage → (S, M, ...) stacked
 
-    from jax.experimental.shard_map import shard_map
-
     # jit here (inlined under an outer jit) — per-tick jax.checkpoint
     # inside shard_map is trace-only
     out_spec = (
         P(axis, None, batch_axes) if batch_axes else P(axis)
     )
-    out = jax.jit(shard_map(
+    out = jax.jit(jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis), x_spec),
         out_specs=out_spec,
-        check_rep=False,
+        check_vma=False,
     ))(stage_params, microbatches)
     return out[-1]
 

@@ -25,7 +25,12 @@ DEFAULT_RULES: Dict[str, Optional[object]] = {
     "vocab": "tp",
     "expert": "ep",
     "stage": "pp",
-    "layers": None,
+    # depth-stacked layer params live stage-major: the leading layer dim
+    # shards over pp so pipeline_apply's shard_map in_spec P("pp") is
+    # satisfied by a local reshape + fsdp all-gather instead of XLA's
+    # "involuntary full rematerialization" (replicate-then-repartition).
+    # On pp=1 meshes the axis has size 1 — a no-op.
+    "layers": "pp",
     "norm": None,
     "head_dim": None,
 }

@@ -64,7 +64,9 @@ class TestMeshPlan:
 class TestShardingRules:
     def test_spec_mapping(self):
         assert spec_for(("embed", "heads")) == P("fsdp", "tp")
-        assert spec_for(("layers", "norm")) == P(None, None)
+        # layers are stage-major (pp) so pipeline shard_map needs no
+        # repartition; on pp=1 meshes the axis is size 1 — a no-op
+        assert spec_for(("layers", "norm")) == P("pp", None)
         assert spec_for(("batch", "seq")) == P(("dp", "fsdp"), "sp")
 
     def test_shard_llama_params(self):
@@ -76,7 +78,7 @@ class TestShardingRules:
             mesh, params, llama.param_logical_axes(config)
         )
         wq = sharded["layers"]["wq"]
-        assert wq.sharding.spec == P(None, "fsdp", "tp")
+        assert wq.sharding.spec == P("pp", "fsdp", "tp")
         # each device holds 1/8 of wq
         assert wq.addressable_shards[0].data.size == wq.size // 8
 

