@@ -57,6 +57,7 @@ class NodeExitReason:
     HARDWARE_ERROR = "hardware_error"
     PREEMPTED = "preempted"
     RELAUNCHED = "relaunched"
+    NO_HEARTBEAT = "no_heartbeat"
     UNKNOWN = "unknown"
 
 

@@ -81,6 +81,9 @@ class Node:
     rank: int = -1
     name: str = ""
     host: str = ""
+    # hosts this node must NOT be scheduled onto (hardware-error relaunch
+    # avoids the faulty host; rendered as nodeAffinity NotIn by k8s specs)
+    avoid_hosts: list = field(default_factory=list)
     status: str = NodeStatus.INITIAL
     exit_reason: str = ""
     relaunch_count: int = 0

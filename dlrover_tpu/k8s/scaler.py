@@ -166,6 +166,12 @@ class PodScaler(Scaler):
         pod = specs.worker_pod(
             self._job, node.id, self._spec, self._master_addr,
             relaunch_count=node.relaunch_count, namespace=self._namespace,
+            resource_override=(
+                node.config_resource
+                if node.config_resource.memory_mb or node.config_resource.cpu
+                else None
+            ),
+            avoid_hosts=node.avoid_hosts,
         )
         name = pod["metadata"]["name"]
         # delete stale predecessors only (older generations); the same

@@ -43,7 +43,12 @@ def worker_pod(
     master_addr: str,
     relaunch_count: int = 0,
     namespace: str = "default",
+    resource_override=None,
+    avoid_hosts=None,
 ) -> Dict:
+    """``resource_override``: a NodeResource carrying per-node adjustments
+    (the job manager's OOM recovery grows memory_mb); ``avoid_hosts``:
+    hostnames excluded via nodeAffinity NotIn (hardware-error relaunch)."""
     env = [
         {"name": EnvKey.JOB_NAME, "value": job_name},
         {"name": EnvKey.MASTER_ADDR, "value": master_addr},
@@ -52,10 +57,17 @@ def worker_pod(
         {"name": "NODE_RANK", "value": str(node_id)},
     ]
     env += [{"name": k, "value": v} for k, v in spec.env.items()]
+    memory_mb = spec.memory_mb
+    cpu = spec.cpu
+    if resource_override is not None:
+        memory_mb = max(memory_mb, int(
+            getattr(resource_override, "memory_mb", 0) or 0
+        ))
+        cpu = max(cpu, getattr(resource_override, "cpu", 0) or 0)
     resources = {
         "requests": {
-            "cpu": str(spec.cpu),
-            "memory": f"{spec.memory_mb}Mi",
+            "cpu": str(cpu),
+            "memory": f"{memory_mb}Mi",
         },
         "limits": {},
     }
@@ -70,6 +82,27 @@ def worker_pod(
         )
         if spec.topology:
             node_selector["cloud.google.com/gke-tpu-topology"] = spec.topology
+    pod_spec = {
+        "restartPolicy": "Never",  # relaunch is the master's decision
+        "nodeSelector": node_selector,
+        "containers": [{
+            "name": "worker",
+            "image": spec.image,
+            "command": list(spec.command),
+            "env": env,
+            "resources": resources,
+        }],
+    }
+    if avoid_hosts:
+        pod_spec["affinity"] = {"nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": [{
+                    "key": "kubernetes.io/hostname",
+                    "operator": "NotIn",
+                    "values": list(avoid_hosts),
+                }]}],
+            },
+        }}
     return {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -83,17 +116,7 @@ def worker_pod(
                 LABEL_GENERATION: str(relaunch_count),
             },
         },
-        "spec": {
-            "restartPolicy": "Never",  # relaunch is the master's decision
-            "nodeSelector": node_selector,
-            "containers": [{
-                "name": "worker",
-                "image": spec.image,
-                "command": list(spec.command),
-                "env": env,
-                "resources": resources,
-            }],
-        },
+        "spec": pod_spec,
     }
 
 

@@ -36,10 +36,19 @@ def pod_exit_reason(pod: Dict) -> str:
         term = (cs.get("state", {}) or {}).get("terminated") or {}
         if term.get("reason") == "OOMKilled":
             return NodeExitReason.OOM
-        if term.get("exitCode") not in (None, 0):
-            # generic crash: relaunchable — FATAL_ERROR (never relaunch)
-            # is reserved for explicitly-reported unretryable failures
+        code = term.get("exitCode")
+        if code in (137, 143, 130, 129):
+            # signal kills (SIGKILL/SIGTERM/SIGINT/SIGHUP): something
+            # external took the pod — KILLED relaunches without a budget
+            # check, so it must NOT cover ordinary crashes
             return NodeExitReason.KILLED
+        if code not in (None, 0):
+            # generic crash: relaunchable on budget (UNKNOWN), so a
+            # crash-looping worker eventually exhausts max_relaunch and
+            # aborts instead of cycling forever; FATAL_ERROR (never
+            # relaunch) stays reserved for explicitly-reported
+            # unretryable failures
+            return NodeExitReason.UNKNOWN
     return NodeExitReason.UNKNOWN
 
 

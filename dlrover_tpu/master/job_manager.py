@@ -10,6 +10,7 @@ heartbeat monitoring and relaunch decisions, and a pluggable
 
 import threading
 import time
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.config import get_context
@@ -36,6 +37,43 @@ class NodeEvent:
         self.node = node
 
 
+@dataclass
+class RelaunchDecision:
+    """Outcome of the relaunch ladder (reference
+    dist_job_manager.py:905 ``_should_relaunch`` returns bool + side
+    effects; here the side effects are explicit)."""
+
+    relaunch: bool
+    reason: str = ""
+    ignore: bool = False           # neither relaunch nor abort (peer
+    #                                already covered by a unit relaunch)
+    grow_memory: bool = False      # OOM recovery: scale memory_mb up
+    fresh_host: bool = False       # hardware error: avoid the same host
+
+
+@dataclass
+class RolePolicy:
+    """Per-role failure handling (reference per-role managers
+    node/worker.py:42,74,108 — ChiefManager/EvaluatorManager/WorkerManager).
+    TPU redesign: one SPMD worker role is the common case; auxiliary roles
+    (e.g. an evaluator or a chief-like coordinator in the unified runtime)
+    differ only in policy, not in manager machinery."""
+
+    critical: bool = False         # failure fails the job (chief semantics)
+    max_relaunch: Optional[int] = None  # None = job default
+    relaunch_always: bool = False  # relaunch even on fatal errors
+
+
+class PendingStrategy:
+    """What to do with a node stuck in PENDING beyond the timeout
+    (reference training_node.py:120 get_pending_timeout +
+    find_pending_node_caused_training_hang: wait / early-stop)."""
+
+    WAIT = "wait"    # keep waiting (reference wait_pending_relaunch)
+    SKIP = "skip"    # release it and train with the survivors (elastic)
+    FAIL = "fail"    # stop the job early — can't reach min world size
+
+
 class JobManager:
     """Owns the node table and decides relaunch/abort.
 
@@ -50,6 +88,12 @@ class JobManager:
         node_num: int,
         scaler=None,
         max_relaunch: Optional[int] = None,
+        node_unit: int = 1,
+        min_nodes: int = 1,
+        pending_timeout_s: Optional[float] = None,
+        pending_strategy: str = PendingStrategy.SKIP,
+        relaunch_always: bool = False,
+        role_policies: Optional[Dict[str, RolePolicy]] = None,
     ):
         ctx = get_context()
         self._job_name = job_name
@@ -58,6 +102,22 @@ class JobManager:
         self._max_relaunch = (
             ctx.node_max_relaunch if max_relaunch is None else max_relaunch
         )
+        # TPU slices are scheduled in host units (a v5e-16 slice = 4 hosts
+        # on one ICI mesh): one dead host invalidates its whole unit, so
+        # relaunch operates on units (reference: node-unit truncation,
+        # rdzv_manager; relaunch side is TPU-specific)
+        self._node_unit = max(1, node_unit)
+        self._min_nodes = max(1, min_nodes)
+        self._pending_timeout_s = (
+            getattr(ctx, "pending_timeout_s", 600.0)
+            if pending_timeout_s is None else pending_timeout_s
+        )
+        self._pending_strategy = pending_strategy
+        self._relaunch_always = relaunch_always
+        self._role_policies: Dict[str, RolePolicy] = {
+            NodeType.WORKER: RolePolicy(),
+            **(role_policies or {}),
+        }
         self._nodes: Dict[int, Node] = {}
         self._lock = threading.Lock()
         self._stopped = threading.Event()
@@ -152,9 +212,12 @@ class JobManager:
         heartbeat-timeout monitor, which must not fire during the silent
         window between pre-check and the agent's run loop (network check)."""
         node = self.get_node(node_id)
-        node.heartbeat_time = timestamp or time.time()
         if running and node.status in (NodeStatus.INITIAL, NodeStatus.PENDING):
             node.update_status(NodeStatus.RUNNING)
+        # stamp AFTER the RUNNING promotion so the first heartbeat lands
+        # >= start_time — otherwise the stale-heartbeat guard in
+        # check_heartbeats would exempt a node that heartbeat exactly once
+        node.heartbeat_time = timestamp or time.time()
 
     def fail_job(self, reason: str) -> None:
         """Fail the whole job (pre-check failure, abort actions)."""
@@ -185,28 +248,153 @@ class JobManager:
         elif node.status == NodeStatus.SUCCEEDED:
             self._check_job_completed()
 
+    def _should_relaunch(self, node: Node) -> RelaunchDecision:
+        """The relaunch ladder (reference dist_job_manager.py:905–988),
+        exit-reason-driven:
+
+        - job already failed/stopping → never;
+        - critical role (chief semantics) → never;
+        - RELAUNCHED → the unit relaunch already covers it;
+        - FATAL_ERROR → never, unless the role opts into relaunch_always;
+        - KILLED/PREEMPTED → relaunch for free (the platform took the
+          host; the node did nothing wrong — reference: KILLED bypasses
+          the budget check);
+        - OOM → grow host memory and retry on budget (reference
+          adjust_oom_resource);
+        - HARDWARE_ERROR (chip/ICI fault) → retry on budget, on a fresh
+          host;
+        - anything else → retry on budget.
+        """
+        policy = self._role_policies.get(node.type, RolePolicy())
+        budget = (
+            policy.max_relaunch
+            if policy.max_relaunch is not None else node.max_relaunch_count
+        )
+        if self._job_stage in (JobStage.FAILED, JobStage.SUCCEEDED):
+            return RelaunchDecision(False, "job is stopping", ignore=True)
+        if not node.relaunchable or node.is_released:
+            return RelaunchDecision(False, "node not relaunchable")
+        if policy.critical:
+            return RelaunchDecision(False, f"critical role {node.type}")
+        reason = node.exit_reason
+        if reason == NodeExitReason.RELAUNCHED:
+            return RelaunchDecision(
+                False, "already being relaunched", ignore=True,
+            )
+        if reason == NodeExitReason.FATAL_ERROR:
+            if not (self._relaunch_always or policy.relaunch_always):
+                return RelaunchDecision(False, "fatal error")
+            return RelaunchDecision(
+                node.relaunch_count < budget, "relaunch_always",
+            )
+        if reason in (NodeExitReason.KILLED, NodeExitReason.PREEMPTED):
+            # the platform took the host; no budget check (reference:
+            # KILLED bypasses it) — the counter still advances below so
+            # replacement pods get fresh names
+            return RelaunchDecision(True, reason)
+        if reason == NodeExitReason.OOM:
+            return RelaunchDecision(
+                node.relaunch_count < budget, "oom", grow_memory=True,
+            )
+        if reason == NodeExitReason.HARDWARE_ERROR:
+            return RelaunchDecision(
+                node.relaunch_count < budget, "hardware error",
+                fresh_host=True,
+            )
+        return RelaunchDecision(
+            node.relaunch_count < budget, reason or "exit",
+        )
+
+    # host-memory growth factor + ceiling for OOM recovery (reference
+    # NodeResourceLimit.MAX_MEMORY + adjust_oom_resource)
+    OOM_MEMORY_FACTOR = 1.5
+    OOM_MEMORY_CAP_MB = 512 * 1024
+
     def _handle_node_failure(self, node: Node) -> None:
+        decision = self._should_relaunch(node)
         # without a scaler (standalone/local master) nobody can replace the
         # node — a relaunchable failure is still a fatal one here
-        if node.should_relaunch() and self._scaler is not None:
+        if decision.ignore:
+            return
+        if decision.relaunch and self._scaler is not None:
             node.inc_relaunch_count()
+            if decision.grow_memory and node.config_resource.memory_mb:
+                node.config_resource.memory_mb = min(
+                    self.OOM_MEMORY_CAP_MB,
+                    node.config_resource.memory_mb * self.OOM_MEMORY_FACTOR,
+                )
+                logger.info(
+                    "node %s OOM — growing memory to %.0f MB",
+                    node.id, node.config_resource.memory_mb,
+                )
+            if decision.fresh_host and node.host:
+                # scheduling hint consumed by specs.worker_pod (nodeAffinity
+                # NotIn) — the replacement pod avoids the faulty host
+                node.avoid_hosts.append(node.host)
+                node.host = ""
             logger.info(
-                "relaunching node %s (attempt %s/%s)",
-                node.id, node.relaunch_count, node.max_relaunch_count,
+                "relaunching node %s (%s, attempt %s/%s)",
+                node.id, decision.reason, node.relaunch_count,
+                node.max_relaunch_count,
             )
-            node.update_status(NodeStatus.PENDING)
-            self._scaler.relaunch_node(node)
+            self._relaunch_unit(node)
         else:
             logger.error(
-                "node %s failed beyond relaunch budget — aborting job",
-                node.id,
+                "node %s failed permanently (%s) — aborting job",
+                node.id, decision.reason,
             )
             self._job_stage = JobStage.FAILED
             self.enqueue_action(
                 JobAbortAction(
-                    reason=f"node {node.id} exhausted relaunch budget",
+                    reason=(
+                        f"node {node.id} failed: {decision.reason}"
+                    ),
                 )
             )
+
+    def _unit_peers(self, node: Node) -> List[Node]:
+        """Nodes sharing the failed node's scheduling unit (ICI slice)."""
+        if self._node_unit <= 1 or node.rank < 0:
+            return [node]
+        unit = node.rank // self._node_unit
+        with self._lock:
+            return [
+                n for n in self._nodes.values()
+                if n.rank >= 0 and n.rank // self._node_unit == unit
+                and not n.is_released
+            ]
+
+    def _relaunch_unit(self, node: Node) -> None:
+        """Relaunch the failed node together with its slice peers: a v5e
+        unit is one ICI mesh, so surviving peers of a dead host cannot
+        train anyway (reference relaunches single pods; node-unit-aware
+        relaunch is the TPU redesign — SURVEY §2.2)."""
+        for peer in self._unit_peers(node):
+            if peer.id != node.id:
+                if NodeStatus.terminal(peer.status):
+                    continue
+                # mark so the peer's own FAILED event (when the scaler
+                # kills it) doesn't trigger a second unit relaunch
+                peer.exit_reason = NodeExitReason.RELAUNCHED
+                peer.update_status(NodeStatus.FAILED)
+                # advance the generation: the scaler replaces pods only
+                # when the name (which embeds relaunch_count) changes —
+                # without this the peer's old pod would survive untouched
+                peer.inc_relaunch_count()
+            peer.update_status(NodeStatus.PENDING)
+            peer.heartbeat_time = 0.0
+            peer.start_time = None
+            # the pending-timeout clock must restart for the new pod
+            peer.create_time = time.time()
+            self._scaler.relaunch_node(peer)
+
+    def release_node(self, node: Node, reason: str = "") -> None:
+        """Give up on a node without failing the job (elastic skip)."""
+        logger.warning("releasing node %s (%s)", node.id, reason)
+        node.is_released = True
+        node.relaunchable = False
+        if self._scaler is not None and hasattr(self._scaler, "remove_node"):
+            self._scaler.remove_node(node)
 
     def _check_job_completed(self) -> None:
         with self._lock:
@@ -226,20 +414,72 @@ class JobManager:
     def _monitor_heartbeats(self) -> None:
         ctx = get_context()
         while not self._stopped.wait(ctx.heartbeat_interval_s):
-            now = time.time()
-            for node in list(self._nodes.values()):
-                if node.status != NodeStatus.RUNNING:
-                    continue
+            self.check_heartbeats()
+            self.check_pending_nodes()
+
+    def check_heartbeats(self, now: Optional[float] = None) -> None:
+        ctx = get_context()
+        now = now or time.time()
+        for node in list(self._nodes.values()):
+            if node.status != NodeStatus.RUNNING:
+                continue
+            if (
+                node.heartbeat_time > 0
+                and now - node.heartbeat_time > ctx.heartbeat_timeout_s
+            ):
                 if (
-                    node.heartbeat_time > 0
-                    and now - node.heartbeat_time > ctx.heartbeat_timeout_s
+                    node.start_time
+                    and node.heartbeat_time < node.start_time
                 ):
-                    logger.warning(
-                        "node %s heartbeat timed out (%.0fs) — marking failed",
-                        node.id, now - node.heartbeat_time,
-                    )
-                    node.exit_reason = NodeExitReason.KILLED
-                    self.update_node_status(node.id, NodeStatus.FAILED)
+                    # stale heartbeat predating the (re)start — the agent
+                    # hasn't begun its loop yet; not a dead node
+                    # (reference dist_job_manager.py:495 skip judgement)
+                    continue
+                logger.warning(
+                    "node %s heartbeat timed out (%.0fs) — marking failed",
+                    node.id, now - node.heartbeat_time,
+                )
+                node.exit_reason = NodeExitReason.NO_HEARTBEAT
+                self.update_node_status(node.id, NodeStatus.FAILED)
+
+    def check_pending_nodes(self, now: Optional[float] = None) -> None:
+        """Apply the pending-timeout strategy (reference
+        find_pending_node_caused_training_hang + pending timeout).
+
+        A node stuck PENDING beyond the timeout either gets skipped
+        (released; the survivors re-rendezvous at a smaller world) or
+        fails the job early when the world can't reach ``min_nodes`` —
+        waiting forever on an unschedulable pod is the hang the reference
+        diagnoses."""
+        if self._pending_strategy == PendingStrategy.WAIT:
+            return
+        now = now or time.time()
+        for node in list(self._nodes.values()):
+            if node.status != NodeStatus.PENDING or node.is_released:
+                continue
+            pending_s = now - (node.create_time or now)
+            if pending_s <= self._pending_timeout_s:
+                continue
+            alive = sum(
+                1 for n in self._nodes.values()
+                if not n.is_released and n.status in (
+                    NodeStatus.RUNNING, NodeStatus.PENDING,
+                    NodeStatus.INITIAL,
+                ) and n.id != node.id
+            )
+            if (
+                self._pending_strategy == PendingStrategy.FAIL
+                or alive < self._min_nodes
+            ):
+                self.fail_job(
+                    f"node {node.id} pending for {pending_s:.0f}s "
+                    f"(> {self._pending_timeout_s:.0f}s) and the world "
+                    f"cannot reach min_nodes={self._min_nodes}"
+                )
+                return
+            self.release_node(
+                node, f"pending {pending_s:.0f}s > timeout",
+            )
 
     # -- diagnosis action queue (master → agent via heartbeat replies) -----
 

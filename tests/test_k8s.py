@@ -93,8 +93,13 @@ def test_pod_exit_reason_classification():
     assert pod_exit_reason({"status": {"containerStatuses": [
         {"state": {"terminated": {"reason": "OOMKilled", "exitCode": 137}}}
     ]}}) == NodeExitReason.OOM
+    # generic crash → UNKNOWN (budget-consuming relaunch); only signal
+    # kills get the budget-free KILLED classification
     assert pod_exit_reason({"status": {"containerStatuses": [
         {"state": {"terminated": {"exitCode": 1}}}
+    ]}}) == NodeExitReason.UNKNOWN
+    assert pod_exit_reason({"status": {"containerStatuses": [
+        {"state": {"terminated": {"exitCode": 137}}}
     ]}}) == NodeExitReason.KILLED
 
 
