@@ -43,6 +43,11 @@ class ElasticJobReconciler:
         self._pod_scalers: Dict[str, PodScaler] = {}
         self._stopped = threading.Event()
         self._threads = []
+        # serializes reconcile passes: the job watch, scaleplan watch and
+        # the main-loop resync all call into reconcile concurrently — the
+        # get-then-create checks (master pod/service, _pod_scalers) are
+        # not idempotent under interleaving
+        self._reconcile_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -82,6 +87,10 @@ class ElasticJobReconciler:
                 self._stopped.wait(1.0)
 
     def _reconcile_job(self, job: Dict) -> None:
+        with self._reconcile_lock:
+            self._reconcile_job_locked(job)
+
+    def _reconcile_job_locked(self, job: Dict) -> None:
         name = job["metadata"]["name"]
         spec = job.get("spec", {})
         phase = job.get("status", {}).get("phase", crd.JobPhase.PENDING)
@@ -128,6 +137,10 @@ class ElasticJobReconciler:
         logger.info("reconcile %s: suspended", name)
 
     def _cleanup_job(self, job: Dict) -> None:
+        with self._reconcile_lock:
+            self._cleanup_job_locked(job)
+
+    def _cleanup_job_locked(self, job: Dict) -> None:
         name = job["metadata"]["name"]
         scaler = self._pod_scalers.pop(name, None)
         if scaler is not None:
@@ -181,6 +194,10 @@ class ElasticJobReconciler:
                 self._stopped.wait(1.0)
 
     def _execute_scaleplan(self, plan_obj: Dict) -> None:
+        with self._reconcile_lock:
+            self._execute_scaleplan_locked(plan_obj)
+
+    def _execute_scaleplan_locked(self, plan_obj: Dict) -> None:
         spec = plan_obj.get("spec", {})
         job_name = spec.get("ownerJob", "")
         job = self._api.get_custom_object(
@@ -234,6 +251,16 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser("dlrover_tpu elasticjob operator")
     parser.add_argument("--namespace", default="default")
     parser.add_argument("--master-port", type=int, default=50001)
+    parser.add_argument(
+        "--resync-seconds", type=int, default=30,
+        help="period of the level-triggered full re-list pass (covers "
+             "watch events lost to apiserver hiccups)",
+    )
+    parser.add_argument(
+        "--liveness-file", default="/tmp/dtpu-operator-alive",
+        help="heartbeat file touched each resync tick (the Deployment's "
+             "exec liveness probe, deploy/manager/manager.yaml)",
+    )
     args = parser.parse_args(argv)
     reconciler = ElasticJobReconciler(
         RealK8sApi(), namespace=args.namespace,
@@ -243,7 +270,16 @@ def main(argv=None) -> int:
     logger.info("elasticjob operator watching namespace %s", args.namespace)
     try:
         while True:
-            time.sleep(60)
+            time.sleep(max(1, args.resync_seconds))
+            try:
+                for job in reconciler._api.list_custom_objects(
+                    args.namespace, crd.ELASTICJOB_PLURAL
+                ):
+                    reconciler._reconcile_job(job)
+                with open(args.liveness_file, "w") as f:
+                    f.write(str(time.time()))
+            except Exception as e:  # noqa: BLE001 — keep the controller up
+                logger.warning("resync pass failed: %r", e)
     except KeyboardInterrupt:
         reconciler.stop()
     return 0
