@@ -335,17 +335,52 @@ def bench_ckpt() -> dict:
     return out
 
 
+def bench_goodput() -> dict:
+    """Fault-injected goodput: the two-agent chaos scenario
+    (examples/chaos_goodput.py — kill one agent, shrink, resume, rejoin)
+    on the CPU backend; orchestration, not the chip, is what's measured.
+    BASELINE driver metric: goodput %% under injected faults (>=95%%)."""
+    import subprocess
+
+    if os.environ.get("BENCH_SKIP_CHAOS"):
+        return {"skipped": "BENCH_SKIP_CHAOS set"}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo, "examples", "chaos_goodput.py"),
+                "--steps", "60", "--step-time", "0.15",
+                "--kill-at-step", "10",
+            ],
+            env=env, capture_output=True, text=True, timeout=360, cwd=repo,
+        )
+        if proc.returncode != 0:
+            return {"error": proc.stderr[-500:]}
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        out.pop("segments", None)
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must still emit a line
+        return {"error": repr(e)}
+
+
 def main() -> None:
     train = bench_train()
     attn = bench_attention()
     ckpt = bench_ckpt()
+    goodput = bench_goodput()
     result = {
         "metric": "llama_train_mfu_bf16",
         "value": train["mfu_pct"],
         "unit": "%",
         # 40% MFU = the commonly-cited good bar for dense LLM training
         "vs_baseline": round(train["mfu_pct"] / 40.0, 3),
-        "detail": {"train": train, "attn": attn, "ckpt": ckpt},
+        "detail": {
+            "train": train, "attn": attn, "ckpt": ckpt,
+            "goodput": goodput,
+        },
     }
     print(json.dumps(result))
 

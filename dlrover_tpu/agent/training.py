@@ -168,7 +168,9 @@ class ElasticTrainingAgent:
         self._current_round = -1
         self._world: Dict[int, NodeMeta] = {}
         # agent-hosted IPC for flash checkpoint (SharedQueue/Lock/Dict + shm)
-        self._ipc_server = LocalIPCServer(ipc_socket_path(config.job_name))
+        self._ipc_server = LocalIPCServer(
+            ipc_socket_path(config.job_name, config.node_rank)
+        )
         self._ckpt_saver = ckpt_saver
         self._hb_thread: Optional[threading.Thread] = None
         self._last_global_step = 0
@@ -335,7 +337,11 @@ class ElasticTrainingAgent:
         if self._ckpt_saver is not None and self._config.save_at_breakpoint:
             try:
                 self._ckpt_saver.save_shm_to_storage(
-                    reason=reason, workers_dead=True
+                    reason=reason, workers_dead=True,
+                    # never block a restart on the commit quorum: a dead
+                    # peer's frame is not coming (the SIGTERM path in
+                    # ckpt_saver keeps its synchronous commit)
+                    async_commit=True,
                 )
             except Exception:  # noqa: BLE001
                 logger.exception("breakpoint checkpoint save failed")

@@ -311,6 +311,15 @@ class AsyncCheckpointSaver:
                 if f.startswith("done_")
             ])
             if count >= self._expected_frames:
+                # monotonic: a late commit (e.g. an async breakpoint
+                # commit whose quorum filled after training resumed and
+                # committed a NEWER step) must never move the restore
+                # point backwards
+                if latest_step(path, self._storage) >= step:
+                    logger.info(
+                        "checkpoint step %s superseded — tracker kept", step,
+                    )
+                    return True
                 tracker = os.path.join(path, CheckpointConstant.TRACKER_FILE)
                 tmp = tracker + ".tmp"
                 self._storage.write(str(step), tmp)
@@ -332,14 +341,21 @@ class AsyncCheckpointSaver:
     # -- breakpoint saves --------------------------------------------------
 
     def save_shm_to_storage(
-        self, reason: str = "", workers_dead: bool = False
+        self, reason: str = "", workers_dead: bool = False,
+        async_commit: bool = False,
     ) -> int:
         """Persist any shm frame newer than what's on disk — called when
         workers fail, membership changes, or the agent gets SIGTERM
         (reference ``save_shm_to_storage``:758). Returns #frames persisted.
 
         ``workers_dead=True`` force-releases frame locks first: a worker
-        that died mid-save can never release its lock itself."""
+        that died mid-save can never release its lock itself.
+        ``async_commit=True`` runs the leader's commit-quorum wait on a
+        background thread: a restart triggered by a DEAD peer must not
+        block re-rendezvous for the full quorum timeout (the peer's frame
+        is never coming; if agents are merely restarting, their saves land
+        and the background commit succeeds). SIGTERM saves stay
+        synchronous — the process is about to die."""
         if not self.ckpt_dir:
             return 0
         persisted = 0
@@ -382,7 +398,17 @@ class AsyncCheckpointSaver:
                 # host's partial save leaves the tracker untouched (correct
                 # — a partial step must never become the restore point).
                 if self._is_commit_leader:
-                    self.commit_checkpoint(self.ckpt_dir, step, timeout_s=30.0)
+                    if async_commit:
+                        threading.Thread(
+                            target=self.commit_checkpoint,
+                            args=(self.ckpt_dir, step),
+                            kwargs={"timeout_s": 30.0},
+                            name=f"bp-commit-{step}", daemon=True,
+                        ).start()
+                    else:
+                        self.commit_checkpoint(
+                            self.ckpt_dir, step, timeout_s=30.0
+                        )
             logger.info(
                 "breakpoint save (%s): persisted %s frame(s) to %s",
                 reason, persisted, self.ckpt_dir,

@@ -406,10 +406,11 @@ class CheckpointEngine:
         the job can never satisfy this incarnation's consistency check.
         """
         step = self.shm_step()
-        if step < 0:
-            return None
         if self.world_size <= 1 or self._master is None:
-            return step
+            return step if step >= 0 else None
+        # a rank with an EMPTY shm must still publish (-1) and join the
+        # barrier: returning early would leave its peers blocking the full
+        # barrier timeout before they fall back to storage
         scope = os.getenv(EnvKey.RDZV_ROUND, "0")
         prefix = f"ckpt/{self.job_name}/restore_step/r{scope}"
         try:
@@ -422,6 +423,8 @@ class CheckpointEngine:
                 logger.warning(
                     "restore barrier timed out — falling back to storage"
                 )
+                return None
+            if step < 0:
                 return None
             keys = [f"{prefix}/{r}" for r in range(self.world_size)]
             values = self._master.kv_multi_get(keys)

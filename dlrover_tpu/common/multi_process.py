@@ -48,13 +48,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def ipc_socket_dir(job_name: str) -> str:
+def ipc_socket_dir(job_name: str, node_rank: int = 0) -> str:
+    """Per-(job, node) socket directory. The node_rank suffix keeps
+    multiple agents of one job apart when they share a host (the
+    dev-loop/chaos-sim case — on a real pod each host has its own /tmp):
+    without it a second agent's server would rebind and steal the first
+    agent's socket mid-run."""
     uid = os.getuid()
-    return f"/tmp/dlrover_tpu_{uid}_{job_name}"
+    return f"/tmp/dlrover_tpu_{uid}_{job_name}_n{node_rank}"
 
 
-def ipc_socket_path(job_name: str) -> str:
-    return os.path.join(ipc_socket_dir(job_name), "ipc.sock")
+def ipc_socket_path(job_name: str, node_rank: int = 0) -> str:
+    return os.path.join(ipc_socket_dir(job_name, node_rank), "ipc.sock")
 
 
 class LocalIPCServer:

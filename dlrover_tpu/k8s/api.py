@@ -88,6 +88,14 @@ class K8sApi(ABC):
     def patch_custom_object(self, namespace: str, plural: str, name: str,
                             patch: Dict) -> Optional[Dict]: ...
 
+    def patch_custom_object_status(self, namespace: str, plural: str,
+                                   name: str, patch: Dict) -> Optional[Dict]:
+        """Patch via the /status subresource. The CRDs declare
+        ``subresources.status``, so a real apiserver STRIPS ``.status``
+        from patches to the main resource — status writes must go here.
+        Default delegates to patch_custom_object (fakes keep one store)."""
+        return self.patch_custom_object(namespace, plural, name, patch)
+
     @abstractmethod
     def delete_custom_object(self, namespace: str, plural: str,
                              name: str) -> bool: ...
@@ -374,6 +382,11 @@ class RealK8sApi(K8sApi):
 
     def patch_custom_object(self, namespace, plural, name, patch):
         return self._custom.patch_namespaced_custom_object(
+            self.GROUP, self.VERSION, namespace, plural, name, patch
+        )
+
+    def patch_custom_object_status(self, namespace, plural, name, patch):
+        return self._custom.patch_namespaced_custom_object_status(
             self.GROUP, self.VERSION, namespace, plural, name, patch
         )
 

@@ -136,6 +136,24 @@ class ElasticJobReconciler:
         self._set_phase(name, crd.JobPhase.SUSPENDED)
         logger.info("reconcile %s: suspended", name)
 
+    def resync(self) -> None:
+        """Level-triggered full pass: re-reconcile every listed job AND
+        clean up jobs whose DELETE watch event was lost to an apiserver
+        hiccup (their PodScaler/pods would otherwise leak forever)."""
+        jobs = self._api.list_custom_objects(
+            self._namespace, crd.ELASTICJOB_PLURAL
+        )
+        listed = {j["metadata"]["name"] for j in jobs}
+        for job in jobs:
+            self._reconcile_job(job)
+        for name in list(self._pod_scalers):
+            if name not in listed:
+                logger.warning(
+                    "job %s vanished without a DELETE event — cleaning up",
+                    name,
+                )
+                self._cleanup_job({"metadata": {"name": name}})
+
     def _cleanup_job(self, job: Dict) -> None:
         with self._reconcile_lock:
             self._cleanup_job_locked(job)
@@ -168,7 +186,7 @@ class ElasticJobReconciler:
         return scaler
 
     def _set_phase(self, name: str, phase: str) -> None:
-        self._api.patch_custom_object(
+        self._api.patch_custom_object_status(
             self._namespace, crd.ELASTICJOB_PLURAL, name,
             {"status": {"phase": phase}},
         )
@@ -228,7 +246,7 @@ class ElasticJobReconciler:
                     "replicas": replicas}}}},
             )
         scaler.scale(plan)
-        self._api.patch_custom_object(
+        self._api.patch_custom_object_status(
             self._namespace, crd.SCALEPLAN_PLURAL,
             plan_obj["metadata"]["name"],
             {"status": {"phase": "Executed"}},
@@ -272,10 +290,7 @@ def main(argv=None) -> int:
         while True:
             time.sleep(max(1, args.resync_seconds))
             try:
-                for job in reconciler._api.list_custom_objects(
-                    args.namespace, crd.ELASTICJOB_PLURAL
-                ):
-                    reconciler._reconcile_job(job)
+                reconciler.resync()
                 with open(args.liveness_file, "w") as f:
                     f.write(str(time.time()))
             except Exception as e:  # noqa: BLE001 — keep the controller up

@@ -158,6 +158,12 @@ class JobManager:
     def nodes(self) -> Dict[int, Node]:
         return self._nodes
 
+    def list_nodes(self) -> List[Node]:
+        """Snapshot for safe iteration — get_node() inserts into the live
+        dict from RPC threads concurrently."""
+        with self._lock:
+            return list(self._nodes.values())
+
     def add_event_callback(self, cb: Callable[[NodeEvent], None]) -> None:
         self._event_callbacks.append(cb)
 
@@ -212,6 +218,15 @@ class JobManager:
         heartbeat-timeout monitor, which must not fire during the silent
         window between pre-check and the agent's run loop (network check)."""
         node = self.get_node(node_id)
+        if running and node.is_released:
+            # a released node re-contacting (preempted host came back):
+            # readmit it — the rendezvous will scale the world back up
+            logger.info("node %s returned after release — readmitting",
+                        node_id)
+            node.is_released = False
+            node.relaunchable = True
+            node.exit_reason = ""
+            node.update_status(NodeStatus.PENDING)
         if running and node.status in (NodeStatus.INITIAL, NodeStatus.PENDING):
             node.update_status(NodeStatus.RUNNING)
         # stamp AFTER the RUNNING promotion so the first heartbeat lands
@@ -316,6 +331,21 @@ class JobManager:
         # node — a relaunchable failure is still a fatal one here
         if decision.ignore:
             return
+        if decision.relaunch and self._scaler is None:
+            # nobody can replace the node (standalone/local master): shrink
+            # elastically when the survivors still satisfy min_nodes — the
+            # master's node-event callback re-rendezvouses them — otherwise
+            # the failure is fatal
+            alive = sum(
+                1 for n in self.list_nodes()
+                if n.id != node.id and not n.is_released
+                and not NodeStatus.terminal(n.status)
+            )
+            if alive >= self._min_nodes:
+                self.release_node(
+                    node, f"{decision.reason}; shrinking to {alive} nodes",
+                )
+                return
         if decision.relaunch and self._scaler is not None:
             node.inc_relaunch_count()
             if decision.grow_memory and node.config_resource.memory_mb:
@@ -420,7 +450,7 @@ class JobManager:
     def check_heartbeats(self, now: Optional[float] = None) -> None:
         ctx = get_context()
         now = now or time.time()
-        for node in list(self._nodes.values()):
+        for node in self.list_nodes():
             if node.status != NodeStatus.RUNNING:
                 continue
             if (
@@ -454,14 +484,14 @@ class JobManager:
         if self._pending_strategy == PendingStrategy.WAIT:
             return
         now = now or time.time()
-        for node in list(self._nodes.values()):
+        for node in self.list_nodes():
             if node.status != NodeStatus.PENDING or node.is_released:
                 continue
             pending_s = now - (node.create_time or now)
             if pending_s <= self._pending_timeout_s:
                 continue
             alive = sum(
-                1 for n in self._nodes.values()
+                1 for n in self.list_nodes()
                 if not n.is_released and n.status in (
                     NodeStatus.RUNNING, NodeStatus.PENDING,
                     NodeStatus.INITIAL,

@@ -45,7 +45,11 @@ class JobMaster:
         from dlrover_tpu.common.metric import JobMetricContext
 
         self.job_name = job_name
-        self.job_manager = JobManager(job_name, node_num, scaler=scaler)
+        self.job_manager = JobManager(
+            job_name, node_num, scaler=scaler,
+            min_nodes=(node_num if min_nodes is None else min_nodes),
+            node_unit=node_unit,
+        )
         self.perf_monitor = PerfMonitor()
         self.task_manager = TaskManager()
         self.metric_context = JobMetricContext()
@@ -106,12 +110,34 @@ class JobMaster:
                     "DLROVER_TPU_HTTP_PORT=%r is not a port; http "
                     "transport disabled", http_port)
         # a dead node's in-flight data shards go straight back on the queue
-        # (reference TaskRescheduleCallback, node/event_callback.py)
-        from dlrover_tpu.common.constants import NodeStatus as _NS
+        # (reference TaskRescheduleCallback, node/event_callback.py), it is
+        # dropped from every rendezvous waiting set, and survivors are told
+        # to re-rendezvous NOW via a restart action on their heartbeat
+        # reply. The reference's torch agents learn of a dead peer when
+        # their NCCL collectives error out; XLA collectives would hang
+        # instead, so master-coordinated re-formation is the TPU redesign
+        # (BASELINE north star: "re-form the ICI mesh after preemption").
+        from dlrover_tpu.common.constants import (
+            DiagnosisActionType as _DA,
+            NodeStatus as _NS,
+        )
+        from dlrover_tpu.diagnosis.action import DiagnosisAction
 
         def _on_node_event(event):
-            if event.node.status in (_NS.FAILED, _NS.DELETED, _NS.BREAKDOWN):
-                self.task_manager.recover_tasks(event.node.id)
+            if event.node.status not in (
+                _NS.FAILED, _NS.DELETED, _NS.BREAKDOWN,
+            ):
+                return
+            self.task_manager.recover_tasks(event.node.id)
+            for manager in self.rdzv_managers.values():
+                manager.remove_alive_node(event.node.rank)
+            for node in self.job_manager.list_nodes():
+                if node.id != event.node.id and node.status == _NS.RUNNING:
+                    self.job_manager.enqueue_action(DiagnosisAction(
+                        _DA.RESTART_WORKER,
+                        instance=node.id,
+                        reason=f"peer node {event.node.id} left the world",
+                    ))
 
         self.job_manager.add_event_callback(_on_node_event)
 
