@@ -33,7 +33,8 @@ from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.storage import (
     CheckpointDeletionStrategy,
     CheckpointStorage,
-    PosixDiskStorage,
+    PosixDiskStorage,  # noqa: F401 — re-exported for callers
+    get_checkpoint_storage,
 )
 from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler, parse_frame
 
@@ -49,7 +50,7 @@ def frame_file(ckpt_dir: str, step: int, node_rank: int, local_rank: int) -> str
 
 
 def latest_step(ckpt_dir: str, storage: Optional[CheckpointStorage] = None) -> int:
-    storage = storage or PosixDiskStorage()
+    storage = storage or get_checkpoint_storage(ckpt_dir)
     tracker = os.path.join(ckpt_dir, CheckpointConstant.TRACKER_FILE)
     content = storage.read(tracker, "r")
     if not content:
@@ -63,7 +64,7 @@ def latest_step(ckpt_dir: str, storage: Optional[CheckpointStorage] = None) -> i
 def load_frames_for_step(
     ckpt_dir: str, step: int, storage: Optional[CheckpointStorage] = None
 ) -> List[Dict]:
-    storage = storage or PosixDiskStorage()
+    storage = storage or get_checkpoint_storage(ckpt_dir)
     d = step_dir(ckpt_dir, step)
     frames = []
     for name in storage.listdir(d):
@@ -86,7 +87,7 @@ def persist_shm_frame(
 ) -> bool:
     """Persist one shm frame as an atomic file write (used directly by
     agent-less workers)."""
-    storage = storage or PosixDiskStorage()
+    storage = storage or get_checkpoint_storage(ckpt_dir)
     meta = shm.read_meta()
     if meta is None or meta["step"] != step:
         return False
@@ -127,7 +128,8 @@ class AsyncCheckpointSaver:
         deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
     ):
         self.ckpt_dir = ckpt_dir
-        self._storage = storage or PosixDiskStorage()
+        # path-aware default: gs:// checkpoint dirs get the GCS backend
+        self._storage = storage or get_checkpoint_storage(ckpt_dir)
         self._node_rank = node_rank
         self._local_world_size = local_world_size
         self._expected_frames = expected_frames or local_world_size
@@ -144,6 +146,10 @@ class AsyncCheckpointSaver:
         )
         self._persisted_steps: Dict[str, int] = {}
         self._lock = threading.Lock()
+        # serializes tracker check+write across the event thread and any
+        # async breakpoint-commit threads (the monotonic check is useless
+        # if two commits interleave between check and move)
+        self._commit_lock = threading.Lock()
         AsyncCheckpointSaver._instance = self
 
     # -- lifecycle ---------------------------------------------------------
@@ -314,16 +320,22 @@ class AsyncCheckpointSaver:
                 # monotonic: a late commit (e.g. an async breakpoint
                 # commit whose quorum filled after training resumed and
                 # committed a NEWER step) must never move the restore
-                # point backwards
-                if latest_step(path, self._storage) >= step:
-                    logger.info(
-                        "checkpoint step %s superseded — tracker kept", step,
+                # point backwards. The lock makes check+write atomic and
+                # the per-step tmp name keeps concurrent commits from
+                # moving each other's payloads.
+                with self._commit_lock:
+                    if latest_step(path, self._storage) >= step:
+                        logger.info(
+                            "checkpoint step %s superseded — tracker kept",
+                            step,
+                        )
+                        return True
+                    tracker = os.path.join(
+                        path, CheckpointConstant.TRACKER_FILE
                     )
-                    return True
-                tracker = os.path.join(path, CheckpointConstant.TRACKER_FILE)
-                tmp = tracker + ".tmp"
-                self._storage.write(str(step), tmp)
-                self._storage.safe_move(tmp, tracker)
+                    tmp = f"{tracker}.tmp{step}"
+                    self._storage.write(str(step), tmp)
+                    self._storage.safe_move(tmp, tracker)
                 logger.info("checkpoint step %s committed (%s frames)",
                             step, count)
                 if self._deletion_strategy is not None:

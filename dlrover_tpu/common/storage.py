@@ -133,11 +133,164 @@ class PosixDiskStorage(CheckpointStorage):
             return []
 
 
+class GcsStorage(CheckpointStorage):
+    """``gs://`` object storage for GKE TPU slices (no CPFS/NAS mounts
+    there — reference fleets are POSIX-only, storage.py:128; this is the
+    TPU addition the ABC was shaped for).
+
+    Semantics mapping:
+
+    - directories are prefixes (``safe_makedirs`` is a no-op; ``listdir``
+      lists immediate children via a delimiter query);
+    - the commit protocol's ``tmp write + safe_move(tracker)`` maps to
+      copy+delete — each GCS object write is atomic, so readers see either
+      the old or the new tracker, never a torn one;
+    - every call retries with exponential backoff (transient 5xx/socket
+      errors must not fail a checkpoint that training already moved past).
+
+    ``client`` is a ``google.cloud.storage.Client``-compatible object —
+    injectable so tests run against a fake without credentials.
+    """
+
+    RETRIES = 3
+    BACKOFF_S = 0.5
+
+    def __init__(self, client=None):
+        self._client = client
+
+    def _c(self):
+        if self._client is None:
+            from google.cloud import storage as gcs
+
+            self._client = gcs.Client()
+        return self._client
+
+    @staticmethod
+    def _split(path: str):
+        if not path.startswith("gs://"):
+            raise ValueError(f"not a gs:// path: {path}")
+        rest = path[5:]
+        bucket, _, key = rest.partition("/")
+        return bucket, key.rstrip("/")
+
+    def _retry(self, fn):
+        import time as _time
+
+        last = None
+        for attempt in range(self.RETRIES):
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — raised after retries
+                last = e
+                _time.sleep(self.BACKOFF_S * (2 ** attempt))
+        logger.warning("gcs operation failed after retries: %r", last)
+        raise last
+
+    def write(self, content, path: str) -> None:
+        bucket, key = self._split(path)
+        if isinstance(content, str):
+            content = content.encode()
+        payload = bytes(content)
+        # the whole client interaction lives inside the retried closure:
+        # the bucket/blob handles themselves can fail transiently
+        self._retry(
+            lambda: self._c().bucket(bucket).blob(key)
+            .upload_from_string(payload)
+        )
+
+    def read(self, path: str, mode: str = "rb"):
+        bucket, key = self._split(path)
+
+        def _get():
+            blob = self._c().bucket(bucket).blob(key)
+            if not blob.exists():
+                return None
+            return blob.download_as_bytes()
+
+        data = self._retry(_get)
+        if data is not None and "b" not in mode:
+            return data.decode()
+        return data
+
+    def safe_rmtree(self, dir_path: str) -> None:
+        bucket, key = self._split(dir_path)
+
+        def _rm():
+            client = self._c()
+            for blob in list(client.list_blobs(bucket, prefix=key + "/")):
+                blob.delete()
+
+        try:
+            self._retry(_rm)
+        except Exception:  # noqa: BLE001 — best-effort like shutil.rmtree
+            pass
+
+    def safe_remove(self, path: str) -> None:
+        bucket, key = self._split(path)
+        try:
+            self._retry(lambda: self._c().bucket(bucket).blob(key).delete())
+        except Exception:  # noqa: BLE001 — parity with os.remove swallow
+            pass
+
+    def safe_makedirs(self, dir_path: str) -> None:
+        pass  # prefixes need no creation
+
+    def safe_move(self, src: str, dst: str) -> None:
+        s_bucket, s_key = self._split(src)
+        d_bucket, d_key = self._split(dst)
+
+        def _mv():
+            client = self._c()
+            sb = client.bucket(s_bucket)
+            blob = sb.blob(s_key)
+            sb.copy_blob(blob, client.bucket(d_bucket), d_key)
+            blob.delete()
+
+        try:
+            self._retry(_mv)
+        except Exception as e:  # noqa: BLE001 — parity with POSIX move
+            logger.warning("gcs move %s -> %s failed: %s", src, dst, e)
+
+    def exists(self, path: str) -> bool:
+        bucket, key = self._split(path)
+
+        def _exists():
+            client = self._c()
+            if client.bucket(bucket).blob(key).exists():
+                return True
+            # a "directory" exists if any object lives under it
+            return any(
+                True for _ in client.list_blobs(
+                    bucket, prefix=key + "/", max_results=1,
+                )
+            )
+
+        return bool(self._retry(_exists))
+
+    def listdir(self, path: str) -> List[str]:
+        bucket, key = self._split(path)
+        prefix = key + "/" if key else ""
+
+        def _ls():
+            client = self._c()
+            it = client.list_blobs(bucket, prefix=prefix, delimiter="/")
+            names = [
+                b.name[len(prefix):] for b in it
+                if b.name != prefix
+            ]
+            names += [
+                p[len(prefix):].rstrip("/")
+                for p in getattr(it, "prefixes", [])
+            ]
+            return sorted(n for n in names if n)
+
+        try:
+            return self._retry(_ls)
+        except Exception:  # noqa: BLE001 — parity with os.listdir swallow
+            return []
+
+
 def get_checkpoint_storage(path: str) -> CheckpointStorage:
     if path.startswith("gs://"):
-        # GCS backend lands with the native writer; gate clearly for now.
-        raise NotImplementedError(
-            "GCS storage backend not yet wired; mount via gcsfuse and use a "
-            "POSIX path, or use PosixDiskStorage."
-        )
+        return GcsStorage()
     return PosixDiskStorage()

@@ -140,19 +140,29 @@ class ElasticJobReconciler:
         """Level-triggered full pass: re-reconcile every listed job AND
         clean up jobs whose DELETE watch event was lost to an apiserver
         hiccup (their PodScaler/pods would otherwise leak forever)."""
+        # snapshot the scaler set BEFORE listing: a job created after the
+        # list (watch thread races us) appears in _pod_scalers but not in
+        # the stale listing — it must not be mistaken for a deleted job
+        known = set(self._pod_scalers)
         jobs = self._api.list_custom_objects(
             self._namespace, crd.ELASTICJOB_PLURAL
         )
         listed = {j["metadata"]["name"] for j in jobs}
         for job in jobs:
-            self._reconcile_job(job)
-        for name in list(self._pod_scalers):
-            if name not in listed:
-                logger.warning(
-                    "job %s vanished without a DELETE event — cleaning up",
-                    name,
+            try:
+                self._reconcile_job(job)
+            except Exception:  # noqa: BLE001 — one bad spec must not
+                # starve the rest of the pass (or the leak cleanup below)
+                logger.exception(
+                    "resync reconcile of %s failed",
+                    job.get("metadata", {}).get("name"),
                 )
-                self._cleanup_job({"metadata": {"name": name}})
+        for name in known - listed:
+            logger.warning(
+                "job %s vanished without a DELETE event — cleaning up",
+                name,
+            )
+            self._cleanup_job({"metadata": {"name": name}})
 
     def _cleanup_job(self, job: Dict) -> None:
         with self._reconcile_lock:

@@ -1,0 +1,174 @@
+"""GCS checkpoint-storage backend against a fake in-memory GCS client
+(VERDICT r1 missing #6): the saver's persist/commit/tracker protocol must
+work unchanged on gs:// paths."""
+
+import pytest
+
+from dlrover_tpu.ckpt.ckpt_saver import (
+    AsyncCheckpointSaver,
+    latest_step,
+    step_dir,
+)
+from dlrover_tpu.common.storage import (
+    GcsStorage,
+    PosixDiskStorage,
+    get_checkpoint_storage,
+)
+
+
+class FakeBlob:
+    def __init__(self, store, bucket, name):
+        self._store = store
+        self._bucket = bucket
+        self.name = name
+
+    def _key(self):
+        return (self._bucket, self.name)
+
+    def upload_from_string(self, data):
+        self._store[self._key()] = bytes(data)
+
+    def exists(self):
+        return self._key() in self._store
+
+    def download_as_bytes(self):
+        return self._store[self._key()]
+
+    def delete(self):
+        del self._store[self._key()]
+
+
+class FakeBucket:
+    def __init__(self, store, name):
+        self._store = store
+        self.name = name
+
+    def blob(self, key):
+        return FakeBlob(self._store, self.name, key)
+
+    def copy_blob(self, blob, dst_bucket, dst_key):
+        self._store[(dst_bucket.name, dst_key)] = self._store[blob._key()]
+
+
+class FakeListing:
+    def __init__(self, blobs, prefixes):
+        self._blobs = blobs
+        self.prefixes = prefixes
+
+    def __iter__(self):
+        return iter(self._blobs)
+
+
+class FakeGcsClient:
+    """The surface of google.cloud.storage.Client that GcsStorage uses."""
+
+    def __init__(self):
+        self.store = {}
+
+    def bucket(self, name):
+        return FakeBucket(self.store, name)
+
+    def list_blobs(self, bucket, prefix="", delimiter=None, max_results=None):
+        matches = sorted(
+            k for (b, k) in self.store if b == bucket
+            and k.startswith(prefix)
+        )
+        if max_results is not None:
+            matches = matches[:max_results]
+        if delimiter is None:
+            return FakeListing(
+                [FakeBlob(self.store, bucket, k) for k in matches], set(),
+            )
+        direct, prefixes = [], set()
+        for k in matches:
+            rest = k[len(prefix):]
+            if delimiter in rest:
+                prefixes.add(prefix + rest.split(delimiter)[0] + delimiter)
+            else:
+                direct.append(FakeBlob(self.store, bucket, k))
+        return FakeListing(direct, prefixes)
+
+
+@pytest.fixture()
+def gcs():
+    return GcsStorage(client=FakeGcsClient())
+
+
+def test_scheme_routing():
+    assert isinstance(get_checkpoint_storage("/tmp/x"), PosixDiskStorage)
+    assert isinstance(get_checkpoint_storage("gs://b/x"), GcsStorage)
+
+
+def test_write_read_roundtrip(gcs):
+    gcs.write(b"\x00\x01frame", "gs://bkt/ckpt/f.bin")
+    assert gcs.read("gs://bkt/ckpt/f.bin") == b"\x00\x01frame"
+    gcs.write("42", "gs://bkt/ckpt/latest_step.txt")
+    assert gcs.read("gs://bkt/ckpt/latest_step.txt", "r") == "42"
+    assert gcs.read("gs://bkt/ckpt/missing") is None
+
+
+def test_listdir_and_exists(gcs):
+    gcs.write(b"a", "gs://bkt/ckpt/10/frame_0.bin")
+    gcs.write(b"b", "gs://bkt/ckpt/10/done/done_0")
+    gcs.write(b"c", "gs://bkt/ckpt/20/frame_0.bin")
+    assert gcs.listdir("gs://bkt/ckpt") == ["10", "20"]
+    assert gcs.listdir("gs://bkt/ckpt/10") == ["done", "frame_0.bin"]
+    assert gcs.exists("gs://bkt/ckpt/10")          # prefix
+    assert gcs.exists("gs://bkt/ckpt/10/frame_0.bin")  # object
+    assert not gcs.exists("gs://bkt/ckpt/30")
+
+
+def test_move_and_rmtree(gcs):
+    gcs.write("5", "gs://bkt/ckpt/latest_step.txt.tmp")
+    gcs.safe_move(
+        "gs://bkt/ckpt/latest_step.txt.tmp", "gs://bkt/ckpt/latest_step.txt"
+    )
+    assert gcs.read("gs://bkt/ckpt/latest_step.txt", "r") == "5"
+    assert not gcs.exists("gs://bkt/ckpt/latest_step.txt.tmp")
+    gcs.write(b"x", "gs://bkt/ckpt/10/frame_0.bin")
+    gcs.safe_rmtree("gs://bkt/ckpt/10")
+    assert not gcs.exists("gs://bkt/ckpt/10")
+
+
+def test_retry_recovers_from_transient_errors(gcs):
+    calls = {"n": 0}
+    real_bucket = gcs._client.bucket
+
+    def flaky_bucket(name):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionResetError("transient")
+        return real_bucket(name)
+
+    gcs._client.bucket = flaky_bucket
+    gcs.BACKOFF_S = 0.0
+    gcs.write(b"ok", "gs://bkt/f")
+    assert gcs.read("gs://bkt/f") == b"ok"
+
+
+def test_saver_commit_protocol_on_gcs(gcs):
+    """The done-files + tracker commit flow (ckpt_saver.commit_checkpoint)
+    runs unchanged against gs:// paths, including the deletion strategy."""
+    from dlrover_tpu.common.storage import KeepLatestStepStrategy
+
+    path = "gs://bkt/job/ckpt"
+    saver = AsyncCheckpointSaver(
+        ckpt_dir=path, storage=gcs, node_rank=0, local_world_size=1,
+        expected_frames=1,
+        deletion_strategy=KeepLatestStepStrategy(1, path),
+    )
+    try:
+        for step in (10, 20):
+            gcs.write(b"frame", f"{step_dir(path, step)}/frame_0.bin")
+            gcs.write(b"", f"{step_dir(path, step)}/._done/done_0")
+            assert saver.commit_checkpoint(path, step, timeout_s=5.0)
+            assert latest_step(path, gcs) == step
+        # KeepLatest(1): step 10 was cleaned up, 20 survives
+        assert not gcs.exists(step_dir(path, 10))
+        assert gcs.exists(step_dir(path, 20))
+        # monotonicity: a stale commit cannot move the tracker back
+        gcs.write(b"", f"{step_dir(path, 10)}/._done/done_0")
+        assert saver.commit_checkpoint(path, 10, timeout_s=5.0)
+        assert latest_step(path, gcs) == 20
+    finally:
+        saver.stop()
