@@ -14,6 +14,7 @@ failures are node-level (relaunch — the chip may be wedged), Python errors
 are process-level (restart in place).
 """
 
+import os
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -103,12 +104,22 @@ class DiagnosisAgent:
     def __init__(
         self,
         collectors: Optional[List[GaugeCollector]] = None,
+        timer_port: int = 18889,
+        stack_dir: str = "/tmp",
     ):
         self._collectors = (
             collectors if collectors is not None
-            else [ResourceCollector(), TpuTimerCollector()]
+            else [ResourceCollector(), TpuTimerCollector(port=timer_port)]
         )
         self._failures: List[WorkerFailure] = []
+        self._timer_port = timer_port
+        self._stack_dir = stack_dir
+        self._last_stack_capture = 0.0
+        self._capture_thread = None
+
+    # minimum seconds between hang-triggered stack captures (a wedged job
+    # raises the gauge on every heartbeat; one dump per window is enough)
+    STACK_CAPTURE_COOLDOWN_S = 120.0
 
     def collect_gauges(self) -> Dict[str, float]:
         gauges: Dict[str, float] = {}
@@ -117,7 +128,89 @@ class DiagnosisAgent:
                 gauges.update(c.collect())
             except Exception:  # noqa: BLE001 — telemetry is best-effort
                 logger.exception("collector %s failed", c.name)
+        self._maybe_capture_stacks(gauges)
         return gauges
+
+    # failed captures retry sooner than the full cooldown (the daemon may
+    # just be restarting while the hang persists)
+    STACK_CAPTURE_RETRY_S = 15.0
+
+    def _maybe_capture_stacks(self, gauges: Dict[str, float]) -> None:
+        """Hang gauge up → pull python+native stacks of every worker from
+        the tpu_timer daemon (reference wires DumpStringStacktrace into
+        its hang path the same way, hosting_service.proto:247).
+
+        The capture runs on a background thread: gdb attach can take ~20s
+        per wedged worker and collect_gauges is called from the agent's
+        heartbeat loop, which must keep beating."""
+        if gauges.get("XPU_TIMER_COMMON_HANG", 0) <= 0:
+            return
+        now = time.time()
+        if now - self._last_stack_capture < self.STACK_CAPTURE_COOLDOWN_S:
+            return
+        if self._capture_thread is not None and (
+            self._capture_thread.is_alive()
+        ):
+            return
+        import threading
+
+        def _capture():
+            path = self.capture_worker_stacks()
+            if path:
+                # stamp the cooldown only on success: a transient RPC
+                # failure must not suppress the diagnostic for 120s of a
+                # live hang
+                self._last_stack_capture = time.time()
+                logger.warning(
+                    "hang detected — worker stacks saved to %s", path,
+                )
+            else:
+                self._last_stack_capture = (
+                    time.time()
+                    - self.STACK_CAPTURE_COOLDOWN_S
+                    + self.STACK_CAPTURE_RETRY_S
+                )
+
+        self._capture_thread = threading.Thread(
+            target=_capture, name="hang-stack-capture", daemon=True,
+        )
+        self._capture_thread.start()
+
+    def capture_worker_stacks(
+        self,
+        port: Optional[int] = None,
+        host: str = "127.0.0.1",
+        out_dir: Optional[str] = None,
+        mode: str = "all",
+        timeout_s: Optional[float] = None,
+    ) -> str:
+        """Fetch python AND native stacks of every worker via the daemon's
+        /stacktrace RPC (gdb batch + faulthandler readback, daemon.cc) and
+        persist them; returns the dump path ('' on failure)."""
+        import urllib.request
+
+        port = self._timer_port if port is None else port
+        out_dir = self._stack_dir if out_dir is None else out_dir
+        if timeout_s is None:
+            # worst case ~22s/worker (gdb timeout + dump wait), serial
+            timeout_s = 30.0 + 25.0 * 8
+        url = f"http://{host}:{port}/stacktrace?mode={mode}"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+                text = resp.read().decode()
+        except OSError as e:
+            logger.warning("stacktrace RPC failed: %r", e)
+            return ""
+        path = os.path.join(
+            out_dir, f"dlrover_tpu_stacks_{time.time_ns()}.json"
+        )
+        try:
+            with open(path, "w") as f:
+                f.write(text)
+        except OSError:
+            logger.exception("could not persist stack dump to %s", path)
+            return ""
+        return path
 
     def diagnose_training_failure(
         self, exit_codes: Dict[int, int], restarts_remaining: int

@@ -5,10 +5,21 @@
 // Workers each serve /metrics on base_port+local_rank (engine.cc httpLoop);
 // this daemon scrapes them and re-serves one merged Prometheus page, so the
 // agent/k8s scrape config needs a single target per host:
-//   GET /metrics     → concatenation of every live worker's gauges
-//   GET /workers     → JSON health of each worker endpoint
-//   GET /dump_stack  → SIGUSR1 to every worker pid (python faulthandler dump —
-//                      the py-spy/gdb analogue of DumpStringStacktrace)
+//   GET /metrics      → concatenation of every live worker's gauges
+//   GET /workers      → JSON health of each worker endpoint
+//   GET /dump_stack   → SIGUSR1 to every worker pid (python faulthandler
+//                       dump into the worker's pystack file)
+//   GET /stacktrace[?pid=N][&mode=python|native|all]
+//                     → the DumpStringStacktrace dual: returns ACTUAL stack
+//                       text per worker — python via SIGUSR1 + reading the
+//                       faulthandler dump file, native via gdb batch
+//                       `thread apply all bt` (the reference shells out to
+//                       py-spy + gdb the same way,
+//                       hosting_service_server_client.cc:74–96)
+//   GET /dump_trace[?name=SUBSTR][&rank=R]
+//                     → the DumpKernelTrace dual: merged chrome-trace JSON
+//                       of every worker's ring buffer, filtered by event
+//                       name substring and/or rank
 //   GET /healthz
 // Usage: tpu_timer_daemon <listen_port> <base_port> <n_workers>
 
@@ -22,6 +33,7 @@
 #include <unistd.h>
 
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -62,33 +74,139 @@ int PidFromHealthz(const std::string& body) {
   return p == std::string::npos ? -1 : atoi(body.c_str() + p + 6);
 }
 
-}  // namespace
+// Value of "<key>=" in the request line's query string, "" if absent.
+std::string QueryParam(const char* req, const char* key) {
+  const char* line_end = strstr(req, "\r\n");
+  std::string line(req, line_end ? (size_t)(line_end - req) : strlen(req));
+  std::string needle = std::string(key) + "=";
+  size_t q = line.find('?');
+  if (q == std::string::npos) return "";
+  size_t p = line.find(needle, q);
+  if (p == std::string::npos) return "";
+  p += needle.size();
+  size_t e = line.find_first_of("& ", p);
+  return line.substr(p, e == std::string::npos ? e : e - p);
+}
 
-int main(int argc, char** argv) {
-  int listen_port = argc > 1 ? atoi(argv[1]) : 18889;
-  int base_port = argc > 2 ? atoi(argv[2]) : 18900;
-  int n_workers = argc > 3 ? atoi(argv[3]) : 8;
-  signal(SIGPIPE, SIG_IGN);
+std::string RunCmd(const std::string& cmd) {
+  FILE* f = popen(cmd.c_str(), "r");
+  if (!f) return "";
+  std::string out;
+  char buf[4096];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out.append(buf, n);
+  pclose(f);
+  return out;
+}
 
-  int fd = socket(AF_INET, SOCK_STREAM, 0);
-  int one = 1;
-  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-  struct sockaddr_in addr;
-  memset(&addr, 0, sizeof(addr));
-  addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_ANY);
-  addr.sin_port = htons((uint16_t)listen_port);
-  if (bind(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
-      listen(fd, 16) != 0) {
-    perror("tpu_timer_daemon bind");
-    return 1;
+std::string ReadFile(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) return "";
+  std::string out;
+  char buf[4096];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out.append(buf, n);
+  fclose(f);
+  return out;
+}
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 16);
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char b[8];
+          snprintf(b, sizeof(b), "\\u%04x", c);
+          out += b;
+        } else {
+          out += (char)c;
+        }
+    }
   }
-  fprintf(stderr, "tpu_timer_daemon on :%d scraping :%d..:%d\n", listen_port,
-          base_port, base_port + n_workers - 1);
+  return out;
+}
 
-  for (;;) {
-    int cfd = accept(fd, nullptr, nullptr);
-    if (cfd < 0) continue;
+// Native stack of a live pid via gdb batch (the reference's
+// DumpStringStacktrace path shells out to gdb identically). Bounded by
+// `timeout` so a wedged ptrace can't hang the daemon.
+std::string NativeStack(int pid) {
+  if (pid <= 0) return "";
+  char cmd[256];
+  snprintf(cmd, sizeof(cmd),
+           "timeout 20 gdb --batch -p %d -ex 'set pagination off' "
+           "-ex 'thread apply all bt 48' 2>&1",
+           pid);
+  return RunCmd(cmd);
+}
+
+// Python stack: raise the faulthandler signal, wait for the interpreter
+// to append its dump, then return ONLY the new suffix of the worker's
+// pystack file (observability/tpu_timer.py install() registers SIGUSR1 →
+// /tmp/tpu_timer_pystack_<pid>.txt; faulthandler appends, so the prefix
+// is previous dumps — same offset trick as stack_viewer.snapshot_offsets).
+std::string PythonStack(int pid) {
+  if (pid <= 0) return "";
+  char path[128];
+  snprintf(path, sizeof(path), "/tmp/tpu_timer_pystack_%d.txt", pid);
+  size_t before = ReadFile(path).size();
+  if (kill(pid, SIGUSR1) != 0) return "";
+  for (int i = 0; i < 20; i++) {  // up to 2s for the dump to land
+    usleep(100 * 1000);
+    std::string now = ReadFile(path);
+    if (now.size() > before) return now.substr(before);
+  }
+  return "";
+}
+
+// Split a chrome-trace object body {"traceEvents":[...]} into its events
+// and keep those whose "name" contains `name_filter` (empty = all).
+void AppendFilteredEvents(const std::string& body,
+                          const std::string& name_filter, bool* first,
+                          std::string* out) {
+  size_t lb = body.find('[');
+  size_t rb = body.rfind(']');
+  if (lb == std::string::npos || rb == std::string::npos || rb <= lb) return;
+  size_t i = lb + 1;
+  int depth = 0;
+  size_t start = std::string::npos;
+  for (; i <= rb; i++) {
+    char c = body[i];
+    if (c == '{') {
+      if (depth == 0) start = i;
+      depth++;
+    } else if (c == '}') {
+      depth--;
+      if (depth == 0 && start != std::string::npos) {
+        std::string ev = body.substr(start, i - start + 1);
+        bool keep = name_filter.empty();
+        if (!keep) {
+          size_t p = ev.find("\"name\":\"");
+          if (p != std::string::npos) {
+            size_t e = ev.find('"', p + 8);
+            keep = e != std::string::npos &&
+                   ev.substr(p + 8, e - (p + 8)).find(name_filter) !=
+                       std::string::npos;
+          }
+        }
+        if (keep) {
+          if (!*first) *out += ",";
+          *first = false;
+          *out += ev;
+        }
+        start = std::string::npos;
+      }
+    }
+  }
+}
+
+void HandleConn(int cfd, int base_port, int n_workers) {
     char req[1024];
     ssize_t n = read(cfd, req, sizeof(req) - 1);
     std::string body, ctype = "text/plain";
@@ -106,6 +224,49 @@ int main(int argc, char** argv) {
           body += h.empty() ? "null" : h;
         }
         body += "]";
+        ctype = "application/json";
+      } else if (strncmp(req, "GET /stacktrace", 15) == 0) {
+        std::string pid_s = QueryParam(req, "pid");
+        std::string mode = QueryParam(req, "mode");
+        if (mode.empty()) mode = "all";
+        std::vector<int> pids;
+        if (!pid_s.empty()) {
+          // atoi of garbage is 0, and kill(0)/kill(-1) signal the whole
+          // process group / all user processes — never pass those through
+          int pid = atoi(pid_s.c_str());
+          if (pid > 0) pids.push_back(pid);
+        } else {
+          for (int i = 0; i < n_workers; i++) {
+            int pid = PidFromHealthz(HttpGet(base_port + i, "/healthz"));
+            if (pid > 0) pids.push_back(pid);
+          }
+        }
+        body = "[";
+        for (size_t i = 0; i < pids.size(); i++) {
+          if (i) body += ",";
+          body += "{\"pid\":" + std::to_string(pids[i]);
+          if (mode == "all" || mode == "python")
+            body += ",\"python\":\"" + JsonEscape(PythonStack(pids[i])) +
+                    "\"";
+          if (mode == "all" || mode == "native")
+            body += ",\"native\":\"" + JsonEscape(NativeStack(pids[i])) +
+                    "\"";
+          body += "}";
+        }
+        body += "]";
+        ctype = "application/json";
+      } else if (strncmp(req, "GET /dump_trace", 15) == 0) {
+        std::string name = QueryParam(req, "name");
+        std::string rank_s = QueryParam(req, "rank");
+        int only = rank_s.empty() ? -1 : atoi(rank_s.c_str());
+        body = "{\"traceEvents\":[";
+        bool first = true;
+        for (int i = 0; i < n_workers; i++) {
+          if (only >= 0 && i != only) continue;
+          AppendFilteredEvents(HttpGet(base_port + i, "/trace"), name,
+                               &first, &body);
+        }
+        body += "]}";
         ctype = "application/json";
       } else if (strncmp(req, "GET /dump_stack", 15) == 0) {
         int sent = 0;
@@ -133,5 +294,40 @@ int main(int argc, char** argv) {
     (void)!write(cfd, hdr, strlen(hdr));
     (void)!write(cfd, body.data(), body.size());
     close(cfd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int listen_port = argc > 1 ? atoi(argv[1]) : 18889;
+  int base_port = argc > 2 ? atoi(argv[2]) : 18900;
+  int n_workers = argc > 3 ? atoi(argv[3]) : 8;
+  signal(SIGPIPE, SIG_IGN);
+
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)listen_port);
+  if (bind(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, 16) != 0) {
+    perror("tpu_timer_daemon bind");
+    return 1;
+  }
+  fprintf(stderr, "tpu_timer_daemon on :%d scraping :%d..:%d\n", listen_port,
+          base_port, base_port + n_workers - 1);
+
+  // one detached thread per connection: a /stacktrace run (gdb can take
+  // ~20s per worker) must not starve /metrics scrapes or /healthz probes
+  // during exactly the hang window it exists to diagnose
+  for (;;) {
+    int cfd = accept(fd, nullptr, nullptr);
+    if (cfd < 0) continue;
+    std::thread([cfd, base_port, n_workers] {
+      HandleConn(cfd, base_port, n_workers);
+    }).detach();
   }
 }
