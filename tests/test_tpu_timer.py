@@ -437,3 +437,24 @@ def test_diagnosis_agent_captures_stacks_on_hang(engine_proc_port, tmp_path):
     finally:
         proc.kill()
         proc.wait()
+
+
+def test_matmul_replay_from_trace(engine_proc_port, tmp_path):
+    """Replay tooling (reference parse_matmul dual): trace events carry
+    flops payloads; replay re-executes equivalent-FLOPs matmuls and
+    reports recorded vs replayed TFLOP/s."""
+    sys.path.insert(0, REPO)
+    from dlrover_tpu.observability.replay import replay, select_matmuls
+
+    trace_path = tmp_path / "trace.json"
+    trace_path.write_text(_get(engine_proc_port, "/trace"))
+    events = json.loads(trace_path.read_text())["traceEvents"]
+    picked = select_matmuls(events, top_k=3)
+    # manual_mm was recorded with flops=3e12 (fixture) — replayable
+    assert any(p["name"] == "manual_mm" for p in picked)
+    report = replay(str(trace_path), top_k=1, iters=2)
+    assert report["kernels"], report
+    k = report["kernels"][0]
+    assert k["replayed_tflops"] > 0
+    assert k["recorded_tflops"] > 0
+    assert k["ratio"] is not None

@@ -38,6 +38,7 @@ enum KernelKind : int {
 struct TraceEvent {
   int64_t ts_us;   // wall-clock start, us since epoch
   int64_t dur_us;  // duration
+  double payload;  // FLOPs (mm) / bytes (memory) — replay tooling input
   int32_t name_id;
   int8_t kind;
 };

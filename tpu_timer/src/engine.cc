@@ -18,6 +18,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <cmath>
 #include <cstdio>
 #include <fstream>
 #include <sstream>
@@ -109,6 +110,7 @@ void Engine::record(int kind, const std::string& name, double dur_us,
   TraceEvent& ev = trace_[trace_next_];
   ev.ts_us = NowUs() - (int64_t)dur_us;
   ev.dur_us = (int64_t)dur_us;
+  ev.payload = payload;
   ev.name_id = internName(name);
   ev.kind = (int8_t)kind;
   trace_next_ = (trace_next_ + 1) % trace_cap_;
@@ -254,10 +256,18 @@ std::string Engine::traceJson() {
     const TraceEvent& ev = trace_[i];
     if (!first) out << ",";
     first = false;
+    // kind-appropriate payload key: mm events carry FLOPs, memory events
+    // carry bytes (pjrt_patch d2h/h2d), anything else is opaque payload
+    static const char* kPayloadKey[] = {"flops", "payload", "bytes"};
+    // a NaN/inf payload from instrumentation must not poison the whole
+    // trace JSON (json parsers reject bare nan/inf)
+    double payload = std::isfinite(ev.payload) ? ev.payload : 0.0;
     out << "{\"name\":\"" << names_[ev.name_id] << "\",\"cat\":\""
         << kKindName[(int)ev.kind] << "\",\"ph\":\"X\",\"ts\":" << ev.ts_us
         << ",\"dur\":" << ev.dur_us << ",\"pid\":" << rank_
-        << ",\"tid\":" << (int)ev.kind << "}";
+        << ",\"tid\":" << (int)ev.kind
+        << ",\"args\":{\"" << kPayloadKey[(int)ev.kind] << "\":"
+        << payload << "}}";
   }
   out << "]}";
   return out.str();
