@@ -26,7 +26,7 @@ import os
 import queue
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -85,6 +85,7 @@ class CheckpointEngine:
         world_size: Optional[int] = None,
         rank: Optional[int] = None,
         replica_manager=None,
+        saving_ranks: Optional[Sequence[int]] = None,
     ):
         self.ckpt_dir = ckpt_dir
         self.job_name = job_name or os.getenv(EnvKey.JOB_NAME, "local")
@@ -129,7 +130,18 @@ class CheckpointEngine:
         if replica_manager is None:
             replica_manager = self._replica_manager_from_env()
         self._replicas = replica_manager
+        # the saver group: exactly the ranks that CALL save (reference
+        # saving-ranks concept, megatron_engine.py:71 / engine.py:241 —
+        # DDP saves on local-rank-0s only, sharded engines on every rank).
+        # Default: every rank saves (the jax norm — each rank owns shards).
+        # Readiness coordination runs within this group only.
+        self.saving_ranks = (
+            sorted(saving_ranks) if saving_ranks is not None
+            else list(range(self.world_size))
+        )
         self._latest_step = -1
+        self._prev_ready_step: Optional[int] = None
+        self._ready_cooldown_until = 0.0
         self._drain_thread: Optional[threading.Thread] = None
         self._drain_ok = False
         # donation safety (see _plan_state): snapshot shards on-device
@@ -155,7 +167,7 @@ class CheckpointEngine:
     # -- save --------------------------------------------------------------
 
     def save_to_memory(self, step: int, state, blocking: bool = False,
-                       _on_drained=None) -> bool:
+                       _on_drained=None, _wait_busy_s: float = 0.0) -> bool:
         """Snapshot ``state`` into shm. Returns False if skipped (previous
         snapshot still draining, or agent busy persisting — reference
         engine.py:340 skips rather than blocks).
@@ -169,19 +181,38 @@ class CheckpointEngine:
         HBM until the drain finishes. ``blocking=True`` restores the
         synchronous reference behavior (used by breakpoint saves where the
         process is about to exit)."""
+        local_ready, acquired, why = True, False, ""
         if self._drain_thread is not None and self._drain_thread.is_alive():
+            if _wait_busy_s > 0:
+                self.wait_drained(_wait_busy_s)
+            if self._drain_thread.is_alive():
+                local_ready, why = False, "previous snapshot draining"
+        if local_ready and self._save_lock is not None:
+            acquired = self._save_lock.acquire(blocking=False)
+            if not acquired:
+                local_ready, why = False, "agent persisting previous"
+        # all-or-none across ranks: a save only proceeds if EVERY rank is
+        # ready (reference check_all_rank_ready, engine.py:57 — gloo
+        # allgather; here the master KV exchanges the flags). Without this,
+        # ranks whose drains finish at different times persist different
+        # steps and no step directory ever collects all its frames.
+        try:
+            ready = self._all_ranks_ready(
+                step, local_ready, min_wait=_wait_busy_s
+            )
+        except Exception:
+            # never leak the shared lock: the agent's persist path and all
+            # future saves block on it for the process lifetime otherwise
+            if acquired:
+                self._save_lock.release()
+            raise
+        if not ready:
+            if acquired:
+                self._save_lock.release()
             logger.info(
-                "step %s: skip memory save, previous snapshot draining",
-                step,
+                "step %s: skip save, %s", step, why or "a peer rank is busy"
             )
             return False
-        if self._save_lock is not None:
-            if not self._save_lock.acquire(blocking=False):
-                logger.info(
-                    "step %s: skip memory save, agent persisting previous",
-                    step,
-                )
-                return False
         try:
             meta, pending = self._plan_state(step, state)
             if self._meta_dict is not None:
@@ -256,6 +287,79 @@ class CheckpointEngine:
             self._drain_thread.start()
         return True
 
+    def _all_ranks_ready(self, step: int, local_ready: bool,
+                         min_wait: float = 0.0) -> bool:
+        """Exchange readiness for save attempt ``step`` across all ranks
+        via the master KV; True only if every rank posted ready. Single
+        rank / no master → the local flag decides. A rank that never posts
+        (crashed, hung) times the others out → everyone skips, training
+        continues, the next attempt retries.
+        """
+        group = self.saving_ranks
+        if len(group) <= 1 or self._master is None or self.rank not in group:
+            return local_ready
+        # cooldown after a timed-out exchange (peer dead or wedged): skip
+        # cheaply instead of re-paying the full poll on every attempt while
+        # the master's failure detection catches up and restarts the world
+        if time.time() < self._ready_cooldown_until:
+            return False
+        # the poll must outlast peer skew: storage-save attempts wait out
+        # their drains first, so peers can arrive up to ``min_wait`` later
+        timeout_s = max(
+            float(os.getenv("DLROVER_TPU_CKPT_READY_TIMEOUT", "10")),
+            min_wait,
+        )
+        base = f"ckpt/{self.job_name}/ready/{step}"
+        keys = [f"{base}/{r}" for r in group]
+        try:
+            self._master.kv_set(
+                f"{base}/{self.rank}", b"1" if local_ready else b"0"
+            )
+            deadline = time.time() + timeout_s
+            abort_key = f"{base}/abort"
+            while True:
+                vals = self._master.kv_multi_get(keys + [abort_key])
+                if vals[-1]:
+                    # a peer timed out waiting on this attempt — all-or-
+                    # none demands we skip too, even if all flags read 1
+                    # by now (closes the late-arrival race: a straggler
+                    # must not save a step its peers already gave up on)
+                    ok = False
+                    break
+                vals = vals[:-1]
+                if all(vals):
+                    ok = all(v == b"1" for v in vals)
+                    break
+                if time.time() > deadline:
+                    logger.warning(
+                        "step %s: readiness exchange timed out "
+                        "(%d/%d saver ranks posted) — skipping save",
+                        step, sum(bool(v) for v in vals), len(group),
+                    )
+                    self._master.kv_set(abort_key, b"1")
+                    self._ready_cooldown_until = time.time() + timeout_s
+                    ok = False
+                    break
+                time.sleep(0.02)
+            # GC the previous attempt's keys — fully resolved by the time
+            # a newer attempt starts (all ranks call saves in step order)
+            if self.rank == group[0] and self._prev_ready_step not in (
+                None, step,
+            ):
+                prev = f"ckpt/{self.job_name}/ready/{self._prev_ready_step}"
+                for r in group:
+                    self._master.kv_delete(f"{prev}/{r}")
+                self._master.kv_delete(f"{prev}/abort")
+            self._prev_ready_step = step
+            return ok
+        except (ConnectionError, RuntimeError) as e:
+            # master unreachable or RPC-layer error (e.g. breakpoint save
+            # during teardown): fall back to the local decision rather
+            # than losing the save or poisoning the save lock
+            logger.warning("readiness exchange unavailable (%r) — using "
+                           "local decision", e)
+            return local_ready
+
     def wait_drained(self, timeout_s: Optional[float] = None) -> bool:
         """Block until the in-flight snapshot (if any) lands; returns False
         on timeout OR if the drain failed (the snapshot was lost)."""
@@ -283,10 +387,14 @@ class CheckpointEngine:
 
         # bare workers (no agent) persist in-process: stay synchronous so
         # "save returned" keeps meaning "bytes durable", as before; with an
-        # agent the persist is its job and only the drain rides our thread
+        # agent the persist is its job and only the drain rides our thread.
+        # Storage saves are rare and durability-bearing — wait out a busy
+        # drain (bounded) instead of skipping, so fast-stepping jobs can't
+        # starve the disk cadence.
+        wait_s = float(os.getenv("DLROVER_TPU_CKPT_STORAGE_WAIT", "60"))
         return self.save_to_memory(
             step, state, blocking=not self._has_agent,
-            _on_drained=_request_persist,
+            _on_drained=_request_persist, _wait_busy_s=wait_s,
         )
 
     def _plan_state(self, step: int, state) -> Tuple[Dict, List]:

@@ -48,7 +48,10 @@ world = ctx.world_size
 # fixed global batch: fewer replicas -> more grad-accum per replica
 accum = max(1, global_batch // max(1, world))
 state = {"step": 0}
-ckpt = Checkpointer(ckpt_dir)
+# single-writer pattern: rank 0 owns the (replicated) state and is the
+# only saver — declare the saver group so readiness coordination does not
+# wait on ranks that never call save
+ckpt = Checkpointer(ckpt_dir, saving_ranks=[0])
 state, last = ckpt.load_checkpoint(state)
 start = last + 1 if last >= 0 else 0
 with open(log_path, "a") as f:

@@ -338,3 +338,95 @@ def test_restore_dispatch_is_parallel():
     # numpy targets keep their historical writability despite the
     # zero-copy frombuffer fast path
     assert out["a"].flags.writeable
+
+
+class _FakeKVMaster:
+    """Just the KV surface the readiness exchange uses, shared across
+    'ranks' in-process."""
+
+    def __init__(self):
+        from dlrover_tpu.master.kv_store import KVStoreService
+
+        self._kv = KVStoreService()
+
+    def kv_set(self, k, v):
+        self._kv.set(k, v)
+
+    def kv_multi_get(self, keys):
+        return self._kv.multi_get(keys)
+
+    def kv_delete(self, k):
+        self._kv.delete(k)
+
+
+def _engine(tmp_path, rank, world, master, lr):
+    return CheckpointEngine(
+        str(tmp_path), job_name=JOB, node_rank=0, local_rank=lr,
+        ipc_socket="/nonexistent", world_size=world, rank=rank,
+        master_client=master,
+    )
+
+
+def test_save_skipped_on_all_ranks_when_one_busy(tmp_path, mesh):
+    """All-or-none saves (reference check_all_rank_ready engine.py:57):
+    if any rank's drain is busy, EVERY rank skips — so persisted step
+    dirs always collect all frames."""
+    master = _FakeKVMaster()
+    e0 = _engine(tmp_path, 0, 2, master, 0)
+    e1 = _engine(tmp_path, 1, 2, master, 1)
+    state = make_state(mesh)
+    # warm both (coordinated attempt must run on both ranks concurrently)
+    t = threading.Thread(target=lambda: e1.save_to_memory(1, state))
+    t.start()
+    assert e0.save_to_memory(1, state)
+    t.join()
+    assert e0.wait_drained(60) and e1.wait_drained(60)
+
+    # fake a busy drain on rank 1
+    release = threading.Event()
+    e1._drain_thread = threading.Thread(target=release.wait)
+    e1._drain_thread.start()
+    os.environ["DLROVER_TPU_CKPT_READY_TIMEOUT"] = "10"
+    try:
+        got = {}
+        t = threading.Thread(
+            target=lambda: got.update(r1=e1.save_to_memory(2, state))
+        )
+        t.start()
+        got["r0"] = e0.save_to_memory(2, state)  # rank 0 is ready…
+        t.join()
+        # …but must skip because rank 1 was not
+        assert got == {"r0": False, "r1": False}
+    finally:
+        release.set()
+        e1._drain_thread.join()
+        os.environ.pop("DLROVER_TPU_CKPT_READY_TIMEOUT", None)
+
+    # both ready again → both save
+    t = threading.Thread(target=lambda: got.update(r1=e1.save_to_memory(3, state)))
+    t.start()
+    got["r0"] = e0.save_to_memory(3, state)
+    t.join()
+    assert got == {"r0": True, "r1": True}
+    assert e0.wait_drained(60) and e1.wait_drained(60)
+
+
+def test_storage_save_waits_out_busy_drain(tmp_path, mesh):
+    """Disk saves must not be starved by fast steps: a busy drain is
+    waited out (bounded), not skipped."""
+    engine = CheckpointEngine(
+        str(tmp_path), job_name=JOB, node_rank=0, local_rank=0,
+        ipc_socket="/nonexistent", world_size=1, rank=0,
+    )
+    state = make_state(mesh)
+    done = threading.Event()
+    engine._drain_thread = threading.Thread(
+        target=lambda: (time.sleep(0.5), done.set())
+    )
+    engine._drain_thread.start()
+    t0 = time.time()
+    assert engine.save_to_storage(5, state)
+    assert done.is_set(), "storage save should have waited for the drain"
+    assert time.time() - t0 >= 0.4
+    restored, step = engine.load(jax.tree.map(lambda x: x, state))
+    assert step == 5
