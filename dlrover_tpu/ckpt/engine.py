@@ -140,7 +140,8 @@ class CheckpointEngine:
             else list(range(self.world_size))
         )
         self._latest_step = -1
-        self._prev_ready_step: Optional[int] = None
+        self._save_seq = 0  # per-engine save-attempt counter (all ranks
+        # call saves in the same order, so it agrees across the group)
         self._ready_cooldown_until = 0.0
         self._drain_thread: Optional[threading.Thread] = None
         self._drain_ok = False
@@ -289,68 +290,74 @@ class CheckpointEngine:
 
     def _all_ranks_ready(self, step: int, local_ready: bool,
                          min_wait: float = 0.0) -> bool:
-        """Exchange readiness for save attempt ``step`` across all ranks
+        """Exchange readiness for this save attempt across the saver group
         via the master KV; True only if every rank posted ready. Single
-        rank / no master → the local flag decides. A rank that never posts
-        (crashed, hung) times the others out → everyone skips, training
-        continues, the next attempt retries.
+        rank / no master → the local flag decides.
+
+        Attempts are identified by a per-engine call counter, NOT the
+        step: every rank calls saves in the same program order, so the
+        n-th call is the same logical attempt everywhere, and two saves at
+        the same step (memory then disk) get distinct, fresh keys — stale
+        flags from an earlier attempt can never satisfy a later one.
+
+        Failure shape under asynchrony: a rank that never posts (crashed,
+        hung) times the others out and they skip; if its flag lands just
+        after a peer's deadline the attempts can split (it saves, they
+        don't) — that costs one incomplete step directory, which commit
+        tolerates (superseded later), and the next attempt re-syncs. After
+        a timeout the rank enters a cooldown during which it posts
+        not-ready cheaply instead of polling, so peers fail fast rather
+        than each re-paying the timeout in turn.
         """
         group = self.saving_ranks
         if len(group) <= 1 or self._master is None or self.rank not in group:
             return local_ready
-        # cooldown after a timed-out exchange (peer dead or wedged): skip
-        # cheaply instead of re-paying the full poll on every attempt while
-        # the master's failure detection catches up and restarts the world
-        if time.time() < self._ready_cooldown_until:
-            return False
-        # the poll must outlast peer skew: storage-save attempts wait out
-        # their drains first, so peers can arrive up to ``min_wait`` later
-        timeout_s = max(
-            float(os.getenv("DLROVER_TPU_CKPT_READY_TIMEOUT", "10")),
-            min_wait,
-        )
-        base = f"ckpt/{self.job_name}/ready/{step}"
-        keys = [f"{base}/{r}" for r in group]
+        self._save_seq += 1
+        base = f"ckpt/{self.job_name}/ready/{self._save_seq}"
+        cooling = time.time() < self._ready_cooldown_until
         try:
             self._master.kv_set(
-                f"{base}/{self.rank}", b"1" if local_ready else b"0"
+                f"{base}/{self.rank}",
+                b"1" if (local_ready and not cooling) else b"0",
             )
+            if cooling or not local_ready:
+                # outcome already determined by our own not-ready flag —
+                # peers read it and fail fast; no need to wait for them
+                return False
+            # the poll must outlast peer skew: storage-save attempts wait
+            # out their drains first, so peers arrive up to min_wait later
+            timeout_s = max(
+                float(os.getenv("DLROVER_TPU_CKPT_READY_TIMEOUT", "10")),
+                min_wait,
+            )
+            keys = [f"{base}/{r}" for r in group]
             deadline = time.time() + timeout_s
-            abort_key = f"{base}/abort"
             while True:
-                vals = self._master.kv_multi_get(keys + [abort_key])
-                if vals[-1]:
-                    # a peer timed out waiting on this attempt — all-or-
-                    # none demands we skip too, even if all flags read 1
-                    # by now (closes the late-arrival race: a straggler
-                    # must not save a step its peers already gave up on)
-                    ok = False
-                    break
-                vals = vals[:-1]
+                vals = self._master.kv_multi_get(keys)
                 if all(vals):
                     ok = all(v == b"1" for v in vals)
                     break
                 if time.time() > deadline:
                     logger.warning(
-                        "step %s: readiness exchange timed out "
-                        "(%d/%d saver ranks posted) — skipping save",
-                        step, sum(bool(v) for v in vals), len(group),
+                        "save attempt %s (step %s): readiness exchange "
+                        "timed out (%d/%d saver ranks posted) — skipping "
+                        "save",
+                        self._save_seq, step,
+                        sum(bool(v) for v in vals), len(group),
                     )
-                    self._master.kv_set(abort_key, b"1")
-                    self._ready_cooldown_until = time.time() + timeout_s
+                    self._ready_cooldown_until = time.time() + float(
+                        os.getenv("DLROVER_TPU_CKPT_READY_COOLDOWN", "30")
+                    )
                     ok = False
                     break
                 time.sleep(0.02)
-            # GC the previous attempt's keys — fully resolved by the time
-            # a newer attempt starts (all ranks call saves in step order)
-            if self.rank == group[0] and self._prev_ready_step not in (
-                None, step,
-            ):
-                prev = f"ckpt/{self.job_name}/ready/{self._prev_ready_step}"
+            # GC old attempts with a generous lag (a straggler may still
+            # be polling the previous attempt's keys — never delete those)
+            gc_seq = self._save_seq - 8
+            if self.rank == group[0] and gc_seq > 0:
+                old = f"ckpt/{self.job_name}/ready/{gc_seq}"
                 for r in group:
-                    self._master.kv_delete(f"{prev}/{r}")
-                self._master.kv_delete(f"{prev}/abort")
-            self._prev_ready_step = step
+                    self._master.kv_delete(f"{old}/{r}")
             return ok
         except (ConnectionError, RuntimeError) as e:
             # master unreachable or RPC-layer error (e.g. breakpoint save

@@ -124,14 +124,21 @@ def hash_features(raw: jnp.ndarray, config: DLRMConfig) -> jnp.ndarray:
     """Map raw categorical ids (B, F) int — arbitrary range — into the
     stacked table's row space: field f occupies rows [f·B, (f+1)·B).
 
-    A multiplicative hash (Knuth) stands in for the reference's
+    An avalanche mixer (murmur3 finalizer) stands in for the reference's
     string-hashing feature column; collisions are the standard
-    hashed-embedding trade.
+    hashed-embedding trade. A bare multiplicative hash mod 2^k would keep
+    only the low bits (ids differing by a multiple of the bucket count
+    would always collide) — the xor-shift rounds mix the high bits in
+    before the modulo.
     """
     c = config
-    h = (raw.astype(jnp.uint32) * jnp.uint32(2654435761)) % jnp.uint32(
-        c.hash_buckets
-    )
+    h = raw.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x45D9F3B)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x45D9F3B)
+    h = h ^ (h >> 16)
+    h = h % jnp.uint32(c.hash_buckets)
     offsets = (jnp.arange(c.n_sparse, dtype=jnp.uint32) * c.hash_buckets)
     return (h + offsets[None, :]).astype(jnp.int32)
 

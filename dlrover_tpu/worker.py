@@ -116,6 +116,41 @@ class WorkerContext:
             return {}
 
 
+def _enable_compilation_cache() -> None:
+    """Point XLA's persistent compilation cache at a per-host directory.
+
+    Elastic restarts re-spawn worker processes, and under jit the first
+    step would otherwise pay full recompilation (tens of seconds for a
+    real model) every restart — the dominant term in restart-to-training
+    time on TPU, where the reference's torch workers pay nothing. With the
+    cache, a restarted worker (same world shape) deserializes the
+    executable instead (SURVEY.md §7 hard part b). Opt out with
+    DLROVER_TPU_COMPILE_CACHE=off; the directory survives process death by
+    design — it must live OUTSIDE any per-run tmpdir.
+    """
+    cache = os.getenv("DLROVER_TPU_COMPILE_CACHE", "")
+    if cache.lower() in ("off", "0", "disable"):
+        return
+    if not cache:
+        cache = os.path.join(
+            os.path.expanduser("~/.cache"), "dlrover_tpu", "xla_cache"
+        )
+    try:
+        os.makedirs(cache, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache)
+        # cache everything that took meaningful XLA time (the threshold is
+        # against compile time proper, not trace+lower wall time — keep it
+        # low or real train steps get filtered); tiny probe computations
+        # stay uncached to keep the directory lean
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.05)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        logger.info("XLA compilation cache at %s", cache)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        logger.warning("compilation cache unavailable: %r", e)
+
+
 def init(initialize_jax_distributed: bool = True) -> WorkerContext:
     """Bootstrap the worker from the agent-provided environment.
 
@@ -126,6 +161,7 @@ def init(initialize_jax_distributed: bool = True) -> WorkerContext:
     """
     rank = int(os.getenv(EnvKey.RANK, "0"))
     world_size = int(os.getenv(EnvKey.WORLD_SIZE, "1"))
+    _enable_compilation_cache()
     coordinator = os.getenv(EnvKey.COORDINATOR_ADDR, "")
     if initialize_jax_distributed and world_size > 1 and coordinator:
         import jax

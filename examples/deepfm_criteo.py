@@ -123,7 +123,13 @@ def main() -> int:
     ckpt_state = {"train": state, "shard_ckpt": ""}
     ckpt_state, start_step = ckpt.load_checkpoint(ckpt_state)
     state = ckpt_state["train"]
-    if sharding_client is not None and ctx.is_leader and ckpt_state["shard_ckpt"]:
+    # restore the shard queue only on a FULL job restart (fresh master,
+    # restart_count 0): a worker-only restart keeps the master's live
+    # queue, and rewinding it would re-serve surviving workers' shards
+    if (
+        sharding_client is not None and ctx.is_leader
+        and ctx.restart_count == 0 and ckpt_state["shard_ckpt"]
+    ):
         sharding_client.restore_shard_checkpoint(ckpt_state["shard_ckpt"])
     if start_step >= 0 and ctx.is_leader:
         print(f"resumed from step {start_step}", flush=True)
@@ -145,7 +151,10 @@ def main() -> int:
             }
             state, result = trainer.train_step(state, batch)
             to_disk = step % CKPT_EVERY == 0
-            if sharding_client is not None and to_disk:
+            if sharding_client is not None:
+                # refreshed EVERY save so the queue snapshot matches the
+                # train state it rides with (a stale snapshot would rewind
+                # the data stream past data already trained)
                 ckpt_state["shard_ckpt"] = sharding_client.shard_checkpoint()
             ckpt_state["train"] = state
             ckpt.save_checkpoint(
