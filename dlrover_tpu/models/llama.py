@@ -189,7 +189,8 @@ def _rope(x, positions, theta: float):
 def _flash_shardable(mesh, batch: int, n_heads: int) -> bool:
     """Whether the short-context flash layout (batch over dp/fsdp, heads
     over tp, sequence resident) divides the mesh evenly."""
-    dp = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    dp = (mesh.shape.get("dcn", 1) * mesh.shape.get("dp", 1)
+          * mesh.shape.get("fsdp", 1))
     tp = mesh.shape.get("tp", 1)
     sp = mesh.shape.get("sp", 1)
     return sp == 1 and batch % dp == 0 and n_heads % tp == 0
@@ -379,7 +380,7 @@ def forward_pp(
     ym = pipeline_apply(
         stage_fn, stages, microbatch(x, M), mesh,
         axis="pp", checkpoint_ticks=not c.remat,
-        batch_axes=("dp", "fsdp"),
+        batch_axes=("dcn", "dp", "fsdp"),
     )
     y = unmicrobatch(ym)
     y = _rms_norm(y, params["final_norm"], c.norm_eps)

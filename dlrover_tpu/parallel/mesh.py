@@ -5,12 +5,15 @@ DeepSpeed (SURVEY.md §2.7). A TPU-native framework owns this layer: one
 ``Mesh`` whose named axes carry every strategy, with XLA GSPMD inserting the
 collectives:
 
+- ``dcn``  — data parallel ACROSS pod slices (outermost: traffic rides the
+  data-center network, not ICI — only the once-per-step gradient
+  all-reduce belongs here; the multi-slice "hybrid mesh" recipe)
 - ``dp``   — pure data parallel (params replicated)
 - ``fsdp`` — data parallel with fully-sharded params/opt state (ZeRO-3)
 - ``sp``   — sequence/context parallel (ring attention axis, long context)
 - ``tp``   — tensor parallel (innermost: highest-bandwidth ICI neighbors)
 - ``ep``   — expert parallel for MoE layers (groups experts across hosts)
-- ``pp``   — pipeline stages (outermost: least traffic between stages)
+- ``pp``   — pipeline stages (outer: least traffic between stages)
 
 Elastic re-mesh policy: ``tp``/``pp``/``ep`` are fixed by the model shapes;
 ``dp × fsdp`` absorbs world-size changes (reference analogue: ElasticTrainer
@@ -26,7 +29,7 @@ import numpy as np
 from dlrover_tpu.common.log import logger
 
 # axis order: outermost (cheapest link, least traffic) → innermost
-AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+AXIS_ORDER = ("dcn", "pp", "dp", "fsdp", "ep", "sp", "tp")
 
 # axes whose size is fixed by the model, not the cluster
 MODEL_AXES = ("pp", "tp", "ep")
@@ -51,8 +54,9 @@ class MeshPlan:
     @property
     def dp_total(self) -> int:
         """Number of data-parallel replicas of the batch axis
-        (dp × fsdp: both shard the batch; fsdp additionally shards params)."""
-        return self.size("dp") * self.size("fsdp")
+        (dcn × dp × fsdp: all shard the batch; fsdp additionally shards
+        params within a slice)."""
+        return self.size("dcn") * self.size("dp") * self.size("fsdp")
 
     def nontrivial_axes(self) -> List[str]:
         return [a for a in AXIS_ORDER if self.size(a) > 1]
@@ -66,19 +70,28 @@ def plan_mesh(
     sp: int = 1,
     fsdp: Optional[int] = None,
     dp: Optional[int] = None,
+    dcn: int = 1,
 ) -> MeshPlan:
     """Fill in dp/fsdp so the axis product covers ``n_devices``.
 
     Unspecified ``fsdp`` absorbs the remainder (ZeRO-style sharding is the
     TPU default — params live sharded in HBM); set ``fsdp=1, dp=None`` for
-    pure replication.
+    pure replication. ``dcn`` = number of pod slices: every other axis
+    lives within one slice (ICI); only the dcn gradient all-reduce crosses
+    the data-center network.
     """
-    fixed = tp * pp * ep * sp
-    if n_devices % fixed != 0:
+    if n_devices % dcn != 0:
         raise ValueError(
-            f"n_devices={n_devices} not divisible by tp*pp*ep*sp={fixed}"
+            f"n_devices={n_devices} not divisible by dcn={dcn} slices"
         )
-    remainder = n_devices // fixed
+    per_slice = n_devices // dcn
+    fixed = tp * pp * ep * sp
+    if per_slice % fixed != 0:
+        raise ValueError(
+            f"per-slice devices {per_slice} not divisible by "
+            f"tp*pp*ep*sp={fixed}"
+        )
+    remainder = per_slice // fixed
     if fsdp is None and dp is None:
         fsdp, dp = remainder, 1
     elif fsdp is None:
@@ -97,7 +110,8 @@ def plan_mesh(
             f"(n_devices={n_devices}, fixed={fixed})"
         )
     return MeshPlan(axes={
-        "pp": pp, "dp": dp, "fsdp": fsdp, "ep": ep, "sp": sp, "tp": tp,
+        "dcn": dcn, "pp": pp, "dp": dp, "fsdp": fsdp, "ep": ep, "sp": sp,
+        "tp": tp,
     })
 
 
@@ -114,6 +128,35 @@ def build_mesh(plan: MeshPlan, devices: Optional[list] = None):
         raise ValueError(
             f"plan needs {plan.n_devices} devices, have {len(devices)}"
         )
+    dcn = plan.size("dcn")
+    if dcn > 1:
+        # slice-major ordering so the leading dcn axis maps whole slices:
+        # every intra-slice axis then lives on ICI and only dcn crosses
+        # the DCN (jax mesh_utils hybrid-mesh recipe). Pick per-slice
+        # blocks from real slice_index groups when present — a dcn row
+        # silently spanning physical slices would put fsdp/tp collectives
+        # on the data-center network. Virtual/CPU devices carry no
+        # slice_index — contiguous id blocks stand in for slices.
+        per_slice = plan.n_devices // dcn
+        groups: Dict[int, list] = {}
+        for d in devices:
+            groups.setdefault(getattr(d, "slice_index", None) or 0, []
+                              ).append(d)
+        if len(groups) > 1:
+            full = [g for g in sorted(groups) if len(groups[g]) >= per_slice]
+            if len(full) < dcn:
+                raise ValueError(
+                    f"plan wants dcn={dcn} slices of {per_slice} devices "
+                    f"but only {len(full)} slices have enough "
+                    f"({ {g: len(v) for g, v in sorted(groups.items())} }); "
+                    "replan with a smaller dcn"
+                )
+            devices = [
+                d for g in full[:dcn]
+                for d in sorted(groups[g], key=lambda d: d.id)[:per_slice]
+            ]
+        else:
+            devices = sorted(devices, key=lambda d: d.id)[: plan.n_devices]
     shape = tuple(plan.size(a) for a in AXIS_ORDER)
     dev_array = np.array(devices[: plan.n_devices]).reshape(shape)
     return Mesh(dev_array, AXIS_ORDER)
@@ -123,8 +166,10 @@ class ElasticMeshManager:
     """Re-plans the mesh when the world size changes (the TPU analogue of
     elastic DDP world re-formation)."""
 
-    def __init__(self, tp: int = 1, pp: int = 1, ep: int = 1, sp: int = 1):
+    def __init__(self, tp: int = 1, pp: int = 1, ep: int = 1, sp: int = 1,
+                 dcn: int = 1):
         self._tp, self._pp, self._ep, self._sp = tp, pp, ep, sp
+        self._dcn = dcn
         self._plan: Optional[MeshPlan] = None
 
     @property
@@ -152,8 +197,16 @@ class ElasticMeshManager:
                 "using %s of %s devices (world must be a multiple of %s)",
                 usable, n_devices, self.min_unit,
             )
+        # losing a whole pod slice shrinks dcn instead of failing: pick
+        # the largest slice count ≤ the configured one that still divides
+        # the usable world (dcn elasticity = reference node-group
+        # elasticity, lifted to slices)
+        dcn = self._dcn
+        while dcn > 1 and usable % (dcn * self.min_unit) != 0:
+            dcn -= 1
         self._plan = plan_mesh(
-            usable, tp=self._tp, pp=self._pp, ep=self._ep, sp=self._sp
+            usable, tp=self._tp, pp=self._pp, ep=self._ep, sp=self._sp,
+            dcn=dcn,
         )
         logger.info("mesh plan for %s devices: %s", usable, self._plan.axes)
         return self._plan

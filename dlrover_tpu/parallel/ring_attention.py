@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dlrover_tpu.parallel.sharding import clamp_spec
+
 from dlrover_tpu.ops.flash_attention import flash_attention
 
 
@@ -166,7 +168,7 @@ def ring_attention(
     q, k, v,
     mesh: Mesh,
     sp_axis: str = "sp",
-    batch_spec=P(("dp", "fsdp"), "tp", "sp", None),
+    batch_spec=None,
     scale: Optional[float] = None,
     use_pallas: Optional[bool] = None,
     block_q: int = 512,
@@ -179,6 +181,10 @@ def ring_attention(
     shard_map. ``use_pallas`` selects the fused flash inner kernel
     (default: on TPU backends).
     """
+    if batch_spec is None:
+        # library default, clamped to the mesh's axes; an explicit caller
+        # spec is passed through verbatim so typos still fail loudly
+        batch_spec = clamp_spec(mesh, P(("dcn", "dp", "fsdp"), "tp", "sp", None))
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
@@ -203,7 +209,7 @@ def ring_attention(
 def sharded_flash_attention(
     q, k, v,
     mesh: Mesh,
-    batch_spec=P(("dp", "fsdp"), "tp", None, None),
+    batch_spec=None,
     scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 1024,
@@ -216,6 +222,10 @@ def sharded_flash_attention(
     per-device block the kernel sees. Callers must ensure the batch/head
     dims divide the mesh axes (see models/llama.py:_attention).
     """
+    if batch_spec is None:
+        batch_spec = clamp_spec(
+            mesh, P(("dcn", "dp", "fsdp"), "tp", None, None)
+        )
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     fn = functools.partial(
         flash_attention, causal=True, scale=scale,

@@ -16,7 +16,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 # over tp (output projection all-gathers logits); "expert" over ep;
 # "seq" over sp (ring attention axis); "layers"/"stage" over pp.
 DEFAULT_RULES: Dict[str, Optional[object]] = {
-    "batch": ("dp", "fsdp"),
+    "batch": ("dcn", "dp", "fsdp"),
     "seq": "sp",
     "embed": "fsdp",
     "heads": "tp",
@@ -81,13 +81,32 @@ def valid_spec_for(mesh, shape, logical_axes: Sequence[Optional[str]],
     re-mesh landing on fsdp=3 with a dim of 64 replicates that dim instead
     of failing. GSPMD would need padding for uneven shards; replication is
     always-correct and the planner keeps axes power-of-two in practice."""
-    spec = spec_for(logical_axes, rules)
+    spec = clamp_spec(mesh, spec_for(logical_axes, rules))
     cleaned = []
     for dim, axis in zip(shape, spec):
         size = _axis_size(mesh, axis)
         cleaned.append(axis if (size > 1 and dim % size == 0) else
                        (axis if size == 1 else None))
     return P(*cleaned)
+
+
+def clamp_spec(mesh, spec: P) -> P:
+    """Drop axis names the mesh doesn't carry from a PartitionSpec.
+
+    The library-default batch specs name every data axis incl. ``dcn``;
+    hand-built meshes (tests, user code with custom axes) may omit some —
+    sharding over an absent axis is a no-op anyway, so dropping the name
+    is semantics-preserving and keeps shard_map's axis check happy.
+    """
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh.shape)
+            return kept if len(kept) > 1 else (kept[0] if kept else None)
+        return entry if entry in mesh.shape else None
+
+    return P(*[keep(e) for e in spec])
 
 
 def shard_tree(mesh, state, logical_tree, rules: Optional[Dict] = None):
@@ -110,17 +129,21 @@ def shard_tree(mesh, state, logical_tree, rules: Optional[Dict] = None):
 
 
 def batch_sharding(mesh) -> NamedSharding:
-    """Input batch: (batch, seq) over ((dp, fsdp), sp)."""
-    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    """Input batch: (batch, seq) over ((dcn, dp, fsdp), sp)."""
+    return NamedSharding(mesh, clamp_spec(mesh, P(("dcn", "dp", "fsdp"), "sp")))
 
 
-def with_batch_constraint(x):
-    """Annotate an activation inside jit: batch over data axes, seq over sp."""
+def with_batch_constraint(x, mesh=None):
+    """Annotate an activation inside jit: batch over data axes, seq over sp.
+
+    Pass ``mesh`` when it may lack some data axes (hand-built meshes) so
+    the spec clamps to the axes that exist."""
     import jax
 
-    return jax.lax.with_sharding_constraint(
-        x, P(("dp", "fsdp"), "sp")
-    )
+    spec = P(("dcn", "dp", "fsdp"), "sp")
+    if mesh is not None:
+        spec = clamp_spec(mesh, spec)
+    return jax.lax.with_sharding_constraint(x, spec)
 
 
 def global_batch_from_local(mesh, local_batch, spec: Optional[P] = None):
@@ -137,7 +160,9 @@ def global_batch_from_local(mesh, local_batch, spec: Optional[P] = None):
     import jax
     import numpy as np
 
-    spec = spec if spec is not None else P(("dp", "fsdp"))
+    spec = spec if spec is not None else clamp_spec(
+        mesh, P(("dcn", "dp", "fsdp"))
+    )
     sharding = NamedSharding(mesh, spec)
     local = np.asarray(local_batch)
     if jax.process_count() == 1:

@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dlrover_tpu.parallel.sharding import clamp_spec
+
 from dlrover_tpu.ops.flash_attention import flash_attention
 
 
@@ -94,7 +96,7 @@ def ulysses_attention(
     q, k, v,
     mesh: Mesh,
     sp_axis: str = "sp",
-    batch_spec=P(("dp", "fsdp"), "tp", "sp", None),
+    batch_spec=None,
     scale: Optional[float] = None,
     use_pallas: Optional[bool] = None,
     block_q: int = 512,
@@ -109,6 +111,12 @@ def ulysses_attention(
     q's shape/sharding. Per-device head counts (for q AND kv) must be
     divisible by the sp axis size.
     """
+    if batch_spec is None:
+        # library default, clamped to the mesh's axes; explicit caller
+        # specs pass through verbatim so typos still fail loudly
+        batch_spec = clamp_spec(
+            mesh, P(("dcn", "dp", "fsdp"), "tp", "sp", None)
+        )
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
