@@ -84,10 +84,11 @@ def bench_train() -> dict:
     batch = int(os.environ.get("BENCH_BATCH", "4" if on_tpu else "2"))
     steps = int(os.environ.get("BENCH_STEPS", "8" if on_tpu else "2"))
     heads = max(1, dim // 128)
+    remat = os.environ.get("BENCH_REMAT", "1") != "0"
     config = llama.LlamaConfig(
         vocab_size=32000, dim=dim, n_layers=layers, n_heads=heads,
         n_kv_heads=max(1, heads // 2), ffn_dim=int(2.75 * dim) // 256 * 256,
-        max_seq_len=seq, remat=True,
+        max_seq_len=seq, remat=remat,
     )
     n_params = llama.num_params(config)
 

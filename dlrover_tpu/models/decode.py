@@ -1,0 +1,197 @@
+"""KV-cache autoregressive decoding for the Llama-family models, TPU-first.
+
+The reference delegates generation to vLLM/Megatron inside its RL examples
+(SURVEY.md §2.5); a from-scratch TPU stack owns the rollout path. Design
+for XLA:
+
+- **static shapes end to end**: the cache is a fixed ``(L, B, T, KV, Dh)``
+  buffer; each step writes one position via ``dynamic_update_slice`` and
+  masks scores past ``pos`` — no growing arrays, so the whole generate
+  loop is ONE compiled program (``lax.scan``), not a recompile per length
+  (the naive concat loop recompiles at every new sequence length);
+- **prefill is a single batched pass**: the prompt runs through the dense
+  causal forward once, k/v captured per layer on the way — MXU-shaped,
+  not token-at-a-time;
+- decode steps are memory-bound matvecs by nature; keeping params bf16
+  and the cache bf16 halves the HBM traffic that dominates them;
+- sampling (temperature / top-k) happens in f32 inside the same program.
+
+Works with ``llama.init_params`` pytrees (stacked layers). MoE decode
+needs routed-expert caching and is intentionally not squeezed into this
+module.
+"""
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models.llama import LlamaConfig, _mlp, _rms_norm, _rope
+
+
+def init_kv_cache(config: LlamaConfig, batch: int,
+                  max_len: Optional[int] = None) -> Dict:
+    """Fixed-size per-layer key/value buffers + the write position."""
+    c = config
+    T = max_len or c.max_seq_len
+    shape = (c.n_layers, batch, T, c.n_kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype=c.dtype),
+        "v": jnp.zeros(shape, dtype=c.dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _split_heads(x, n_heads, head_dim):
+    B, S, _ = x.shape
+    return x.reshape(B, S, n_heads, head_dim)
+
+
+def _attend(q, k, v, mask, scale):
+    """q (B,Q,H,Dh) against k/v (B,T,KV,Dh) with GQA repeat; mask
+    (B,1,Q,T) or broadcastable. f32 softmax."""
+    groups = q.shape[2] // k.shape[2]
+    k = jnp.repeat(k, groups, axis=2)
+    v = jnp.repeat(v, groups, axis=2)
+    scores = jnp.einsum(
+        "bqhd,bthd->bhqt", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    scores = jnp.where(mask, scores, jnp.float32(-1e30))
+    att = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqt,bthd->bqhd", att.astype(v.dtype), v)
+    return out.reshape(out.shape[0], out.shape[1], -1)
+
+
+def prefill(params: Dict, tokens, config: LlamaConfig,
+            max_len: int) -> Tuple[jnp.ndarray, Dict]:
+    """Run the prompt ``tokens`` (B, P) through the model in one batched
+    pass, building a ``max_len``-slot cache. Returns (logits for the next
+    token (B, V), cache)."""
+    c = config
+    B, P = tokens.shape
+    T = max_len
+    if P > T:
+        raise ValueError(f"prompt length {P} exceeds cache length {T}")
+    x = params["tok_embed"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(P)[None, :], (B, P))
+    causal = (
+        jnp.arange(P)[None, None, :, None] >= jnp.arange(P)[None, None, None, :]
+    )
+    scale = c.head_dim ** -0.5
+
+    def layer_fn(h, layer):
+        xn = _rms_norm(h, layer["attn_norm"], c.norm_eps)
+        q = _rope(_split_heads(xn @ layer["wq"], c.n_heads, c.head_dim),
+                  positions, c.rope_theta)
+        k = _rope(_split_heads(xn @ layer["wk"], c.n_kv_heads, c.head_dim),
+                  positions, c.rope_theta)
+        v = _split_heads(xn @ layer["wv"], c.n_kv_heads, c.head_dim)
+        out = _attend(q, k, v, causal, scale)
+        h = h + out @ layer["wo"]
+        h = h + _mlp(_rms_norm(h, layer["ffn_norm"], c.norm_eps), layer)
+        return h, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, params["layers"])
+    pad = [(0, 0), (0, 0), (0, T - P), (0, 0), (0, 0)]
+    cache = {
+        "k": jnp.pad(ks, pad).astype(c.dtype),
+        "v": jnp.pad(vs, pad).astype(c.dtype),
+        "pos": jnp.int32(P),
+    }
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = (x[:, -1] @ params["lm_head"]).astype(jnp.float32)
+    return logits, cache
+
+
+def decode_step(params: Dict, token, cache: Dict,
+                config: LlamaConfig) -> Tuple[jnp.ndarray, Dict]:
+    """One autoregressive step: ``token`` (B,) int32 at position
+    ``cache['pos']`` → (next-token logits (B, V), updated cache)."""
+    c = config
+    B = token.shape[0]
+    T = cache["k"].shape[2]
+    pos = cache["pos"]
+    x = params["tok_embed"][token][:, None, :]          # (B, 1, D)
+    positions = jnp.broadcast_to(pos[None, None], (B, 1))
+    # attend to [0, pos] only (the cache beyond is zeros/garbage)
+    mask = (jnp.arange(T)[None, None, None, :] <= pos)
+    scale = c.head_dim ** -0.5
+
+    def layer_fn(h, inputs):
+        layer, k_l, v_l = inputs
+        xn = _rms_norm(h, layer["attn_norm"], c.norm_eps)
+        q = _rope(_split_heads(xn @ layer["wq"], c.n_heads, c.head_dim),
+                  positions, c.rope_theta)
+        k_new = _rope(
+            _split_heads(xn @ layer["wk"], c.n_kv_heads, c.head_dim),
+            positions, c.rope_theta,
+        )
+        v_new = _split_heads(xn @ layer["wv"], c.n_kv_heads, c.head_dim)
+        k_l = jax.lax.dynamic_update_slice(
+            k_l, k_new.astype(k_l.dtype), (0, pos, 0, 0)
+        )
+        v_l = jax.lax.dynamic_update_slice(
+            v_l, v_new.astype(v_l.dtype), (0, pos, 0, 0)
+        )
+        out = _attend(q, k_l, v_l, mask, scale)
+        h = h + out @ layer["wo"]
+        h = h + _mlp(_rms_norm(h, layer["ffn_norm"], c.norm_eps), layer)
+        return h, (k_l, v_l)
+
+    x, (k_all, v_all) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+    )
+    cache = {"k": k_all, "v": v_all, "pos": pos + 1}
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, cache
+
+
+def sample_token(logits, key, temperature: float = 1.0, top_k: int = 0):
+    """f32 categorical sampling; temperature 0 → greedy; top_k > 0 keeps
+    only the k best logits (both static Python values)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, jnp.float32(-1e30), logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(params: Dict, prompt, config: LlamaConfig, key,
+             max_new_tokens: int, temperature: float = 1.0,
+             top_k: int = 0, max_len: Optional[int] = None):
+    """Sample ``max_new_tokens`` continuations of ``prompt`` (B, P).
+    Returns (B, P + max_new_tokens) int32. One compiled program: batched
+    prefill + a ``lax.scan`` of cached decode steps."""
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    max_len = max_len or total
+    if total > max_len:
+        # dynamic_update_slice would silently clamp writes to the last
+        # slot and corrupt the tail — refuse instead
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"cache length {max_len}"
+        )
+    logits, cache = prefill(params, prompt, config, max_len)
+    keys = jax.random.split(key, max_new_tokens)
+
+    def step(carry, step_key):
+        logits, cache = carry
+        nxt = sample_token(logits, step_key, temperature, top_k)
+        logits, cache = decode_step(params, nxt, cache, config)
+        return (logits, cache), nxt
+
+    if max_new_tokens > 1:
+        # the token sampled from the final carry needs no decode step —
+        # scanning all max_new_tokens would waste one full forward
+        (logits, cache), toks = jax.lax.scan(
+            step, (logits, cache), keys[:-1]
+        )
+        toks = toks.T
+    else:
+        toks = jnp.zeros((B, 0), jnp.int32)
+    last = sample_token(logits, keys[-1], temperature, top_k)
+    return jnp.concatenate([prompt, toks, last[:, None]], axis=1)

@@ -58,16 +58,18 @@ class RolloutWorkload(BaseWorkload):
         import jax
         import jax.numpy as jnp
 
-        from dlrover_tpu.models import llama
+        from dlrover_tpu.models import decode
 
         self._step += 1
         key = jax.random.PRNGKey(self.rank * 1000 + self._step)
-        tokens = jnp.ones((batch_size, 4), dtype=jnp.int32)
-        for _ in range(6):  # greedy-ish sampling loop, static shapes
-            logits = llama.forward(self.params, tokens, self.cfg)
-            key, sub = jax.random.split(key)
-            nxt = jax.random.categorical(sub, logits[:, -1, :])
-            tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+        prompt = jnp.ones((batch_size, 4), dtype=jnp.int32)
+        # KV-cache rollout (models/decode.py): batched prefill + one
+        # compiled scan of cached steps — no recompile per length, no
+        # O(S²) re-forward per token (what vLLM does for the reference's
+        # RL examples, owned natively here)
+        tokens = decode.generate(
+            self.params, prompt, self.cfg, key, max_new_tokens=6,
+        )
         return [[int(t) for t in row] for row in tokens]
 
 
