@@ -79,6 +79,24 @@ def load_frames_for_step(
     return frames
 
 
+def merge_frame_leaves(frames):
+    """Merge frames' leaf metas into {path: meta with all shards}; each
+    shard entry carries its source frame under ``_frame`` (used by both
+    the engine's storage restore and the orbax export)."""
+    merged = {}
+    for frame in frames:
+        for leaf in frame["leaves"]:
+            entry = merged.setdefault(
+                leaf["path"],
+                {**{k: v for k, v in leaf.items() if k != "shards"},
+                 "shards": []},
+            )
+            entry["shards"].extend(
+                dict(sh, _frame=frame) for sh in leaf.get("shards", [])
+            )
+    return merged
+
+
 def persist_shm_frame(
     shm: SharedMemoryHandler,
     ckpt_dir: str,

@@ -1,0 +1,149 @@
+"""``dtpu-ckpt`` — checkpoint inspection and format conversion.
+
+The reference ships checkpoint tooling per framework (tracker files,
+Megatron converters); here one CLI covers the Flash Checkpoint dir
+format:
+
+    dtpu-ckpt inspect /path/to/ckpt            # steps, leaves, sizes
+    dtpu-ckpt export /path/to/ckpt --out /path/orbax [--step N]
+    dtpu-ckpt import /path/orbax --ckpt-dir /path/to/ckpt --step N
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _inspect(args) -> int:
+    """Metadata-only: shapes/dtypes/sizes come from the frame metas —
+    no array assembly, so inspecting a 100 GB checkpoint stays cheap."""
+    from dlrover_tpu.ckpt.ckpt_saver import (
+        latest_step,
+        load_frames_for_step,
+        merge_frame_leaves,
+    )
+    from dlrover_tpu.ckpt.engine import _np_dtype
+    from dlrover_tpu.common.storage import get_checkpoint_storage
+
+    storage = get_checkpoint_storage(args.ckpt_dir)
+    step = args.step if args.step is not None else latest_step(
+        args.ckpt_dir, storage
+    )
+    if step < 0:
+        print(f"no committed checkpoint under {args.ckpt_dir}",
+              file=sys.stderr)
+        return 1
+    frames = load_frames_for_step(args.ckpt_dir, step, storage)
+    merged = merge_frame_leaves(frames)
+    arrays = {
+        k: m for k, m in merged.items() if m.get("kind") == "array"
+    }
+    total = sum(
+        int(np.prod(m["gshape"])) * _np_dtype(m["dtype"]).itemsize
+        for m in arrays.values()
+    )
+    info = {
+        "ckpt_dir": args.ckpt_dir,
+        "step": step,
+        "frames": len(frames),
+        "leaves": len(merged),
+        "array_leaves": len(arrays),
+        "total_bytes": total,
+        "total_gb": round(total / 1e9, 3),
+    }
+    if args.verbose:
+        info["arrays"] = {
+            k: {"shape": list(m["gshape"]), "dtype": m["dtype"]}
+            for k, m in sorted(arrays.items())
+        }
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def _export(args) -> int:
+    from dlrover_tpu.ckpt.orbax_compat import export_to_orbax
+
+    step, n = export_to_orbax(args.ckpt_dir, args.out, args.step)
+    print(json.dumps({"step": step, "leaves": n, "out": args.out}))
+    return 0
+
+
+def _import(args) -> int:
+    """Orbax → a committed Flash Checkpoint step (flat tree as saved by
+    export; arbitrary orbax trees import leaf-for-leaf)."""
+    from dlrover_tpu.ckpt.engine import CheckpointEngine
+    from dlrover_tpu.ckpt.orbax_compat import import_from_orbax
+
+    from dlrover_tpu.ckpt.ckpt_saver import latest_step
+    from dlrover_tpu.ckpt.orbax_compat import unflatten_keystr
+    from dlrover_tpu.ckpt.shm_handler import shm_name
+    from dlrover_tpu.common.multi_process import unlink_shared_memory
+
+    newest = latest_step(args.ckpt_dir)
+    if newest >= args.step and not args.force:
+        print(
+            f"{args.ckpt_dir} already has committed step {newest} >= "
+            f"{args.step}; importing would roll the restore point back. "
+            "Pass --force to do it anyway.", file=sys.stderr,
+        )
+        return 1
+    tree = import_from_orbax(args.orbax_path)
+    if isinstance(tree, dict) and tree and all(
+        k.startswith("[") for k in tree
+    ):
+        # a flat keystr tree (our own export format): rebuild the nested
+        # structure so the training loop's target pytree can restore it
+        tree = unflatten_keystr(tree)
+    job = f"import{os.getpid()}"
+    engine = CheckpointEngine(
+        args.ckpt_dir, job_name=job, node_rank=0,
+        local_rank=0, ipc_socket="/nonexistent", world_size=1, rank=0,
+    )
+    try:
+        if not engine.save_to_storage(args.step, tree):
+            print("import save failed", file=sys.stderr)
+            return 1
+        engine.wait_drained(600)
+    finally:
+        # one-shot conversion: the shm staging segment is pure scratch
+        unlink_shared_memory(shm_name(job, 0, 0))
+    print(json.dumps({
+        "step": args.step, "ckpt_dir": args.ckpt_dir,
+        "leaves": len(tree) if isinstance(tree, dict) else None,
+    }))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="dtpu-ckpt", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pi = sub.add_parser("inspect", help="show a checkpoint's contents")
+    pi.add_argument("ckpt_dir")
+    pi.add_argument("--step", type=int, default=None)
+    pi.add_argument("-v", "--verbose", action="store_true")
+    pi.set_defaults(fn=_inspect)
+
+    pe = sub.add_parser("export", help="export a step to orbax format")
+    pe.add_argument("ckpt_dir")
+    pe.add_argument("--out", required=True)
+    pe.add_argument("--step", type=int, default=None)
+    pe.set_defaults(fn=_export)
+
+    pm = sub.add_parser("import", help="import an orbax checkpoint")
+    pm.add_argument("orbax_path")
+    pm.add_argument("--ckpt-dir", required=True)
+    pm.add_argument("--step", type=int, default=0)
+    pm.add_argument("--force", action="store_true",
+                    help="allow rolling the restore point backwards")
+    pm.set_defaults(fn=_import)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -606,22 +606,9 @@ class CheckpointEngine:
         frames = load_frames_for_step(path, step)
         if not frames:
             return None, -1
-        lookup: Dict[str, List[Dict]] = {}
-        for frame in frames:
-            for leaf in frame["leaves"]:
-                entry = dict(leaf)
-                entry["_frame"] = frame
-                lookup.setdefault(leaf["path"], []).append(entry)
+        from dlrover_tpu.ckpt.ckpt_saver import merge_frame_leaves
 
-        merged = {}
-        for p, entries in lookup.items():
-            base = dict(entries[0])
-            base["shards"] = [
-                dict(s, _frame=e["_frame"])
-                for e in entries
-                for s in e.get("shards", [])
-            ]
-            merged[p] = base
+        merged = merge_frame_leaves(frames)
 
         from dlrover_tpu.ckpt.shm_handler import frame_shard_bytes
 

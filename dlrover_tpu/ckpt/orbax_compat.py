@@ -1,0 +1,164 @@
+"""Orbax interop + target-free checkpoint reading.
+
+The Flash Checkpoint frame format is built for the save hot path (flat
+shard bytes + msgpack meta, shm-friendly); Orbax is the JAX ecosystem's
+interchange format. This module bridges them so users can migrate in
+either direction (the reference's per-framework checkpointers play the
+same compatibility role for torch ecosystems, flash_checkpoint/ddp.py):
+
+- :func:`read_committed_flat` rebuilds FULL arrays from a committed step's
+  frames without needing a target pytree (every saved shard is placed into
+  its global index range) — also the basis of ``dtpu-ckpt inspect``;
+- :func:`export_to_orbax` writes those arrays as an Orbax checkpoint
+  whose tree is a flat ``{keystr_path: array}`` dict (raw jax keystr keys
+  — reversible and collision-free);
+- :func:`import_from_orbax` restores an Orbax checkpoint and (optionally)
+  re-keys the flat dict back into the structure of a target pytree;
+  :func:`unflatten_keystr` rebuilds a nested dict/list tree when no
+  target exists (the CLI import path) — ready for
+  ``Checkpointer.save_checkpoint`` or ``shard_tree``.
+
+Export requires a *committed* checkpoint with all frames present (the
+commit protocol guarantees this); an incomplete step raises.
+"""
+
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.ckpt.ckpt_saver import (
+    latest_step,
+    load_frames_for_step,
+    merge_frame_leaves,
+)
+from dlrover_tpu.ckpt.engine import _np_dtype, _tree_flatten_with_names
+from dlrover_tpu.ckpt.shm_handler import frame_shard_bytes
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.storage import get_checkpoint_storage
+
+
+_KEYSTR_TOKEN = re.compile(r"\[(?:'([^']*)'|(\d+))\]")
+
+
+def unflatten_keystr(flat: Dict[str, Any]) -> Any:
+    """Invert jax ``keystr`` paths (``['a']['b'][0]``) into a nested
+    dict/list pytree. Tuples and custom nodes flatten to lists/dicts —
+    fine for checkpoint payloads, whose consumers re-key into their own
+    target structure anyway."""
+    root: Dict[Any, Any] = {}
+    for path, value in flat.items():
+        tokens = [
+            m.group(1) if m.group(1) is not None else int(m.group(2))
+            for m in _KEYSTR_TOKEN.finditer(path)
+        ]
+        if not tokens:
+            raise ValueError(f"unparseable keystr path: {path!r}")
+        node = root
+        for tok in tokens[:-1]:
+            node = node.setdefault(tok, {})
+        node[tokens[-1]] = value
+
+    def listify(node):
+        if not isinstance(node, dict):
+            return node
+        out = {k: listify(v) for k, v in node.items()}
+        if out and all(isinstance(k, int) for k in out):
+            return [out[i] for i in sorted(out)]
+        return out
+
+    return listify(root)
+
+
+def read_committed_flat(
+    ckpt_dir: str, step: Optional[int] = None, storage=None,
+) -> Tuple[Dict[str, Any], int]:
+    """Read a committed step into ``{keystr_path: full ndarray | value}``
+    without a target pytree."""
+    storage = storage or get_checkpoint_storage(ckpt_dir)
+    if step is None:
+        step = latest_step(ckpt_dir, storage)
+    if step < 0:
+        raise FileNotFoundError(f"no committed checkpoint under {ckpt_dir}")
+    frames = load_frames_for_step(ckpt_dir, step, storage)
+    if not frames:
+        raise FileNotFoundError(f"step {step} has no frames in {ckpt_dir}")
+
+    merged = merge_frame_leaves(frames)
+
+    out: Dict[str, Any] = {}
+    for path, meta in merged.items():
+        if meta.get("kind") == "value":
+            out[path] = meta["value"]
+            continue
+        dtype = _np_dtype(meta["dtype"])
+        gshape = tuple(meta["gshape"])
+        arr = np.zeros(gshape, dtype)
+        covered = 0
+        for shard in meta["shards"]:
+            data = np.frombuffer(
+                frame_shard_bytes(shard["_frame"], shard), dtype
+            ).reshape(shard["lshape"])
+            idx = tuple(
+                slice(st, st + ln)
+                for st, ln in zip(shard["start"], shard["lshape"])
+            )
+            arr[idx] = data
+            covered += data.size
+        if covered < int(np.prod(gshape)):
+            raise ValueError(
+                f"checkpoint incomplete for {path}: {covered}/"
+                f"{int(np.prod(gshape))} elements present across "
+                f"{len(frames)} frames"
+            )
+        out[path] = arr
+    return out, step
+
+
+def export_to_orbax(
+    ckpt_dir: str, out_path: str, step: Optional[int] = None,
+) -> Tuple[int, int]:
+    """Export a committed step as an Orbax checkpoint (flat keystr-keyed
+    tree). Returns (step, leaf count)."""
+    import orbax.checkpoint as ocp
+
+    flat, step = read_committed_flat(ckpt_dir, step)
+    # keys are the raw jax keystr paths: reversible (unflatten_keystr) and
+    # collision-free, unlike any prettified flattening
+    tree = dict(flat)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(os.path.abspath(out_path), tree)
+    logger.info(
+        "exported step %s (%d leaves) to orbax at %s",
+        step, len(tree), out_path,
+    )
+    return step, len(tree)
+
+
+def import_from_orbax(orbax_path: str, target: Any = None) -> Any:
+    """Restore an Orbax checkpoint. With ``target``, a flat keystr-keyed
+    tree (as written by :func:`export_to_orbax`) is re-keyed into the
+    target's structure; without, the raw restored tree is returned."""
+    import orbax.checkpoint as ocp
+
+    restored = ocp.PyTreeCheckpointer().restore(os.path.abspath(orbax_path))
+    if target is None:
+        return restored
+    if not isinstance(restored, dict):
+        raise TypeError("target re-keying needs a dict orbax tree")
+    named, treedef = _tree_flatten_with_names(target)
+    leaves = []
+    for path, leaf in named:
+        if path not in restored:
+            raise KeyError(
+                f"orbax tree has no entry for {path} "
+                f"(has {sorted(restored)[:8]}…)"
+            )
+        value = restored[path]
+        if hasattr(leaf, "dtype") and hasattr(value, "astype"):
+            value = np.asarray(value).astype(leaf.dtype)
+        leaves.append(value)
+    import jax
+
+    return jax.tree_util.tree_unflatten(treedef, leaves)
