@@ -196,6 +196,69 @@ def bench_attention() -> dict:
     }
 
 
+def bench_decode() -> dict:
+    """KV-cache generation throughput on the train-bench model shapes:
+    tokens/s for batched sampling (models/decode.py), plus the
+    model-bandwidth bound it should approach (decode is HBM-bound: every
+    token reads all params + the KV cache once)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import decode, llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    dim = int(os.environ.get("BENCH_DIM", "2048" if on_tpu else "256"))
+    layers = int(os.environ.get("BENCH_LAYERS", "16" if on_tpu else "2"))
+    heads = max(1, dim // 128)
+    batch = int(os.environ.get("BENCH_DECODE_BATCH", "8" if on_tpu else "2"))
+    prompt_len = 128 if on_tpu else 16
+    new_tokens = int(os.environ.get("BENCH_DECODE_TOKENS",
+                                    "256" if on_tpu else "8"))
+    config = llama.LlamaConfig(
+        vocab_size=32000, dim=dim, n_layers=layers, n_heads=heads,
+        n_kv_heads=max(1, heads // 2), ffn_dim=int(2.75 * dim) // 256 * 256,
+        max_seq_len=prompt_len + new_tokens, remat=False,
+    )
+    n_params = llama.num_params(config)
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, config.vocab_size
+    )
+    gen = jax.jit(functools.partial(
+        decode.generate, config=config, max_new_tokens=new_tokens,
+        temperature=1.0, top_k=40,
+    ))
+    out = gen(params, prompt, key=jax.random.PRNGKey(2))
+    _ = int(out[0, -1])  # compile + force
+    rtt = _fetch_rtt()
+    t0 = time.perf_counter()
+    out = gen(params, prompt, key=jax.random.PRNGKey(3))
+    _ = int(out[0, -1])
+    dt = max(1e-9, time.perf_counter() - t0 - rtt)
+    toks = batch * new_tokens
+    # HBM roof: params read once per step (batch shares the read)
+    param_bytes = n_params * 2  # bf16
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    hbm_gbps = next(
+        (v for k, v in {"v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0,
+                        "v4": 1228.0}.items() if k in kind),
+        0.0,
+    )
+    steps_per_s = new_tokens / dt
+    result = {
+        "params_b": round(n_params / 1e9, 3),
+        "batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "tokens_per_s": round(toks / dt, 1),
+        "steps_per_s": round(steps_per_s, 1),
+        "hbm_roof_steps_per_s": (
+            round(hbm_gbps * 1e9 / param_bytes, 1) if hbm_gbps else 0.0
+        ),
+    }
+    del params, out
+    gc.collect()
+    return result
+
+
 def bench_ckpt() -> dict:
     import jax
     import jax.numpy as jnp
@@ -370,6 +433,7 @@ def bench_goodput() -> dict:
 def main() -> None:
     train = bench_train()
     attn = bench_attention()
+    dec = bench_decode()
     ckpt = bench_ckpt()
     goodput = bench_goodput()
     result = {
@@ -379,7 +443,7 @@ def main() -> None:
         # 40% MFU = the commonly-cited good bar for dense LLM training
         "vs_baseline": round(train["mfu_pct"] / 40.0, 3),
         "detail": {
-            "train": train, "attn": attn, "ckpt": ckpt,
+            "train": train, "attn": attn, "decode": dec, "ckpt": ckpt,
             "goodput": goodput,
         },
     }

@@ -16,9 +16,11 @@ for XLA:
   and the cache bf16 halves the HBM traffic that dominates them;
 - sampling (temperature / top-k) happens in f32 inside the same program.
 
-Works with ``llama.init_params`` pytrees (stacked layers). MoE decode
-needs routed-expert caching and is intentionally not squeezed into this
-module.
+Works with ``llama.init_params`` AND ``moe.init_params`` pytrees (stacked
+layers): the FFN half of each decode step dispatches on the config — a
+MoE config routes the single position through its experts (the dispatch
+einsums collapse to top-k expert matvecs at S=1; the KV cache itself is
+attention-only, so nothing expert-specific needs caching).
 """
 
 from typing import Dict, Optional, Tuple
@@ -26,10 +28,20 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from dlrover_tpu.models.llama import LlamaConfig, _mlp, _rms_norm, _rope
+from dlrover_tpu.models.llama import _mlp, _rms_norm, _rope
 
 
-def init_kv_cache(config: LlamaConfig, batch: int,
+def _ffn(xn, layer, config) -> jnp.ndarray:
+    """Dense SwiGLU or routed-expert FFN, by config family."""
+    if getattr(config, "n_experts", 0):
+        from dlrover_tpu.models.moe import _moe_ffn
+
+        out, _ = _moe_ffn(xn, layer, config)  # aux loss unused at decode
+        return out
+    return _mlp(xn, layer)
+
+
+def init_kv_cache(config, batch: int,
                   max_len: Optional[int] = None) -> Dict:
     """Fixed-size per-layer key/value buffers + the write position."""
     c = config
@@ -62,7 +74,7 @@ def _attend(q, k, v, mask, scale):
     return out.reshape(out.shape[0], out.shape[1], -1)
 
 
-def prefill(params: Dict, tokens, config: LlamaConfig,
+def prefill(params: Dict, tokens, config,
             max_len: int) -> Tuple[jnp.ndarray, Dict]:
     """Run the prompt ``tokens`` (B, P) through the model in one batched
     pass, building a ``max_len``-slot cache. Returns (logits for the next
@@ -88,7 +100,7 @@ def prefill(params: Dict, tokens, config: LlamaConfig,
         v = _split_heads(xn @ layer["wv"], c.n_kv_heads, c.head_dim)
         out = _attend(q, k, v, causal, scale)
         h = h + out @ layer["wo"]
-        h = h + _mlp(_rms_norm(h, layer["ffn_norm"], c.norm_eps), layer)
+        h = h + _ffn(_rms_norm(h, layer["ffn_norm"], c.norm_eps), layer, c)
         return h, (k, v)
 
     x, (ks, vs) = jax.lax.scan(layer_fn, x, params["layers"])
@@ -104,7 +116,7 @@ def prefill(params: Dict, tokens, config: LlamaConfig,
 
 
 def decode_step(params: Dict, token, cache: Dict,
-                config: LlamaConfig) -> Tuple[jnp.ndarray, Dict]:
+                config) -> Tuple[jnp.ndarray, Dict]:
     """One autoregressive step: ``token`` (B,) int32 at position
     ``cache['pos']`` → (next-token logits (B, V), updated cache)."""
     c = config
@@ -135,7 +147,7 @@ def decode_step(params: Dict, token, cache: Dict,
         )
         out = _attend(q, k_l, v_l, mask, scale)
         h = h + out @ layer["wo"]
-        h = h + _mlp(_rms_norm(h, layer["ffn_norm"], c.norm_eps), layer)
+        h = h + _ffn(_rms_norm(h, layer["ffn_norm"], c.norm_eps), layer, c)
         return h, (k_l, v_l)
 
     x, (k_all, v_all) = jax.lax.scan(
@@ -159,7 +171,7 @@ def sample_token(logits, key, temperature: float = 1.0, top_k: int = 0):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-def generate(params: Dict, prompt, config: LlamaConfig, key,
+def generate(params: Dict, prompt, config, key,
              max_new_tokens: int, temperature: float = 1.0,
              top_k: int = 0, max_len: Optional[int] = None):
     """Sample ``max_new_tokens`` continuations of ``prompt`` (B, P).
