@@ -236,8 +236,13 @@ def bench_decode() -> dict:
     _ = int(out[0, -1])
     dt = max(1e-9, time.perf_counter() - t0 - rtt)
     toks = batch * new_tokens
-    # HBM roof: params read once per step (batch shares the read)
-    param_bytes = n_params * 2  # bf16
+    # HBM roof: params + the KV cache are read once per step (batch
+    # shares the param read; the cache scales with batch and context)
+    cache_bytes = (
+        2 * layers * batch * (prompt_len + new_tokens)
+        * config.n_kv_heads * config.head_dim * 2  # k+v, bf16
+    )
+    param_bytes = n_params * 2 + cache_bytes  # bf16
     kind = getattr(jax.devices()[0], "device_kind", "").lower()
     hbm_gbps = next(
         (v for k, v in {"v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0,

@@ -64,6 +64,16 @@ class KVStoreService:
         with self._lock:
             self._store.clear()
 
+    def dump(self) -> Dict[str, bytes]:
+        """Copy of the whole store (master state snapshots)."""
+        with self._lock:
+            return dict(self._store)
+
+    def restore(self, data: Dict[str, bytes]) -> None:
+        with self._cond:
+            self._store.update(data)
+            self._cond.notify_all()
+
 
 class SyncService:
     """Named barriers across nodes (reference sync_service.py:25)."""

@@ -41,6 +41,7 @@ class JobMaster:
         node_unit: int = 1,
         scaler=None,
         diagnosis_master=None,
+        state_dir: Optional[str] = None,
     ):
         from dlrover_tpu.common.metric import JobMetricContext
 
@@ -98,6 +99,25 @@ class JobMaster:
         # HttpMasterServicer, servicer.py:881): DLROVER_TPU_HTTP_PORT=0
         # picks a free port, unset disables
         self._http_server = None
+        # master failover: snapshot durable control-plane state (KV,
+        # shard queues, global step) so a restarted master with the same
+        # --state-dir resumes instead of losing data position
+        state_dir = state_dir or os.getenv("DLROVER_TPU_MASTER_STATE_DIR")
+        self._snapshot_loop = None
+        self._state_store = None
+        if state_dir:
+            from dlrover_tpu.master.state_store import (
+                MasterStateStore,
+                SnapshotLoop,
+            )
+
+            self._state_store = MasterStateStore(state_dir)
+            self._snapshot_loop = SnapshotLoop(
+                self._state_store, self,
+                interval_s=float(
+                    os.getenv("DLROVER_TPU_MASTER_SNAPSHOT_S", "30")
+                ),
+            )
         http_port = os.getenv("DLROVER_TPU_HTTP_PORT")
         if http_port:  # unset OR empty (un-templated manifest) disables
             from dlrover_tpu.common.http_server import HTTPTransportServer
@@ -155,6 +175,8 @@ class JobMaster:
         get_emitter("master").instant(
             MasterEvent.JOB_START, job=self.job_name
         )
+        if self._state_store is not None:
+            self._state_store.restore(self)
         self._server.start()
         if self._http_server is not None:
             self._http_server.start()
@@ -163,6 +185,8 @@ class JobMaster:
         self.metric_collector.start()
         if self.diagnosis_master is not None:
             self.diagnosis_master.start()
+        if self._snapshot_loop is not None:
+            self._snapshot_loop.start()
         logger.info(
             "master for job %s serving on port %s", self.job_name, self.port
         )
@@ -171,6 +195,8 @@ class JobMaster:
         # job_status is consumed by subclasses reporting run outcomes
         # (DistributedJobMaster → Brain); the base teardown ignores it
         del job_status
+        if self._snapshot_loop is not None:
+            self._snapshot_loop.stop()
         self.job_manager.stop()
         self.task_manager.stop()
         self.metric_collector.stop()
@@ -355,6 +381,9 @@ def main(argv=None) -> int:
     parser.add_argument("--min-nodes", type=int, default=None)
     parser.add_argument("--max-nodes", type=int, default=None)
     parser.add_argument("--node-unit", type=int, default=1)
+    parser.add_argument("--state-dir", default="",
+                        help="snapshot/restore master state here "
+                             "(failover across master restarts)")
     parser.add_argument("--port-file", default="",
                         help="write the bound port to this file (standalone)")
     parser.add_argument("--platform", default="local",
@@ -377,6 +406,7 @@ def main(argv=None) -> int:
         min_nodes=args.min_nodes,
         max_nodes=args.max_nodes,
         node_unit=args.node_unit,
+        state_dir=args.state_dir or None,
     )
     if args.platform == "kubernetes":
         from dlrover_tpu.k8s.api import RealK8sApi

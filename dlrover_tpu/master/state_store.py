@@ -1,0 +1,138 @@
+"""Master state snapshot/restore — job-master failover.
+
+The reference treats a dead master as a job restart (the k8s operator
+recreates the master pod; Python-side state is rebuilt from pod watches
+and workers re-rendezvous). This store makes the restart cheaper and
+data-safe: the master periodically snapshots its *durable* control-plane
+state to disk, and a restarted master (same ``--state-dir``) resumes it —
+while the rpc client's retry/backoff (common/rpc.py:174) carries live
+agents across the outage without their noticing more than latency.
+
+Persisted (the state whose loss costs correctness or data):
+- the KV store — checkpoint readiness/step keys, user barriers' backing;
+- every registered dataset: its creation params + the shard-queue
+  position (todo/doing re-queued as todo, epochs, completion counts), so
+  a master restart does not re-serve consumed data or drop in-flight
+  shards (reference get_shard_checkpoint semantics, task_manager.py:125);
+- the last completed global step (perf monitor seed, so hang detection
+  and speed windows restart sane).
+
+Deliberately NOT persisted: rendezvous rounds (agents re-join; worlds are
+moment-in-time), node runtime state (rebuilt from heartbeats/watches),
+metrics (history lives in the Brain).
+
+Snapshots are atomic (tmp + rename) msgpack blobs; a torn write can never
+eat the previous snapshot.
+"""
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import msgpack
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import logger
+
+SNAPSHOT_FILE = "master_state.msgpack"
+
+
+class MasterStateStore:
+    def __init__(self, state_dir: str):
+        self.state_dir = state_dir
+        os.makedirs(state_dir, exist_ok=True)
+        self.path = os.path.join(state_dir, SNAPSHOT_FILE)
+
+    # -- capture -----------------------------------------------------------
+
+    def snapshot(self, master) -> Dict[str, Any]:
+        datasets = []
+        for name in master.task_manager.dataset_names():
+            params = master.task_manager.dataset_params(name)
+            if params is None:
+                continue
+            datasets.append({
+                "params": comm.serialize(params),
+                "ckpt": master.task_manager.get_shard_checkpoint(name),
+            })
+        return {
+            "ts": time.time(),
+            "job_name": master.job_name,
+            "kv": master.kv_store.dump(),
+            "datasets": datasets,
+            "global_step": master.perf_monitor.completed_global_step,
+        }
+
+    def save(self, master) -> None:
+        blob = msgpack.packb(self.snapshot(master), use_bin_type=True)
+        # pid+thread id: the periodic thread and the final stop() save may
+        # overlap — each writes its own tmp, os.replace stays atomic
+        tmp = f"{self.path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # -- restore -----------------------------------------------------------
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path, "rb") as f:
+            return msgpack.unpackb(f.read(), raw=False)
+
+    def restore(self, master) -> bool:
+        snap = self.load()
+        if snap is None:
+            return False
+        master.kv_store.restore(snap.get("kv", {}))
+        for entry in snap.get("datasets", []):
+            params = comm.deserialize(entry["params"])
+            master.task_manager.new_dataset(params)
+            master.task_manager.restore_shard_checkpoint(entry["ckpt"])
+        step = int(snap.get("global_step", 0))
+        if step > 0:
+            master.perf_monitor.collect_global_step(step, time.time())
+        logger.info(
+            "master state restored from %s: %d kv keys, %d datasets, "
+            "step %s (snapshot age %.1fs)",
+            self.path, len(snap.get("kv", {})), len(snap.get("datasets", [])),
+            step, time.time() - snap.get("ts", time.time()),
+        )
+        return True
+
+
+class SnapshotLoop:
+    """Background periodic saver; final save on stop."""
+
+    def __init__(self, store: MasterStateStore, master,
+                 interval_s: float = 30.0):
+        self._store = store
+        self._master = master
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="master-snapshot", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._save("periodic")
+
+    def _save(self, why: str) -> None:
+        try:
+            self._store.save(self._master)
+        except Exception:  # noqa: BLE001 — snapshots must not kill the master
+            logger.warning("master %s snapshot failed", why, exc_info=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+        self._save("final")

@@ -63,6 +63,7 @@ class TaskManager:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._datasets: Dict[str, _DatasetManager] = {}
+        self._params: Dict[str, DatasetShardParams] = {}
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -81,8 +82,17 @@ class TaskManager:
                 return
             splitter = DatasetSplitter.build(params)
             self._datasets[params.dataset_name] = _DatasetManager(splitter)
+            self._params[params.dataset_name] = params
             logger.info("task manager: registered dataset %s (size=%s)",
                         params.dataset_name, params.dataset_size)
+
+    def dataset_names(self):
+        with self._lock:
+            return list(self._datasets)
+
+    def dataset_params(self, name: str) -> Optional[DatasetShardParams]:
+        with self._lock:
+            return self._params.get(name)
 
     def get_task(self, node_id: int, dataset_name: str) -> Optional[TaskMessage]:
         with self._lock:
@@ -162,13 +172,22 @@ class TaskManager:
             todo = [t.task_id for t in ds.todo]
             doing = list(ds.doing.keys())
             shards = {
-                t.task_id: [t.shard.start, t.shard.end]
+                t.task_id: [
+                    t.shard.start, t.shard.end,
+                    list(t.shard.record_indices)
+                    if t.shard.record_indices else None,
+                ]
                 for t in list(ds.todo) + [p.task for p in ds.doing.values()]
             }
             return json.dumps({
                 "dataset": dataset_name,
                 "epoch": ds.splitter.epoch,
-                "todo": todo + doing,  # in-flight counts as not-done
+                # splitter position beyond the queue: a streaming splitter
+                # must not refill from offset 0 after restore
+                "splitter_offset": getattr(ds.splitter, "_offset", None),
+                # in-flight counts as not-done, and re-queues FIRST — those
+                # are the oldest shards (restore preserves rough order)
+                "todo": doing + todo,
                 "shards": shards,
                 "next_task_id": ds.next_task_id,
                 "completed": ds.completed,
@@ -183,13 +202,18 @@ class TaskManager:
             if ds is None:
                 return
             ds.splitter.epoch = data["epoch"]
+            offset = data.get("splitter_offset")
+            if offset is not None and hasattr(ds.splitter, "_offset"):
+                ds.splitter._offset = offset
             ds.todo.clear()
             ds.doing.clear()
             ds.completed = data.get("completed", 0)
             for tid in data["todo"]:
-                start, end = data["shards"][str(tid)] if isinstance(
+                entry = data["shards"][str(tid)] if isinstance(
                     next(iter(data["shards"].keys()), 0), str
                 ) else data["shards"][tid]
+                start, end = entry[0], entry[1]
+                indices = entry[2] if len(entry) > 2 else None
                 ds.todo.append(
                     TaskMessage(
                         task_id=int(tid),
@@ -198,6 +222,7 @@ class TaskManager:
                             name=f"{data['dataset']}:{start}:{end}",
                             start=start,
                             end=end,
+                            record_indices=indices,
                         ),
                         dataset_name=data["dataset"],
                     )

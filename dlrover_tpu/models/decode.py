@@ -34,8 +34,16 @@ from dlrover_tpu.models.llama import _mlp, _rms_norm, _rope
 def _ffn(xn, layer, config) -> jnp.ndarray:
     """Dense SwiGLU or routed-expert FFN, by config family."""
     if getattr(config, "n_experts", 0):
+        import dataclasses
+
         from dlrover_tpu.models.moe import _moe_ffn
 
+        # route per token: a training route_group_size can't divide the
+        # S=1 decode token count, and grouping unrelated batch rows would
+        # let capacity drops zero out tokens — per-token groups make
+        # capacity >= top_k, so nothing drops at decode
+        if config.route_group_size is not None:
+            config = dataclasses.replace(config, route_group_size=None)
         out, _ = _moe_ffn(xn, layer, config)  # aux loss unused at decode
         return out
     return _mlp(xn, layer)
