@@ -187,12 +187,50 @@ def bench_attention() -> dict:
 
     t_flash = timed(lambda a, b, c: flash_attention(a, b, c, causal=True))
     t_naive = timed(full_causal_attention)
+
+    # long-context proof: the pallas kernel streams K/V in blocks, so the
+    # O(S²) score tensor never materializes — 16k sequence on one chip
+    # where the dense path's f32 scores alone (B·H·S² ≈ 17 GB) exceed HBM
+    S_long = int(os.environ.get("BENCH_ATTN_LONG_SEQ", "16384"))
+    Bl, Hl = 1, 16
+    kl = jax.random.split(jax.random.PRNGKey(7), 3)
+    ql, kl_, vl = (
+        jax.random.normal(kk, (Bl, Hl, S_long, D), dtype=jnp.bfloat16)
+        for kk in kl
+    )
+    long_iters = 10
+    vg = jax.value_and_grad(
+        lambda a, b, c: flash_attention(a, b, c, causal=True)
+        .astype(jnp.float32).mean()
+    )
+
+    @jax.jit
+    def long_loop(a):
+        def body(a, _):
+            loss, da = vg(a, kl_, vl)
+            return a + (1e-6 * loss).astype(a.dtype) * da, loss
+
+        a, losses = jax.lax.scan(body, a, None, length=long_iters)
+        return losses[-1]
+
+    _ = float(long_loop(ql))  # compile + warmup
+    t0 = time.perf_counter()
+    _ = float(long_loop(ql))
+    t_long = max(1e-9, time.perf_counter() - t0 - rtt) / long_iters
+    dense_scores_gb = Bl * Hl * S_long * S_long * 4 / 1e9
+    del ql, kl_, vl
+    gc.collect()
     return {
         "shape_bhsd": [B, H, S, D],
         "iters": iters,
         "flash_fwdbwd_ms": round(1e3 * t_flash, 3),
         "naive_fwdbwd_ms": round(1e3 * t_naive, 3),
         "flash_speedup": round(t_naive / t_flash, 2),
+        "long_context": {
+            "seq": S_long, "batch": Bl, "heads": Hl,
+            "flash_fwdbwd_ms": round(1e3 * t_long, 1),
+            "dense_scores_would_need_gb": round(dense_scores_gb, 1),
+        },
     }
 
 
