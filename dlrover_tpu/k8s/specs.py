@@ -140,7 +140,14 @@ def master_pod(
             "labels": {LABEL_JOB: job_name, LABEL_TYPE: "master"},
         },
         "spec": {
-            "restartPolicy": "Never",
+            # OnFailure: a crashed master container restarts IN the same
+            # pod, so the emptyDir state volume survives and --state-dir
+            # failover (master/state_store.py) resumes KV + shard queues;
+            # agents ride the gap on rpc retry. Pod-level loss still falls
+            # back to operator recreation (fresh state, job-restart
+            # semantics — the reference's only mode).
+            "restartPolicy": "OnFailure",
+            "volumes": [{"name": "master-state", "emptyDir": {}}],
             "containers": [{
                 "name": "master",
                 "image": image,
@@ -154,8 +161,13 @@ def master_pod(
                     "--job-name", job_name,
                     "--node-num", str(node_num),
                     "--port", str(port),
+                    "--state-dir", "/var/lib/dtpu-master",
                 ],
                 "ports": [{"containerPort": port}],
+                "volumeMounts": [{
+                    "name": "master-state",
+                    "mountPath": "/var/lib/dtpu-master",
+                }],
                 # job_uid (the ElasticJob CR uid) gives a RESTARTED master
                 # of the same job instance a stable Brain identity
                 "env": [{"name": EnvKey.JOB_NAME, "value": job_name}] + (
