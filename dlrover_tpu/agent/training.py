@@ -177,7 +177,10 @@ class ElasticTrainingAgent:
         self._last_step_ts = 0.0
         # node-side diagnosis: telemetry gauges for heartbeats + the
         # restart-vs-relaunch verdict on worker failure
-        self._diagnosis = DiagnosisAgent()
+        self._diagnosis = DiagnosisAgent(
+            ipc_server=self._ipc_server,
+            local_world_size=config.nproc_per_node,
+        )
         self._events = get_emitter(f"agent_{config.node_rank}")
         self._training_monitor = None
         self._replica_service = None

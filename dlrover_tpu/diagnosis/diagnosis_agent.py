@@ -106,6 +106,8 @@ class DiagnosisAgent:
         collectors: Optional[List[GaugeCollector]] = None,
         timer_port: int = 18889,
         stack_dir: str = "/tmp",
+        ipc_server=None,
+        local_world_size: int = 1,
     ):
         self._collectors = (
             collectors if collectors is not None
@@ -116,6 +118,13 @@ class DiagnosisAgent:
         self._stack_dir = stack_dir
         self._last_stack_capture = 0.0
         self._capture_thread = None
+        # xprof-on-hang: with the agent IPC server in hand, a hang also
+        # requests an XLA trace from every worker (observability/
+        # profiler.py) — stacks say where the host is, the trace says
+        # what the device was doing
+        self._ipc_server = ipc_server
+        self._local_world_size = local_world_size
+        self._last_profile_request = 0.0
 
     # minimum seconds between hang-triggered stack captures (a wedged job
     # raises the gauge on every heartbeat; one dump per window is enough)
@@ -155,6 +164,13 @@ class DiagnosisAgent:
         import threading
 
         def _capture():
+            # own cooldown, independent of stack-RPC success: the 15s
+            # stack-retry path must not re-trace a wedged job every beat
+            if time.time() - self._last_profile_request > (
+                self.STACK_CAPTURE_COOLDOWN_S
+            ):
+                self._last_profile_request = time.time()
+                self._request_worker_profiles()
             path = self.capture_worker_stacks()
             if path:
                 # stamp the cooldown only on success: a transient RPC
@@ -175,6 +191,27 @@ class DiagnosisAgent:
             target=_capture, name="hang-stack-capture", daemon=True,
         )
         self._capture_thread.start()
+
+    def _request_worker_profiles(self, duration_s: float = 3.0) -> None:
+        """Post an xprof capture request to every local worker (hang
+        path; reference DumpKernelTrace analogue at the XLA level)."""
+        if self._ipc_server is None:
+            return
+        try:
+            from dlrover_tpu.observability.profiler import (
+                PROFILE_DICT,
+                request_profile,
+            )
+
+            pdict = self._ipc_server.local_dict(PROFILE_DICT)
+            for lr in range(self._local_world_size):
+                request_profile(pdict, lr, duration_s)
+            logger.warning(
+                "hang detected — requested %0.1fs xprof traces from %d "
+                "workers", duration_s, self._local_world_size,
+            )
+        except Exception:  # noqa: BLE001 — diagnosis must not crash
+            logger.warning("xprof request failed", exc_info=True)
 
     def capture_worker_stacks(
         self,

@@ -1,0 +1,142 @@
+"""On-demand XLA profiling of live workers (xprof traces).
+
+The reference daemon serves ``DumpKernelTrace`` — pull a window of kernel
+events from a running job (hosting_service.proto:247). The TPU-native
+deep equivalent is an **xprof capture**: ``jax.profiler`` writes the full
+XLA execution timeline (device compute, DMA, host callbacks) viewable in
+TensorBoard/xprof — strictly richer than the tpu_timer event ring for
+postmortems, but too heavy to run always-on. So it is request-driven:
+
+- the worker runs a :class:`ProfileListener` daemon thread, polling the
+  agent-served ``profile_requests`` SharedDict (the same IPC plane Flash
+  Checkpoint uses — it works while the devices are wedged, which is
+  exactly when a profile of the wedge is wanted);
+- the agent (or an operator via the agent) posts a request with a
+  duration; the listener brackets ``start_trace``/``stop_trace`` around
+  the next N seconds of whatever the main thread is executing and posts
+  the output dir back;
+- the hang path requests one automatically: stacks say where the *host*
+  is; the trace says what the *device* was doing.
+
+Profiling is cooperative and asynchronous — the training loop is never
+paused; the trace simply records it.
+"""
+
+import os
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.multi_process import SharedDict
+
+PROFILE_DICT = "profile_requests"
+
+
+def request_key(local_rank: int) -> str:
+    return f"req/{local_rank}"
+
+
+def done_key(local_rank: int) -> str:
+    return f"done/{local_rank}"
+
+
+class ProfileListener:
+    """Worker-side daemon serving profile requests for this process."""
+
+    def __init__(self, ipc_socket: str, local_rank: int,
+                 out_root: Optional[str] = None, poll_s: float = 1.0):
+        self._dict = SharedDict(PROFILE_DICT, ipc_socket)
+        self._local_rank = local_rank
+        self._out_root = out_root or os.getenv(
+            "DLROVER_TPU_PROFILE_DIR", "/tmp/dlrover_tpu_profiles"
+        )
+        self._poll_s = poll_s
+        self._last_id = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        # seed the dedup id from any pre-existing request: a relaunched
+        # worker must not replay the pre-restart hang request and trace
+        # its own startup noise
+        try:
+            stale = self._dict.get(request_key(self._local_rank))
+            if stale:
+                self._last_id = stale.get("id")
+        except OSError:
+            pass
+        self._thread = threading.Thread(
+            target=self._run, name="profile-listener", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            try:
+                req = self._dict.get(request_key(self._local_rank))
+            except OSError:
+                continue  # agent IPC briefly down (restart) — keep polling
+            if not req or req.get("id") == self._last_id:
+                continue
+            self._last_id = req.get("id")
+            self._capture(req)
+
+    def _capture(self, req: dict) -> None:
+        import jax
+
+        duration = float(req.get("duration_s", 3.0))
+        out_dir = os.path.join(
+            self._out_root,
+            f"xprof_{self._local_rank}_{req.get('id')}",
+        )
+        os.makedirs(out_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(out_dir)
+            # the trace records the MAIN thread's ongoing step execution;
+            # this thread only brackets the window
+            time.sleep(duration)
+            jax.profiler.stop_trace()
+            ok = True
+            logger.info("xprof trace (%.1fs) written to %s",
+                        duration, out_dir)
+        except Exception as e:  # noqa: BLE001 — a failed capture must not
+            # kill the worker; report it back instead
+            ok = False
+            logger.warning("xprof capture failed: %r", e)
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — may not have started
+                pass
+        try:
+            self._dict.set(done_key(self._local_rank), {
+                "id": req.get("id"), "dir": out_dir, "ok": ok,
+                "ts": time.time(),
+            })
+        except OSError:
+            pass
+
+
+def request_profile(profile_dict, local_rank: int,
+                    duration_s: float = 3.0) -> str:
+    """Agent side: post a request into the (server-local) profile dict.
+    Returns the request id to await in ``done/<rank>``."""
+    req_id = f"{time.time():.3f}"
+    profile_dict[request_key(local_rank)] = {
+        "id": req_id, "duration_s": duration_s,
+    }
+    return req_id
+
+
+def await_profile(profile_dict, local_rank: int, req_id: str,
+                  timeout_s: float = 60.0) -> Optional[dict]:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        done = profile_dict.get(done_key(local_rank))
+        if done and done.get("id") == req_id:
+            return done
+        time.sleep(0.2)
+    return None

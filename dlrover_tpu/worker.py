@@ -183,6 +183,18 @@ def init(initialize_jax_distributed: bool = True) -> WorkerContext:
             int(os.getenv(EnvKey.NODE_ID, "0")),
             int(os.getenv(EnvKey.NODE_RANK, "0")),
         )
+    ipc = os.getenv("DLROVER_TPU_IPC_SOCKET", "")
+    if ipc and os.path.exists(ipc) and os.getenv(
+        "DLROVER_TPU_PROFILE_LISTENER", "1"
+    ) != "0":
+        # on-demand xprof capture (observability/profiler.py): the agent's
+        # hang diagnosis asks workers for an XLA trace over this channel
+        from dlrover_tpu.observability.profiler import ProfileListener
+
+        listener = ProfileListener(
+            ipc, int(os.getenv(EnvKey.LOCAL_RANK, "0"))
+        )
+        listener.start()
     if os.getenv("TPU_TIMER_ENABLE"):
         # agent opted this job into the observability plane: start the
         # native engine, serve per-rank metrics, patch the live PJRT table

@@ -2,6 +2,7 @@
 restart-vs-relaunch verdicts (reference test model: SURVEY.md §4 —
 rendezvous/diagnosis managers driven directly with fake state)."""
 
+import os
 import time
 
 import pytest
@@ -323,3 +324,69 @@ class TestDiagnosisAgent:
         # psutil is available in the image; tpu_timer daemon is not running
         assert "node_cpu_percent" in gauges
         assert all(isinstance(v, float) for v in gauges.values())
+
+
+class TestProfileOnDemand:
+    def test_request_capture_roundtrip(self, tmp_path):
+        """Agent posts an xprof request; the worker-side listener captures
+        an XLA trace of ongoing computation and reports back."""
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.common.multi_process import LocalIPCServer
+        from dlrover_tpu.observability.profiler import (
+            PROFILE_DICT,
+            ProfileListener,
+            await_profile,
+            request_profile,
+        )
+
+        sock = str(tmp_path / "ipc.sock")
+        server = LocalIPCServer(sock)
+        server.start()
+        listener = ProfileListener(
+            sock, local_rank=0, out_root=str(tmp_path / "prof"),
+            poll_s=0.1,
+        )
+        listener.start()
+        try:
+            pdict = server.local_dict(PROFILE_DICT)
+            req_id = request_profile(pdict, 0, duration_s=0.5)
+            # run some device work inside the capture window
+            f = jax.jit(lambda x: jnp.sin(x @ x).sum())
+            t_end = time.time() + 1.5
+            while time.time() < t_end:
+                float(f(jnp.ones((64, 64))))
+            done = await_profile(pdict, 0, req_id, timeout_s=30)
+            assert done is not None, "no capture report"
+            assert done["ok"], done
+            files = []
+            for root, _, names in os.walk(done["dir"]):
+                files += names
+            assert files, "trace dir is empty"
+        finally:
+            listener.stop()
+            server.stop()
+
+    def test_hang_triggers_profile_request(self, tmp_path):
+        """The hang path posts requests for every local worker."""
+        from dlrover_tpu.common.multi_process import LocalIPCServer
+        from dlrover_tpu.diagnosis.diagnosis_agent import DiagnosisAgent
+        from dlrover_tpu.observability.profiler import (
+            PROFILE_DICT,
+            request_key,
+        )
+
+        sock = str(tmp_path / "ipc2.sock")
+        server = LocalIPCServer(sock)
+        server.start()
+        try:
+            agent = DiagnosisAgent(
+                collectors=[], ipc_server=server, local_world_size=2,
+            )
+            agent._request_worker_profiles(duration_s=1.0)
+            pdict = server.local_dict(PROFILE_DICT)
+            assert request_key(0) in pdict and request_key(1) in pdict
+            assert pdict[request_key(1)]["duration_s"] == 1.0
+        finally:
+            server.stop()
