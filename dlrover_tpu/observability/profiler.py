@@ -83,7 +83,11 @@ class ProfileListener:
             if not req or req.get("id") == self._last_id:
                 continue
             self._last_id = req.get("id")
-            self._capture(req)
+            try:
+                self._capture(req)
+            except Exception:  # noqa: BLE001 — the listener must outlive
+                # any single capture failure (full disk, IPC hiccup, …)
+                logger.warning("profile capture crashed", exc_info=True)
 
     def _capture(self, req: dict) -> None:
         import jax
@@ -93,8 +97,8 @@ class ProfileListener:
             self._out_root,
             f"xprof_{self._local_rank}_{req.get('id')}",
         )
-        os.makedirs(out_dir, exist_ok=True)
         try:
+            os.makedirs(out_dir, exist_ok=True)
             jax.profiler.start_trace(out_dir)
             # the trace records the MAIN thread's ongoing step execution;
             # this thread only brackets the window
@@ -116,8 +120,9 @@ class ProfileListener:
                 "id": req.get("id"), "dir": out_dir, "ok": ok,
                 "ts": time.time(),
             })
-        except OSError:
-            pass
+        except Exception:  # noqa: BLE001 — incl. RPC dispatch errors; the
+            # report is best-effort, the listener must keep serving
+            logger.warning("profile report failed", exc_info=True)
 
 
 def request_profile(profile_dict, local_rank: int,
