@@ -54,10 +54,14 @@ def init_kv_cache(config, batch: int, max_len: Optional[int] = None,
     """Fixed-size per-layer key/value buffers + the write position.
 
     ``quantize=True`` stores int8 k/v with per-vector f32 scales
-    (absmax over head_dim): decode is HBM-bound and the cache is the
-    term that grows with context, so int8 halves its traffic vs bf16 and
-    doubles the max context per HBM — at ~0.4% per-element error, which
-    the attention softmax washes out further.
+    (absmax over head_dim): the cache is the memory term that grows with
+    context, so int8 DOUBLES the max context per HBM at ~0.4%
+    per-element error (which the attention softmax washes out further).
+    Measured on v5e it is a capacity knob, not (yet) a speed knob: the
+    XLA-level dequantize materializes a bf16 copy before the attention
+    matmuls, so the bandwidth saving is spent — turning it into a
+    throughput win needs a pallas kernel that fuses dequant into the
+    attend (future work, like ops/flash_attention.py for training).
     """
     c = config
     T = max_len or c.max_seq_len
