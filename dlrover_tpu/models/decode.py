@@ -102,18 +102,26 @@ def _split_heads(x, n_heads, head_dim):
 
 
 def _attend(q, k, v, mask, scale):
-    """q (B,Q,H,Dh) against k/v (B,T,KV,Dh) with GQA repeat; mask
-    (B,1,Q,T) or broadcastable. f32 softmax."""
-    groups = q.shape[2] // k.shape[2]
-    k = jnp.repeat(k, groups, axis=2)
-    v = jnp.repeat(v, groups, axis=2)
+    """q (B,Q,H,Dh) against k/v (B,T,KV,Dh), grouped-query; mask
+    broadcastable to (B,1,Q,T). f32 softmax.
+
+    GQA via a grouped einsum, NOT ``jnp.repeat``: decode is bound by
+    reading the cache, and materializing K/V ``groups`` times would
+    multiply exactly that traffic."""
+    B, Q, H, Dh = q.shape
+    KV = k.shape[2]
+    g = H // KV
+    qg = q.reshape(B, Q, KV, g, Dh)
     scores = jnp.einsum(
-        "bqhd,bthd->bhqt", q, k, preferred_element_type=jnp.float32
+        "bqkgd,btkd->bkgqt", qg, k, preferred_element_type=jnp.float32
     ) * scale
-    scores = jnp.where(mask, scores, jnp.float32(-1e30))
+    # mask (B,1,Q,T) → broadcast over the (KV, g) head axes
+    scores = jnp.where(mask[:, :, None], scores, jnp.float32(-1e30))
     att = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqt,bthd->bqhd", att.astype(v.dtype), v)
-    return out.reshape(out.shape[0], out.shape[1], -1)
+    out = jnp.einsum(
+        "bkgqt,btkd->bqkgd", att.astype(v.dtype), v
+    )
+    return out.reshape(B, Q, H * Dh)
 
 
 def prefill(params: Dict, tokens, config,
