@@ -262,18 +262,46 @@ def bench_decode() -> dict:
     prompt = jax.random.randint(
         jax.random.PRNGKey(1), (batch, prompt_len), 0, config.vocab_size
     )
-    gen = jax.jit(functools.partial(
-        decode.generate, config=config, max_new_tokens=new_tokens,
+    rtt = _fetch_rtt()
+
+    def timed_gen(pr, n_new):
+        gen = jax.jit(functools.partial(
+            decode.generate, config=config, max_new_tokens=n_new,
+            temperature=1.0, top_k=40,
+        ))
+        out = gen(params, pr, key=jax.random.PRNGKey(2))
+        _ = int(out[0, -1])  # compile + force
+        t0 = time.perf_counter()
+        out = gen(params, pr, key=jax.random.PRNGKey(3))
+        _ = int(out[0, -1])
+        return max(1e-9, time.perf_counter() - t0 - rtt)
+
+    dt = timed_gen(prompt, new_tokens)
+    toks = batch * new_tokens
+    # long-context point: decode cost grows with the cache the attention
+    # reads each step; this pins the curve's other end
+    long_prompt = int(os.environ.get(
+        "BENCH_DECODE_LONG_PROMPT", "2048" if on_tpu else "32"
+    ))
+    long_new = 128 if on_tpu else 4
+    import dataclasses
+
+    config_long = dataclasses.replace(
+        config, max_seq_len=max(config.max_seq_len, long_prompt + long_new)
+    )
+    gen_long = jax.jit(functools.partial(
+        decode.generate, config=config_long, max_new_tokens=long_new,
         temperature=1.0, top_k=40,
     ))
-    out = gen(params, prompt, key=jax.random.PRNGKey(2))
-    _ = int(out[0, -1])  # compile + force
-    rtt = _fetch_rtt()
-    t0 = time.perf_counter()
-    out = gen(params, prompt, key=jax.random.PRNGKey(3))
+    lp = jax.random.randint(
+        jax.random.PRNGKey(4), (batch, long_prompt), 0, config.vocab_size
+    )
+    out = gen_long(params, lp, key=jax.random.PRNGKey(5))
     _ = int(out[0, -1])
-    dt = max(1e-9, time.perf_counter() - t0 - rtt)
-    toks = batch * new_tokens
+    t0 = time.perf_counter()
+    out = gen_long(params, lp, key=jax.random.PRNGKey(6))
+    _ = int(out[0, -1])
+    dt_long = max(1e-9, time.perf_counter() - t0 - rtt)
     # HBM roof: params + the KV cache are read once per step (batch
     # shares the param read; the cache scales with batch and context)
     cache_bytes = (
@@ -296,6 +324,11 @@ def bench_decode() -> dict:
         "hbm_roof_steps_per_s": (
             round(hbm_gbps * 1e9 / param_bytes, 1) if hbm_gbps else 0.0
         ),
+        "long_context": {
+            "prompt_len": long_prompt, "new_tokens": long_new,
+            "tokens_per_s": round(batch * long_new / dt_long, 1),
+            "steps_per_s": round(long_new / dt_long, 1),
+        },
     }
     del params, out
     gc.collect()
