@@ -462,3 +462,142 @@ def flash_attention(
         bool(interpret),
     )
     return (o, lse) if return_lse else o
+
+
+# ---------------------------------------------------------------------------
+# decode (single-token) attention against a KV cache
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, block_k: int, kv_heads: int, rows: int,
+):
+    j = pl.program_id(1)
+    nk = pl.num_programs(1)
+    pos = pos_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _attend():
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_k), 1
+        )
+        mask = cols <= pos
+        # static unroll over KV heads: the K/V block is fetched ONCE for
+        # all heads (the bandwidth decode is bound by), the per-head
+        # matmuls run back to back out of VMEM
+        for h in range(kv_heads):
+            r0 = h * rows
+            q = q_ref[0, h].astype(jnp.float32)           # (rows, d)
+            k = k_ref[0, :, h, :].astype(jnp.float32)     # (block_k, d)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s = jnp.where(mask, s, NEG_INF)
+            m_prev = m_scr[r0:r0 + rows]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.where(mask, jnp.exp(s - m_new[:, :1]), 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            l_scr[r0:r0 + rows] = (
+                l_scr[r0:r0 + rows] * alpha
+                + jnp.sum(p, axis=-1, keepdims=True)
+            )
+            m_scr[r0:r0 + rows] = m_new
+            acc_scr[r0:r0 + rows] = (
+                acc_scr[r0:r0 + rows] * alpha[:, :1]
+                + jax.lax.dot_general(
+                    p, v_ref[0, :, h, :].astype(jnp.float32),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+
+    # blocks fully past ``pos`` do no work (their index map also clamps,
+    # so the pipeline re-targets an already-fetched block — ~no bandwidth)
+    pl.when(j * block_k <= pos)(_attend)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        for h in range(kv_heads):
+            r0 = h * rows
+            l = l_scr[r0:r0 + rows]
+            safe_l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, h] = (
+                acc_scr[r0:r0 + rows] / safe_l[:, :1]
+            ).astype(o_ref.dtype)
+
+
+def flash_decode_attention(
+    q, k, v, pos,
+    scale: Optional[float] = None,
+    block_k: int = 256,
+    interpret: Optional[bool] = None,
+):
+    """Single-token attention against a KV cache, fused.
+
+    q: (B, KV, G, Dh) — the current token's query heads grouped by KV
+    head (G = H // KV, the GQA group). k/v: (B, T, KV, Dh) — the cache in
+    its native layout (no transpose; the kernel reads each K/V block once
+    for ALL heads). ``pos``: scalar int32, the token's position — only
+    cache slots ``[0, pos]`` attend, and K blocks beyond ``pos`` are
+    skipped at ~zero bandwidth via a scalar-prefetch-clamped index map.
+    T must divide by ``block_k`` (callers round the cache length up at
+    creation).
+
+    Returns (B, KV, G, Dh).
+    """
+    B, KV, G, Dh = q.shape
+    T = k.shape[1]
+    if T % block_k != 0:
+        raise ValueError(f"cache length {T} not divisible by {block_k}")
+    if scale is None:
+        scale = Dh ** -0.5
+    if interpret is None:
+        interpret = _default_interpret()
+    rows = _round_up(G, 8)
+    if rows != G:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, rows - G), (0, 0)))
+    nk = T // block_k
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=float(scale), block_k=int(block_k),
+        kv_heads=KV, rows=rows,
+    )
+
+    def _clamped(b, j, pos_ref):
+        return (b, jnp.minimum(j, pos_ref[0] // block_k), 0, 0)
+
+    if pltpu is None:  # pragma: no cover — CPU build without pallas TPU
+        raise NotImplementedError("flash_decode_attention needs pallas TPU")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, nk),
+        in_specs=[
+            _vmem_spec((1, KV, rows, Dh), lambda b, j, p: (b, 0, 0, 0)),
+            _vmem_spec((1, block_k, KV, Dh), _clamped),
+            _vmem_spec((1, block_k, KV, Dh), _clamped),
+        ],
+        out_specs=[
+            _vmem_spec((1, KV, rows, Dh), lambda b, j, p: (b, 0, 0, 0)),
+        ],
+        scratch_shapes=[
+            _vmem_scratch((KV * rows, LANES), jnp.float32),
+            _vmem_scratch((KV * rows, LANES), jnp.float32),
+            _vmem_scratch((KV * rows, Dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((B, KV, rows, Dh), q.dtype)],
+        interpret=interpret,
+    )(pos_arr, q, k, v)[0]
+    return out[:, :, :G]

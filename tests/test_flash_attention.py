@@ -233,3 +233,35 @@ class TestRingFlash:
         gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), b, atol=1e-4)
+
+
+class TestFlashDecode:
+    def test_matches_masked_oracle_across_positions(self):
+        from dlrover_tpu.ops.flash_attention import flash_decode_attention
+
+        B, KV, G, Dh, T = 2, 4, 2, 16, 64
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (B, KV, G, Dh), jnp.float32)
+        k = jax.random.normal(ks[1], (B, T, KV, Dh), jnp.float32)
+        v = jax.random.normal(ks[2], (B, T, KV, Dh), jnp.float32)
+        scale = Dh ** -0.5
+        for pos in (0, 7, 31, 37, 63):
+            out = flash_decode_attention(q, k, v, pos, block_k=16)
+            s = jnp.einsum("bkgd,btkd->bkgt", q, k) * scale
+            mask = jnp.arange(T)[None, None, None, :] <= pos
+            s = jnp.where(mask, s, -1e30)
+            ref = jnp.einsum(
+                "bkgt,btkd->bkgd", jax.nn.softmax(s, -1), v
+            )
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=2e-5,
+                err_msg=f"pos={pos}",
+            )
+
+    def test_rejects_indivisible_cache(self):
+        from dlrover_tpu.ops.flash_attention import flash_decode_attention
+
+        q = jnp.zeros((1, 2, 2, 16))
+        k = v = jnp.zeros((1, 60, 2, 16))
+        with pytest.raises(ValueError, match="not divisible"):
+            flash_decode_attention(q, k, v, 0, block_k=16)
