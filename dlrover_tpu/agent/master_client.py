@@ -130,6 +130,12 @@ class MasterClient:
     def kv_delete(self, key: str) -> None:
         self._client.call("kv", comm.KeyValueRequest(op="delete", key=key))
 
+    def kv_delete_prefix(self, prefix: str) -> int:
+        resp = self._client.call(
+            "kv", comm.KeyValueRequest(op="delete_prefix", key=prefix)
+        )
+        return int(resp.value)
+
     def kv_multi_get(self, keys: List[str]) -> List[bytes]:
         resp = self._client.call(
             "kv", comm.KeyValueRequest(op="multi_get", keys=keys)

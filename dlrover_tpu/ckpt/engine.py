@@ -143,6 +143,19 @@ class CheckpointEngine:
         self._save_seq = 0  # per-engine save-attempt counter (all ranks
         # call saves in the same order, so it agrees across the group)
         self._ready_cooldown_until = 0.0
+        # GC the whole ready/ namespace once per incarnation: previous
+        # incarnations' trailing (un-GC'd) attempt keys would otherwise
+        # accumulate in the master KV — and its failover snapshots —
+        # forever. Old-incarnation stragglers can only see a deleted key
+        # as "peer not ready yet" and time out, the safe failure.
+        if (self._master is not None and self.saving_ranks
+                and self.rank == self.saving_ranks[0]):
+            gc = getattr(self._master, "kv_delete_prefix", None)
+            if gc is not None:
+                try:
+                    gc(f"ckpt/{self.job_name}/ready/")
+                except (ConnectionError, RuntimeError):
+                    pass  # best-effort: the leak is bounded per incarnation
         self._drain_thread: Optional[threading.Thread] = None
         self._drain_ok = False
         # donation safety (see _plan_state): snapshot shards on-device
@@ -313,7 +326,12 @@ class CheckpointEngine:
         if len(group) <= 1 or self._master is None or self.rank not in group:
             return local_ready
         self._save_seq += 1
-        base = f"ckpt/{self.job_name}/ready/{self._save_seq}"
+        # scope by rendezvous round: _save_seq restarts at 0 in a new
+        # worker incarnation while the master KV (and its failover
+        # snapshot) survives — unscoped, a fresh attempt could read a
+        # previous incarnation's stale b"1" for a dead peer and split
+        incarnation = os.getenv(EnvKey.RDZV_ROUND, "0")
+        base = f"ckpt/{self.job_name}/ready/r{incarnation}/{self._save_seq}"
         cooling = time.time() < self._ready_cooldown_until
         try:
             self._master.kv_set(
@@ -355,7 +373,7 @@ class CheckpointEngine:
             # be polling the previous attempt's keys — never delete those)
             gc_seq = self._save_seq - 8
             if self.rank == group[0] and gc_seq > 0:
-                old = f"ckpt/{self.job_name}/ready/{gc_seq}"
+                old = f"ckpt/{self.job_name}/ready/r{incarnation}/{gc_seq}"
                 for r in group:
                     self._master.kv_delete(f"{old}/{r}")
             return ok

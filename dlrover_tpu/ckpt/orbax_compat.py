@@ -95,16 +95,36 @@ def read_committed_flat(
         dtype = _np_dtype(meta["dtype"])
         gshape = tuple(meta["gshape"])
         arr = np.zeros(gshape, dtype)
-        covered = 0
+        # exact coverage: dedupe shards covering the identical region (a
+        # replicated leaf is saved identically by several ranks), then
+        # require the rest pairwise disjoint — a plain element-count sum
+        # would let an overlap mask a genuine hole (silent zero-fill)
+        boxes: list = []
+        seen = set()
         for shard in meta["shards"]:
+            box = (tuple(shard["start"]), tuple(shard["lshape"]))
+            if box in seen:
+                continue
+            seen.add(box)
+            boxes.append((box, shard))
+        for i, ((st_a, ln_a), _) in enumerate(boxes):
+            for (st_b, ln_b), _ in boxes[i + 1:]:
+                overlaps = all(
+                    a < b + lb and b < a + la
+                    for a, la, b, lb in zip(st_a, ln_a, st_b, ln_b)
+                )
+                if overlaps:
+                    raise ValueError(
+                        f"checkpoint shards overlap for {path}: "
+                        f"{st_a}/{ln_a} vs {st_b}/{ln_b} — refusing to "
+                        "export (coverage would be ambiguous)"
+                    )
+        covered = 0
+        for (st, ln), shard in boxes:
             data = np.frombuffer(
                 frame_shard_bytes(shard["_frame"], shard), dtype
             ).reshape(shard["lshape"])
-            idx = tuple(
-                slice(st, st + ln)
-                for st, ln in zip(shard["start"], shard["lshape"])
-            )
-            arr[idx] = data
+            arr[tuple(slice(s, s + l) for s, l in zip(st, ln))] = data
             covered += data.size
         if covered < int(np.prod(gshape)):
             raise ValueError(

@@ -50,6 +50,18 @@ class KVStoreService:
         with self._lock:
             self._store.pop(key, None)
 
+    def delete_prefix(self, prefix: str) -> int:
+        """Drop every key under ``prefix``; returns how many were dropped.
+        (Engine-init GC of a previous incarnation's coordination keys —
+        the writers restart their sequence counters, so the old keys are
+        unreachable garbage that would otherwise persist in failover
+        snapshots forever.)"""
+        with self._lock:
+            doomed = [k for k in self._store if k.startswith(prefix)]
+            for k in doomed:
+                del self._store[k]
+            return len(doomed)
+
     def multi_get(self, keys: List[str]) -> List[bytes]:
         with self._lock:
             return [self._store.get(k, b"") for k in keys]

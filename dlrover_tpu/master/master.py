@@ -118,6 +118,12 @@ class JobMaster:
                     os.getenv("DLROVER_TPU_MASTER_SNAPSHOT_S", "30")
                 ),
             )
+            # dataset registration snapshots immediately: a crash in the
+            # periodic window would otherwise lose the dataset for good
+            # (sharding clients never re-issue setup_dataset)
+            self.task_manager.on_new_dataset = (
+                lambda: self._snapshot_loop.save_now("dataset-registered")
+            )
         http_port = os.getenv("DLROVER_TPU_HTTP_PORT")
         if http_port:  # unset OR empty (un-templated manifest) disables
             from dlrover_tpu.common.http_server import HTTPTransportServer

@@ -134,6 +134,9 @@ class MasterServicer:
         if req.op == "delete":
             kv.delete(req.key)
             return comm.KeyValueResponse(found=True)
+        if req.op == "delete_prefix":
+            n = kv.delete_prefix(req.key)
+            return comm.KeyValueResponse(found=True, value=str(n).encode())
         if req.op == "multi_get":
             return comm.KeyValueResponse(found=True, values=kv.multi_get(req.keys))
         if req.op == "multi_set":

@@ -43,6 +43,11 @@ class MasterStateStore:
         self.state_dir = state_dir
         os.makedirs(state_dir, exist_ok=True)
         self.path = os.path.join(state_dir, SNAPSHOT_FILE)
+        # capture+replace must be atomic as a PAIR: without this, the
+        # periodic thread can capture a pre-registration snapshot, lose
+        # the CPU to a dataset-registration save, then replace the newer
+        # file with its stale blob
+        self._save_lock = threading.Lock()
 
     # -- capture -----------------------------------------------------------
 
@@ -65,15 +70,14 @@ class MasterStateStore:
         }
 
     def save(self, master) -> None:
-        blob = msgpack.packb(self.snapshot(master), use_bin_type=True)
-        # pid+thread id: the periodic thread and the final stop() save may
-        # overlap — each writes its own tmp, os.replace stays atomic
-        tmp = f"{self.path}.tmp.{os.getpid()}.{threading.get_ident()}"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        with self._save_lock:
+            blob = msgpack.packb(self.snapshot(master), use_bin_type=True)
+            tmp = f"{self.path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
 
     # -- restore -----------------------------------------------------------
 
@@ -88,10 +92,18 @@ class MasterStateStore:
         if snap is None:
             return False
         master.kv_store.restore(snap.get("kv", {}))
-        for entry in snap.get("datasets", []):
-            params = comm.deserialize(entry["params"])
-            master.task_manager.new_dataset(params)
-            master.task_manager.restore_shard_checkpoint(entry["ckpt"])
+        # suppress the registration-snapshot hook while replaying: it
+        # would overwrite this snapshot between new_dataset and the shard
+        # checkpoint restore, losing the queue position on a re-crash
+        hook, master.task_manager.on_new_dataset = (
+            master.task_manager.on_new_dataset, None)
+        try:
+            for entry in snap.get("datasets", []):
+                params = comm.deserialize(entry["params"])
+                master.task_manager.new_dataset(params)
+                master.task_manager.restore_shard_checkpoint(entry["ckpt"])
+        finally:
+            master.task_manager.on_new_dataset = hook
         step = int(snap.get("global_step", 0))
         if step > 0:
             master.perf_monitor.collect_global_step(step, time.time())
@@ -123,9 +135,11 @@ class SnapshotLoop:
 
     def _run(self) -> None:
         while not self._stop.wait(self._interval):
-            self._save("periodic")
+            self.save_now("periodic")
 
-    def _save(self, why: str) -> None:
+    def save_now(self, why: str) -> None:
+        """Snapshot immediately; never raises (a disk error must not turn
+        into a failed RPC for whichever caller triggered the save)."""
         try:
             self._store.save(self._master)
         except Exception:  # noqa: BLE001 — snapshots must not kill the master
@@ -135,4 +149,4 @@ class SnapshotLoop:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(5.0)
-        self._save("final")
+        self.save_now("final")

@@ -66,6 +66,10 @@ class TaskManager:
         self._params: Dict[str, DatasetShardParams] = {}
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # master failover hook: a dataset registered between periodic
+        # snapshots would vanish on a master crash (clients never re-issue
+        # setup_dataset), so registration triggers an immediate snapshot
+        self.on_new_dataset = None
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -85,6 +89,9 @@ class TaskManager:
             self._params[params.dataset_name] = params
             logger.info("task manager: registered dataset %s (size=%s)",
                         params.dataset_name, params.dataset_size)
+        cb = self.on_new_dataset
+        if cb is not None:  # outside the lock — the snapshot re-enters us
+            cb()
 
     def dataset_names(self):
         with self._lock:

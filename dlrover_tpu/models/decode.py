@@ -33,8 +33,48 @@ import os
 from dlrover_tpu.models.llama import _mlp, _rms_norm, _rope
 
 # K-block size of the fused decode kernel; caches sized in multiples of
-# this take the pallas path (generate rounds its cache length up to it)
+# this can take the pallas path
 _DECODE_BLOCK_K = 256
+
+
+def flash_decode_wanted(T: int, quantized: bool,
+                        live_len: Optional[int] = None) -> bool:
+    """Should the single-token attend use the fused pallas kernel?
+
+    Auto policy (measured on v5e, commit 042625f + the int8 fusion):
+    - int8 cache → yes: in-VMEM dequant halves the cache HBM traffic the
+      step is bound by; the XLA path materializes a bf16 copy instead;
+    - bf16 cache → only when the cache is meaningfully larger than the
+      live context (preallocated serving cache): the kernel skips blocks
+      past ``pos`` at ~zero bandwidth, but XLA's batched matmul beats it
+      when every block is live (right-sized cache).
+    ``DLROVER_TPU_FLASH_DECODE=1/0`` force-overrides; default is auto.
+    ``live_len`` is the statically-known context the cache will actually
+    hold (prompt + budget) when the caller knows it; None means assume
+    the cache is fully live.
+    """
+    env = os.getenv("DLROVER_TPU_FLASH_DECODE", "auto")
+    if env in ("0", "off"):
+        return False
+    if T % _DECODE_BLOCK_K != 0 or jax.default_backend() != "tpu":
+        return False
+    if env == "1":
+        return True
+    if quantized:
+        # fused int8 traffic ≈ T bytes/vector vs einsum ≈ live_len int8 +
+        # 2×live_len bf16 materialized + read back (~5×live_len): the
+        # kernel wins unless block padding dwarfs the live context (tiny
+        # prompts rounded up to one 256 block)
+        return live_len is None or T <= live_len * 4
+    # bf16: worth it only when the kernel can actually SKIP cache blocks
+    # the einsum would read — needs both a 2x size ratio and at least one
+    # whole skippable block (else a short context padded up to one block
+    # reads MORE than a tight einsum cache, up to block_k/live_len times)
+    return (
+        live_len is not None
+        and T >= live_len * 2
+        and T - live_len >= _DECODE_BLOCK_K
+    )
 
 
 def _ffn(xn, layer, config) -> jnp.ndarray:
@@ -107,7 +147,8 @@ def _split_heads(x, n_heads, head_dim):
     return x.reshape(B, S, n_heads, head_dim)
 
 
-def _attend(q, k, v, mask, scale, pos=None):
+def _attend(q, k, v, mask, scale, pos=None, flash=False,
+            k_scale=None, v_scale=None):
     """q (B,Q,H,Dh) against k/v (B,T,KV,Dh), grouped-query; mask
     broadcastable to (B,1,Q,T). f32 softmax.
 
@@ -115,27 +156,22 @@ def _attend(q, k, v, mask, scale, pos=None):
     reading the cache, and materializing K/V ``groups`` times would
     multiply exactly that traffic.
 
-    DLROVER_TPU_FLASH_DECODE=1 opts the single-token path into the fused
-    pallas kernel (ops/flash_attention.py flash_decode_attention), which
-    skips reading cache blocks past ``pos`` entirely. Measured on v5e:
-    +16% when the cache is much larger than the live context (serving
-    with a preallocated cache), but SLOWER than this einsum when the
-    cache is right-sized to the sequence (XLA's batched matmul beats the
-    kernel's per-head unrolled MXU tiles at pos≈T) — hence opt-in."""
+    ``flash`` (static, from :func:`flash_decode_wanted`) routes the
+    single-token path into the fused pallas kernel
+    (ops/flash_attention.py flash_decode_attention), which skips cache
+    blocks past ``pos`` entirely and — given ``k_scale``/``v_scale`` —
+    reads the int8 cache directly, dequantizing in VMEM."""
     B, Q, H, Dh = q.shape
     T = k.shape[1]
     KV = k.shape[2]
     g = H // KV
-    if (
-        pos is not None and Q == 1 and T % _DECODE_BLOCK_K == 0
-        and jax.default_backend() == "tpu"
-        and os.getenv("DLROVER_TPU_FLASH_DECODE", "0") == "1"
-    ):
+    if flash and pos is not None and Q == 1:
         from dlrover_tpu.ops.flash_attention import flash_decode_attention
 
         qg = q.reshape(B, KV, g, Dh)
         out = flash_decode_attention(
-            qg, k, v, pos, scale=scale, block_k=_DECODE_BLOCK_K
+            qg, k, v, pos, scale=scale, block_k=_DECODE_BLOCK_K,
+            k_scale=k_scale, v_scale=v_scale,
         )
         return out.reshape(B, Q, H * Dh)
     qg = q.reshape(B, Q, KV, g, Dh)
@@ -204,9 +240,13 @@ def prefill(params: Dict, tokens, config,
 
 
 def decode_step(params: Dict, token, cache: Dict,
-                config) -> Tuple[jnp.ndarray, Dict]:
+                config, flash: Optional[bool] = None) -> Tuple[jnp.ndarray, Dict]:
     """One autoregressive step: ``token`` (B,) int32 at position
-    ``cache['pos']`` → (next-token logits (B, V), updated cache)."""
+    ``cache['pos']`` → (next-token logits (B, V), updated cache).
+
+    ``flash`` routes the attend through the fused pallas decode kernel
+    (must be a static Python bool; None = :func:`flash_decode_wanted`
+    auto policy)."""
     c = config
     B = token.shape[0]
     T = cache["k"].shape[2]
@@ -218,6 +258,8 @@ def decode_step(params: Dict, token, cache: Dict,
     scale = c.head_dim ** -0.5
 
     quantized = "k_scale" in cache
+    if flash is None:
+        flash = flash_decode_wanted(T, quantized)
     # one scan for both layouts: the per-layer cache slices are threaded
     # as a dict keyed by this list, so adding a cache field means adding
     # one key — the carry structure and rebuild stay single-sited
@@ -248,13 +290,21 @@ def decode_step(params: Dict, token, cache: Dict,
             )
             for name, val in writes.items()
         }
-        if quantized:
+        if quantized and flash:
+            # fused dequant-attend: the int8 cache goes straight into the
+            # kernel, no bf16 materialization
+            out = _attend(
+                q, slices["k"], slices["v"], mask, scale, pos=pos,
+                flash=True, k_scale=slices["k_scale"],
+                v_scale=slices["v_scale"],
+            )
+        elif quantized:
             k_read = _dequantize(slices["k"], slices["k_scale"], c.dtype)
             v_read = _dequantize(slices["v"], slices["v_scale"], c.dtype)
+            out = _attend(q, k_read, v_read, mask, scale, pos=None)
         else:
-            k_read, v_read = slices["k"], slices["v"]
-        out = _attend(q, k_read, v_read, mask, scale,
-                      pos=None if quantized else pos)
+            out = _attend(q, slices["k"], slices["v"], mask, scale,
+                          pos=pos, flash=flash)
         h = h + out @ layer["wo"]
         h = h + _ffn(_rms_norm(h, layer["ffn_norm"], c.norm_eps), layer, c)
         return h, slices
@@ -290,11 +340,17 @@ def generate(params: Dict, prompt, config, key,
     prefill + a ``lax.scan`` of cached decode steps."""
     B, P = prompt.shape
     total = P + max_new_tokens
-    # round the cache up to the fused decode kernel's block size: the
-    # padding slots are masked anyway and the kernel skips unused blocks
-    max_len = max_len or (
-        -(-total // _DECODE_BLOCK_K) * _DECODE_BLOCK_K
-    )
+    if max_len is None:
+        # a right-sized cache keeps per-step KV traffic minimal on the
+        # einsum path; the fused kernel needs a block-multiple length but
+        # skips the padded blocks at ~zero bandwidth, so round up only
+        # when the kernel will actually run — one decision decides BOTH
+        # the size and the routing, so they cannot disagree
+        rounded = -(-total // _DECODE_BLOCK_K) * _DECODE_BLOCK_K
+        flash = flash_decode_wanted(rounded, quantize_cache, live_len=total)
+        max_len = rounded if flash else total
+    else:
+        flash = flash_decode_wanted(max_len, quantize_cache, live_len=total)
     if total > max_len:
         # dynamic_update_slice would silently clamp writes to the last
         # slot and corrupt the tail — refuse instead
@@ -302,15 +358,15 @@ def generate(params: Dict, prompt, config, key,
             f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"cache length {max_len}"
         )
+    keys = jax.random.split(key, max_new_tokens)
     logits, cache = prefill(
         params, prompt, config, max_len, quantize=quantize_cache
     )
-    keys = jax.random.split(key, max_new_tokens)
 
     def step(carry, step_key):
         logits, cache = carry
         nxt = sample_token(logits, step_key, temperature, top_k)
-        logits, cache = decode_step(params, nxt, cache, config)
+        logits, cache = decode_step(params, nxt, cache, config, flash=flash)
         return (logits, cache), nxt
 
     if max_new_tokens > 1:

@@ -470,9 +470,14 @@ def flash_attention(
 
 
 def _decode_kernel(
-    pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-    *, scale: float, block_k: int, kv_heads: int, rows: int,
+    pos_ref, q_ref, k_ref, v_ref, *rest,
+    scale: float, block_k: int, kv_heads: int, rows: int, quantized: bool,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
     j = pl.program_id(1)
     nk = pl.num_programs(1)
     pos = pos_ref[0]
@@ -495,6 +500,13 @@ def _decode_kernel(
             r0 = h * rows
             q = q_ref[0, h].astype(jnp.float32)           # (rows, d)
             k = k_ref[0, :, h, :].astype(jnp.float32)     # (block_k, d)
+            v = v_ref[0, :, h, :].astype(jnp.float32)
+            if quantized:
+                # dequantize IN VMEM: HBM saw only int8 values + one f32
+                # scale per vector — the bandwidth saving an XLA-level
+                # dequant spends by materializing the bf16 copy
+                k = k * ks_ref[0, :, h][:, None]
+                v = v * vs_ref[0, :, h][:, None]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -513,7 +525,7 @@ def _decode_kernel(
             acc_scr[r0:r0 + rows] = (
                 acc_scr[r0:r0 + rows] * alpha[:, :1]
                 + jax.lax.dot_general(
-                    p, v_ref[0, :, h, :].astype(jnp.float32),
+                    p, v,
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
@@ -539,6 +551,8 @@ def flash_decode_attention(
     scale: Optional[float] = None,
     block_k: int = 256,
     interpret: Optional[bool] = None,
+    k_scale=None,
+    v_scale=None,
 ):
     """Single-token attention against a KV cache, fused.
 
@@ -551,12 +565,21 @@ def flash_decode_attention(
     T must divide by ``block_k`` (callers round the cache length up at
     creation).
 
+    With ``k_scale``/``v_scale`` (B, T, KV) f32, k/v are int8 and are
+    dequantized inside the kernel (per-vector absmax scales) — HBM
+    traffic for the cache is halved vs bf16, which is the whole game for
+    the bandwidth-bound decode step. An XLA-level dequant can't deliver
+    that: it materializes the bf16 copy first (models/decode.py history).
+
     Returns (B, KV, G, Dh).
     """
     B, KV, G, Dh = q.shape
     T = k.shape[1]
     if T % block_k != 0:
         raise ValueError(f"cache length {T} not divisible by {block_k}")
+    quantized = k_scale is not None
+    if quantized and v_scale is None:
+        raise ValueError("k_scale given without v_scale")
     if scale is None:
         scale = Dh ** -0.5
     if interpret is None:
@@ -569,22 +592,36 @@ def flash_decode_attention(
 
     kernel = functools.partial(
         _decode_kernel, scale=float(scale), block_k=int(block_k),
-        kv_heads=KV, rows=rows,
+        kv_heads=KV, rows=rows, quantized=quantized,
     )
 
     def _clamped(b, j, pos_ref):
         return (b, jnp.minimum(j, pos_ref[0] // block_k), 0, 0)
 
+    def _clamped3(b, j, pos_ref):
+        return (b, jnp.minimum(j, pos_ref[0] // block_k), 0)
+
     if pltpu is None:  # pragma: no cover — CPU build without pallas TPU
         raise NotImplementedError("flash_decode_attention needs pallas TPU")
+    in_specs = [
+        _vmem_spec((1, KV, rows, Dh), lambda b, j, p: (b, 0, 0, 0)),
+        _vmem_spec((1, block_k, KV, Dh), _clamped),
+        _vmem_spec((1, block_k, KV, Dh), _clamped),
+    ]
+    operands = [q, k, v]
+    if quantized:
+        in_specs += [
+            _vmem_spec((1, block_k, KV), _clamped3),
+            _vmem_spec((1, block_k, KV), _clamped3),
+        ]
+        operands += [
+            jnp.asarray(k_scale, jnp.float32),
+            jnp.asarray(v_scale, jnp.float32),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, nk),
-        in_specs=[
-            _vmem_spec((1, KV, rows, Dh), lambda b, j, p: (b, 0, 0, 0)),
-            _vmem_spec((1, block_k, KV, Dh), _clamped),
-            _vmem_spec((1, block_k, KV, Dh), _clamped),
-        ],
+        in_specs=in_specs,
         out_specs=[
             _vmem_spec((1, KV, rows, Dh), lambda b, j, p: (b, 0, 0, 0)),
         ],
@@ -594,10 +631,11 @@ def flash_decode_attention(
             _vmem_scratch((KV * rows, Dh), jnp.float32),
         ],
     )
+    out_dtype = q.dtype
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((B, KV, rows, Dh), q.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((B, KV, rows, Dh), out_dtype)],
         interpret=interpret,
-    )(pos_arr, q, k, v)[0]
+    )(pos_arr, *operands)[0]
     return out[:, :, :G]

@@ -192,3 +192,56 @@ class TestQuantizedCache:
                               7, quantize_cache=True)
         assert out.shape == (2, 12)
         assert int(out.max()) < c.vocab_size
+
+    def test_fused_flash_step_matches_xla_paths(self):
+        """decode_step(flash=True) — pallas interpret on CPU — must agree
+        with the einsum path, for both the bf16 cache and the int8 cache
+        (in-kernel dequant vs the XLA materialized dequant)."""
+        c, params, tokens = _setup(B=2, S=24)
+        P = 8
+        T = 256  # fused kernel needs a block-multiple cache length
+        for quantize in (False, True):
+            logits, cache = decode.prefill(params, tokens[:, :P], c, T,
+                                           quantize=quantize)
+            nxt = tokens[:, P]
+            ref_logits, ref_cache = decode.decode_step(
+                params, nxt, cache, c, flash=False
+            )
+            out_logits, out_cache = decode.decode_step(
+                params, nxt, cache, c, flash=True
+            )
+            np.testing.assert_allclose(
+                np.asarray(out_logits), np.asarray(ref_logits),
+                atol=2e-4, rtol=2e-4, err_msg=f"quantize={quantize}",
+            )
+            assert int(out_cache["pos"]) == int(ref_cache["pos"])
+
+    def test_generate_default_cache_is_tight_without_flash(self):
+        """When the fused kernel won't run, generate must size the cache
+        to exactly prompt + budget — the einsum reads every slot every
+        step, so block-padding would inflate KV traffic."""
+        c, params, _ = _setup()
+        prompt = jnp.ones((1, 5), jnp.int32)
+        seen = {}
+        orig = decode.prefill
+
+        def spy(params, tokens, config, max_len, quantize=False):
+            seen["max_len"] = max_len
+            return orig(params, tokens, config, max_len, quantize=quantize)
+
+        decode.prefill = spy
+        try:
+            decode.generate(params, prompt, c, jax.random.PRNGKey(0), 7)
+        finally:
+            decode.prefill = orig
+        assert seen["max_len"] == 12  # 5 prompt + 7 new, no block padding
+
+    def test_flash_policy_requires_a_skippable_block(self):
+        # short context padded to one block must NOT take the kernel: it
+        # would read the whole 256-slot block where a tight einsum cache
+        # reads only live_len slots
+        assert not decode.flash_decode_wanted(256, False, live_len=10)
+        assert not decode.flash_decode_wanted(12, False, live_len=12)
+        # int8: padding a tiny context to one block reads ~block_k/live
+        # more int8 bytes than a tight einsum cache — refuse there too
+        assert not decode.flash_decode_wanted(256, True, live_len=12)

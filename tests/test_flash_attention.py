@@ -265,3 +265,41 @@ class TestFlashDecode:
         k = v = jnp.zeros((1, 60, 2, 16))
         with pytest.raises(ValueError, match="not divisible"):
             flash_decode_attention(q, k, v, 0, block_k=16)
+
+    def test_int8_fused_dequant_matches_dequantized_oracle(self):
+        """The in-kernel dequant path must agree with attending over the
+        explicitly dequantized cache (the XLA fallback path)."""
+        from dlrover_tpu.ops.flash_attention import flash_decode_attention
+
+        B, KV, G, Dh, T = 2, 2, 4, 16, 48
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(ks[0], (B, KV, G, Dh), jnp.float32)
+        kf = jax.random.normal(ks[1], (B, T, KV, Dh), jnp.float32)
+        vf = jax.random.normal(ks[2], (B, T, KV, Dh), jnp.float32)
+
+        def quant(x):
+            s = jnp.max(jnp.abs(x), axis=-1) / 127.0
+            s = jnp.maximum(s, 1e-9)
+            return (
+                jnp.clip(jnp.round(x / s[..., None]), -127, 127)
+                .astype(jnp.int8),
+                s,
+            )
+
+        kq, ksc = quant(kf)
+        vq, vsc = quant(vf)
+        kd = kq.astype(jnp.float32) * ksc[..., None]
+        vd = vq.astype(jnp.float32) * vsc[..., None]
+        scale = Dh ** -0.5
+        for pos in (0, 17, 47):
+            out = flash_decode_attention(
+                q, kq, vq, pos, block_k=16, k_scale=ksc, v_scale=vsc
+            )
+            s = jnp.einsum("bkgd,btkd->bkgt", q, kd) * scale
+            mask = jnp.arange(T)[None, None, None, :] <= pos
+            s = jnp.where(mask, s, -1e30)
+            ref = jnp.einsum("bkgt,btkd->bkgd", jax.nn.softmax(s, -1), vd)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=2e-5,
+                err_msg=f"pos={pos}",
+            )

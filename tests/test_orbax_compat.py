@@ -80,6 +80,7 @@ def test_read_committed_flat_rebuilds_full_arrays(tmp_path, mesh):
 
 
 def test_orbax_roundtrip(tmp_path, mesh):
+    pytest.importorskip("orbax.checkpoint")
     _save(tmp_path, mesh)
     out = tmp_path / "orbax_ckpt"
     step, n = export_to_orbax(str(tmp_path), str(out))
@@ -103,6 +104,7 @@ def test_orbax_roundtrip(tmp_path, mesh):
 
 
 def test_cli_inspect_export_import(tmp_path, mesh, capsys):
+    pytest.importorskip("orbax.checkpoint")
     _save(tmp_path, mesh)
     assert ckpt_cli(["inspect", str(tmp_path), "-v"]) == 0
     info = json.loads(capsys.readouterr().out)
