@@ -30,7 +30,7 @@ from dlrover_tpu.unified.scheduler import (
 class UnifiedMaster:
     def __init__(self, job: DLJob, job_name: str = "unified",
                  backend: str = "process", max_restarts: int = 3,
-                 start_method: str = "fork"):
+                 start_method: str = "forkserver"):
         if backend != "process":
             raise ValueError(f"unknown backend {backend!r} "
                              "(ray backend: not in this build)")

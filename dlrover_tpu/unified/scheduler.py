@@ -191,7 +191,15 @@ class ProcessScheduler:
     _create_actor_by_graph, scheduler.py:89)."""
 
     def __init__(self, graph: ExecutionGraph, job_name: str = "unified",
-                 start_method: str = "fork"):
+                 start_method: str = "forkserver"):
+        # forkserver, NOT fork: the scheduler lives in a master process
+        # that has imported jax — XLA's thread pools are already running,
+        # and forking a multithreaded parent can deadlock the child on a
+        # lock some pool thread held at fork time (a real hazard on TPU
+        # hosts, not lint noise). The forkserver process is single-
+        # threaded and clean; actors fork from IT. Children re-import
+        # their workload module (spawn semantics for user code), so no
+        # state sneaks in through the fork either.
         self.graph = graph
         self.job_name = job_name
         self._mp = mp.get_context(start_method)

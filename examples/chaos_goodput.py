@@ -35,39 +35,94 @@ sys.path.insert(
 )
 
 WORKER_SRC = '''
+"""Chaos worker: REAL distributed training, not a sleep loop.
+
+Every incarnation bootstraps ``jax.distributed`` through worker.init()
+(master-rendezvoused coordinator), builds a dp mesh over the JOINT world
+(all processes' devices), and runs a jitted SGD step whose global-batch
+mean forces a cross-process reduction — so world formation, re-formation
+at a new size after the kill, and collective correctness are all load-
+bearing, not simulated. The gradient is exactly 1.0 per step by
+construction, so the final weight equals the step count iff no step was
+lost or double-applied across shrink/rejoin.
+"""
 import json, os, sys, time
+import numpy as np
 from dlrover_tpu import worker
 from dlrover_tpu.ckpt import Checkpointer, StorageType
 from dlrover_tpu.common.event import TrainEvent, get_emitter
 
-ctx = worker.init(initialize_jax_distributed=False)
+ctx = worker.init()  # initialize_jax_distributed=True: the real path
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
 ckpt_dir, log_path = sys.argv[1], sys.argv[2]
 steps, step_time = int(sys.argv[3]), float(sys.argv[4])
 global_batch = int(sys.argv[5])
 world = ctx.world_size
-# fixed global batch: fewer replicas -> more grad-accum per replica
+# fixed global batch: fewer replicas -> each shards MORE rows of the same
+# global batch (the dp resharding folds what grad-accum would stage)
 accum = max(1, global_batch // max(1, world))
-state = {"step": 0}
+
+devices = jax.devices()  # the JOINT world's devices, 1 per process
+mesh = Mesh(np.array(devices), ("dp",))
+repl = NamedSharding(mesh, P())
+data_sh = NamedSharding(mesh, P("dp"))
+
+# collective proof: psum of one 1.0 per device == world size
+psum_check = jax.jit(jax.shard_map(
+    lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+    in_specs=P("dp"), out_specs=P(),
+))
+ones = jax.device_put(jnp.ones((len(devices),), jnp.float32), data_sh)
+world_check = float(np.asarray(jax.device_get(psum_check(ones)))[0])
+
+D = 8
+def loss_fn(w, x):
+    # global-batch mean: XLA inserts the cross-process reduction
+    return jnp.mean(x @ w)
+
+@jax.jit
+def train_step(w, x):
+    # one full-global-batch step; x all-ones makes the grad exactly 1.0
+    g = jax.grad(loss_fn)(w, x)
+    return w + g  # "lr=-1": w increments by exactly 1 per global step
+
+state = {"w": jnp.zeros((D,), jnp.float32), "step": 0}
 # single-writer pattern: rank 0 owns the (replicated) state and is the
 # only saver — declare the saver group so readiness coordination does not
 # wait on ranks that never call save
 ckpt = Checkpointer(ckpt_dir, saving_ranks=[0])
 state, last = ckpt.load_checkpoint(state)
 start = last + 1 if last >= 0 else 0
+w = jax.device_put(jnp.asarray(state["w"]), repl)
+# identical on every process (device_put requires that multi-process);
+# rows/replica = accum * rows-per-micro-batch — fixed global batch
+x = jax.device_put(jnp.ones((global_batch, D), jnp.float32), data_sh)
 with open(log_path, "a") as f:
     f.write(json.dumps({"event": "segment_start", "rank": ctx.rank,
-                        "world": world, "accum": accum,
-                        "start": start}) + "\\n")
+                        "world": world, "accum": accum, "start": start,
+                        "psum": world_check,
+                        "w_at_start": float(np.asarray(state["w"])[0]),
+                        }) + "\\n")
 em = get_emitter(f"worker_{ctx.rank}")
 for s in range(start, steps):
     with em.span(TrainEvent.TRAINING, step=s, world=world):
-        time.sleep(step_time)  # stands in for accum micro-steps
+        w = train_step(w, x)
+        w.block_until_ready()
+        if step_time:
+            time.sleep(step_time)  # pace the drill (kill timing)
     if ctx.rank == 0:
-        ckpt.save_checkpoint(s, {"step": s}, StorageType.DISK)
+        ckpt.save_checkpoint(
+            s, {"w": np.asarray(jax.device_get(w)), "step": s},
+            StorageType.DISK,
+        )
     ctx.report_step(s)
 with open(log_path, "a") as f:
-    f.write(json.dumps({"event": "done", "rank": ctx.rank,
-                        "world": world}) + "\\n")
+    f.write(json.dumps({"event": "done", "rank": ctx.rank, "world": world,
+                        "w_final": float(np.asarray(jax.device_get(w))[0]),
+                        "psum": world_check}) + "\\n")
 '''
 
 
@@ -147,6 +202,11 @@ def main(argv=None) -> int:
         env = dict(os.environ)
         env.update({
             "JAX_PLATFORMS": "cpu",
+            # exactly ONE device per worker process: the joint world's
+            # device count must equal the process count for the psum
+            # world-check (a test runner's 8-device XLA_FLAGS would leak
+            # in otherwise)
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
             "DLROVER_TPU_EVENT_DIR": event_dir,
             "DLROVER_TPU_HEARTBEAT_INTERVAL_S": "0.5",
             "DLROVER_TPU_HEARTBEAT_TIMEOUT_S": "3",
@@ -227,9 +287,9 @@ def main(argv=None) -> int:
             except subprocess.TimeoutExpired:
                 pass
         wall = time.time() - t_start
-        segments = [
-            r for r in _read_log(log_path) if r["event"] == "segment_start"
-        ]
+        records = _read_log(log_path)
+        segments = [r for r in records if r["event"] == "segment_start"]
+        dones = [r for r in records if r["event"] == "done"]
         goodput = _merged_goodput(event_dir)
         # this scenario packs one kill + one rejoin into a ~20 s toy job,
         # so the raw fraction is dominated by the fixed recovery cost; the
@@ -250,6 +310,16 @@ def main(argv=None) -> int:
             "step_at_shrink": step_before_rejoin,
             "final_step": master.perf_monitor.completed_global_step,
             "segments": segments,
+            # distributed-core proof: every segment's psum equals its
+            # world size (real collectives over the joint world), and the
+            # final weight equals the step count (grad=1/step by
+            # construction — no step lost or doubled across shrink/rejoin)
+            "w_final": max(
+                (d.get("w_final", -1.0) for d in dones), default=-1.0
+            ),
+            "psum_ok": all(
+                s.get("psum") == s["world"] for s in segments
+            ) and bool(segments),
         }
         print(json.dumps(result))
         return 0

@@ -35,7 +35,8 @@ def test_chaos_kill_shrink_resume_rejoin():
     worlds = [(s["world"], s["accum"]) for s in segments]
     # phase 1: both nodes at world=2, accum=4 (global batch 8)
     assert worlds.count((2, 4)) >= 2
-    # phase 2: the survivor shrank to world=1 and grad-accum DOUBLED
+    # phase 2: the survivor shrank to world=1 and its per-replica share of
+    # the fixed global batch DOUBLED
     shrink = [s for s in segments if s["world"] == 1]
     assert shrink and shrink[0]["accum"] == 8
     # ... resuming from a checkpoint, not from scratch
@@ -49,7 +50,18 @@ def test_chaos_kill_shrink_resume_rejoin():
     assert all(s["start"] >= shrink[0]["start"] for s in rejoin)
     # training finished every step
     assert result["final_step"] == 59
+    # the distributed core is real: every incarnation bootstrapped
+    # jax.distributed over the joint world and its psum equaled the world
+    # size (2 -> 1 after the kill -> 2 after rejoin)
+    assert result["psum_ok"] is True
+    assert {s["psum"] for s in segments} == {1.0, 2.0}
+    # grad is exactly 1/step by construction: the final weight equals the
+    # step count iff no step was lost or double-applied across the
+    # shrink/rejoin (collectives stayed correct at every world size)
+    assert result["w_final"] == 60.0
     # the goodput numbers exist and are sane
     assert 0 < result["goodput_pct"] <= 100
     # per-fault recovery cost at production scale clears the reference bar
+    # — now including REAL restore + recompile + collective costs, not
+    # sleep-loop orchestration overhead only
     assert result["goodput_1h_extrapolated_pct"] >= 95.0

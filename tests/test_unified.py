@@ -360,7 +360,9 @@ def test_e2e_elastic_training_stream(tmp_path):
         "import os\n"
         "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
         "from dlrover_tpu import worker\n"
-        "ctx = worker.init(initialize_jax_distributed=False)\n"
+        "ctx = worker.init()\n"  # real jax.distributed bootstrap (world=2)
+        "import jax\n"
+        "assert len(jax.devices()) > len(jax.local_devices())\n"
         f"open('{tmp_path}/done_' + str(ctx.rank), 'w').write('ok')\n"
     )
     b = DLJobBuilder().node_num(1).device_per_node(4)
