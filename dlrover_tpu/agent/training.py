@@ -297,7 +297,12 @@ class ElasticTrainingAgent:
         except ConnectionError:
             return False
 
-    def _stop_workers(self, sig: int = signal.SIGTERM, grace_s: float = 10.0) -> None:
+    def _stop_workers(self, sig: int = signal.SIGTERM,
+                      grace_s: Optional[float] = None) -> None:
+        if grace_s is None:
+            from dlrover_tpu.common.config import get_context
+
+            grace_s = get_context().worker_stop_grace_s
         for w in self._workers:
             if w.proc.poll() is None:
                 try:

@@ -22,8 +22,17 @@ class DefaultValues:
     # --- heartbeats / monitoring ---
     heartbeat_interval_s: float = 15.0
     heartbeat_timeout_s: float = 300.0
+    # grace after a heartbeat connection drops before declaring the node
+    # dead (covers benign reconnects); detection latency for a killed
+    # agent is ~this value instead of heartbeat_timeout_s
+    conn_drop_grace_s: float = 1.0
     monitor_interval_s: float = 0.2
     # --- relaunch / restart budgets ---
+    # SIGTERM→SIGKILL escalation window when stopping workers for a
+    # restart: persistence is the AGENT's job (shm outlives the workers),
+    # so a worker wedged in a dead collective gets little grace — every
+    # second here is direct fault-recovery latency
+    worker_stop_grace_s: float = 3.0
     node_max_relaunch: int = 3
     worker_max_restart: int = 100
     relaunch_on_worker_failure: int = 3

@@ -96,6 +96,10 @@ class Node:
     start_time: Optional[float] = None
     finish_time: Optional[float] = None
     heartbeat_time: float = 0.0
+    # master-clock contact stamp: heartbeat_time carries the AGENT's
+    # timestamp (clock skew!), so second-scale liveness comparisons
+    # (connection-drop grace recheck) use this instead
+    contact_time: float = 0.0
     # rendezvous participation
     local_world_size: int = 1
     paral_config_version: int = 0

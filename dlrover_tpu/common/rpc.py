@@ -27,8 +27,39 @@ class RPCError(RuntimeError):
     pass
 
 
+# per-connection context, visible to handlers during dispatch: a handler
+# that learns who is on the other end (e.g. heartbeat carries node_id)
+# stamps it here, and the server's on_disconnect hook receives it when
+# the connection dies — the master uses this to notice an agent's death
+# the moment the kernel closes its sockets instead of waiting out the
+# heartbeat timeout
+_conn_ctx = threading.local()
+
+
+def connection_ctx() -> Dict[str, Any]:
+    """The current RPC connection's context dict (empty off-connection)."""
+    ctx = getattr(_conn_ctx, "ctx", None)
+    if ctx is None:
+        ctx = {}
+        _conn_ctx.ctx = ctx
+    return ctx
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
+        try:
+            self._serve()
+        finally:
+            ctx = connection_ctx()
+            on_disconnect = getattr(self.server, "on_disconnect", None)
+            if ctx and on_disconnect is not None:
+                try:
+                    on_disconnect(dict(ctx))
+                except Exception:  # noqa: BLE001 — a hook must not kill the server thread
+                    logger.exception("rpc on_disconnect hook failed")
+            _conn_ctx.ctx = None
+
+    def _serve(self) -> None:
         self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         registry: Dict[str, Callable] = self.server.registry  # type: ignore[attr-defined]
         dedup = self.server.dedup  # type: ignore[attr-defined]
@@ -50,8 +81,24 @@ class _Handler(socketserver.BaseRequestHandler):
                 with dedup_lock:
                     cached = dedup.get(key)
                 if cached is not None:
+                    resp, cached_ctx = cached
+                    if cached_ctx:
+                        # a replay is still CONTACT from that peer: rebind
+                        # the identity to this connection (so its loss is
+                        # noticed too) and tell the liveness hook — else a
+                        # reconnect whose first frame is a retry would
+                        # look silent to the connection-drop grace recheck
+                        connection_ctx().update(cached_ctx)
+                        on_contact = getattr(
+                            self.server, "on_contact", None
+                        )
+                        if on_contact is not None:
+                            try:
+                                on_contact(dict(cached_ctx))
+                            except Exception:  # noqa: BLE001
+                                logger.exception("rpc on_contact failed")
                     try:
-                        send_msg(self.request, cached)
+                        send_msg(self.request, resp)
                         continue
                     except (ConnectionError, OSError):
                         return
@@ -72,7 +119,7 @@ class _Handler(socketserver.BaseRequestHandler):
             resp_bytes = len(resp.get("p", b"") or b"")
             if key[0] is not None and resp_bytes <= 1024 * 1024:
                 with dedup_lock:
-                    dedup[key] = resp
+                    dedup[key] = (resp, dict(connection_ctx()))
                     while len(dedup) > 8192:
                         dedup.pop(next(iter(dedup)))
             try:
@@ -94,7 +141,21 @@ class RPCServer:
         self._server.registry = {}  # type: ignore[attr-defined]
         self._server.dedup = {}  # type: ignore[attr-defined]
         self._server.dedup_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._server.on_disconnect = None  # type: ignore[attr-defined]
+        self._server.on_contact = None  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+
+    def set_on_disconnect(self, hook: Callable[[Dict[str, Any]], None]) -> None:
+        """``hook(ctx)`` fires when a connection whose handlers stamped
+        :func:`connection_ctx` closes (for any reason, including process
+        death)."""
+        self._server.on_disconnect = hook  # type: ignore[attr-defined]
+
+    def set_on_contact(self, hook: Callable[[Dict[str, Any]], None]) -> None:
+        """``hook(ctx)`` fires when a dedup-replayed frame arrives from an
+        identified peer (the handler never runs on replay, so liveness
+        bookkeeping would miss the contact otherwise)."""
+        self._server.on_contact = hook  # type: ignore[attr-defined]
 
     @property
     def port(self) -> int:

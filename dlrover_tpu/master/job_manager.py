@@ -233,6 +233,64 @@ class JobManager:
         # >= start_time — otherwise the stale-heartbeat guard in
         # check_heartbeats would exempt a node that heartbeat exactly once
         node.heartbeat_time = timestamp or time.time()
+        node.contact_time = time.time()  # master clock, skew-free
+
+    def record_raw_contact(self, node_id: int) -> None:
+        """Transport-level proof of life (e.g. a dedup-replayed RPC frame
+        whose handler never ran): bump only the master-clock contact
+        stamp the connection-drop recheck reads."""
+        self.get_node(node_id).contact_time = time.time()
+
+    def report_connection_lost(self, node_id: int) -> None:
+        """The node's heartbeat TCP connection died (rpc.py on_disconnect).
+
+        A SIGKILLed/OOM-killed/preempted agent loses its sockets the
+        moment the kernel reaps it — detecting that here cuts fault
+        detection from ``heartbeat_timeout_s`` to ``conn_drop_grace_s``.
+        The grace recheck filters benign drops (agent-side reconnect,
+        master proxy blips): if the node makes ANY contact after the
+        drop, nothing happens; the heartbeat timeout stays as backstop
+        for the cases with no connection to lose.
+        (Reference counterpart: heartbeat monitor only,
+        dist_job_manager.py:473–496 — this is the latency upgrade its
+        95%-goodput bar needs at realistic fault rates.)"""
+        node = self.get_node(node_id)
+        if node.status != NodeStatus.RUNNING or node.is_released:
+            return
+        drop_ts = time.time()
+        ctx = get_context()
+        # the grace must outlast one full heartbeat cadence: an IDLE
+        # connection reset (conntrack timeout, proxy blip) re-contacts
+        # only at the agent's next tick, so a shorter grace would declare
+        # healthy-but-quiet nodes dead. Detection latency for a real
+        # death is therefore ~1.5 heartbeat intervals (sub-second worlds
+        # configure a sub-second interval), vs heartbeat_timeout_s (the
+        # 300s-scale backstop) without drop detection.
+        grace = max(ctx.conn_drop_grace_s, 1.5 * ctx.heartbeat_interval_s)
+        logger.info(
+            "node %s heartbeat connection dropped — %.1fs grace recheck",
+            node_id, grace,
+        )
+
+        def _recheck():
+            if self._stopped.is_set():
+                return
+            n = self.get_node(node_id)
+            if (
+                n.status == NodeStatus.RUNNING
+                and not n.is_released
+                and n.contact_time < drop_ts  # master clock both sides
+            ):
+                logger.warning(
+                    "node %s made no contact in the %.1fs since its "
+                    "connection dropped — marking failed", node_id, grace,
+                )
+                n.exit_reason = NodeExitReason.NO_HEARTBEAT
+                self.update_node_status(node_id, NodeStatus.FAILED)
+
+        t = threading.Timer(grace, _recheck)
+        t.daemon = True
+        t.start()
 
     def fail_job(self, reason: str) -> None:
         """Fail the whole job (pre-check failure, abort actions)."""

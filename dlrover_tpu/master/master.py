@@ -95,6 +95,20 @@ class JobMaster:
         )
         self._server = RPCServer(port=port)
         self._server.register_object(self.servicer)
+        # fast fault detection: an agent's death closes its heartbeat TCP
+        # connection; the grace recheck in report_connection_lost turns
+        # that into a node-failed event in ~conn_drop_grace_s instead of
+        # the heartbeat timeout
+        self._server.set_on_disconnect(
+            lambda ctx: self.job_manager.report_connection_lost(
+                ctx["node_id"]
+            ) if "node_id" in ctx else None
+        )
+        self._server.set_on_contact(
+            lambda ctx: self.job_manager.record_raw_contact(
+                ctx["node_id"]
+            ) if "node_id" in ctx else None
+        )
         # optional HTTP transport mirroring the same servicer (reference
         # HttpMasterServicer, servicer.py:881): DLROVER_TPU_HTTP_PORT=0
         # picks a free port, unset disables

@@ -65,8 +65,12 @@ class RendezvousManager(ABC):
         self._lastcall_time: float = 0.0
         self._rdzv_round = 0
         self._start_rdzv_ts: float = 0.0
-        # node ranks that died mid-round and must not block the next cut
         self._node_unit = 1
+        # node ranks known dead (released by the master): the effective
+        # max world shrinks by these, so a post-fault re-rendezvous cuts
+        # the moment every SURVIVOR has joined instead of waiting out the
+        # last-call window hoping the dead node returns
+        self._dead_ranks: set = set()
 
     @property
     def name(self) -> str:
@@ -95,6 +99,7 @@ class RendezvousManager(ABC):
         """Node died: drop it from the waiting set so the next cut isn't
         blocked by a ghost (reference ``remove_alive_node``)."""
         with self._lock:
+            self._dead_ranks.add(node_rank)
             if node_rank in self._waiting_nodes:
                 del self._waiting_nodes[node_rank]
                 logger.info(
@@ -107,6 +112,9 @@ class RendezvousManager(ABC):
         with self._lock:
             if not self._waiting_nodes:
                 self._start_rdzv_ts = time.time()
+            # a dead node re-joining is alive again: restore it to the
+            # expected world so the cut waits for real stragglers only
+            self._dead_ranks.discard(meta.node_rank)
             self._waiting_nodes[meta.node_rank] = meta
             # a (re)joining node invalidates the previous world: agents still
             # polling get_comm_world will block until the new round cuts, and
@@ -134,7 +142,16 @@ class RendezvousManager(ABC):
         params = self._rdzv_params
         waiting = len(self._waiting_nodes)
         completed = False
-        if waiting >= params.max_nodes:
+        # known-dead nodes shrink the world the cut is waiting for: after
+        # a fault, the survivors ARE the world — cut immediately instead
+        # of burning the last-call window on a node that isn't coming
+        # (dead ranks above max_nodes don't inflate the target)
+        dead_in_world = len(
+            {r for r in self._dead_ranks if r < params.max_nodes}
+        )
+        effective_max = max(params.min_nodes,
+                            params.max_nodes - dead_in_world)
+        if waiting >= effective_max:
             completed = True
         elif (
             waiting >= params.min_nodes

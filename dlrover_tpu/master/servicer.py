@@ -164,6 +164,12 @@ class MasterServicer:
         return comm.BaseResponse()
 
     def rpc_heartbeat(self, req: comm.HeartbeatRequest) -> comm.HeartbeatResponse:
+        # bind this TCP connection to the node: if the agent dies, the
+        # kernel closes the socket and the server's on_disconnect hook
+        # reports the loss instantly — heartbeat timeout stays as backstop
+        from dlrover_tpu.common.rpc import connection_ctx
+
+        connection_ctx()["node_id"] = req.node_id
         action = self._job_manager.report_heartbeat(req.node_id, req.timestamp)
         if req.global_step and self._perf_monitor is not None:
             self._perf_monitor.collect_global_step(

@@ -170,6 +170,26 @@ def init(initialize_jax_distributed: bool = True) -> WorkerContext:
             coordinator_address=coordinator,
             num_processes=world_size,
             process_id=rank,
+            # elastic jobs reap crashed workers FAST: a worker whose
+            # collective failed (peer died) otherwise blocks in the
+            # distributed client's exit barrier for the full 300s default
+            # — pointlessly, since its agent owns checkpoint persistence
+            # and will re-rendezvous a fresh incarnation. The barrier
+            # still coordinates healthy shutdowns within the timeout.
+            # 60s (not jax's 300s): long enough for a healthy world's
+            # ranks to reach the exit barrier skewed (rank 0 writing a
+            # final checkpoint), short enough that a crashed worker whose
+            # peer died doesn't pin the host — the agent's SIGKILL
+            # escalation (worker_stop_grace_s) reaps faster anyway when
+            # it wants the slot back
+            shutdown_timeout_seconds=int(
+                os.getenv("DLROVER_TPU_DIST_SHUTDOWN_S", "60")
+            ),
+            # detect a dead peer at the runtime level too (the master's
+            # connection-drop detection is the primary signal)
+            heartbeat_timeout_seconds=int(
+                os.getenv("DLROVER_TPU_DIST_HEARTBEAT_S", "30")
+            ),
         )
         logger.info(
             "jax.distributed initialized: rank=%s/%s coordinator=%s",

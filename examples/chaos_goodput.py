@@ -210,6 +210,11 @@ def main(argv=None) -> int:
             "DLROVER_TPU_EVENT_DIR": event_dir,
             "DLROVER_TPU_HEARTBEAT_INTERVAL_S": "0.5",
             "DLROVER_TPU_HEARTBEAT_TIMEOUT_S": "3",
+            # a worker whose peer died has already crashed out of its
+            # collective; it lingers only in the distributed client's
+            # exit barrier — escalate to SIGKILL fast
+            "DLROVER_TPU_WORKER_STOP_GRACE_S": "1",
+            "DLROVER_TPU_DIST_SHUTDOWN_S": "5",
         })
         env.pop("PALLAS_AXON_POOL_IPS", None)
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -254,6 +259,16 @@ def main(argv=None) -> int:
         # phase 2: kill agent 1 (whole process group: agent + its worker)
         os.killpg(os.getpgid(agents[1].pid), signal.SIGKILL)
         kill_ts = time.time()
+        # detection: the master notices the death via the heartbeat
+        # connection drop (grace recheck), NOT the heartbeat timeout
+        from dlrover_tpu.common.constants import NodeStatus
+
+        _wait(
+            lambda: master.job_manager.nodes[1].status == NodeStatus.FAILED
+            or master.job_manager.nodes[1].is_released,
+            30, "master detects the dead agent",
+        )
+        detect_s = time.time() - kill_ts
         _wait(
             lambda: any(
                 r["event"] == "segment_start" and r["world"] == 1
@@ -306,6 +321,7 @@ def main(argv=None) -> int:
             "unproductive_s": round(unproductive, 2),
             "wall_s": round(wall, 2),
             "productive_s": round(goodput["productive_s"], 2),
+            "detect_s": round(detect_s, 2),
             "shrink_detect_s": round(shrink_s, 2),
             "step_at_shrink": step_before_rejoin,
             "final_step": master.perf_monitor.completed_global_step,

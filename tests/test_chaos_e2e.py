@@ -59,6 +59,12 @@ def test_chaos_kill_shrink_resume_rejoin():
     # step count iff no step was lost or double-applied across the
     # shrink/rejoin (collectives stayed correct at every world size)
     assert result["w_final"] == 60.0
+    # fault DETECTION rides the heartbeat-connection drop (grace recheck),
+    # not the heartbeat timeout: ~conn_drop_grace_s, with CI headroom
+    assert result["detect_s"] <= 3.0, result["detect_s"]
+    # kill -> world-1 training resumed (detect + restart + re-rendezvous +
+    # re-init + restore + recompile), with CI headroom over the ~5s local
+    assert result["shrink_detect_s"] <= 15.0, result["shrink_detect_s"]
     # the goodput numbers exist and are sane
     assert 0 < result["goodput_pct"] <= 100
     # per-fault recovery cost at production scale clears the reference bar

@@ -37,3 +37,78 @@ def test_unknown_fields_ignored():
 
     out = comm.deserialize(msgpack.packb(raw, use_bin_type=True))
     assert out.value is True
+
+
+def test_rpc_disconnect_hook_fires_with_stamped_ctx():
+    """A handler stamps connection_ctx; killing the client's socket fires
+    the server's on_disconnect with that context (the master's instant
+    agent-death detection rides this)."""
+    import threading
+
+    from dlrover_tpu.common.rpc import RPCClient, RPCServer, connection_ctx
+
+    server = RPCServer(host="127.0.0.1")
+
+    def echo(req):
+        connection_ctx()["node_id"] = req.node_id
+        return comm.BoolResponse(value=True)
+
+    server.register("echo", echo)
+    dropped = []
+    fired = threading.Event()
+
+    def on_disconnect(ctx):
+        dropped.append(ctx)
+        fired.set()
+
+    server.set_on_disconnect(on_disconnect)
+    server.start()
+    try:
+        client = RPCClient(f"127.0.0.1:{server.port}")
+        assert client.call("echo", comm.BaseRequest(node_id=7)).value
+        assert not dropped  # connection still alive
+        client._close()  # simulate the agent dying (kernel closes socket)
+        assert fired.wait(5.0)
+        assert dropped == [{"node_id": 7}]
+    finally:
+        server.stop()
+
+
+def test_rpc_dedup_replay_counts_as_contact():
+    """A reconnect whose first frame is a RETRY is answered from the dedup
+    cache without running the handler — the on_contact hook must still
+    fire so liveness bookkeeping sees the peer."""
+    import socket
+
+    from dlrover_tpu.common.multi_process import recv_msg, send_msg
+    from dlrover_tpu.common.rpc import RPCServer, connection_ctx
+
+    server = RPCServer(host="127.0.0.1")
+    calls = []
+
+    def hb(req):
+        calls.append(req.node_id)
+        connection_ctx()["node_id"] = req.node_id
+        return comm.BoolResponse(value=True)
+
+    server.register("hb", hb)
+    contacts = []
+    server.set_on_contact(lambda ctx: contacts.append(ctx))
+    server.start()
+    try:
+        frame = {"m": "hb", "p": comm.serialize(comm.BaseRequest(node_id=9)),
+                 "id": 1, "c": "client-x"}
+        s1 = socket.create_connection(("127.0.0.1", server.port))
+        send_msg(s1, frame)
+        assert recv_msg(s1)["ok"]
+        s1.close()  # response delivered, then the connection blips
+        # retry of the SAME frame on a fresh connection: replayed, not
+        # re-executed — but it IS contact
+        s2 = socket.create_connection(("127.0.0.1", server.port))
+        send_msg(s2, frame)
+        assert recv_msg(s2)["ok"]
+        s2.close()
+        assert calls == [9]  # handler ran exactly once
+        assert contacts == [{"node_id": 9}]
+    finally:
+        server.stop()

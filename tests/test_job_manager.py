@@ -186,6 +186,82 @@ def test_heartbeat_timeout_marks_no_heartbeat():
     assert node.relaunch_count == 1
 
 
+def test_connection_drop_declares_death_after_grace():
+    """A dropped heartbeat connection with no re-contact inside the grace
+    marks the node dead — detection in ~conn_drop_grace_s, not the
+    heartbeat timeout."""
+    from dlrover_tpu.common.config import get_context
+
+    get_context().set("conn_drop_grace_s", 0.1)
+    get_context().set("heartbeat_interval_s", 0.05)
+    try:
+        jm, scaler = make_manager()
+        node = jm.nodes[0]
+        node.contact_time = time.time()
+        jm.report_connection_lost(0)
+        time.sleep(0.3)
+        assert node.exit_reason == NodeExitReason.NO_HEARTBEAT
+        assert scaler.relaunched == [0]
+    finally:
+        get_context().set("conn_drop_grace_s", 1.0)
+        get_context().set("heartbeat_interval_s", 15.0)
+
+
+def test_connection_drop_with_recontact_is_benign():
+    """An agent that reconnects (client retry) within the grace must NOT
+    be declared dead."""
+    from dlrover_tpu.common.config import get_context
+
+    get_context().set("conn_drop_grace_s", 0.2)
+    get_context().set("heartbeat_interval_s", 0.05)
+    try:
+        jm, _ = make_manager()
+        node = jm.nodes[0]
+        node.contact_time = time.time()
+        jm.report_connection_lost(0)
+        jm.record_node_contact(0, running=True)  # reconnected heartbeat
+        time.sleep(0.4)
+        assert node.status == NodeStatus.RUNNING
+        assert node.exit_reason == ""
+    finally:
+        get_context().set("conn_drop_grace_s", 1.0)
+        get_context().set("heartbeat_interval_s", 15.0)
+
+
+def test_connection_drop_grace_covers_idle_heartbeat_cadence():
+    """With a long heartbeat interval, an idle-connection reset must get a
+    grace that outlasts the next tick — not the 1s default."""
+    from dlrover_tpu.common.config import get_context
+
+    get_context().set("heartbeat_interval_s", 15.0)
+    jm, _ = make_manager()
+    node = jm.nodes[0]
+    node.contact_time = time.time()
+    jm.report_connection_lost(0)
+    time.sleep(1.5)  # > conn_drop_grace_s default; << 1.5 * interval
+    assert node.status == NodeStatus.RUNNING
+
+
+def test_raw_contact_defuses_drop_recheck():
+    """A dedup-replayed frame (handler never runs) still counts as proof
+    of life via record_raw_contact."""
+    from dlrover_tpu.common.config import get_context
+
+    get_context().set("conn_drop_grace_s", 0.2)
+    get_context().set("heartbeat_interval_s", 0.05)
+    try:
+        jm, _ = make_manager()
+        node = jm.nodes[0]
+        node.contact_time = time.time()
+        jm.report_connection_lost(0)
+        jm.record_raw_contact(0)
+        time.sleep(0.4)
+        assert node.status == NodeStatus.RUNNING
+    finally:
+        get_context().set("conn_drop_grace_s", 1.0)
+        get_context().set("heartbeat_interval_s", 15.0)
+
+
 def test_oom_override_reaches_pod_spec():
     """The grown memory must actually render into the replacement pod
     (not just the Node object)."""

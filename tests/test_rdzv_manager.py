@@ -81,6 +81,28 @@ def test_dead_node_removed_from_waiting():
     assert sorted(world) == [0, 1]
 
 
+def test_shrink_cut_is_immediate_after_known_death():
+    """Post-fault re-rendezvous must NOT wait out the last-call window for
+    a node the master already released: the survivors are the world."""
+    m = ElasticTrainingRendezvousManager()
+    m.update_rdzv_params(1, 2, waiting_timeout=30.0)  # window >> test time
+    m.join_rendezvous(_meta(0))
+    m.join_rendezvous(_meta(1))
+    _, _, world = m.get_comm_world(0)
+    assert sorted(world) == [0, 1]
+    # node 1 dies (master releases it); the survivor re-joins
+    m.remove_alive_node(1)
+    m.join_rendezvous(_meta(0))
+    _, _, world = m.get_comm_world(0)  # no sleep: must cut NOW
+    assert sorted(world) == [0]
+    # the dead node coming back makes the world wait for 2 again: node 0's
+    # lone re-join must not cut at 1 (no known-dead anymore)
+    m.join_rendezvous(_meta(1))
+    m.join_rendezvous(_meta(0))
+    _, _, world = m.get_comm_world(0)
+    assert sorted(world) == [0, 1]
+
+
 def test_second_round_membership_change():
     m = ElasticTrainingRendezvousManager()
     m.update_rdzv_params(2, 2, waiting_timeout=0.05)
