@@ -435,3 +435,27 @@ class ReplicaListResponse:
     """(owner_rank, local_rank, step) triples held by a peer."""
 
     entries: List[List[int]] = field(default_factory=list)
+
+
+# --------------------------------------------------------------------------
+# Unified runtime: remote actor transport (unified/remote.py)
+# --------------------------------------------------------------------------
+
+
+@message
+class SpawnActorRequest:
+    """Ask a host daemon to start one actor process (reference: the Ray
+    actor-creation options the unified scheduler builds per vertex,
+    unified/master/scheduler.py:161)."""
+
+    name: str = ""
+    module_name: str = ""
+    class_name: str = ""
+    ctx_blob: bytes = b""  # pickled WorkloadContext (job trust domain)
+    callback_addr: str = ""  # scheduler's call-home listener
+    token: str = ""  # per-job call-home auth (CallHomeListener.token)
+
+
+@message
+class ActorRefRequest:
+    name: str = ""

@@ -103,12 +103,16 @@ class DLJob:
     collocations: List[Set[str]]
 
     def submit(self, job_name: str = "unified", backend: str = "process",
-               timeout_s: float = 300.0) -> int:
+               timeout_s: float = 300.0, hosts=None) -> int:
         """Run to completion under an in-proc UnifiedMaster (reference
-        driver/main.py submits to a Ray-actor master). Returns exit code."""
+        driver/main.py submits to a Ray-actor master). Returns exit code.
+
+        ``hosts``: optional {node_index: actor-host daemon addr} for
+        multi-node placement (unified/remote.py)."""
         from dlrover_tpu.unified.master import UnifiedMaster
 
-        master = UnifiedMaster(self, job_name=job_name, backend=backend)
+        master = UnifiedMaster(self, job_name=job_name, backend=backend,
+                               hosts=hosts)
         return master.run(timeout_s=timeout_s)
 
 

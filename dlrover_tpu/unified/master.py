@@ -30,7 +30,12 @@ from dlrover_tpu.unified.scheduler import (
 class UnifiedMaster:
     def __init__(self, job: DLJob, job_name: str = "unified",
                  backend: str = "process", max_restarts: int = 3,
-                 start_method: str = "forkserver"):
+                 start_method: str = "forkserver",
+                 hosts: Optional[Dict[int, str]] = None):
+        """``hosts`` maps placement node_index → that node's actor-host
+        daemon address (unified/remote.py); mapped nodes get their actors
+        spawned remotely, unmapped ones locally — so a laptop run and a
+        multi-host run are the same job description."""
         if backend != "process":
             raise ValueError(f"unknown backend {backend!r} "
                              "(ray backend: not in this build)")
@@ -39,7 +44,7 @@ class UnifiedMaster:
         self.graph = ExecutionGraph(job)
         self.placement = HostFillPlacement(self.graph)
         self.scheduler = ProcessScheduler(
-            self.graph, job_name, start_method=start_method
+            self.graph, job_name, start_method=start_method, hosts=hosts,
         )
         self.failover = FailoverCoordinator(self.scheduler, max_restarts)
 

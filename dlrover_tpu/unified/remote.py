@@ -1,0 +1,360 @@
+"""Remote actor transport: place unified-runtime actors on OTHER hosts.
+
+The reference's unified scheduler creates Ray actors across a cluster
+(`dlrover/python/unified/master/scheduler.py:161-189` — placement groups
++ ``actor_creation_opts.remote(...)``). This build has no Ray; its
+TPU-native equivalent is three small pieces on top of the stack's own
+primitives:
+
+- :class:`ActorHostServicer` — a per-host daemon (one per node, started
+  by the operator/agent or the ``dtpu-actor-host`` CLI) serving
+  spawn/kill/alive over the typed RPC plane (common/rpc.py). It owns the
+  actor *processes* of its host.
+- **call-home duplex channel** — a spawned actor dials the scheduler's
+  listener and speaks the exact protocol the local transport speaks over
+  an ``mp.Pipe`` (``(method, args, kwargs)`` → ``("ok", result)``), so
+  ``_actor_main`` is shared verbatim between local and remote actors.
+- :class:`SocketConn` — the Pipe-shaped adapter (send/recv/poll/close)
+  over that TCP socket, pickle-framed. Pickle is confined to the job's
+  own trust domain (master ↔ its actors), exactly like Ray's.
+
+Liveness: actor death closes the call-home socket, so the scheduler sees
+``EOFError``/reset on the next call — same failure shape as a dead local
+process — and can double-check with the host daemon's ``alive`` RPC.
+"""
+
+import hmac
+import os
+import pickle
+import secrets
+import select
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import msgpack
+
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RPCClient, RPCServer
+
+
+# --------------------------------------------------------------------------
+# framing: 4-byte big-endian length + payload
+# --------------------------------------------------------------------------
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(n)
+        if not b:
+            raise EOFError("connection closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket, max_bytes: int = 1 << 20) -> bytes:
+    (n,) = struct.unpack(">I", _read_exact(sock, 4))
+    if n > max_bytes:
+        raise ValueError(f"oversized frame ({n} bytes)")
+    return _read_exact(sock, n)
+
+
+class SocketConn:
+    """``mp.Pipe``-shaped duplex connection over a TCP socket.
+
+    Payloads are pickled — used ONLY after the token handshake
+    authenticated the peer as one of this job's own actors (the same
+    trust model as Ray's actor channel). Unauthenticated bytes never
+    reach ``pickle.loads``: the hello frame is msgpack.
+    """
+
+    def __init__(self, sock: socket.socket):
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # clear any connect()-time timeout: it would otherwise apply to
+        # every recv, and an actor idle for >timeout between calls would
+        # die in its serving loop
+        sock.settimeout(None)
+        self._sock = sock
+        self._lock = threading.Lock()
+
+    def send(self, obj) -> None:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            _send_frame(self._sock, payload)
+
+    def recv(self):
+        return pickle.loads(_recv_frame(self._sock, max_bytes=1 << 31))
+
+    def poll(self, timeout: float = 0.0) -> bool:
+        try:
+            r, _, _ = select.select([self._sock], [], [], timeout)
+        except (OSError, ValueError):  # closed fd
+            return True  # let recv raise the real error
+        return bool(r)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _send_hello(sock: socket.socket, name: str, pid: int,
+                token: str) -> None:
+    _send_frame(sock, msgpack.packb(
+        {"hello": name, "pid": pid, "token": token}, use_bin_type=True,
+    ))
+
+
+# --------------------------------------------------------------------------
+# spawned-actor entry (runs on the remote host, via the daemon)
+# --------------------------------------------------------------------------
+
+
+def _remote_actor_main(ctx_blob: bytes, module_name: str, class_name: str,
+                       callback_addr: str, name: str, token: str) -> None:
+    """Child entry on the actor's host: dial the scheduler, present the
+    job token, then serve calls exactly like a local actor."""
+    from dlrover_tpu.unified.scheduler import _actor_main
+
+    ctx = pickle.loads(ctx_blob)
+    host, port = callback_addr.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=30)
+    _send_hello(sock, name, os.getpid(), token)
+    _actor_main(ctx, module_name, class_name, SocketConn(sock))
+
+
+# --------------------------------------------------------------------------
+# per-host daemon
+# --------------------------------------------------------------------------
+
+
+class ActorHostServicer:
+    """Spawn/kill/alive for this host's actor processes.
+
+    The daemon uses a ``forkserver`` context for the same reason the
+    local scheduler does: it may import jax-adjacent modules, and forking
+    a multithreaded parent is a deadlock hazard.
+    """
+
+    def __init__(self):
+        import multiprocessing as mp
+
+        self._mp = mp.get_context("forkserver")
+        self._procs: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def rpc_spawn_actor(self, req: comm.SpawnActorRequest) -> comm.BaseResponse:
+        with self._lock:
+            old = self._procs.pop(req.name, None)
+        if old is not None and old.is_alive():
+            old.kill()
+            old.join(5)
+        proc = self._mp.Process(
+            target=_remote_actor_main,
+            args=(req.ctx_blob, req.module_name, req.class_name,
+                  req.callback_addr, req.name, req.token),
+            name=req.name, daemon=True,
+        )
+        proc.start()
+        with self._lock:
+            self._procs[req.name] = proc
+        logger.info("actor host: spawned %s (pid %s) -> %s",
+                    req.name, proc.pid, req.callback_addr)
+        return comm.BaseResponse(success=True, message=str(proc.pid))
+
+    def rpc_kill_actor(self, req: comm.ActorRefRequest) -> comm.BaseResponse:
+        with self._lock:
+            proc = self._procs.get(req.name)
+        if proc is not None and proc.is_alive():
+            proc.kill()
+            proc.join(5)
+        return comm.BaseResponse(success=True)
+
+    def rpc_actor_alive(self, req: comm.ActorRefRequest) -> comm.BoolResponse:
+        with self._lock:
+            proc = self._procs.get(req.name)
+        return comm.BoolResponse(value=bool(proc is not None and proc.is_alive()))
+
+    def shutdown(self) -> None:
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for p in procs:
+            if p.is_alive():
+                p.kill()
+                p.join(5)
+
+
+def serve_actor_host(port: int = 0, host: str = "0.0.0.0"
+                     ) -> Tuple[RPCServer, ActorHostServicer]:
+    servicer = ActorHostServicer()
+    server = RPCServer(host=host, port=port)
+    server.register_object(servicer)
+    server.start()
+    logger.info("actor host daemon serving on port %s", server.port)
+    return server, servicer
+
+
+def main(argv=None) -> int:
+    """``dtpu-actor-host`` CLI — one per node of a unified job."""
+    import argparse
+    import time
+
+    parser = argparse.ArgumentParser("dtpu-actor-host")
+    parser.add_argument("--port", type=int, default=8471)
+    parser.add_argument("--host", default="0.0.0.0")
+    args = parser.parse_args(argv)
+    server, servicer = serve_actor_host(args.port, args.host)
+    print(f"actor host ready on {server.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        servicer.shutdown()
+        server.stop()
+    return 0
+
+
+# --------------------------------------------------------------------------
+# scheduler-side client
+# --------------------------------------------------------------------------
+
+
+class ActorHostClient:
+    """Thin typed client for one host daemon.
+
+    Short timeout: these calls are lifecycle/liveness probes — against a
+    partitioned or powered-off host they must fail in seconds, not pin
+    the failover path for the RPC plane's 330s barrier-grade default.
+    """
+
+    def __init__(self, addr: str, timeout_s: float = 10.0):
+        self.addr = addr
+        self._client = RPCClient(addr, timeout_s=timeout_s, retries=3)
+
+    def spawn(self, name: str, ctx_blob: bytes, module_name: str,
+              class_name: str, callback_addr: str, token: str = "") -> int:
+        resp = self._client.call("spawn_actor", comm.SpawnActorRequest(
+            name=name, ctx_blob=ctx_blob, module_name=module_name,
+            class_name=class_name, callback_addr=callback_addr, token=token,
+        ))
+        if not resp.success:
+            raise RuntimeError(f"spawn {name} on {self.addr}: {resp.message}")
+        return int(resp.message)
+
+    def kill(self, name: str) -> None:
+        self._client.call("kill_actor", comm.ActorRefRequest(name=name))
+
+    def alive(self, name: str) -> bool:
+        return self._client.call(
+            "actor_alive", comm.ActorRefRequest(name=name)
+        ).value
+
+
+class CallHomeListener:
+    """The scheduler's accept loop: spawned actors dial in, authenticate
+    with the per-job token, and :meth:`wait_for` hands the matched
+    connection to the spawn path.
+
+    Pre-auth bytes are msgpack only (never pickle): an arbitrary dialer
+    that reaches this port can at most fail the constant-time token
+    compare and be dropped. Connections are keyed (name, pid) so a stale
+    previous-incarnation hello can never be handed to a restart.
+    """
+
+    def __init__(self, host: str = "0.0.0.0"):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self.token = secrets.token_hex(16)
+        self._conns: Dict[Tuple[str, int], SocketConn] = {}
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="actor-callhome", daemon=True
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                sock, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handshake, args=(sock,), daemon=True
+            ).start()
+
+    def _handshake(self, sock: socket.socket) -> None:
+        try:
+            sock.settimeout(30)
+            msg = msgpack.unpackb(
+                _recv_frame(sock, max_bytes=4096), raw=False
+            )
+            name, pid = msg["hello"], int(msg["pid"])
+            token = msg.get("token", "")
+            if not hmac.compare_digest(str(token), self.token):
+                logger.warning("call-home with bad token rejected")
+                sock.close()
+                return
+        except (EOFError, OSError, ValueError, KeyError, TypeError,
+                msgpack.UnpackException):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        conn = SocketConn(sock)  # clears the handshake timeout
+        with self._cond:
+            self._conns[(name, pid)] = conn
+            self._cond.notify_all()
+
+    def wait_for(self, name: str, pid: int,
+                 timeout_s: float) -> Tuple[SocketConn, int]:
+        """Block for the hello of exactly the (name, pid) incarnation the
+        daemon just spawned; drops any stale same-name entries."""
+        import time
+
+        deadline = time.time() + timeout_s
+        key = (name, pid)
+        with self._cond:
+            while key not in self._conns:
+                # a previous incarnation's late hello is garbage: close it
+                # so it can't linger (and can't be matched by anyone)
+                for k in [k for k in self._conns if k[0] == name
+                          and k != key]:
+                    self._conns.pop(k).close()
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"actor {name} (pid {pid}) never dialed back "
+                        f"within {timeout_s}s"
+                    )
+                self._cond.wait(remaining)
+            return self._conns.pop(key), pid
+
+    def close(self) -> None:
+        self._stopped = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._cond:
+            for conn in self._conns.values():
+                conn.close()
+            self._conns.clear()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

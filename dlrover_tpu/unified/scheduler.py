@@ -118,6 +118,71 @@ class ActorHandle:
             self.proc.join(timeout=5)
 
 
+class RemoteActorHandle(ActorHandle):
+    """Proxy for an actor hosted on ANOTHER node via its host daemon
+    (unified/remote.py). The duplex call channel is the actor's call-home
+    TCP connection; process lifecycle goes through the daemon's RPC."""
+
+    def __init__(self, vertex: ExecutionVertex, host_client, conn, pid: int):
+        # no local proc: liveness is socket-shaped (EOF on death) with the
+        # daemon as the authority
+        self.vertex = vertex
+        self.proc = None
+        self._conn = conn
+        self._lock = threading.Lock()
+        self._host = host_client
+        self._pid = pid
+        self._dead = False
+
+    @property
+    def alive(self) -> bool:
+        if self._dead:
+            return False
+        try:
+            return self._host.alive(self.vertex.name)
+        except ConnectionError:
+            return False  # daemon gone ⇒ its actors are unreachable anyway
+
+    def call(self, method: str, *args, timeout: Optional[float] = None,
+             **kwargs) -> Any:
+        with self._lock:
+            if self._dead:
+                raise ActorDiedError(self.vertex.name, "(known dead)")
+            try:
+                self._conn.send((method, args, kwargs))
+                if timeout is not None and not self._conn.poll(timeout):
+                    self.kill()
+                    raise ActorDiedError(self.vertex.name,
+                                         f"(call {method} timed out)")
+                status, payload = self._conn.recv()
+            except (EOFError, ConnectionError, OSError) as e:
+                self._dead = True
+                raise ActorDiedError(self.vertex.name, f"({e!r})") from e
+            if status == "err":
+                raise ActorCallError(
+                    f"{self.vertex.name}.{method}: {payload}")
+            return payload
+
+    def stop(self, grace_s: float = 5.0) -> None:
+        if not self._dead:
+            try:
+                with self._lock:
+                    self._conn.send(("__stop__",))
+                    self._conn.poll(grace_s)
+            except (OSError, EOFError, ConnectionError):
+                pass
+        self.kill()
+
+    def kill(self) -> None:
+        self._dead = True
+        try:
+            self._host.kill(self.vertex.name)
+        except ConnectionError:
+            logger.warning("actor host %s unreachable killing %s",
+                           self._host.addr, self.vertex.name)
+        self._conn.close()
+
+
 class RoleGroup:
     """Broadcast/fan-out proxy over every instance of a role (reference
     trainer's RG_* role-group handles). ``call`` broadcasts the same args;
@@ -191,7 +256,8 @@ class ProcessScheduler:
     _create_actor_by_graph, scheduler.py:89)."""
 
     def __init__(self, graph: ExecutionGraph, job_name: str = "unified",
-                 start_method: str = "forkserver"):
+                 start_method: str = "forkserver",
+                 hosts: Optional[Dict[int, str]] = None):
         # forkserver, NOT fork: the scheduler lives in a master process
         # that has imported jax — XLA's thread pools are already running,
         # and forking a multithreaded parent can deadlock the child on a
@@ -204,12 +270,28 @@ class ProcessScheduler:
         self.job_name = job_name
         self._mp = mp.get_context(start_method)
         self.handles: Dict[str, ActorHandle] = {}
+        # multi-node placement: {node_index: actor-host daemon addr}.
+        # Vertices placed on a mapped node spawn THROUGH that daemon and
+        # call home over TCP (unified/remote.py); unmapped nodes spawn
+        # locally — the single-host dev loop needs no daemons at all.
+        # (Reference: Ray placement groups + remote actor creation,
+        # unified/master/scheduler.py:161–189.)
+        self._hosts = dict(hosts or {})
+        self._host_clients: Dict[str, Any] = {}
+        self._callhome = None
         # must cover a full-fleet broadcast: a role-group call over N SPMD
         # actors needs N concurrent in-flight calls or the collective
         # inside them deadlocks behind the pool queue
         self._pool = ThreadPoolExecutor(
             max_workers=max(32, 2 * len(graph.vertices()))
         )
+
+    def _host_client(self, addr: str):
+        from dlrover_tpu.unified.remote import ActorHostClient
+
+        if addr not in self._host_clients:
+            self._host_clients[addr] = ActorHostClient(addr)
+        return self._host_clients[addr]
 
     def schedule(self, ready_timeout_s: float = 60.0) -> None:
         """Spawn every vertex and wait for readiness (reference
@@ -229,17 +311,43 @@ class ProcessScheduler:
             job_name=self.job_name, config=self.graph.job.config,
             env=env, restart_count=v.restart_count,
         )
-        parent_conn, child_conn = self._mp.Pipe()
-        proc = self._mp.Process(
-            target=_actor_main,
-            args=(ctx, v.module_name, v.class_name, child_conn),
-            name=v.name, daemon=True,
-        )
-        proc.start()
-        child_conn.close()
-        handle = ActorHandle(v, proc, parent_conn)
+        if v.node_index in self._hosts:
+            handle = self._spawn_remote(v, ctx)
+        else:
+            parent_conn, child_conn = self._mp.Pipe()
+            proc = self._mp.Process(
+                target=_actor_main,
+                args=(ctx, v.module_name, v.class_name, child_conn),
+                name=v.name, daemon=True,
+            )
+            proc.start()
+            child_conn.close()
+            handle = ActorHandle(v, proc, parent_conn)
         self.handles[v.name] = handle
         return handle
+
+    def _spawn_remote(self, v: ExecutionVertex, ctx: WorkloadContext
+                      ) -> "RemoteActorHandle":
+        import pickle
+
+        from dlrover_tpu.common.rpc import local_host_ip
+        from dlrover_tpu.unified.remote import CallHomeListener
+
+        if self._callhome is None:
+            self._callhome = CallHomeListener()
+        client = self._host_client(self._hosts[v.node_index])
+        callback = f"{local_host_ip()}:{self._callhome.port}"
+        pid = client.spawn(
+            v.name, pickle.dumps(ctx), v.module_name, v.class_name, callback,
+            token=self._callhome.token,
+        )
+        try:
+            # match on (name, pid): a stale hello from a previous
+            # incarnation must never be bound to this restart
+            conn, pid = self._callhome.wait_for(v.name, pid, timeout_s=60.0)
+        except TimeoutError as e:
+            raise ActorDiedError(v.name, f"({e})") from e
+        return RemoteActorHandle(v, client, conn, pid)
 
     @staticmethod
     def _await_ready(handles: List[ActorHandle], timeout_s: float) -> None:
@@ -294,4 +402,7 @@ class ProcessScheduler:
         for h in self.handles.values():
             h.stop()
         self.handles.clear()
+        if self._callhome is not None:
+            self._callhome.close()
+            self._callhome = None
         self._pool.shutdown(wait=False)
