@@ -187,6 +187,21 @@ def _attend(q, k, v, mask, scale, pos=None, flash=False,
     return out.reshape(B, Q, H * Dh)
 
 
+def planned_cache_len(total: int, quantize_cache: bool,
+                      max_len: Optional[int] = None) -> Tuple[int, bool]:
+    """(allocated cache length, will-the-fused-kernel-run) for a
+    :func:`generate` call with these arguments — the ONE sizing/routing
+    decision, shared with the bench's HBM-roof accounting so a reported
+    %-of-roof always describes the cache actually allocated."""
+    if max_len is None:
+        rounded = -(-total // _DECODE_BLOCK_K) * _DECODE_BLOCK_K
+        flash = flash_decode_wanted(rounded, quantize_cache,
+                                    live_len=total)
+        return (rounded if flash else total), flash
+    return max_len, flash_decode_wanted(max_len, quantize_cache,
+                                        live_len=total)
+
+
 def prefill(params: Dict, tokens, config,
             max_len: int, quantize: bool = False) -> Tuple[jnp.ndarray, Dict]:
     """Run the prompt ``tokens`` (B, P) through the model in one batched
@@ -340,17 +355,12 @@ def generate(params: Dict, prompt, config, key,
     prefill + a ``lax.scan`` of cached decode steps."""
     B, P = prompt.shape
     total = P + max_new_tokens
-    if max_len is None:
-        # a right-sized cache keeps per-step KV traffic minimal on the
-        # einsum path; the fused kernel needs a block-multiple length but
-        # skips the padded blocks at ~zero bandwidth, so round up only
-        # when the kernel will actually run — one decision decides BOTH
-        # the size and the routing, so they cannot disagree
-        rounded = -(-total // _DECODE_BLOCK_K) * _DECODE_BLOCK_K
-        flash = flash_decode_wanted(rounded, quantize_cache, live_len=total)
-        max_len = rounded if flash else total
-    else:
-        flash = flash_decode_wanted(max_len, quantize_cache, live_len=total)
+    # a right-sized cache keeps per-step KV traffic minimal on the einsum
+    # path; the fused kernel needs a block-multiple length but skips the
+    # padded blocks at ~zero bandwidth, so the cache is rounded up only
+    # when the kernel will actually run — planned_cache_len decides BOTH
+    # the size and the routing, so they cannot disagree
+    max_len, flash = planned_cache_len(total, quantize_cache, max_len)
     if total > max_len:
         # dynamic_update_slice would silently clamp writes to the last
         # slot and corrupt the tail — refuse instead

@@ -5,6 +5,13 @@ TPU redesign of the reference xpu_timer stack (xpu_timer/: LD_PRELOAD CUDA
 hook + brpc daemon + py tools) — see tpu_timer/README.md for the mapping.
 """
 
-from dlrover_tpu.observability.tpu_timer import TpuTimer, find_library
+from dlrover_tpu.observability.tpu_timer import (
+    TpuTimer,
+    find_library,
+    install_tracepoints,
+    trace_function,
+)
 
-__all__ = ["TpuTimer", "find_library"]
+__all__ = [
+    "TpuTimer", "find_library", "install_tracepoints", "trace_function",
+]

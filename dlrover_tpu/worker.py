@@ -228,6 +228,12 @@ def init(initialize_jax_distributed: bool = True) -> WorkerContext:
             local_rank=int(os.getenv(EnvKey.LOCAL_RANK, "0")),
         )
         timer.enable_gc_hook()
+        if os.getenv("DLROVER_TPU_TRACE_FUNCS"):
+            # opt-in user-function tracepoints into the same trace plane
+            # (observability/tpu_timer.py install_tracepoints)
+            from dlrover_tpu.observability import install_tracepoints
+
+            install_tracepoints()
     return WorkerContext(
         rank=rank,
         world_size=world_size,

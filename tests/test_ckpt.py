@@ -430,3 +430,61 @@ def test_storage_save_waits_out_busy_drain(tmp_path, mesh):
     assert time.time() - t0 >= 0.4
     restored, step = engine.load(jax.tree.map(lambda x: x, state))
     assert step == 5
+
+
+def test_packed_restore_many_small_leaves(tmp_path, mesh):
+    """Many small leaves (mixed dtypes, sharded + replicated + scalar)
+    restore bit-exact through the packed transfer path, with the H2D put
+    count collapsing to ~one per device rather than one per leaf×device
+    (engine.py _ShardPacker — the per-put fixed cost is what dominated
+    many-leaf restores)."""
+    import numpy as np
+
+    from dlrover_tpu.ckpt import engine as eng_mod
+
+    state = {"step": jnp.zeros((), jnp.int32)}
+    rng = np.random.default_rng(0)
+    for i in range(40):
+        state[f"w{i}"] = jax.device_put(
+            jnp.asarray(rng.standard_normal((8, 8)), jnp.float32),
+            NamedSharding(mesh, P("data", "model")),
+        )
+        state[f"b{i}"] = jax.device_put(
+            jnp.asarray(rng.standard_normal((16,)), jnp.bfloat16),
+            NamedSharding(mesh, P(None)),
+        )
+    state["q"] = jax.device_put(
+        jnp.asarray(rng.integers(-100, 100, (32,)), jnp.int8),
+        NamedSharding(mesh, P(None)),
+    )
+    engine = CheckpointEngine(
+        str(tmp_path), job_name=f"pack{os.getpid()}", node_rank=0,
+        local_rank=0, ipc_socket="/nonexistent", world_size=1, rank=0,
+    )
+    try:
+        assert engine.save_to_memory(3, state, blocking=True)
+
+        puts = []
+        real_put = jax.device_put
+
+        def counting_put(x, *a, **k):
+            puts.append(getattr(x, "nbytes", 0))
+            return real_put(x, *a, **k)
+
+        jax.device_put = counting_put
+        try:
+            restored, step = engine.load(state)
+        finally:
+            jax.device_put = real_put
+        assert step == 3
+        for k in state:
+            np.testing.assert_array_equal(
+                np.asarray(restored[k]), np.asarray(state[k]),
+                err_msg=k,
+            )
+            assert restored[k].dtype == state[k].dtype, k
+        # 81 small leaves × 8 devices would be ~650 direct puts; packed,
+        # it's one buffer per device (scalar 'step' may add a couple)
+        assert len(puts) <= 2 * len(jax.devices()), len(puts)
+    finally:
+        unlink_shared_memory(shm_name(engine.job_name, 0, 0))
