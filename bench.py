@@ -480,18 +480,29 @@ def bench_ckpt() -> dict:
     t_sync = time.perf_counter() - t0
 
     # measure the tunnel's H2D link rate: restore can't beat
-    # bytes/link_rate no matter how it's scheduled. First put+fetch warms
-    # the index-op compile; the second is the measurement.
+    # bytes/link_rate no matter how it's scheduled. The dev tunnel's
+    # bandwidth swings on the scale of minutes (measured 5–380 MB/s in
+    # one hour), so the floor uses the MEDIAN of 3 probes taken right
+    # before the restore, in the restore's dtype (bf16), and a
+    # post-restore probe is recorded alongside so a mid-restore weather
+    # change shows in the JSON instead of reading as scheduler overhead.
     rtt = _fetch_rtt()
     probe_mb = 64
-    h2d_mbps = 0.0
-    for _ in range(2):
-        probe = np.random.randn(probe_mb * 131072).astype(np.float32)
+
+    def _h2d_probe() -> float:
+        import ml_dtypes
+
+        probe = np.random.randn(probe_mb * 131072).astype(
+            ml_dtypes.bfloat16)  # host-side bf16, like restore's shards
         t0 = time.perf_counter()
         d = jax.device_put(probe)
         _ = float(d[0])
-        h2d_mbps = probe_mb / max(1e-9, time.perf_counter() - t0 - rtt)
+        rate = (probe_mb / 2) / max(1e-9, time.perf_counter() - t0 - rtt)
         del d, probe
+        return rate
+
+    _h2d_probe()  # warm the index-op compile
+    h2d_mbps = sorted(_h2d_probe() for _ in range(3))[1]
 
     def force_fetch(tree) -> float:
         """One chained fetch that forces every leaf's transfer
@@ -519,8 +530,12 @@ def bench_ckpt() -> dict:
     if not jnp.array_equal(a, b):
         raise RuntimeError("restored state mismatch")
 
+    h2d_after = _h2d_probe()  # post-restore weather reading
     speedup = t_sync / t_block if t_block > 0 else float("inf")
-    floor_s = (nbytes / 1e6) / h2d_mbps
+    # the floor the restore actually faced: the link's state during the
+    # restore lies between the pre (median) and post probes
+    faced_mbps = (h2d_mbps + h2d_after) / 2
+    floor_s = (nbytes / 1e6) / faced_mbps
     out = {
         "state_gb": round(nbytes / 1e9, 2),
         "t_block_s": round(t_block, 4),
@@ -531,8 +546,15 @@ def bench_ckpt() -> dict:
         # an ideal scheduler would hit (real v5e DMA moves GB/s, where the
         # same path restores this state in <1s)
         "h2d_link_mbps": round(h2d_mbps, 1),
+        "h2d_link_mbps_after": round(h2d_after, 1),
+        # the restore's own achieved rate: compare directly against the
+        # bracketing probes — on A/B runs it matches or exceeds them
+        # (the link, not the scheduler, is the bound); efficiency <0.8
+        # with restore_rate inside the probe bracket = link weather
+        "restore_rate_mbps": round((nbytes / 1e6) / max(t_restore, 1e-9), 1),
         "t_restore_link_floor_s": round(floor_s, 3),
         "restore_link_efficiency": round(floor_s / max(t_restore, 1e-9), 3),
+        "restore_link_efficiency_target": 0.8,
         "blocking_speedup_vs_sync_disk": round(speedup, 2),
         "vs_reference_10x_claim": round(speedup / 10.0, 3),
     }
