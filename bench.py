@@ -379,6 +379,17 @@ def bench_decode() -> dict:
         "int8_fused": variant(lp, long_new, long_total,
                               quantize_cache=True),
     }
+    if on_tpu:
+        # the round-2 finding made recordable: the XLA-level dequant
+        # (int8 cache, kernel off) spends the saved bandwidth on a bf16
+        # materialization — the fused kernel must beat it here
+        os.environ["DLROVER_TPU_FLASH_DECODE"] = "0"
+        try:
+            long["int8_xla_dequant"] = variant(
+                lp, long_new, long_total, quantize_cache=True,
+            )
+        finally:
+            os.environ.pop("DLROVER_TPU_FLASH_DECODE", None)
     best_long = max(long, key=lambda k: long[k]["tokens_per_s"])
 
     result = {
