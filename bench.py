@@ -383,14 +383,23 @@ def bench_decode() -> dict:
         # the round-2 finding made recordable: the XLA-level dequant
         # (int8 cache, kernel off) spends the saved bandwidth on a bf16
         # materialization — the fused kernel must beat it here
+        prev = os.environ.get("DLROVER_TPU_FLASH_DECODE")
         os.environ["DLROVER_TPU_FLASH_DECODE"] = "0"
         try:
             long["int8_xla_dequant"] = variant(
                 lp, long_new, long_total, quantize_cache=True,
             )
         finally:
-            os.environ.pop("DLROVER_TPU_FLASH_DECODE", None)
-    best_long = max(long, key=lambda k: long[k]["tokens_per_s"])
+            if prev is None:
+                os.environ.pop("DLROVER_TPU_FLASH_DECODE", None)
+            else:
+                os.environ["DLROVER_TPU_FLASH_DECODE"] = prev
+    # headline over AUTO-reachable variants only: the forced-override
+    # diagnostic must not publish throughput the stack never auto-selects
+    best_long = max(
+        (k for k in long if k != "int8_xla_dequant"),
+        key=lambda k: long[k]["tokens_per_s"],
+    )
 
     result = {
         "params_b": round(n_params / 1e9, 3),
