@@ -241,10 +241,8 @@ def forward(
 def next_token_loss(params, tokens, config: MoEConfig, mesh=None):
     """Causal LM loss + router load-balancing aux term."""
     logits, aux = forward(params, tokens[:, :-1], config, mesh)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean() + config.router_aux_weight * aux
+    return _llama.cross_entropy(logits, tokens[:, 1:]) \
+        + config.router_aux_weight * aux
 
 
 def num_params(config: MoEConfig) -> Tuple[int, int]:

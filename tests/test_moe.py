@@ -133,3 +133,22 @@ class TestExpertParallel:
         assert all(
             bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads)
         )
+
+
+def test_cross_entropy_matches_log_softmax_gather():
+    """The logsumexp-gather formulation (llama.cross_entropy) is the
+    log_softmax+gather NLL with the (B,S,V) logp intermediate elided —
+    values must agree to float tolerance."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dlrover_tpu.models import llama
+
+    key = jax.random.PRNGKey(0)
+    logits = jax.random.normal(key, (2, 5, 17), jnp.float32) * 3.0
+    targets = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 17)
+    ours = llama.cross_entropy(logits, targets)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ref = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0].mean()
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-6)
