@@ -494,19 +494,17 @@ def _decode_kernel(
         # once, dequantized once, and the per-head matmuls run as ONE
         # KV-batched dot_general — a python unroll over heads was 16
         # separate (8, d)x(d, block_k) matmuls plus 16 sets of softmax
-        # bookkeeping per block, and measured SLOWER than XLA's einsum
-        k = k_ref[0].astype(jnp.float32)            # (block_k, KV, d)
-        v = v_ref[0].astype(jnp.float32)
+        # bookkeeping per block, and measured SLOWER than XLA's einsum.
+        # The cache is head-major (models/decode.py init_kv_cache), so
+        # blocks arrive already batched by head — no in-VMEM transpose.
+        kt = k_ref[0].astype(jnp.float32)           # (KV, block_k, d)
+        vt = v_ref[0].astype(jnp.float32)
         if quantized:
             # dequantize IN VMEM: HBM saw only int8 values + one f32
             # scale per vector — the bandwidth saving an XLA-level
             # dequant spends by materializing the bf16 copy
-            k = k * ks_ref[0][:, :, None]
-            v = v * vs_ref[0][:, :, None]
-        # Mosaic requires matching batch-dim POSITIONS, so the K/V blocks
-        # are transposed head-major first (cheap: minor dim preserved)
-        kt = jnp.transpose(k, (1, 0, 2))            # (KV, block_k, d)
-        vt = jnp.transpose(v, (1, 0, 2))
+            kt = kt * ks_ref[0][:, :, None]
+            vt = vt * vs_ref[0][:, :, None]
         q = q_ref[0].astype(jnp.float32)            # (KV, rows, d)
         s = jax.lax.dot_general(
             q, kt, (((2,), (2,)), ((0,), (0,))),
@@ -562,15 +560,15 @@ def flash_decode_attention(
     """Single-token attention against a KV cache, fused.
 
     q: (B, KV, G, Dh) — the current token's query heads grouped by KV
-    head (G = H // KV, the GQA group). k/v: (B, T, KV, Dh) — the cache in
-    its native layout (no transpose; the kernel reads each K/V block once
-    for ALL heads). ``pos``: scalar int32, the token's position — only
+    head (G = H // KV, the GQA group). k/v: (B, KV, T, Dh) — the cache in
+    its head-major layout (blocks arrive batched by head, each read once
+    for ALL of that head's queries). ``pos``: scalar int32 — only
     cache slots ``[0, pos]`` attend, and K blocks beyond ``pos`` are
     skipped at ~zero bandwidth via a scalar-prefetch-clamped index map.
     T must divide by ``block_k`` (callers round the cache length up at
     creation).
 
-    With ``k_scale``/``v_scale`` (B, T, KV) f32, k/v are int8 and are
+    With ``k_scale``/``v_scale`` (B, KV, T) f32, k/v are int8 and are
     dequantized inside the kernel (per-vector absmax scales) — HBM
     traffic for the cache is halved vs bf16, which is the whole game for
     the bandwidth-bound decode step. An XLA-level dequant can't deliver
@@ -579,7 +577,7 @@ def flash_decode_attention(
     Returns (B, KV, G, Dh).
     """
     B, KV, G, Dh = q.shape
-    T = k.shape[1]
+    T = k.shape[2]  # head-major cache: (B, KV, T, Dh)
     if T % block_k != 0:
         raise ValueError(f"cache length {T} not divisible by {block_k}")
     quantized = k_scale is not None
@@ -601,23 +599,23 @@ def flash_decode_attention(
     )
 
     def _clamped(b, j, pos_ref):
-        return (b, jnp.minimum(j, pos_ref[0] // block_k), 0, 0)
+        return (b, 0, jnp.minimum(j, pos_ref[0] // block_k), 0)
 
     def _clamped3(b, j, pos_ref):
-        return (b, jnp.minimum(j, pos_ref[0] // block_k), 0)
+        return (b, 0, jnp.minimum(j, pos_ref[0] // block_k))
 
     if pltpu is None:  # pragma: no cover — CPU build without pallas TPU
         raise NotImplementedError("flash_decode_attention needs pallas TPU")
     in_specs = [
         _vmem_spec((1, KV, rows, Dh), lambda b, j, p: (b, 0, 0, 0)),
-        _vmem_spec((1, block_k, KV, Dh), _clamped),
-        _vmem_spec((1, block_k, KV, Dh), _clamped),
+        _vmem_spec((1, KV, block_k, Dh), _clamped),
+        _vmem_spec((1, KV, block_k, Dh), _clamped),
     ]
     operands = [q, k, v]
     if quantized:
         in_specs += [
-            _vmem_spec((1, block_k, KV), _clamped3),
-            _vmem_spec((1, block_k, KV), _clamped3),
+            _vmem_spec((1, KV, block_k), _clamped3),
+            _vmem_spec((1, KV, block_k), _clamped3),
         ]
         operands += [
             jnp.asarray(k_scale, jnp.float32),

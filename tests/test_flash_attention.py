@@ -242,16 +242,16 @@ class TestFlashDecode:
         B, KV, G, Dh, T = 2, 4, 2, 16, 64
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
         q = jax.random.normal(ks[0], (B, KV, G, Dh), jnp.float32)
-        k = jax.random.normal(ks[1], (B, T, KV, Dh), jnp.float32)
-        v = jax.random.normal(ks[2], (B, T, KV, Dh), jnp.float32)
+        k = jax.random.normal(ks[1], (B, KV, T, Dh), jnp.float32)
+        v = jax.random.normal(ks[2], (B, KV, T, Dh), jnp.float32)
         scale = Dh ** -0.5
         for pos in (0, 7, 31, 37, 63):
             out = flash_decode_attention(q, k, v, pos, block_k=16)
-            s = jnp.einsum("bkgd,btkd->bkgt", q, k) * scale
+            s = jnp.einsum("bkgd,bktd->bkgt", q, k) * scale
             mask = jnp.arange(T)[None, None, None, :] <= pos
             s = jnp.where(mask, s, -1e30)
             ref = jnp.einsum(
-                "bkgt,btkd->bkgd", jax.nn.softmax(s, -1), v
+                "bkgt,bktd->bkgd", jax.nn.softmax(s, -1), v
             )
             np.testing.assert_allclose(
                 np.asarray(out), np.asarray(ref), atol=2e-5,
@@ -262,7 +262,7 @@ class TestFlashDecode:
         from dlrover_tpu.ops.flash_attention import flash_decode_attention
 
         q = jnp.zeros((1, 2, 2, 16))
-        k = v = jnp.zeros((1, 60, 2, 16))
+        k = v = jnp.zeros((1, 2, 60, 16))
         with pytest.raises(ValueError, match="not divisible"):
             flash_decode_attention(q, k, v, 0, block_k=16)
 
@@ -274,8 +274,8 @@ class TestFlashDecode:
         B, KV, G, Dh, T = 2, 2, 4, 16, 48
         ks = jax.random.split(jax.random.PRNGKey(3), 3)
         q = jax.random.normal(ks[0], (B, KV, G, Dh), jnp.float32)
-        kf = jax.random.normal(ks[1], (B, T, KV, Dh), jnp.float32)
-        vf = jax.random.normal(ks[2], (B, T, KV, Dh), jnp.float32)
+        kf = jax.random.normal(ks[1], (B, KV, T, Dh), jnp.float32)
+        vf = jax.random.normal(ks[2], (B, KV, T, Dh), jnp.float32)
 
         def quant(x):
             s = jnp.max(jnp.abs(x), axis=-1) / 127.0
@@ -295,10 +295,10 @@ class TestFlashDecode:
             out = flash_decode_attention(
                 q, kq, vq, pos, block_k=16, k_scale=ksc, v_scale=vsc
             )
-            s = jnp.einsum("bkgd,btkd->bkgt", q, kd) * scale
+            s = jnp.einsum("bkgd,bktd->bkgt", q, kd) * scale
             mask = jnp.arange(T)[None, None, None, :] <= pos
             s = jnp.where(mask, s, -1e30)
-            ref = jnp.einsum("bkgt,btkd->bkgd", jax.nn.softmax(s, -1), vd)
+            ref = jnp.einsum("bkgt,bktd->bkgd", jax.nn.softmax(s, -1), vd)
             np.testing.assert_allclose(
                 np.asarray(out), np.asarray(ref), atol=2e-5,
                 err_msg=f"pos={pos}",

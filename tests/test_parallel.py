@@ -327,4 +327,3 @@ class TestMultiSlice:
         # dcn elasticity falls back to one flat world rather than failing
         plan = mgr.replan(6)
         assert plan.size("dcn") == 1 and plan.n_devices == 6
-
