@@ -314,7 +314,9 @@ def bench_decode() -> dict:
             decode.generate, config=cfg, max_new_tokens=n_new,
             temperature=1.0, top_k=40, **gen_kw,
         ))
-        calls = iter(range(2, 100))
+        import itertools
+
+        calls = itertools.count(2)
 
         def _gen_once():
             out = gen(params, pr, key=jax.random.PRNGKey(next(calls)))

@@ -1,0 +1,110 @@
+"""Batched KV-cache serving on one chip: prefill + decode with the cache
+strategy knobs, as a runnable example.
+
+The reference delegates generation to vLLM/Megatron inside its RL stack;
+this stack owns the rollout/serving path (models/decode.py). What this
+example shows:
+
+- one compiled program per (prompt length bucket, budget): batched
+  prefill + a ``lax.scan`` of cached decode steps — no per-token python,
+  no recompiles while serving a bucket;
+- the cache-strategy knobs and when each wins (measured, one v5e):
+  * default (tight bf16 cache) — highest throughput when HBM is ample:
+    2071 tok/s at batch 8 / short context on the 0.9B bench model;
+  * ``quantize_cache=True`` — int8 KV halves cache HBM; with the fused
+    in-VMEM dequant kernel (auto-selected) it is the FASTEST path at
+    long context (640 vs 619 tok/s at 2k) and doubles max context;
+  * ``max_len=...`` — preallocated serving cache; the fused kernel skips
+    blocks past ``pos`` so an oversized cache costs ~nothing to read;
+- time-to-first-token is a separate prefill call you can overlap with
+  the previous batch's decode.
+
+Run: ``python examples/llama_serve_decode.py [--batch 8] [--prompt-len 2048]``
+(CPU works for a smoke run; numbers need the chip).
+"""
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("llama_serve_decode")
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--prompt-len", type=int, default=128)
+    parser.add_argument("--new-tokens", type=int, default=64)
+    parser.add_argument("--dim", type=int, default=0,
+                        help="0 = auto (2048 on TPU, tiny on CPU)")
+    parser.add_argument("--int8-cache", action="store_true")
+    parser.add_argument("--max-len", type=int, default=0,
+                        help="preallocated cache length (0 = tight)")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from dlrover_tpu.models import decode, llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    dim = args.dim or (2048 if on_tpu else 256)
+    layers = 16 if on_tpu else 2
+    heads = max(1, dim // 128)
+    total = args.prompt_len + args.new_tokens
+    config = llama.LlamaConfig(
+        vocab_size=32000, dim=dim, n_layers=layers, n_heads=heads,
+        n_kv_heads=max(1, heads // 2),
+        ffn_dim=int(2.75 * dim) // 256 * 256,
+        max_seq_len=max(total, args.max_len or 0), remat=False,
+    )
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (args.batch, args.prompt_len),
+        0, config.vocab_size,
+    )
+
+    cache_len, flash = decode.planned_cache_len(
+        total, args.int8_cache, args.max_len or None
+    )
+    print(f"model {llama.num_params(config)/1e9:.2f}B | batch {args.batch} "
+          f"| cache {cache_len} slots "
+          f"({'int8' if args.int8_cache else 'bf16'}) "
+          f"| attend: {'fused kernel' if flash else 'XLA einsum'}")
+
+    gen = jax.jit(functools.partial(
+        decode.generate, config=config, max_new_tokens=args.new_tokens,
+        temperature=0.8, top_k=40, quantize_cache=args.int8_cache,
+        max_len=args.max_len or None,
+    ))
+    out = gen(params, prompt, key=jax.random.PRNGKey(2))
+    _ = int(out[0, -1])  # compile + run once
+    t0 = time.perf_counter()
+    out = gen(params, prompt, key=jax.random.PRNGKey(3))
+    _ = int(out[0, -1])
+    dt = time.perf_counter() - t0
+    toks = args.batch * args.new_tokens
+    print(f"decode: {toks} tokens in {dt:.2f}s = {toks/dt:.0f} tok/s "
+          f"({args.new_tokens/dt:.1f} steps/s)")
+
+    # TTFT view: prefill alone (overlap this with the previous batch's
+    # decode in a real server loop)
+    pre = jax.jit(functools.partial(
+        decode.prefill, config=config, max_len=cache_len,
+        quantize=args.int8_cache,
+    ))
+    logits, cache = pre(params, prompt)
+    _ = float(logits.ravel()[0])
+    t0 = time.perf_counter()
+    logits, cache = pre(params, prompt)
+    _ = float(logits.ravel()[0])
+    print(f"ttft (prefill {args.prompt_len} tokens x{args.batch}): "
+          f"{1e3*(time.perf_counter()-t0):.0f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
