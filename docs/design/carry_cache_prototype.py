@@ -1,0 +1,85 @@
+"""Round-4 lead: carry-cache decode step (see round3_subsystems.md
+"Known headroom"). Standalone A/B harness — current decode_step vs a
+variant that carries the FULL (L,B,KV,T,Dh) cache through the layer scan
+and updates one row in place per layer, removing the ~4.6 GB/step of
+stacked-ys cache copies the current layer scan pays at long context.
+Run on a chip: python docs/design/carry_cache_prototype.py
+"""
+import sys, time, functools
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+from dlrover_tpu.models import decode, llama
+from dlrover_tpu.models.llama import _rms_norm, _rope, _mlp
+from dlrover_tpu.models.decode import _split_heads, _attend
+
+dim, layers = 2048, 16
+heads = dim // 128
+B, T = 8, 2176
+c = llama.LlamaConfig(vocab_size=32000, dim=dim, n_layers=layers, n_heads=heads,
+    n_kv_heads=heads//2, ffn_dim=int(2.75*dim)//256*256, max_seq_len=T, remat=False)
+params = llama.init_params(c, jax.random.PRNGKey(0))
+prompt = jax.random.randint(jax.random.PRNGKey(1), (B, 2048), 0, 32000)
+logits, cache = jax.jit(functools.partial(decode.prefill, config=c, max_len=T))(params, prompt)
+tok = jnp.ones((B,), jnp.int32)
+probe = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+_ = float(probe(jnp.ones((8,)))); t0=time.perf_counter()
+for _ in range(3): _ = float(probe(jnp.ones((8,))))
+rtt = (time.perf_counter()-t0)/3
+
+def step_carry(token, cch):
+    """Cache stays in the scan CARRY; per-layer row update is an in-place
+    dynamic_update_slice on the full (L,B,KV,T,Dh) buffer."""
+    pos = cch["pos"]
+    x = params["tok_embed"][token][:, None, :]
+    positions = jnp.broadcast_to(pos[None, None], (B, 1))
+    mask = (jnp.arange(T)[None, None, None, :] <= pos)
+    scale = c.head_dim ** -0.5
+    def layer_fn(carry, inputs):
+        h, kc, vc = carry
+        layer, li = inputs
+        xn = _rms_norm(h, layer["attn_norm"], c.norm_eps)
+        q = _rope(_split_heads(xn @ layer["wq"], c.n_heads, c.head_dim), positions, c.rope_theta)
+        k_new = _rope(_split_heads(xn @ layer["wk"], c.n_kv_heads, c.head_dim), positions, c.rope_theta)
+        v_new = _split_heads(xn @ layer["wv"], c.n_kv_heads, c.head_dim)
+        k_new = jnp.swapaxes(k_new, 1, 2).astype(kc.dtype)[None]
+        v_new = jnp.swapaxes(v_new, 1, 2).astype(vc.dtype)[None]
+        kc = jax.lax.dynamic_update_slice(kc, k_new, (li, 0, 0, pos, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v_new, (li, 0, 0, pos, 0))
+        k_l = jax.lax.dynamic_index_in_dim(kc, li, 0, keepdims=False)
+        v_l = jax.lax.dynamic_index_in_dim(vc, li, 0, keepdims=False)
+        out = _attend(q, k_l, v_l, mask, scale, pos=None)
+        h = h + out @ layer["wo"]
+        h = h + _mlp(_rms_norm(h, layer["ffn_norm"], c.norm_eps), layer)
+        return (h, kc, vc), ()
+    (x, kc, vc), _ = jax.lax.scan(
+        layer_fn, (x, cch["k"], cch["v"]),
+        (params["layers"], jnp.arange(c.n_layers)))
+    x = _rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": kc, "v": vc, "pos": pos + 1}
+
+iters = 64
+def bench(label, step_fn):
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def loop(t, cch):
+        def body(carry, _):
+            lg, cc = step_fn(t, carry)
+            return cc, lg[0, 0]
+        cc, lgs = jax.lax.scan(body, cch, None, length=iters)
+        return cc, lgs[-1]
+    cc = jax.tree.map(jnp.copy, cache)
+    cc, lg = loop(tok, cc); _ = float(lg)
+    cc = jax.tree.map(jnp.copy, cache)
+    t0 = time.perf_counter()
+    cc, lg = loop(tok, cc); _ = float(lg)
+    dt = (time.perf_counter()-t0-rtt)/iters
+    print(f"{label}: {dt*1e3:.2f} ms/step ({1/dt:.1f} steps/s)", flush=True)
+
+bench("current decode_step", lambda t, cc: decode.decode_step(params, t, cc, c))
+bench("carry-cache step   ", step_carry)
+# correctness: logits must match
+l1, _ = jax.jit(lambda t, cc: decode.decode_step(params, t, cc, c))(tok, cache)
+l2, _ = jax.jit(step_carry)(tok, cache)
+import numpy as np
+err = float(jnp.max(jnp.abs(l1 - l2)))
+print("max logit err carry vs current:", err)
