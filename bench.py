@@ -309,7 +309,10 @@ def bench_decode() -> dict:
         return hbm_gbps * 1e9 / (n_params * 2 + cache_bytes)
 
     def timed_gen(pr, n_new, seq_total, **gen_kw):
-        """Median-of-N timing; returns (dt, allocated cache length)."""
+        """Median-of-N timing; returns (dt_total, dt_prefill, cache_len,
+        quantized). ``dt_prefill`` times the same prefill program
+        generate() runs internally (same cache length/dtype), so
+        ``dt_total - dt_prefill`` isolates the decode-step scan."""
         cfg = config
         if seq_total > config.max_seq_len:
             import dataclasses
@@ -334,17 +337,33 @@ def bench_decode() -> dict:
         quant = bool(gen_kw.get("quantize_cache"))
         ml, _ = decode.planned_cache_len(total, quant,
                                          gen_kw.get("max_len"))
-        return dt, ml, quant
+        pre = jax.jit(functools.partial(
+            decode.prefill, config=cfg, max_len=ml, quantize=quant,
+        ))
+
+        def _prefill_once():
+            lg, _ = pre(params, pr)
+            _ = float(lg.ravel()[0])
+
+        dt_pre = median_timed(_prefill_once)
+        return dt, dt_pre, ml, quant
 
     total = prompt_len + new_tokens
 
     def variant(pr, n_new, seq_total, **kw):
-        dt, cache_len, quant = timed_gen(pr, n_new, seq_total, **kw)
+        dt, dt_pre, cache_len, quant = timed_gen(pr, n_new, seq_total, **kw)
         roof = roof_steps_per_s(cache_len, quant)
-        sps = n_new / dt
+        # decode-only rate: generate() = one prefill + n_new decode
+        # steps; the prefill is reported on its own (and as TTFT) — the
+        # HBM-roof comparison only makes sense for the decode steps,
+        # which are what the roof models
+        dt_dec = max(dt - dt_pre, 1e-9)
+        sps = n_new / dt_dec
         return {
-            "tokens_per_s": round(batch * n_new / dt, 1),
+            "tokens_per_s": round(batch * n_new / dt_dec, 1),
             "steps_per_s": round(sps, 1),
+            "e2e_tokens_per_s": round(batch * n_new / dt, 1),
+            "prefill_s": round(dt_pre, 4),
             "cache_len": cache_len,
             "hbm_roof_steps_per_s": round(roof, 1) if roof else 0.0,
             "pct_of_roof": round(100.0 * sps / roof, 1) if roof else 0.0,

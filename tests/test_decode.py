@@ -173,8 +173,9 @@ class TestQuantizedCache:
         logits, qcache = decode.prefill(params, tokens[:, :P], c, 32,
                                         quantize=True)
         # cache payload is int8 (quarter of the f32 baseline; scales are
-        # 1/head_dim extra)
-        assert qcache["k"].dtype == jnp.int8
+        # 1/head_dim extra); fields are per-layer tuples
+        assert all(kl.dtype == jnp.int8 for kl in qcache["k"])
+        assert len(qcache["k"]) == c.n_layers
         step = jax.jit(lambda t, cch: decode.decode_step(params, t, cch, c))
         max_err = 0.0
         for i in range(P, tokens.shape[1]):
