@@ -144,17 +144,25 @@ class CheckpointEngine:
         self._save_seq = 0  # per-engine save-attempt counter (all ranks
         # call saves in the same order, so it agrees across the group)
         self._ready_cooldown_until = 0.0
-        # GC the whole ready/ namespace once per incarnation: previous
-        # incarnations' trailing (un-GC'd) attempt keys would otherwise
-        # accumulate in the master KV — and its failover snapshots —
-        # forever. Old-incarnation stragglers can only see a deleted key
-        # as "peer not ready yet" and time out, the safe failure.
+        # GC PREVIOUS incarnations' ready/ namespaces once per
+        # incarnation: their trailing (un-GC'd) attempt keys would
+        # otherwise accumulate in the master KV — and its failover
+        # snapshots — forever. Scoped to rounds r{i} for i < the current
+        # rendezvous round, NOT the whole ready/ prefix: faster peers of
+        # THIS incarnation may already have posted first-attempt ready
+        # keys before this engine finishes __init__, and a whole-prefix
+        # delete would eat them and split the save barrier (rank 0 times
+        # out while peers proceed). Old-incarnation stragglers can only
+        # see a deleted key as "peer not ready yet" and time out, the
+        # safe failure.
         if (self._master is not None and self.saving_ranks
                 and self.rank == self.saving_ranks[0]):
             gc = getattr(self._master, "kv_delete_prefix", None)
             if gc is not None:
+                cur_round = int(os.getenv(EnvKey.RDZV_ROUND, "0") or 0)
                 try:
-                    gc(f"ckpt/{self.job_name}/ready/")
+                    for i in range(cur_round):
+                        gc(f"ckpt/{self.job_name}/ready/r{i}/")
                 except (ConnectionError, RuntimeError):
                     pass  # best-effort: the leak is bounded per incarnation
         self._drain_thread: Optional[threading.Thread] = None

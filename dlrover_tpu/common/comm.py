@@ -454,8 +454,10 @@ class SpawnActorRequest:
     ctx_blob: bytes = b""  # pickled WorkloadContext (job trust domain)
     callback_addr: str = ""  # scheduler's call-home listener
     token: str = ""  # per-job call-home auth (CallHomeListener.token)
+    secret: str = ""  # daemon-side spawn auth (ActorHostServicer secret)
 
 
 @message
 class ActorRefRequest:
     name: str = ""
+    secret: str = ""  # daemon-side auth, same as SpawnActorRequest

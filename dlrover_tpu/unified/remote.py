@@ -143,16 +143,36 @@ class ActorHostServicer:
     The daemon uses a ``forkserver`` context for the same reason the
     local scheduler does: it may import jax-adjacent modules, and forking
     a multithreaded parent is a deadlock hazard.
+
+    ``secret`` authenticates CALLERS to the daemon: spawn executes an
+    arbitrary module:class and unpickles a caller-supplied context blob,
+    so an open daemon port is remote code execution. With a secret set,
+    every spawn/kill/alive request must carry it (constant-time compare);
+    :func:`serve_actor_host` refuses to bind a non-loopback interface
+    without one. (The per-job ``token`` field is different auth: it
+    authenticates ACTORS to the scheduler's call-home listener.)
     """
 
-    def __init__(self):
+    def __init__(self, secret: Optional[str] = None):
         import multiprocessing as mp
 
         self._mp = mp.get_context("forkserver")
         self._procs: Dict[str, object] = {}
         self._lock = threading.Lock()
+        self._secret = secret or ""
+
+    def _authorized(self, req) -> bool:
+        if not self._secret:
+            return True
+        return hmac.compare_digest(
+            str(getattr(req, "secret", "")), self._secret
+        )
 
     def rpc_spawn_actor(self, req: comm.SpawnActorRequest) -> comm.BaseResponse:
+        if not self._authorized(req):
+            logger.warning("actor host: spawn %s rejected (bad secret)",
+                           req.name)
+            return comm.BaseResponse(success=False, message="unauthorized")
         with self._lock:
             old = self._procs.pop(req.name, None)
         if old is not None and old.is_alive():
@@ -172,6 +192,8 @@ class ActorHostServicer:
         return comm.BaseResponse(success=True, message=str(proc.pid))
 
     def rpc_kill_actor(self, req: comm.ActorRefRequest) -> comm.BaseResponse:
+        if not self._authorized(req):
+            return comm.BaseResponse(success=False, message="unauthorized")
         with self._lock:
             proc = self._procs.get(req.name)
         if proc is not None and proc.is_alive():
@@ -180,6 +202,11 @@ class ActorHostServicer:
         return comm.BaseResponse(success=True)
 
     def rpc_actor_alive(self, req: comm.ActorRefRequest) -> comm.BoolResponse:
+        if not self._authorized(req):
+            # an auth misconfiguration must surface as an ERROR (RPCError
+            # at the caller), never read as "actor dead" — that would
+            # trigger spurious failover instead of fixing the secret
+            raise PermissionError("unauthorized")
         with self._lock:
             proc = self._procs.get(req.name)
         return comm.BoolResponse(value=bool(proc is not None and proc.is_alive()))
@@ -194,9 +221,16 @@ class ActorHostServicer:
                 p.join(5)
 
 
-def serve_actor_host(port: int = 0, host: str = "0.0.0.0"
+def serve_actor_host(port: int = 0, host: str = "0.0.0.0",
+                     secret: Optional[str] = None,
                      ) -> Tuple[RPCServer, ActorHostServicer]:
-    servicer = ActorHostServicer()
+    if not secret and host not in ("127.0.0.1", "::1", "localhost"):
+        # an open spawn port is RCE — refuse, don't warn
+        raise ValueError(
+            f"refusing to serve the actor-host spawn RPC on {host!r} "
+            f"without a secret; pass secret=... or bind loopback"
+        )
+    servicer = ActorHostServicer(secret=secret)
     server = RPCServer(host=host, port=port)
     server.register_object(servicer)
     server.start()
@@ -212,8 +246,20 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser("dtpu-actor-host")
     parser.add_argument("--port", type=int, default=8471)
     parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument(
+        "--secret-file",
+        help="file holding the spawn-auth secret (required unless --host "
+        "is loopback); also readable from $DTPU_ACTOR_HOST_SECRET",
+    )
     args = parser.parse_args(argv)
-    server, servicer = serve_actor_host(args.port, args.host)
+    secret = os.environ.get("DTPU_ACTOR_HOST_SECRET", "")
+    if args.secret_file:
+        with open(args.secret_file) as f:
+            secret = f.read().strip()
+    try:
+        server, servicer = serve_actor_host(args.port, args.host, secret)
+    except ValueError as e:
+        parser.error(str(e))
     print(f"actor host ready on {server.port}", flush=True)
     try:
         while True:
@@ -237,8 +283,10 @@ class ActorHostClient:
     the failover path for the RPC plane's 330s barrier-grade default.
     """
 
-    def __init__(self, addr: str, timeout_s: float = 10.0):
+    def __init__(self, addr: str, timeout_s: float = 10.0,
+                 secret: str = ""):
         self.addr = addr
+        self.secret = secret
         self._client = RPCClient(addr, timeout_s=timeout_s, retries=3)
 
     def spawn(self, name: str, ctx_blob: bytes, module_name: str,
@@ -246,17 +294,25 @@ class ActorHostClient:
         resp = self._client.call("spawn_actor", comm.SpawnActorRequest(
             name=name, ctx_blob=ctx_blob, module_name=module_name,
             class_name=class_name, callback_addr=callback_addr, token=token,
+            secret=self.secret,
         ))
         if not resp.success:
             raise RuntimeError(f"spawn {name} on {self.addr}: {resp.message}")
         return int(resp.message)
 
     def kill(self, name: str) -> None:
-        self._client.call("kill_actor", comm.ActorRefRequest(name=name))
+        resp = self._client.call("kill_actor", comm.ActorRefRequest(
+            name=name, secret=self.secret))
+        if not resp.success:
+            # a silently-ignored unauthorized kill would leave the actor
+            # running (and holding its chip) while the scheduler believes
+            # it dead
+            raise RuntimeError(f"kill {name} on {self.addr}: {resp.message}")
 
     def alive(self, name: str) -> bool:
         return self._client.call(
-            "actor_alive", comm.ActorRefRequest(name=name)
+            "actor_alive", comm.ActorRefRequest(name=name,
+                                                secret=self.secret)
         ).value
 
 

@@ -257,7 +257,8 @@ class ProcessScheduler:
 
     def __init__(self, graph: ExecutionGraph, job_name: str = "unified",
                  start_method: str = "forkserver",
-                 hosts: Optional[Dict[int, str]] = None):
+                 hosts: Optional[Dict[int, str]] = None,
+                 host_secret: str = ""):
         # forkserver, NOT fork: the scheduler lives in a master process
         # that has imported jax — XLA's thread pools are already running,
         # and forking a multithreaded parent can deadlock the child on a
@@ -277,6 +278,9 @@ class ProcessScheduler:
         # (Reference: Ray placement groups + remote actor creation,
         # unified/master/scheduler.py:161–189.)
         self._hosts = dict(hosts or {})
+        # spawn-auth secret shared with the hosts' daemons (the daemons
+        # refuse non-loopback service without one — unified/remote.py)
+        self._host_secret = host_secret
         self._host_clients: Dict[str, Any] = {}
         self._callhome = None
         # must cover a full-fleet broadcast: a role-group call over N SPMD
@@ -290,7 +294,8 @@ class ProcessScheduler:
         from dlrover_tpu.unified.remote import ActorHostClient
 
         if addr not in self._host_clients:
-            self._host_clients[addr] = ActorHostClient(addr)
+            self._host_clients[addr] = ActorHostClient(
+                addr, secret=self._host_secret)
         return self._host_clients[addr]
 
     def schedule(self, ready_timeout_s: float = 60.0) -> None:

@@ -203,3 +203,39 @@ def test_callhome_rejects_unauthenticated_dialers():
         s.close()
     finally:
         listener.close()
+
+
+def test_daemon_spawn_requires_secret():
+    """The spawn RPC executes an arbitrary module:class and unpickles a
+    caller blob — an open daemon port would be RCE. With a secret set,
+    wrong/missing-secret spawn+kill are refused and alive reads deny;
+    the right secret works; and a non-loopback bind without a secret is
+    refused outright."""
+    server, servicer = serve_actor_host(
+        port=0, host="127.0.0.1", secret="s3kr1t")
+    addr = f"127.0.0.1:{server.port}"
+    try:
+        from dlrover_tpu.common.rpc import RPCError
+
+        bad = ActorHostClient(addr, secret="wrong")
+        with pytest.raises(RuntimeError, match="unauthorized"):
+            bad.spawn("x", b"", "m", "C", "127.0.0.1:1", token="t")
+        # liveness must ERROR on bad auth, not read as "actor dead"
+        with pytest.raises(RPCError, match="unauthorized"):
+            bad.alive("anything")
+        with pytest.raises(RuntimeError, match="unauthorized"):
+            bad.kill("anything")
+        good = ActorHostClient(addr, secret="s3kr1t")
+        # a bogus module still *spawns* (the child fails later inside its
+        # own process) — authorization is what's under test here
+        pid = good.spawn(
+            "authtest", b"", "nonexistent_mod", "C", "127.0.0.1:1",
+            token="t",
+        )
+        assert pid > 0
+        good.kill("authtest")
+    finally:
+        servicer.shutdown()
+        server.stop()
+    with pytest.raises(ValueError, match="refusing"):
+        serve_actor_host(port=0, host="0.0.0.0")
