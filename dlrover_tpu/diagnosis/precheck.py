@@ -14,7 +14,11 @@ jax.distributed.initialize (which would hang) is the point of this chain.
 import time
 from typing import List, Optional, Tuple
 
-from dlrover_tpu.common.constants import NodeStatus, PreCheckStatus
+from dlrover_tpu.common.constants import (
+    DiagnosisActionType,
+    NodeStatus,
+    PreCheckStatus,
+)
 from dlrover_tpu.common.log import logger
 
 
@@ -35,6 +39,13 @@ class PreCheckOperator:
 
     def check(self, job_manager) -> PreCheckResult:
         return PreCheckResult()
+
+    def failed_actions(self, result: PreCheckResult, job_manager) -> List:
+        """Recovery to attempt when the timed-out check names abnormal
+        nodes (reference failed_actions, precheck_operator.py:336,424:
+        relaunch the stuck pods, then re-check). Empty list = nothing to
+        try — the chain fails the job."""
+        return []
 
     def run(self, job_manager) -> PreCheckResult:
         """Poll check() until pass or timeout."""
@@ -77,6 +88,21 @@ class SchedulingPreCheckOperator(PreCheckOperator):
             )
         return PreCheckResult()
 
+    def failed_actions(self, result: PreCheckResult, job_manager) -> List:
+        # a pod stuck Pending past the deadline is usually a bad node /
+        # unschedulable placement — relaunch it and re-check (reference
+        # SchedulingPreCheckOperator.failed_actions:336)
+        from dlrover_tpu.diagnosis.action import NodeAction
+
+        return [
+            NodeAction(
+                node_id=nid,
+                action_type=DiagnosisActionType.MASTER_RELAUNCH_WORKER,
+                reason="pre-check: not scheduled in time",
+            )
+            for nid in result.abnormal_nodes
+        ]
+
 
 class ConnectionPreCheckOperator(PreCheckOperator):
     """All running nodes have heartbeated recently — i.e. the agent on every
@@ -104,6 +130,21 @@ class ConnectionPreCheckOperator(PreCheckOperator):
                 abnormal_nodes=silent,
             )
         return PreCheckResult()
+
+    def failed_actions(self, result: PreCheckResult, job_manager) -> List:
+        # an agent that scheduled but never reaches the master is a
+        # network/bootstrap fault on that host — relaunch it (reference
+        # ConnectionPreCheckOperator.failed_actions:424)
+        from dlrover_tpu.diagnosis.action import NodeAction
+
+        return [
+            NodeAction(
+                node_id=nid,
+                action_type=DiagnosisActionType.MASTER_RELAUNCH_WORKER,
+                reason="pre-check: agent unreachable",
+            )
+            for nid in result.abnormal_nodes
+        ]
 
 
 def get_precheck_operators(names: List[str]) -> List[PreCheckOperator]:
@@ -145,6 +186,18 @@ class PreCheckRunner:
         for op in self._operators:
             result = op.run(job_manager)
             if not result.passed:
+                # one recovery round (reference diagnosis_master.py:99
+                # loop over failed_actions): apply the operator's
+                # recovery — relaunch the named nodes master-side, on the
+                # no-budget KILLED path (a stuck-Pending pod or an
+                # unreachable agent is the platform's fault, not the
+                # node's) — then give the check one more full window
+                actions = op.failed_actions(result, job_manager)
+                if actions:
+                    for action in actions:
+                        self._apply_recovery(action, job_manager)
+                    result = op.run(job_manager)
+            if not result.passed:
                 self._status = PreCheckStatus.FAIL
                 self._reason = f"{op.name}: {result.reason}"
                 logger.error("pre-check failed — %s", self._reason)
@@ -153,3 +206,25 @@ class PreCheckRunner:
         self._status = PreCheckStatus.PASS
         self._reason = ""
         return True
+
+    @staticmethod
+    def _apply_recovery(action, job_manager) -> None:
+        from dlrover_tpu.common.constants import (
+            DiagnosisActionType as A,
+            NodeExitReason,
+        )
+        from dlrover_tpu.diagnosis.action import NodeAction
+
+        if isinstance(action, NodeAction) and action.action_type in (
+            A.MASTER_RELAUNCH_WORKER, A.RELAUNCH_WORKER,
+        ):
+            logger.warning(
+                "pre-check recovery: relaunching node %s (%s)",
+                action.instance, action.reason,
+            )
+            job_manager.update_node_status(
+                action.instance, NodeStatus.FAILED,
+                exit_reason=NodeExitReason.KILLED,
+            )
+        else:
+            job_manager.enqueue_action(action)

@@ -8,6 +8,7 @@ heartbeat monitoring and relaunch decisions, and a pluggable
 :class:`~dlrover_tpu.master.scaler.Scaler` that actually (re)creates nodes.
 """
 
+import heapq
 import threading
 import time
 from dataclasses import dataclass
@@ -125,6 +126,13 @@ class JobManager:
         self._action_queue = DiagnosisActionQueue()
         self._event_callbacks: List[Callable[[NodeEvent], None]] = []
         self._monitor_thread: Optional[threading.Thread] = None
+        # conn-drop grace rechecks: ONE scheduler thread draining a heap
+        # of (due_time, node_id, drop_ts) — a Timer thread per drop would
+        # spawn an unbounded thread burst exactly when a whole rack
+        # disconnects at once
+        self._recheck_heap: List[tuple] = []
+        self._recheck_cond = threading.Condition()
+        self._recheck_thread: Optional[threading.Thread] = None
         for node_id in range(node_num):
             self._nodes[node_id] = Node(
                 type=NodeType.WORKER,
@@ -149,6 +157,8 @@ class JobManager:
 
     def stop(self) -> None:
         self._stopped.set()
+        with self._recheck_cond:
+            self._recheck_cond.notify_all()
 
     @property
     def job_stage(self) -> str:
@@ -272,25 +282,60 @@ class JobManager:
             node_id, grace,
         )
 
-        def _recheck():
-            if self._stopped.is_set():
-                return
-            n = self.get_node(node_id)
-            if (
-                n.status == NodeStatus.RUNNING
-                and not n.is_released
-                and n.contact_time < drop_ts  # master clock both sides
-            ):
-                logger.warning(
-                    "node %s made no contact in the %.1fs since its "
-                    "connection dropped — marking failed", node_id, grace,
+        with self._recheck_cond:
+            heapq.heappush(
+                self._recheck_heap, (drop_ts + grace, node_id, drop_ts)
+            )
+            if self._recheck_thread is None:
+                self._recheck_thread = threading.Thread(
+                    target=self._recheck_loop, name="conn-drop-recheck",
+                    daemon=True,
                 )
-                n.exit_reason = NodeExitReason.NO_HEARTBEAT
-                self.update_node_status(node_id, NodeStatus.FAILED)
+                self._recheck_thread.start()
+            self._recheck_cond.notify_all()
 
-        t = threading.Timer(grace, _recheck)
-        t.daemon = True
-        t.start()
+    def _recheck_loop(self) -> None:
+        """Drain the grace-recheck heap: sleeps until the earliest due
+        entry, wakes early when a new drop lands in front of it."""
+        while not self._stopped.is_set():
+            with self._recheck_cond:
+                if not self._recheck_heap:
+                    self._recheck_cond.wait(timeout=5.0)
+                    if not self._recheck_heap:
+                        # idle exit — clear the handle UNDER THE LOCK so a
+                        # concurrent drop either lands before this check
+                        # (heap non-empty, loop continues) or sees None
+                        # and starts a fresh thread; an is_alive() peek
+                        # at a dying thread must not strand its entry
+                        self._recheck_thread = None
+                        return
+                    continue
+                due, node_id, drop_ts = self._recheck_heap[0]
+                delay = due - time.time()
+                if delay > 0:
+                    self._recheck_cond.wait(timeout=delay)
+                    continue  # re-read the heap: a nearer entry may exist
+                heapq.heappop(self._recheck_heap)
+            try:
+                self._recheck_one(node_id, drop_ts)
+            except Exception:  # noqa: BLE001 — a vanished node (scale-
+                # down race) must not kill the shared scheduler thread
+                logger.exception("conn-drop recheck for node %s failed",
+                                 node_id)
+
+    def _recheck_one(self, node_id: int, drop_ts: float) -> None:
+        n = self.get_node(node_id)
+        if (
+            n.status == NodeStatus.RUNNING
+            and not n.is_released
+            and n.contact_time < drop_ts  # master clock both sides
+        ):
+            logger.warning(
+                "node %s made no contact in the grace window since its "
+                "connection dropped — marking failed", node_id,
+            )
+            n.exit_reason = NodeExitReason.NO_HEARTBEAT
+            self.update_node_status(node_id, NodeStatus.FAILED)
 
     def fail_job(self, reason: str) -> None:
         """Fail the whole job (pre-check failure, abort actions)."""

@@ -111,6 +111,36 @@ class TestPreCheck:
         assert runner.status()[0] == PreCheckStatus.PASS
         assert runner.run(self._manager())
 
+    def test_failed_scheduling_relaunches_then_passes(self):
+        """The recovery round (reference failed_actions:336): a node
+        stuck Pending past the deadline is relaunched master-side — on
+        the no-budget KILLED path — and the re-check passes once the
+        replacement contacts the master."""
+
+        class ReplacingScaler:
+            def __init__(self, jm_ref):
+                self.jm = jm_ref
+                self.relaunched = []
+
+            def relaunch_node(self, node):
+                self.relaunched.append(node.id)
+                # the replacement pod schedules and contacts the master
+                self.jm[0].record_node_contact(node.id)
+
+            def remove_node(self, node):
+                pass
+
+        jm_box = []
+        scaler = ReplacingScaler(jm_box)
+        jm = JobManager("t", 2, scaler=scaler)
+        jm_box.append(jm)
+        jm._job_stage = "running"
+        jm.nodes[0].update_status(NodeStatus.RUNNING)
+        jm.nodes[0].heartbeat_time = time.time()
+        runner = PreCheckRunner([SchedulingPreCheckOperator(timeout_s=0)])
+        assert runner.run(jm)
+        assert scaler.relaunched == [1]
+
 
 class TestHangDetection:
     def test_no_stall_no_action(self):

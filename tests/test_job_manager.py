@@ -262,6 +262,34 @@ def test_raw_contact_defuses_drop_recheck():
         get_context().set("heartbeat_interval_s", 15.0)
 
 
+def test_mass_connection_drops_share_one_recheck_thread():
+    """A whole rack disconnecting at once must coalesce into ONE
+    scheduler thread draining the grace heap — not a Timer thread per
+    drop — and every un-recontacted node must still be declared dead."""
+    import threading as _threading
+
+    from dlrover_tpu.common.config import get_context
+
+    get_context().set("conn_drop_grace_s", 0.2)
+    get_context().set("heartbeat_interval_s", 0.05)
+    try:
+        jm, scaler = make_manager(n=16)
+        before = _threading.active_count()
+        for node in jm.nodes.values():
+            node.contact_time = time.time()
+        for node_id in jm.nodes:
+            jm.report_connection_lost(node_id)
+        # all 16 drops ride the single recheck thread
+        assert _threading.active_count() <= before + 1
+        time.sleep(0.8)
+        for node in jm.nodes.values():
+            assert node.exit_reason == NodeExitReason.NO_HEARTBEAT
+        assert sorted(scaler.relaunched) == sorted(jm.nodes)
+    finally:
+        get_context().set("conn_drop_grace_s", 1.0)
+        get_context().set("heartbeat_interval_s", 15.0)
+
+
 def test_oom_override_reaches_pod_spec():
     """The grown memory must actually render into the replacement pod
     (not just the Node object)."""
