@@ -262,7 +262,13 @@ class AsyncCheckpointSaver:
         # stale frame must hold the commit quorum open
         self._write_done_files(path, step, persisted)
         if self._is_commit_leader:
-            self.commit_checkpoint(path, step)
+            # quorum size rides in the frame meta (engine._plan_state):
+            # a single-writer job's commit must wait for its one frame,
+            # not one per host
+            meta = persisted[0].read_meta() or {}
+            self.commit_checkpoint(
+                path, step, expected_frames=meta.get("expected_frames"),
+            )
 
     def _frame_lock(self, shm: SharedMemoryHandler):
         """The per-frame lock the worker writes under — the agent takes it
@@ -321,11 +327,16 @@ class AsyncCheckpointSaver:
             self._storage.write("1", done)
 
     def commit_checkpoint(
-        self, path: str, step: int, timeout_s: Optional[float] = None
+        self, path: str, step: int, timeout_s: Optional[float] = None,
+        expected_frames: Optional[int] = None,
     ) -> bool:
-        """Wait for all hosts' done files, then move the tracker
-        (reference ``commit_checkpoint``:992)."""
+        """Wait for all expected done files, then move the tracker
+        (reference ``commit_checkpoint``:992). ``expected_frames``
+        overrides the world-derived default — the saver group's size as
+        recorded in the frame meta (a single-writer job commits on ONE
+        frame regardless of world size)."""
         timeout_s = timeout_s or CheckpointConstant.SAVE_TIMEOUT_S
+        expected = expected_frames or self._expected_frames
         done_dir = os.path.join(step_dir(path, step), CheckpointConstant.DONE_DIR)
         poll = get_context().ckpt_commit_poll_s
         deadline = time.time() + timeout_s
@@ -334,7 +345,7 @@ class AsyncCheckpointSaver:
                 f for f in self._storage.listdir(done_dir)
                 if f.startswith("done_")
             ])
-            if count >= self._expected_frames:
+            if count >= expected:
                 # monotonic: a late commit (e.g. an async breakpoint
                 # commit whose quorum filled after training resumed and
                 # committed a NEWER step) must never move the restore

@@ -530,6 +530,13 @@ class CheckpointEngine:
             "local_rank": self.local_rank,
             "rank": self.rank,
             "world_size": self.world_size,
+            # commit quorum = the SAVER GROUP's size, carried with the
+            # frame: the agent-side commit must not wait for one frame
+            # per host when a single-writer (saving_ranks=[0]) job only
+            # ever produces one — that mismatch held every commit open
+            # for the full timeout at world>1 and starved the persist
+            # loop behind it
+            "expected_frames": len(self.saving_ranks),
             "leaves": leaves_meta,
         }
         return meta, pending
