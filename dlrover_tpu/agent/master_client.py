@@ -92,6 +92,14 @@ class MasterClient:
         )
         return resp.data["nodes"], resp.data["reason"]
 
+    def clear_node_check(self) -> None:
+        """Start a fresh check session for THIS node (drops its sticky
+        round results on the master)."""
+        self._client.call(
+            "clear_node_check",
+            comm.NetworkReadyRequest(node_id=self._node_rank),
+        )
+
     def check_straggler(self) -> List[int]:
         resp = self._client.call(
             "check_straggler", comm.StragglerExistRequest(node_id=self._node_id)

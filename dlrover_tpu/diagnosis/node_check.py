@@ -86,7 +86,7 @@ def tcp_pair_benchmark(
     node_rank: int,
     group: Dict[int, NodeMeta],
     payload_mb: float = 4.0,
-    timeout_s: float = 60.0,
+    timeout_s: float = 0.0,
 ) -> float:
     """All-to-one echo over DCN within a pair group; returns seconds.
 
@@ -98,6 +98,11 @@ def tcp_pair_benchmark(
     ranks = sorted(group)
     if len(ranks) < 2:
         return 0.0
+    if not timeout_s:
+        # a pair whose partner died pre-connect costs this whole window;
+        # chaos/e2e drills shrink it (default matches the reference's
+        # 60s gloo store timeout)
+        timeout_s = float(os.getenv("DLROVER_TPU_CHECK_TIMEOUT_S", "60"))
     payload = os.urandom(int(payload_mb * 1024 * 1024))
     leader = ranks[0]
     leader_meta = group[leader]

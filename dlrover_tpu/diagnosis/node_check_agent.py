@@ -68,6 +68,12 @@ def run_node_check(
 ) -> bool:
     """Run up to two check rounds; returns False if THIS node is deemed
     faulty (or an excluded straggler)."""
+    try:
+        # fresh session: this node's previous-session results must not
+        # ride into the new verdict (a re-sickened host re-proves health)
+        client.clear_node_check()
+    except RuntimeError:
+        pass  # older master without the RPC — verdicts still work
     _one_check_round(config, client, 1, matmul_size, payload_mb)
     faults, reason = _wait_verdict(client)
     if faults:

@@ -262,16 +262,18 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         self._fault_nodes: List[int] = []
         self._straggler_nodes: List[int] = []
 
-    def join_rendezvous(self, meta) -> int:
-        """A healthy node re-joining starts a fresh check: drop its sticky
-        pass so a replaced/re-sickened host can't ride an old verdict. A
-        *failed* node keeps its False — round-2 re-pairing and the
-        passed-in-any-round exoneration depend on it."""
+    def clear_node_check(self, node_rank: int) -> None:
+        """Drop this node's check state — called by the agent when it
+        STARTS a check session (round 1), so a replaced/re-sickened host
+        re-proves health instead of riding an old pass. Session freshness
+        is this explicit call, NOT a join-time reset: joins also happen
+        for round 2, where wiping a healthy node's round-1 pass would
+        defeat the passed-in-any-round exoneration (a good node paired
+        with the bad one in round 2 fails that round through no fault of
+        its own)."""
         with self._lock:
-            if self._node_status.get(meta.node_rank) is True:
-                del self._node_status[meta.node_rank]
-            self._node_times.pop(meta.node_rank, None)
-        return super().join_rendezvous(meta)
+            self._node_status.pop(node_rank, None)
+            self._node_times.pop(node_rank, None)
 
     def get_comm_world(
         self, node_rank: int
@@ -328,12 +330,19 @@ class NetworkCheckRendezvousManager(RendezvousManager):
 
     def check_fault_node(self) -> Tuple[List[int], str]:
         """Return (fault_node_ranks, reason); empty reason ⇒ verdict ready
-        (reference :720)."""
+        (reference :720).
+
+        The expected cohort is the last COMPLETED check round's world
+        (``_latest_rdzv_nodes``), never the currently-forming round's
+        node set: a fast node polling the verdict while a slow peer is
+        already joining the next round must not see a shrunken/empty
+        cohort and read it as "no faults" — that race let a
+        mock-faulted node skip round 2 and pass the check."""
         with self._lock:
-            if not self._rdzv_nodes:
+            if not self._latest_rdzv_nodes:
                 return [], NetworkFailureReason.NO_INIT
             reported = set(self._node_status)
-            expected = set(self._rdzv_nodes)
+            expected = set(self._latest_rdzv_nodes)
             if not expected.issubset(reported):
                 return [], NetworkFailureReason.WAITING_NODE
             faults = sorted(

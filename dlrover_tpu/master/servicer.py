@@ -99,6 +99,13 @@ class MasterServicer:
         faults, reason = manager.check_fault_node()
         return comm.BaseResponse(data={"nodes": faults, "reason": reason})
 
+    def rpc_clear_node_check(
+        self, req: comm.NetworkReadyRequest
+    ) -> comm.BaseResponse:
+        manager = self._rdzv_managers[RendezvousName.NODE_CHECK]
+        manager.clear_node_check(req.node_id)
+        return comm.BaseResponse()
+
     def rpc_check_straggler(
         self, req: comm.StragglerExistRequest
     ) -> comm.BaseResponse:

@@ -107,6 +107,14 @@ with open(log_path, "a") as f:
                         "w_at_start": float(np.asarray(state["w"])[0]),
                         }) + "\\n")
 em = get_emitter(f"worker_{ctx.rank}")
+# second fault type: a WEDGED worker (drill --hang-at-step). Rank 0 stops
+# stepping OUTSIDE any span (so the stall is unproductive time, honestly
+# accounted); its peer then blocks inside the next step's collective. The
+# master's hang diagnostician sees the global step stall, broadcasts
+# RESTART_WORKER, and the agents soft-restart both workers from the
+# checkpoint. The marker file makes the fault one-shot across restarts.
+hang_at = int(os.environ.get("DTPU_CHAOS_HANG_AT_STEP", "0"))
+hang_marker = os.environ.get("DTPU_CHAOS_HANG_MARKER", "")
 for s in range(start, steps):
     with em.span(TrainEvent.TRAINING, step=s, world=world):
         w = train_step(w, x)
@@ -119,6 +127,14 @@ for s in range(start, steps):
             StorageType.DISK,
         )
     ctx.report_step(s)
+    if (hang_at and hang_marker and s >= hang_at and ctx.rank == 0
+            and not os.path.exists(hang_marker)):
+        with open(hang_marker, "w") as mf:
+            mf.write(str(time.time()))
+        with open(log_path, "a") as f:
+            f.write(json.dumps({"event": "hang_start", "step": s,
+                                "rank": ctx.rank}) + "\\n")
+        time.sleep(3600)  # wedged until the watchdog restart kills us
 with open(log_path, "a") as f:
     f.write(json.dumps({"event": "done", "rank": ctx.rank, "world": world,
                         "w_final": float(np.asarray(jax.device_get(w))[0]),
@@ -170,6 +186,13 @@ def main(argv=None) -> int:
     parser.add_argument("--steps", type=int, default=60)
     parser.add_argument("--step-time", type=float, default=0.15)
     parser.add_argument("--kill-at-step", type=int, default=10)
+    parser.add_argument(
+        "--hang-at-step", type=int, default=0,
+        help="second fault type: rank 0 wedges at this step; the master's "
+        "hang diagnostician must detect the stall and restart the "
+        "workers (0 = disabled)",
+    )
+    parser.add_argument("--hang-downtime", type=float, default=4.0)
     parser.add_argument("--global-batch", type=int, default=8)
     parser.add_argument("--keep-workdir", action="store_true")
     args = parser.parse_args(argv)
@@ -182,6 +205,13 @@ def main(argv=None) -> int:
     ctx = get_context()
     ctx.heartbeat_interval_s = 0.5
     ctx.heartbeat_timeout_s = 3.0
+    if args.hang_at_step:
+        # the hang watchdog must out-wait a normal step but beat the
+        # drill's timescale; re-rendezvous resets the PerfMonitor, so
+        # recovery windows (no steps yet) can't false-trigger it
+        ctx.hang_downtime_s = args.hang_downtime
+        ctx.diagnosis_interval_s = 1.0
+        ctx.hang_restart_workers = True
 
     workdir = tempfile.mkdtemp(prefix="dtpu_chaos_")
     event_dir = os.path.join(workdir, "events")
@@ -198,8 +228,13 @@ def main(argv=None) -> int:
     )
     master.prepare()
 
+    hang_marker = os.path.join(workdir, "hang.marker")
+
     def start_agent(rank):
         env = dict(os.environ)
+        if args.hang_at_step:
+            env["DTPU_CHAOS_HANG_AT_STEP"] = str(args.hang_at_step)
+            env["DTPU_CHAOS_HANG_MARKER"] = hang_marker
         env.update({
             "JAX_PLATFORMS": "cpu",
             # exactly ONE device per worker process: the joint world's
@@ -289,12 +324,37 @@ def main(argv=None) -> int:
             90, "world scaled back to 2",
         )
 
-        # phase 4: run to completion
+        # phase 3b (second fault type): rank 0 wedges at --hang-at-step;
+        # the master's hang diagnostician must notice the step stall and
+        # broadcast a worker restart — the watchdog recovery path, where
+        # the SIGKILL above exercised the connection-drop path
+        hang_recover_s = None
+        if args.hang_at_step:
+            _wait(
+                lambda: any(
+                    r["event"] == "hang_start"
+                    for r in _read_log(log_path)
+                ),
+                # generous: reaching the hang step takes steps*step_time
+                60 + args.steps * (args.step_time + 0.6),
+                "worker wedge (hang fault)",
+            )
+            with open(hang_marker) as mf:
+                hang_ts = float(mf.read().strip())
+            _wait(
+                lambda: master.perf_monitor.completed_global_step
+                > args.hang_at_step + 1,
+                120, "watchdog restart resumed training past the hang",
+            )
+            hang_recover_s = time.time() - hang_ts
+
+        # phase 4: run to completion (timeout scaled to the drill length)
         _wait(
             lambda: any(
                 r["event"] == "done" for r in _read_log(log_path)
             ),
-            180, "training completion",
+            max(180, args.steps * (args.step_time + 0.6)),
+            "training completion",
         )
         for p in agents.values():
             try:
@@ -323,6 +383,12 @@ def main(argv=None) -> int:
             "productive_s": round(goodput["productive_s"], 2),
             "detect_s": round(detect_s, 2),
             "shrink_detect_s": round(shrink_s, 2),
+            "faults_injected": 2 if args.hang_at_step else 1,
+            # wedge -> watchdog stall detection -> broadcast restart ->
+            # training resumed past the hang step (None = fault disabled)
+            "hang_recover_s": (
+                round(hang_recover_s, 2) if hang_recover_s else None
+            ),
             "step_at_shrink": step_before_rejoin,
             "final_step": master.perf_monitor.completed_global_step,
             "segments": segments,

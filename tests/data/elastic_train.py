@@ -7,6 +7,7 @@ file reveals whether the restarted run actually resumed from a checkpoint.
 
 import os
 import sys
+import time
 
 import jax.numpy as jnp
 
@@ -15,19 +16,27 @@ from dlrover_tpu.ckpt import Checkpointer, StorageType
 
 ctx = worker.init()
 ckpt_dir, out_file = sys.argv[1], sys.argv[2]
+if ctx.world_size > 1:
+    out_file = f"{out_file}.r{ctx.rank}"  # one output per rank
 crash_step = int(os.getenv("CRASH_AT_STEP", "-1"))
+step_time = float(os.getenv("STEP_TIME_S", "0"))
 if os.getenv("CRASH_IMMEDIATELY") == "1":
     os._exit(7)
 
 state = {"w": jnp.zeros((4, 4), jnp.float32), "step": 0}
-ckpt = Checkpointer(ckpt_dir)
+# single-writer: rank 0 owns the (replicated) toy state, so a restore
+# works across world-size changes (scale-up tests re-rendezvous 1 -> 2)
+ckpt = Checkpointer(ckpt_dir, saving_ranks=[0])
 state, step = ckpt.load_checkpoint(state)
 start = step + 1 if step >= 0 else 0
 
 for s in range(start, 10):
     state = {"w": state["w"] + 1.0, "step": s}
-    ckpt.save_checkpoint(s, state, StorageType.DISK)
+    if ctx.rank == 0:
+        ckpt.save_checkpoint(s, state, StorageType.DISK)
     ctx.report_step(s)
+    if step_time:
+        time.sleep(step_time)  # pace scale-up drills
     if s == crash_step and (
         ctx.restart_count == 0 or os.getenv("ALWAYS_CRASH") == "1"
     ):
@@ -36,5 +45,5 @@ for s in range(start, 10):
 
 with open(out_file, "w") as f:
     f.write(f"done w={float(state['w'][0, 0])} start={start} "
-            f"restarts={ctx.restart_count}")
+            f"restarts={ctx.restart_count} world={ctx.world_size}")
 print("training complete", flush=True)

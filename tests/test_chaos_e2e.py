@@ -60,14 +60,53 @@ def test_chaos_kill_shrink_resume_rejoin():
     # shrink/rejoin (collectives stayed correct at every world size)
     assert result["w_final"] == 60.0
     # fault DETECTION rides the heartbeat-connection drop (grace recheck),
-    # not the heartbeat timeout: ~conn_drop_grace_s, with CI headroom
-    assert result["detect_s"] <= 3.0, result["detect_s"]
+    # not the heartbeat timeout: ~conn_drop_grace_s (1.2s measured)
+    assert result["detect_s"] <= 2.0, result["detect_s"]
     # kill -> world-1 training resumed (detect + restart + re-rendezvous +
-    # re-init + restore + recompile), with CI headroom over the ~5s local
-    assert result["shrink_detect_s"] <= 15.0, result["shrink_detect_s"]
+    # re-init + restore + recompile): 4.6-4.8s measured, 2x CI headroom
+    assert result["shrink_detect_s"] <= 10.0, result["shrink_detect_s"]
     # the goodput numbers exist and are sane
     assert 0 < result["goodput_pct"] <= 100
     # per-fault recovery cost at production scale clears the reference bar
     # — now including REAL restore + recompile + collective costs, not
     # sleep-loop orchestration overhead only
     assert result["goodput_1h_extrapolated_pct"] >= 95.0
+
+
+def test_chaos_direct_goodput_two_faults():
+    """The reference's >=95% goodput bar measured DIRECTLY — no 1-hour
+    extrapolation: a ~10-minute drill with TWO fault types (agent
+    SIGKILL through the connection-drop path, then a wedged worker
+    through the hang-watchdog path) must keep the measured
+    productive-fraction of wall time at or above 95%.
+
+    (Reference: 69%->95% goodput claim, README.md:55-57, proven there
+    with multi-node chaos experiments,
+    docs/tech_report/fault_tolerance_exps.md.)"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "examples", "chaos_goodput.py"),
+            "--steps", "1100", "--step-time", "0.45",
+            "--kill-at-step", "50", "--hang-at-step", "800",
+            "--hang-downtime", "3",
+        ],
+        env=env, capture_output=True, text=True, timeout=1500, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["faults_injected"] == 2
+    # the drill ran long enough that the direct number is meaningful
+    assert result["wall_s"] >= 180.0, result["wall_s"]
+    # both recovery paths fired
+    assert result["detect_s"] <= 2.0, result["detect_s"]
+    assert result["hang_recover_s"] is not None
+    assert result["hang_recover_s"] <= 30.0, result["hang_recover_s"]
+    # every step completed exactly once across both faults
+    assert result["final_step"] == 1099
+    assert result["w_final"] == 1100.0
+    assert result["psum_ok"] is True
+    # THE bar: measured goodput, no extrapolation
+    assert result["goodput_pct"] >= 95.0, result
