@@ -52,6 +52,12 @@ class ElasticLaunchConfig:
     # and serve per-rank metrics; the agent runs the per-host aggregation
     # daemon on :18889 (reference xpu_timer_launch LD_PRELOAD + daemon)
     tpu_timer: bool = False
+    # start this node's unified-runtime actor-host daemon and register it
+    # with the master, so a unified job submitted with
+    # submit(master_addr=...) can place actors on every node without a
+    # hand-built hosts map (unified/remote.py; reference: Ray supplies
+    # this placement layer, unified/master/scheduler.py:161)
+    actor_host: bool = False
 
     def auto_configure_params(self) -> None:
         """Fill topology-dependent defaults from the environment

@@ -70,6 +70,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="poll master-tuned dataloader/grad-accum config")
     p.add_argument("--no-save-at-breakpoint", dest="save_at_breakpoint",
                    action="store_false")
+    p.add_argument("--actor-host", dest="actor_host", action="store_true",
+                   help="start this node's unified-runtime actor-host "
+                   "daemon and register it with the master (multi-host "
+                   "unified jobs; needs $DTPU_ACTOR_HOST_SECRET for a "
+                   "non-loopback bind)")
     p.add_argument("--tpu-timer", dest="tpu_timer", action="store_true",
                    help="enable the native profiler plane: workers patch "
                         "the PJRT table, agent aggregates on :18889")
@@ -98,6 +103,7 @@ def config_from_args(args) -> ElasticLaunchConfig:
         ckpt_replica=args.ckpt_replica,
         auto_tunning=args.auto_tunning,
         tpu_timer=args.tpu_timer,
+        actor_host=args.actor_host,
         entrypoint=args.entrypoint,
         args=args.args[1:] if args.args[:1] == ["--"] else list(args.args),
     )
@@ -166,8 +172,43 @@ def _apply_master_run_config(client: MasterClient,
                            "ignored (version skew?)", key)
 
 
+def _launch_actor_host(config: ElasticLaunchConfig):
+    """Per-node unified-runtime daemon, registered with the master
+    (reference: Ray's node-level raylet gives the unified scheduler its
+    placement layer for free; here the agent owns that daemon). Binds
+    all interfaces only when a spawn-auth secret is present — otherwise
+    loopback (the single-host dev shape)."""
+    import subprocess
+
+    secure = bool(os.environ.get("DTPU_ACTOR_HOST_SECRET"))
+    host = "0.0.0.0" if secure else "127.0.0.1"
+    cmd = [
+        sys.executable, "-m", "dlrover_tpu.unified.remote",
+        "--port", "0", "--host", host,
+    ]
+    if secure:
+        cmd += [
+            "--master-addr", config.master_addr,
+            "--job-name", config.job_name,
+            "--node-rank", str(config.node_rank),
+        ]
+    else:
+        # loopback daemon: do NOT register it with the master — a
+        # 127.0.0.1 address in the placement map would point remote
+        # submitters at their own host (or a colliding local port); a
+        # missing registration fails resolution loudly instead
+        logger.warning(
+            "--actor-host without $DTPU_ACTOR_HOST_SECRET: daemon binds "
+            "loopback and is NOT registered with the master — remote "
+            "nodes cannot place actors here"
+        )
+    proc = subprocess.Popen(cmd)
+    return proc
+
+
 def run(config: ElasticLaunchConfig) -> int:
     master = None
+    actor_host_proc = None
     if config.master_addr == "":
         master = _launch_local_master(config)
         logger.info("standalone master at %s", config.master_addr)
@@ -175,6 +216,8 @@ def run(config: ElasticLaunchConfig) -> int:
         config.master_addr, config.node_id, config.node_rank
     )
     try:
+        if config.actor_host:
+            actor_host_proc = _launch_actor_host(config)
         _apply_master_run_config(client, config)
         wait_pre_check(client)
         if config.network_check:
@@ -201,6 +244,12 @@ def run(config: ElasticLaunchConfig) -> int:
         agent = ElasticTrainingAgent(config, client, ckpt_saver=saver)
         return agent.run()
     finally:
+        if actor_host_proc is not None:
+            actor_host_proc.terminate()
+            try:
+                actor_host_proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — escalate, never hang exit
+                actor_host_proc.kill()
         if master is not None:
             master.stop()
 

@@ -56,7 +56,24 @@ def worker_pod(
         {"name": EnvKey.NODE_RANK, "value": str(node_id)},
         {"name": "NODE_RANK", "value": str(node_id)},
     ]
-    env += [{"name": k, "value": v} for k, v in spec.env.items()]
+    def _env_entry(name: str, value: str) -> Dict:
+        # "secret:<secret-name>:<key>" renders a secretKeyRef instead of a
+        # literal — secrets (e.g. DTPU_ACTOR_HOST_SECRET, the unified
+        # actor-host spawn auth) must never sit in the CR as plaintext
+        if isinstance(value, str) and value.startswith("secret:"):
+            parts = value.split(":", 2)
+            if len(parts) != 3 or not parts[1] or not parts[2]:
+                raise ValueError(
+                    f"env {name!r}: {value!r} does not match "
+                    f"'secret:<secret-name>:<key>' (a literal value must "
+                    f"not start with 'secret:')"
+                )
+            return {"name": name, "valueFrom": {
+                "secretKeyRef": {"name": parts[1], "key": parts[2]}
+            }}
+        return {"name": name, "value": value}
+
+    env += [_env_entry(k, v) for k, v in spec.env.items()]
     memory_mb = spec.memory_mb
     cpu = spec.cpu
     if resource_override is not None:

@@ -103,16 +103,22 @@ class DLJob:
     collocations: List[Set[str]]
 
     def submit(self, job_name: str = "unified", backend: str = "process",
-               timeout_s: float = 300.0, hosts=None) -> int:
+               timeout_s: float = 300.0, hosts=None,
+               master_addr: str = "", cluster_job: str = "") -> int:
         """Run to completion under an in-proc UnifiedMaster (reference
         driver/main.py submits to a Ray-actor master). Returns exit code.
 
         ``hosts``: optional {node_index: actor-host daemon addr} for
-        multi-node placement (unified/remote.py)."""
+        multi-node placement (unified/remote.py). ``master_addr``: the
+        deployed-cluster alternative — resolve that map from a live job
+        master's KV, where each node's daemon registered itself under
+        the ELASTIC job's name; pass that name as ``cluster_job`` when
+        it differs from this unified ``job_name``."""
         from dlrover_tpu.unified.master import UnifiedMaster
 
         master = UnifiedMaster(self, job_name=job_name, backend=backend,
-                               hosts=hosts)
+                               hosts=hosts, master_addr=master_addr,
+                               cluster_job=cluster_job)
         return master.run(timeout_s=timeout_s)
 
 

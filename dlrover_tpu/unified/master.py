@@ -12,6 +12,7 @@ Two stream shapes (reference DLStreamType):
 """
 
 import importlib
+import os
 import time
 from typing import Dict, Optional
 
@@ -31,20 +32,37 @@ class UnifiedMaster:
     def __init__(self, job: DLJob, job_name: str = "unified",
                  backend: str = "process", max_restarts: int = 3,
                  start_method: str = "forkserver",
-                 hosts: Optional[Dict[int, str]] = None):
+                 hosts: Optional[Dict[int, str]] = None,
+                 master_addr: str = "", cluster_job: str = ""):
         """``hosts`` maps placement node_index → that node's actor-host
         daemon address (unified/remote.py); mapped nodes get their actors
         spawned remotely, unmapped ones locally — so a laptop run and a
-        multi-host run are the same job description."""
+        multi-host run are the same job description.
+
+        ``master_addr``: resolve ``hosts`` from a live job master's KV
+        instead of a hand-built dict — each node's agent (dtpu-run
+        --actor-host) or the daemon CLI registers its daemon there, which
+        is the deployed-cluster path (reference: Ray GCS placement,
+        unified/master/scheduler.py:161). Daemons register under the
+        ELASTIC job's name (the dtpu-run --job_name), which may differ
+        from this unified job's ``job_name`` — pass it as
+        ``cluster_job`` when it does (defaults to ``job_name``). The
+        spawn-auth secret rides $DTPU_ACTOR_HOST_SECRET on both sides."""
         if backend != "process":
             raise ValueError(f"unknown backend {backend!r} "
                              "(ray backend: not in this build)")
+        if hosts is None and master_addr:
+            from dlrover_tpu.unified.remote import hosts_from_master
+
+            hosts = hosts_from_master(
+                master_addr, cluster_job or job_name, job.node_num)
         self.job = job
         self.job_name = job_name
         self.graph = ExecutionGraph(job)
         self.placement = HostFillPlacement(self.graph)
         self.scheduler = ProcessScheduler(
             self.graph, job_name, start_method=start_method, hosts=hosts,
+            host_secret=os.environ.get("DTPU_ACTOR_HOST_SECRET", ""),
         )
         self.failover = FailoverCoordinator(self.scheduler, max_restarts)
 

@@ -238,6 +238,55 @@ def serve_actor_host(port: int = 0, host: str = "0.0.0.0",
     return server, servicer
 
 
+def register_with_master(master_addr: str, job_name: str, node_rank: int,
+                         advertise_addr: str) -> None:
+    """Publish this node's daemon address in the job master's KV store —
+    the cluster-wiring step Ray's GCS does for the reference
+    (unified/master/scheduler.py:161 gets placement for free from Ray).
+    The unified scheduler resolves ``{node_rank: addr}`` back out with
+    :func:`hosts_from_master`."""
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    client = MasterClient(master_addr, node_id=node_rank,
+                          node_rank=node_rank)
+    client.kv_set(f"unified/{job_name}/hosts/{node_rank}",
+                  advertise_addr.encode())
+    logger.info("actor host registered with master %s as node %s -> %s",
+                master_addr, node_rank, advertise_addr)
+
+
+def hosts_from_master(master_addr: str, job_name: str, node_num: int,
+                      timeout_s: float = 60.0) -> Dict[int, str]:
+    """Resolve the {node_index: daemon addr} placement map from a live
+    master's KV store, waiting for all ``node_num`` daemons to register
+    (agents start daemons asynchronously at bootstrap)."""
+    import time
+
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    client = MasterClient(master_addr, node_id=-1, node_rank=-1)
+    deadline = time.time() + timeout_s
+    hosts: Dict[int, str] = {}
+    while True:
+        for rank in range(node_num):
+            if rank in hosts:
+                continue
+            val = client.kv_get(f"unified/{job_name}/hosts/{rank}")
+            if val:
+                hosts[rank] = val.decode()
+        if len(hosts) == node_num:
+            return hosts
+        if time.time() >= deadline:
+            raise TimeoutError(
+                f"only {sorted(hosts)} of {node_num} actor-host daemons "
+                f"registered under unified/{job_name}/hosts/ on "
+                f"{master_addr} within {timeout_s}s — check that the "
+                f"daemons were started with THIS job name (daemons "
+                f"register under the elastic job's --job_name)"
+            )
+        time.sleep(0.5)
+
+
 def main(argv=None) -> int:
     """``dtpu-actor-host`` CLI — one per node of a unified job."""
     import argparse
@@ -251,6 +300,15 @@ def main(argv=None) -> int:
         help="file holding the spawn-auth secret (required unless --host "
         "is loopback); also readable from $DTPU_ACTOR_HOST_SECRET",
     )
+    parser.add_argument(
+        "--master-addr", default="",
+        help="job master RPC address; when given (with --job-name and "
+        "--node-rank) the daemon registers itself in the master KV so "
+        "the unified scheduler can resolve placement without a "
+        "hand-built hosts map",
+    )
+    parser.add_argument("--job-name", default="")
+    parser.add_argument("--node-rank", type=int, default=0)
     args = parser.parse_args(argv)
     secret = os.environ.get("DTPU_ACTOR_HOST_SECRET", "")
     if args.secret_file:
@@ -260,7 +318,23 @@ def main(argv=None) -> int:
         server, servicer = serve_actor_host(args.port, args.host, secret)
     except ValueError as e:
         parser.error(str(e))
+    if args.master_addr:
+        from dlrover_tpu.common.rpc import local_host_ip
+
+        ip = (args.host if args.host not in ("0.0.0.0", "::", "")
+              else local_host_ip())
+        register_with_master(args.master_addr, args.job_name,
+                             args.node_rank, f"{ip}:{server.port}")
     print(f"actor host ready on {server.port}", flush=True)
+    # SIGTERM (the agent's shutdown path) must run the same cleanup as
+    # ^C: python's default SIGTERM action skips atexit, which would
+    # orphan this host's actor processes
+    import signal
+
+    def _term(*_):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _term)
     try:
         while True:
             time.sleep(3600)

@@ -86,6 +86,22 @@ def test_worker_pod_spec_tpu_resources():
     assert env["DLROVER_TPU_MASTER_ADDR"] == "10.0.0.1:50001"
 
 
+def test_worker_pod_secret_env_renders_secret_key_ref():
+    """'secret:<name>:<key>' env values become secretKeyRefs — the
+    actor-host spawn secret must never land in the pod spec as a
+    literal."""
+    spec = worker_spec()
+    spec.env["DTPU_ACTOR_HOST_SECRET"] = "secret:dlrover-actor-host:secret"
+    spec.env["PLAIN"] = "v"
+    pod = specs.worker_pod("j1", 0, spec, "m:1")
+    entries = {e["name"]: e for e in pod["spec"]["containers"][0]["env"]}
+    assert entries["DTPU_ACTOR_HOST_SECRET"]["valueFrom"] == {
+        "secretKeyRef": {"name": "dlrover-actor-host", "key": "secret"}
+    }
+    assert "value" not in entries["DTPU_ACTOR_HOST_SECRET"]
+    assert entries["PLAIN"]["value"] == "v"
+
+
 def test_pod_exit_reason_classification():
     assert pod_exit_reason(
         {"status": {"reason": "Preempted"}}
