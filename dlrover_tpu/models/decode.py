@@ -52,9 +52,16 @@ def flash_decode_wanted(T: int, quantized: bool,
                         live_len: Optional[int] = None) -> bool:
     """Should the single-token attend use the fused pallas kernel?
 
-    Auto policy (measured on v5e, commit 042625f + the int8 fusion):
-    - int8 cache → yes: in-VMEM dequant halves the cache HBM traffic the
-      step is bound by; the XLA path materializes a bf16 copy instead;
+    Auto policy (measured on v5e; r4 re-measurement on the per-layer
+    in-place cache):
+    - int8 cache → yes: the fused kernel reads int8 + scales straight
+      from HBM. On a fully-live cache the XLA dequant path has caught up
+      (r4: 157 vs 160 steps/s at 2k ctx — the in-place carry removed the
+      copies that made materialization expensive), so the kernel's edge
+      there is now the preallocated case, where it skips dead blocks.
+      Either int8 path trails tight bf16 by ~15% at 2k (dequant VPU work
+      + per-layer quantize): int8 is the CAPACITY knob (half the cache
+      HBM → twice the context), bf16 the throughput path;
     - bf16 cache → only when the cache is meaningfully larger than the
       live context (preallocated serving cache): the kernel skips blocks
       past ``pos`` at ~zero bandwidth, but XLA's batched matmul beats it
@@ -131,8 +138,10 @@ def init_kv_cache(config, batch: int, max_len: Optional[int] = None,
     (absmax over head_dim): the cache is the memory term that grows with
     context, so int8 DOUBLES the max context per HBM at ~0.4%
     per-element error (which the attention softmax washes out further).
-    The fused decode kernel dequantizes in VMEM (ops/flash_attention.py),
-    making int8 a throughput knob too, not just capacity.
+    int8 is the CAPACITY knob: since the per-layer in-place cache, tight
+    bf16 is ~15% faster at 2k ctx (the dequant work outweighs the saved
+    bandwidth — see flash_decode_wanted), so quantize when the context
+    must fit, not for speed.
     """
     c = config
     T = max_len or c.max_seq_len
@@ -391,14 +400,25 @@ def decode_step(params: Dict, token, cache: Dict,
 
 def sample_token(logits, key, temperature: float = 1.0, top_k: int = 0):
     """f32 categorical sampling; temperature 0 → greedy; top_k > 0 keeps
-    only the k best logits (both static Python values)."""
+    only the k best logits (both static Python values).
+
+    With top_k the categorical runs over the (B, k) TOP-K VALUES and the
+    choice maps back through the indices — not over a masked (B, V)
+    tensor: the full-vocab gumbel+reduction was ~0.6 ms/step at V=32k
+    (~12% of a 2k-ctx decode step on v5e), the k-wide one is free."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
     if top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, jnp.float32(-1e30), logits)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+        vals, idx = jax.lax.top_k(logits, top_k)        # (..., k)
+        choice = jax.random.categorical(
+            key, vals / temperature, axis=-1
+        )
+        return jnp.take_along_axis(
+            idx, choice[..., None], axis=-1
+        )[..., 0].astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits / temperature, axis=-1
+    ).astype(jnp.int32)
 
 
 def generate(params: Dict, prompt, config, key,

@@ -8,12 +8,14 @@ example shows:
 - one compiled program per (prompt length bucket, budget): batched
   prefill + a ``lax.scan`` of cached decode steps — no per-token python,
   no recompiles while serving a bucket;
-- the cache-strategy knobs and when each wins (measured, one v5e):
-  * default (tight bf16 cache) — highest throughput when HBM is ample:
-    2071 tok/s at batch 8 / short context on the 0.9B bench model;
-  * ``quantize_cache=True`` — int8 KV halves cache HBM; with the fused
-    in-VMEM dequant kernel (auto-selected) it is the FASTEST path at
-    long context (640 vs 619 tok/s at 2k) and doubles max context;
+- the cache-strategy knobs and when each wins (measured, one v5e; r4
+  per-layer in-place cache):
+  * default (tight bf16 cache) — the THROUGHPUT path: ~2250-2360 tok/s
+    short ctx / ~1630-1750 tok/s decode-only at 2k on the 0.9B bench
+    model (68-78% of the HBM roof);
+  * ``quantize_cache=True`` — the CAPACITY knob: int8 KV halves cache
+    HBM (double the max context per chip) at ~15% lower decode rate at
+    2k — the dequant work now outweighs the saved bandwidth;
   * ``max_len=...`` — preallocated serving cache; the fused kernel skips
     blocks past ``pos`` so an oversized cache costs ~nothing to read;
 - time-to-first-token is a separate prefill call you can overlap with

@@ -196,6 +196,41 @@ class TestNetworkCheck:
         faults, _ = m.check_fault_node()
         assert faults == [3]
 
+    def test_verdict_stable_while_next_round_forms(self):
+        """The verdict must judge against the last COMPLETED round's
+        cohort: a fast node polling check_fault_node while a slow peer
+        already joined the next round must NOT see a shrunken/empty
+        cohort and read 'no faults' (that race let a mock-faulted node
+        skip round 2 and pass its check)."""
+        m = self._manager(2)
+        for r in range(2):
+            m.get_comm_world(r)
+        m.report_network_check_result(0, True, 1.0)
+        m.report_network_check_result(1, False, 0.0)
+        assert m.check_fault_node() == ([1], "node_failure")
+        # node 0 joins round 2 (clears the forming node set) — node 1's
+        # poll must still see the round-1 verdict, not an empty cohort
+        m.join_rendezvous(_meta(0))
+        assert m.check_fault_node() == ([1], "node_failure")
+
+    def test_session_clear_is_per_node_and_explicit(self):
+        """clear_node_check drops ONE node's sticky results (fresh
+        session for a replaced/re-sickened host) without touching its
+        peers' round-1 passes — the exoneration data round 2 needs."""
+        m = self._manager(2)
+        for r in range(2):
+            m.get_comm_world(r)
+        m.report_network_check_result(0, True, 1.0)
+        m.report_network_check_result(1, False, 0.0)
+        assert m.check_fault_node()[0] == [1]
+        # node 1 is replaced; its agent starts a fresh session
+        m.clear_node_check(1)
+        assert m.check_fault_node() == ([], "waiting_node")  # must re-report
+        m.report_network_check_result(1, True, 1.0)
+        assert m.check_fault_node() == ([], "")
+        # and a node that passed before keeps that pass across the clear
+        assert m._node_status[0] is True
+
     def test_all_pass(self):
         m = self._manager(2)
         m.get_comm_world(0)
