@@ -59,8 +59,9 @@ def flash_decode_wanted(T: int, quantized: bool,
       (r4: 157 vs 160 steps/s at 2k ctx — the in-place carry removed the
       copies that made materialization expensive), so the kernel's edge
       there is now the preallocated case, where it skips dead blocks.
-      Either int8 path trails tight bf16 by ~15% at 2k (dequant VPU work
-      + per-layer quantize): int8 is the CAPACITY knob (half the cache
+      Either int8 path trails tight bf16 by 13-21% at 2k across runs
+      (dequant VPU work + per-layer quantize; the spread is tunnel-run
+      variance): int8 is the CAPACITY knob (half the cache
       HBM → twice the context), bf16 the throughput path;
     - bf16 cache → only when the cache is meaningfully larger than the
       live context (preallocated serving cache): the kernel skips blocks
@@ -139,9 +140,9 @@ def init_kv_cache(config, batch: int, max_len: Optional[int] = None,
     context, so int8 DOUBLES the max context per HBM at ~0.4%
     per-element error (which the attention softmax washes out further).
     int8 is the CAPACITY knob: since the per-layer in-place cache, tight
-    bf16 is ~15% faster at 2k ctx (the dequant work outweighs the saved
-    bandwidth — see flash_decode_wanted), so quantize when the context
-    must fit, not for speed.
+    bf16 is 13-21% faster at 2k ctx across runs — the dequant work
+    outweighs the saved bandwidth (see flash_decode_wanted) — so
+    quantize when the context must fit, not for speed.
     """
     c = config
     T = max_len or c.max_seq_len

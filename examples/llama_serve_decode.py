@@ -14,8 +14,8 @@ example shows:
     short ctx / ~1630-1750 tok/s decode-only at 2k on the 0.9B bench
     model (68-78% of the HBM roof);
   * ``quantize_cache=True`` — the CAPACITY knob: int8 KV halves cache
-    HBM (double the max context per chip) at ~15% lower decode rate at
-    2k — the dequant work now outweighs the saved bandwidth;
+    HBM (double the max context per chip) at 13-21% lower decode rate at
+    2k (run-to-run spread) — the dequant work now outweighs the saved bandwidth;
   * ``max_len=...`` — preallocated serving cache; the fused kernel skips
     blocks past ``pos`` so an oversized cache costs ~nothing to read;
 - time-to-first-token is a separate prefill call you can overlap with

@@ -274,3 +274,26 @@ def test_unified_placement_resolved_from_live_master(tmp_path):
             d.kill()
             d.wait(timeout=10)
         master.stop()
+
+
+def test_hosts_from_master_roundtrip_and_mismatch():
+    """register_with_master -> hosts_from_master resolve the placement
+    map through a live master KV; a wrong job name fails loudly with the
+    key prefix in the message (the silent-empty-map failure mode)."""
+    from dlrover_tpu.master.master import LocalJobMaster
+    from dlrover_tpu.unified.remote import (
+        hosts_from_master,
+        register_with_master,
+    )
+
+    master = LocalJobMaster(job_name="hfm", node_num=2)
+    master.prepare()
+    try:
+        register_with_master(master.addr, "hfm", 0, "10.0.0.1:8471")
+        register_with_master(master.addr, "hfm", 1, "10.0.0.2:8471")
+        hosts = hosts_from_master(master.addr, "hfm", 2, timeout_s=10)
+        assert hosts == {0: "10.0.0.1:8471", 1: "10.0.0.2:8471"}
+        with pytest.raises(TimeoutError, match="unified/wrongname/hosts"):
+            hosts_from_master(master.addr, "wrongname", 2, timeout_s=1.5)
+    finally:
+        master.stop()
