@@ -52,17 +52,17 @@ def flash_decode_wanted(T: int, quantized: bool,
                         live_len: Optional[int] = None) -> bool:
     """Should the single-token attend use the fused pallas kernel?
 
-    Auto policy (measured on v5e; r4 re-measurement on the per-layer
-    in-place cache):
-    - int8 cache → yes: the fused kernel reads int8 + scales straight
-      from HBM. On a fully-live cache the XLA dequant path has caught up
-      (r4: 157 vs 160 steps/s at 2k ctx — the in-place carry removed the
-      copies that made materialization expensive), so the kernel's edge
-      there is now the preallocated case, where it skips dead blocks.
-      Either int8 path trails tight bf16 by 13-21% at 2k across runs
-      (dequant VPU work + per-layer quantize; the spread is tunnel-run
-      variance): int8 is the CAPACITY knob (half the cache
-      HBM → twice the context), bf16 the throughput path;
+    Auto policy (measured on v5e; r4 final — fused-batch kernel grid +
+    scale-folding, ops/flash_attention.py):
+    - int8 cache → yes: the kernel reads int8 + per-vector scales
+      straight from HBM, converts in VMEM, and folds the scales into
+      the (rows x block) score/probability planes instead of scaling
+      the K/V blocks (head_dim x fewer VPU multiplies). At 2k ctx this
+      is the FASTEST decode path: 235-254 steps/s = 69-74% of the int8
+      roof (1881-2030 tok/s at batch 8) vs tight bf16's 1621-1754
+      tok/s across runs — int8 won every same-run pair by 14-25% — at
+      HALF the cache HBM: capacity AND throughput. The XLA dequant
+      path (kernel off) materializes a bf16 copy and trails both;
     - bf16 cache → only when the cache is meaningfully larger than the
       live context (preallocated serving cache): the kernel skips blocks
       past ``pos`` at ~zero bandwidth, but XLA's batched matmul beats it
@@ -139,10 +139,11 @@ def init_kv_cache(config, batch: int, max_len: Optional[int] = None,
     (absmax over head_dim): the cache is the memory term that grows with
     context, so int8 DOUBLES the max context per HBM at ~0.4%
     per-element error (which the attention softmax washes out further).
-    int8 is the CAPACITY knob: since the per-layer in-place cache, tight
-    bf16 is 13-21% faster at 2k ctx across runs — the dequant work
-    outweighs the saved bandwidth (see flash_decode_wanted) — so
-    quantize when the context must fit, not for speed.
+    int8 is the capacity knob AND (with the fused kernel's scale-folding,
+    r4 final) the long-context throughput path: at 2k ctx it decodes 14-25%
+    faster than tight bf16 (same-run pairs) — the saved bandwidth finally outruns the
+    dequant work — while short contexts are a wash (see
+    flash_decode_wanted for the measured numbers).
     """
     c = config
     T = max_len or c.max_seq_len

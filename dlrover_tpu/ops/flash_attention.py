@@ -471,7 +471,7 @@ def flash_attention(
 
 def _decode_kernel(
     pos_ref, q_ref, k_ref, v_ref, *rest,
-    scale: float, block_k: int, kv_heads: int, rows: int, quantized: bool,
+    scale: float, block_k: int, g_blk: int, rows: int, quantized: bool,
 ):
     if quantized:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
@@ -489,51 +489,57 @@ def _decode_kernel(
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _attend():
-        kv, rws = kv_heads, rows
-        # whole-block loads, ALL heads at once: the K/V block is fetched
-        # once, dequantized once, and the per-head matmuls run as ONE
-        # KV-batched dot_general — a python unroll over heads was 16
-        # separate (8, d)x(d, block_k) matmuls plus 16 sets of softmax
-        # bookkeeping per block, and measured SLOWER than XLA's einsum.
-        # The cache is head-major (models/decode.py init_kv_cache), so
-        # blocks arrive already batched by head — no in-VMEM transpose.
-        kt = k_ref[0].astype(jnp.float32)           # (KV, block_k, d)
-        vt = v_ref[0].astype(jnp.float32)
-        if quantized:
-            # dequantize IN VMEM: HBM saw only int8 values + one f32
-            # scale per vector — the bandwidth saving an XLA-level
-            # dequant spends by materializing the bf16 copy
-            kt = kt * ks_ref[0][:, :, None]
-            vt = vt * vs_ref[0][:, :, None]
-        q = q_ref[0].astype(jnp.float32)            # (KV, rows, d)
+        # whole-block loads over the FUSED (batch x kv-head) axis: one
+        # DMA fetches the K/V block for every batch row and head at
+        # once, dequantized once, and the per-group matmuls run as ONE
+        # batched dot_general. (History: a python unroll over heads was
+        # 16 separate matmuls and measured slower than XLA's einsum; a
+        # grid axis over batch (the r3 shape) paid per-grid-step
+        # overhead B times per block — fusing batch into the block cut
+        # the grid from B*nk to ~nk steps per call.) The cache is
+        # head-major (models/decode.py init_kv_cache), so blocks arrive
+        # already batched — no in-VMEM transpose.
+        g, rws = g_blk, rows
+        # int8 blocks: only the s8->f32 CONVERT touches every (row, d)
+        # element — the per-vector scales fold into the (rows x block_k)
+        # score/probability planes instead (ks into the QK columns, vs
+        # into p before the AV matmul), which is head_dim x fewer VPU
+        # multiplies than scaling the K/V blocks themselves. HBM still
+        # saw only int8 values + one f32 scale per vector.
+        kt = k_ref[:].astype(jnp.float32)           # (g_blk, block_k, d)
+        vt = v_ref[:].astype(jnp.float32)
+        q = q_ref[:].astype(jnp.float32)            # (g_blk, rows, d)
         s = jax.lax.dot_general(
             q, kt, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        ) * scale                                    # (KV, rows, block_k)
+        ) * scale                                    # (g_blk, rows, block_k)
+        if quantized:
+            s = s * ks_ref[:][:, None, :]
         colmask = (
             j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, 1, block_k), 2
             )
         ) <= pos
         s = jnp.where(colmask, s, NEG_INF)
-        m_prev = m_scr[:].reshape(kv, rws, LANES)
+        m_prev = m_scr[:].reshape(g, rws, LANES)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)           # lane-replicated
         p = jnp.where(colmask, jnp.exp(s - m_new[:, :, :1]), 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_scr[:] = (
-            l_scr[:].reshape(kv, rws, LANES) * alpha
+            l_scr[:].reshape(g, rws, LANES) * alpha
             + jnp.sum(p, axis=-1, keepdims=True)
-        ).reshape(kv * rws, LANES)
-        m_scr[:] = m_new.reshape(kv * rws, LANES)
+        ).reshape(g * rws, LANES)
+        m_scr[:] = m_new.reshape(g * rws, LANES)
         d = acc_scr.shape[-1]
+        pv = p * vs_ref[:][:, None, :] if quantized else p
         acc_scr[:] = (
-            acc_scr[:].reshape(kv, rws, d) * alpha[:, :, :1]
+            acc_scr[:].reshape(g, rws, d) * alpha[:, :, :1]
             + jax.lax.dot_general(
-                p, vt, (((2,), (1,)), ((0,), (0,))),
+                pv, vt, (((2,), (1,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
             )
-        ).reshape(kv * rws, d)
+        ).reshape(g * rws, d)
 
     # blocks fully past ``pos`` do no work (their index map also clamps,
     # so the pipeline re-targets an already-fetched block — ~no bandwidth)
@@ -542,10 +548,10 @@ def _decode_kernel(
     @pl.when(j == nk - 1)
     def _finish():
         d = acc_scr.shape[-1]
-        l = l_scr[:].reshape(kv_heads, rows, LANES)
+        l = l_scr[:].reshape(g_blk, rows, LANES)
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (
-            acc_scr[:].reshape(kv_heads, rows, d) / safe_l[:, :, :1]
+        o_ref[:] = (
+            acc_scr[:].reshape(g_blk, rows, d) / safe_l[:, :, :1]
         ).astype(o_ref.dtype)
 
 
@@ -590,55 +596,79 @@ def flash_decode_attention(
     rows = _round_up(G, 8)
     if rows != G:
         q = jnp.pad(q, ((0, 0), (0, 0), (0, rows - G), (0, 0)))
-    nk = T // block_k
+    # batch and kv-head fuse into ONE leading axis (free reshapes): a
+    # grid axis over batch made the pipeline pay per-grid-step overhead
+    # B times per K block — fused blocks make each DMA B*KV-wide and cut
+    # the grid to ~nk steps. bf16 blocks are 2x int8 bytes, so they use
+    # half the K width to hold the same VMEM footprint.
+    fused = B * KV
+    qf = q.reshape(fused, rows, Dh)
+    kf = k.reshape(fused, T, Dh)
+    vf = v.reshape(fused, T, Dh)
+    bk = block_k if quantized else max(128, block_k // 2)
+    if T % bk != 0:
+        # the halved bf16 width must still tile the cache — fall back to
+        # the caller-validated divisor rather than silently dropping the
+        # T % bk tail slots from attention
+        bk = block_k
+    # largest row-chunk of the fused axis whose K/V blocks stay ~<=1 MB
+    # each: k+v double-buffered is 4 of these in flight, plus scales/q/
+    # out/scratch, against the ~16 MB scoped-VMEM limit (2 MB blocks
+    # measured 17.45M > 16M on v5e)
+    limit = max(8, (1024 * 1024) // (bk * Dh * (1 if quantized else 2)))
+    g_blk = fused
+    while g_blk > limit and g_blk % 2 == 0:
+        g_blk //= 2
+    ng = fused // g_blk
+    nk = T // bk
     pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
 
     kernel = functools.partial(
-        _decode_kernel, scale=float(scale), block_k=int(block_k),
-        kv_heads=KV, rows=rows, quantized=quantized,
+        _decode_kernel, scale=float(scale), block_k=int(bk),
+        g_blk=g_blk, rows=rows, quantized=quantized,
     )
 
-    def _clamped(b, j, pos_ref):
-        return (b, 0, jnp.minimum(j, pos_ref[0] // block_k), 0)
+    def _clamped(i, j, pos_ref):
+        return (i, jnp.minimum(j, pos_ref[0] // bk), 0)
 
-    def _clamped3(b, j, pos_ref):
-        return (b, 0, jnp.minimum(j, pos_ref[0] // block_k))
+    def _clamped2(i, j, pos_ref):
+        return (i, jnp.minimum(j, pos_ref[0] // bk))
 
     if pltpu is None:  # pragma: no cover — CPU build without pallas TPU
         raise NotImplementedError("flash_decode_attention needs pallas TPU")
     in_specs = [
-        _vmem_spec((1, KV, rows, Dh), lambda b, j, p: (b, 0, 0, 0)),
-        _vmem_spec((1, KV, block_k, Dh), _clamped),
-        _vmem_spec((1, KV, block_k, Dh), _clamped),
+        _vmem_spec((g_blk, rows, Dh), lambda i, j, p: (i, 0, 0)),
+        _vmem_spec((g_blk, bk, Dh), _clamped),
+        _vmem_spec((g_blk, bk, Dh), _clamped),
     ]
-    operands = [q, k, v]
+    operands = [qf, kf, vf]
     if quantized:
         in_specs += [
-            _vmem_spec((1, KV, block_k), _clamped3),
-            _vmem_spec((1, KV, block_k), _clamped3),
+            _vmem_spec((g_blk, bk), _clamped2),
+            _vmem_spec((g_blk, bk), _clamped2),
         ]
         operands += [
-            jnp.asarray(k_scale, jnp.float32),
-            jnp.asarray(v_scale, jnp.float32),
+            jnp.asarray(k_scale, jnp.float32).reshape(fused, T),
+            jnp.asarray(v_scale, jnp.float32).reshape(fused, T),
         ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(B, nk),
+        grid=(ng, nk),
         in_specs=in_specs,
         out_specs=[
-            _vmem_spec((1, KV, rows, Dh), lambda b, j, p: (b, 0, 0, 0)),
+            _vmem_spec((g_blk, rows, Dh), lambda i, j, p: (i, 0, 0)),
         ],
         scratch_shapes=[
-            _vmem_scratch((KV * rows, LANES), jnp.float32),
-            _vmem_scratch((KV * rows, LANES), jnp.float32),
-            _vmem_scratch((KV * rows, Dh), jnp.float32),
+            _vmem_scratch((g_blk * rows, LANES), jnp.float32),
+            _vmem_scratch((g_blk * rows, LANES), jnp.float32),
+            _vmem_scratch((g_blk * rows, Dh), jnp.float32),
         ],
     )
     out_dtype = q.dtype
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((B, KV, rows, Dh), out_dtype)],
+        out_shape=[jax.ShapeDtypeStruct((fused, rows, Dh), out_dtype)],
         interpret=interpret,
     )(pos_arr, *operands)[0]
-    return out[:, :, :G]
+    return out.reshape(B, KV, rows, Dh)[:, :, :G]

@@ -9,13 +9,16 @@ example shows:
   prefill + a ``lax.scan`` of cached decode steps — no per-token python,
   no recompiles while serving a bucket;
 - the cache-strategy knobs and when each wins (measured, one v5e; r4
-  per-layer in-place cache):
-  * default (tight bf16 cache) — the THROUGHPUT path: ~2250-2360 tok/s
-    short ctx / ~1630-1750 tok/s decode-only at 2k on the 0.9B bench
-    model (68-78% of the HBM roof);
-  * ``quantize_cache=True`` — the CAPACITY knob: int8 KV halves cache
-    HBM (double the max context per chip) at 13-21% lower decode rate at
-    2k (run-to-run spread) — the dequant work now outweighs the saved bandwidth;
+  final — per-layer in-place cache + fused-batch scale-folding kernel):
+  * default (tight bf16 cache) — ~2250-2490 tok/s short ctx /
+    ~1620-1750 tok/s decode-only at 2k on the 0.9B bench model (68-78%
+    of the HBM roof); simplest when HBM is ample;
+  * ``quantize_cache=True`` — capacity AND long-context throughput:
+    int8 KV halves cache HBM (double the max context per chip) and at
+    2k ctx decodes 14-25% FASTER than bf16 in same-run pairs (1881-2030
+    vs 1621-1643 tok/s paired; bf16 spans 1621-1754 across all runs —
+    the fused kernel folds the scales into the score planes, so the
+    saved bandwidth outruns the dequant work); short ctx is a wash;
   * ``max_len=...`` — preallocated serving cache; the fused kernel skips
     blocks past ``pos`` so an oversized cache costs ~nothing to read;
 - time-to-first-token is a separate prefill call you can overlap with

@@ -258,6 +258,30 @@ class TestFlashDecode:
                 err_msg=f"pos={pos}",
             )
 
+    def test_bf16_block_halving_never_drops_tail_slots(self):
+        """The bf16 path halves the K block width for VMEM; if the
+        halved width doesn't tile the cache it must fall back to the
+        caller-validated block_k — not floor nk and silently drop the
+        tail slots from attention (regression: T=192 block_k=16 made
+        bk=128, nk=1, and keys 128..191 never attended)."""
+        from dlrover_tpu.ops.flash_attention import flash_decode_attention
+
+        B, KV, G, Dh, T = 1, 2, 2, 16, 192
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(ks[0], (B, KV, G, Dh), jnp.float32)
+        k = jax.random.normal(ks[1], (B, KV, T, Dh), jnp.float32)
+        v = jax.random.normal(ks[2], (B, KV, T, Dh), jnp.float32)
+        pos = 150  # attends into the would-be-dropped tail
+        out = flash_decode_attention(q, k, v, pos, block_k=16)
+        scale = Dh ** -0.5
+        s = jnp.einsum("bkgd,bktd->bkgt", q, k) * scale
+        mask = jnp.arange(T)[None, None, None, :] <= pos
+        s = jnp.where(mask, s, -1e30)
+        ref = jnp.einsum("bkgt,bktd->bkgd", jax.nn.softmax(s, -1), v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
     def test_rejects_indivisible_cache(self):
         from dlrover_tpu.ops.flash_attention import flash_decode_attention
 
