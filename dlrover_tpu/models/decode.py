@@ -58,8 +58,8 @@ def flash_decode_wanted(T: int, quantized: bool,
       straight from HBM, converts in VMEM, and folds the scales into
       the (rows x block) score/probability planes instead of scaling
       the K/V blocks (head_dim x fewer VPU multiplies). At 2k ctx this
-      is the FASTEST decode path: 235-254 steps/s = 69-74% of the int8
-      roof (1881-2030 tok/s at batch 8) vs tight bf16's 1621-1754
+      is the FASTEST decode path: 235-261 steps/s = 69-76% of the int8
+      roof (1881-2088 tok/s at batch 8) vs tight bf16's 1621-1754
       tok/s across runs — int8 won every same-run pair by 14-25% — at
       HALF the cache HBM: capacity AND throughput. The XLA dequant
       path (kernel off) materializes a bf16 copy and trails both;
@@ -258,6 +258,17 @@ def prefill(params: Dict, tokens, config,
         jnp.arange(P)[None, None, :, None] >= jnp.arange(P)[None, None, None, :]
     )
     scale = c.head_dim ** -0.5
+    # long prompts take the pallas flash kernel (the same one training
+    # uses): the dense einsum materializes the (B, H, P, P) score tensor
+    # — at a 2k prompt that is ~2 GB of f32 written+read per layer, a
+    # pure TTFT tax the blockwise kernel never pays (measured 0.40 s →
+    # 0.16 s at 2k × batch 8 on v5e). Same override knob as training:
+    # config.use_flash_attention (None = auto by backend).
+    uf = getattr(c, "use_flash_attention", None)
+    use_flash = (
+        (jax.default_backend() == "tpu" if uf is None else uf)
+        and P >= 256
+    )
 
     def layer_fn(h, layer):
         xn = _rms_norm(h, layer["attn_norm"], c.norm_eps)
@@ -270,7 +281,20 @@ def prefill(params: Dict, tokens, config,
         # at MXU-shaped prefill cost — decode reads it every step)
         k = jnp.swapaxes(k, 1, 2)                    # (B, KV, P, Dh)
         v = jnp.swapaxes(v, 1, 2)
-        out = _attend(q, k, v, causal, scale)
+        if use_flash:
+            from dlrover_tpu.ops.flash_attention import (
+                flash_attention,
+                repeat_kv,
+            )
+
+            kr, vr = repeat_kv(k, v, c.n_heads // c.n_kv_heads)
+            out = flash_attention(
+                jnp.swapaxes(q, 1, 2), kr, vr, causal=True, scale=scale,
+            )
+            out = jnp.swapaxes(out, 1, 2).reshape(
+                B, P, c.n_heads * c.head_dim)
+        else:
+            out = _attend(q, k, v, causal, scale)
         h = h + out @ layer["wo"]
         h = h + _ffn(_rms_norm(h, layer["ffn_norm"], c.norm_eps), layer, c)
         return h, (k, v)

@@ -225,9 +225,10 @@ def _attention(x, layer, config: LlamaConfig, positions, mesh):
     # which scatters unrepeated K/V (1/rep the all-to-all bytes) and
     # broadcasts heads device-locally after
     rep = c.n_heads // c.n_kv_heads
-    if rep > 1 and not (use_sp and strategy == "ulysses"):
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
+    if not (use_sp and strategy == "ulysses"):
+        from dlrover_tpu.ops.flash_attention import repeat_kv
+
+        k, v = repeat_kv(k, v, rep)
     if use_sp:
         # honor an explicit kernel opt-out in the sp paths too
         if strategy == "ulysses":

@@ -73,6 +73,16 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def repeat_kv(k, v, rep: int):
+    """Broadcast grouped-query K/V heads up to the query head count for
+    kernels that take one KV timeline per query head. Head axis is 1
+    ((B, KV, S, D) → (B, KV*rep, S, D)); the ONE shared site for the
+    GQA repeat convention (llama attention, ulysses, decode prefill)."""
+    if rep <= 1:
+        return k, v
+    return jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -614,11 +624,13 @@ def flash_decode_attention(
     # largest row-chunk of the fused axis whose K/V blocks stay ~<=1 MB
     # each: k+v double-buffered is 4 of these in flight, plus scales/q/
     # out/scratch, against the ~16 MB scoped-VMEM limit (2 MB blocks
-    # measured 17.45M > 16M on v5e)
-    limit = max(8, (1024 * 1024) // (bk * Dh * (1 if quantized else 2)))
-    g_blk = fused
-    while g_blk > limit and g_blk % 2 == 0:
-        g_blk //= 2
+    # measured 17.45M > 16M on v5e). Sized from the cache dtype's real
+    # itemsize, and chosen as the largest DIVISOR of the fused axis (not
+    # repeated halving, which strands odd factors over the limit).
+    limit = max(8, (1024 * 1024) // (bk * Dh * k.dtype.itemsize))
+    g_blk = max(
+        d for d in range(1, fused + 1) if fused % d == 0 and d <= limit
+    )
     ng = fused // g_blk
     nk = T // bk
     pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)

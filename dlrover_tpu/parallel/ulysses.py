@@ -54,10 +54,9 @@ def _ulysses_local(q, k, v, axis_name: str, scale: float, use_pallas: bool,
         split_axis=1, concat_axis=2, tiled=True,
     )
     qg, kg, vg = a2a(q), a2a(k), a2a(v)
-    rep = qg.shape[1] // kg.shape[1]
-    if rep > 1:
-        kg = jnp.repeat(kg, rep, axis=1)
-        vg = jnp.repeat(vg, rep, axis=1)
+    from dlrover_tpu.ops.flash_attention import repeat_kv
+
+    kg, vg = repeat_kv(kg, vg, qg.shape[1] // kg.shape[1])
     if use_pallas:
         out = flash_attention(
             qg, kg, vg, causal=True, scale=scale,

@@ -15,7 +15,7 @@ example shows:
     of the HBM roof); simplest when HBM is ample;
   * ``quantize_cache=True`` — capacity AND long-context throughput:
     int8 KV halves cache HBM (double the max context per chip) and at
-    2k ctx decodes 14-25% FASTER than bf16 in same-run pairs (1881-2030
+    2k ctx decodes 14-25% FASTER than bf16 in same-run pairs (1881-2088
     vs 1621-1643 tok/s paired; bf16 spans 1621-1754 across all runs —
     the fused kernel folds the scales into the score planes, so the
     saved bandwidth outruns the dequant work); short ctx is a wash;
