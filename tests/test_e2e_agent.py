@@ -309,3 +309,34 @@ def test_network_check_excludes_fault_node(job, tmp_path):
     faults, _ = check_mgr.check_fault_node()
     assert faults == [3]
     assert master.job_manager.nodes[3].exit_reason == "hardware_error"
+
+
+def test_run_cli_actor_host_loopback(job, tmp_path):
+    """dtpu-run --actor-host without a spawn secret: the agent starts a
+    LOOPBACK daemon for the single-host dev shape, does NOT register it
+    with the master (a 127.0.0.1 entry would poison a remote submitter's
+    placement map), and tears it down with the run."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    out_file = str(tmp_path / "out.txt")
+    env = _worker_env()
+    env.pop("DTPU_ACTOR_HOST_SECRET", None)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dlrover_tpu.agent.run",
+            "--standalone", "--nproc_per_node=1", "--actor-host",
+            f"--job_name={job}", f"--ckpt_dir={ckpt_dir}",
+            SCRIPT, ckpt_dir, out_file,
+        ],
+        env=env, capture_output=True, text=True, timeout=180,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "done w=10.0" in open(out_file).read()
+    # the daemon came up on loopback...
+    combined = proc.stderr + proc.stdout
+    assert "actor host ready on" in combined
+    # ...unregistered: the secure path logs the distinctive
+    # "actor host registered with master" (unified/remote.py) — it must
+    # be absent, and the explicit not-registered warning present
+    assert "actor host registered with master" not in combined
+    assert "NOT registered" in combined
