@@ -806,6 +806,13 @@ def _emit(detail: dict, elapsed: float) -> None:
 
 
 def main() -> None:
+    # the framework's persistent XLA compilation cache (worker.py): the
+    # bench pays tens of seconds of compiles per section otherwise, all
+    # charged against its own wall-clock budget — and a re-run (the
+    # driver after a dev run, or repeat rounds) deserializes instead
+    from dlrover_tpu.worker import enable_compilation_cache
+
+    enable_compilation_cache()
     t_start = time.monotonic()
     budget = float(os.environ.get("BENCH_TIME_BUDGET_S", "1200"))
     detail = {}

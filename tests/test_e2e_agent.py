@@ -220,24 +220,43 @@ def test_scale_up_mid_run(job, tmp_path):
     assert "start=0" not in open(f"{out_file}.r0").read()
 
 
-def test_run_cli_standalone(job, tmp_path):
-    """The real CLI surface: python -m dlrover_tpu.agent.run --standalone."""
+def _run_cli(job, tmp_path, extra_args=(), env=None, timeout=180):
+    """Run the real dtpu-run CLI in its own process GROUP and return
+    (returncode, combined output, out_file). The group kill in the
+    timeout path matters: --actor-host spawns a daemon that inherits
+    the captured pipes — killing only the agent would leave it holding
+    the write ends and subprocess's drain would hang forever."""
+    import signal
+
     ckpt_dir = str(tmp_path / "ckpt")
     out_file = str(tmp_path / "out.txt")
-    proc = subprocess.run(
+    proc = subprocess.Popen(
         [
             sys.executable, "-m", "dlrover_tpu.agent.run",
-            "--standalone", "--nproc_per_node=1",
+            "--standalone", "--nproc_per_node=1", *extra_args,
             f"--job_name={job}", f"--ckpt_dir={ckpt_dir}",
             SCRIPT, ckpt_dir, out_file,
         ],
-        env=_worker_env(),
-        capture_output=True,
-        text=True,
-        timeout=180,
+        env=env or _worker_env(),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        start_new_session=True,
     )
-    assert proc.returncode == 0, proc.stderr[-2000:]
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    finally:
+        if proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+    return proc.returncode, out, out_file
+
+
+def test_run_cli_standalone(job, tmp_path):
+    """The real CLI surface: python -m dlrover_tpu.agent.run --standalone."""
+    rc, out, out_file = _run_cli(job, tmp_path)
+    assert rc == 0, out[-2000:]
     assert "done w=10.0" in open(out_file).read()
 
 
@@ -316,27 +335,17 @@ def test_run_cli_actor_host_loopback(job, tmp_path):
     LOOPBACK daemon for the single-host dev shape, does NOT register it
     with the master (a 127.0.0.1 entry would poison a remote submitter's
     placement map), and tears it down with the run."""
-    ckpt_dir = str(tmp_path / "ckpt")
-    out_file = str(tmp_path / "out.txt")
     env = _worker_env()
     env.pop("DTPU_ACTOR_HOST_SECRET", None)
-    proc = subprocess.run(
-        [
-            sys.executable, "-m", "dlrover_tpu.agent.run",
-            "--standalone", "--nproc_per_node=1", "--actor-host",
-            f"--job_name={job}", f"--ckpt_dir={ckpt_dir}",
-            SCRIPT, ckpt_dir, out_file,
-        ],
-        env=env, capture_output=True, text=True, timeout=180,
-        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    rc, out, out_file = _run_cli(
+        job, tmp_path, extra_args=("--actor-host",), env=env,
     )
-    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert rc == 0, out[-2000:]
     assert "done w=10.0" in open(out_file).read()
     # the daemon came up on loopback...
-    combined = proc.stderr + proc.stdout
-    assert "actor host ready on" in combined
+    assert "actor host ready on" in out
     # ...unregistered: the secure path logs the distinctive
     # "actor host registered with master" (unified/remote.py) — it must
     # be absent, and the explicit not-registered warning present
-    assert "actor host registered with master" not in combined
-    assert "NOT registered" in combined
+    assert "actor host registered with master" not in out
+    assert "NOT registered" in out
