@@ -58,6 +58,11 @@ class ElasticLaunchConfig:
     # hand-built hosts map (unified/remote.py; reference: Ray supplies
     # this placement layer, unified/master/scheduler.py:161)
     actor_host: bool = False
+    # keep pre-imported spare interpreters so worker (re)spawns skip the
+    # numpy/jax import cost — the largest fixed term of restart-to-training
+    # after the persistent compilation cache (agent/warm_spawn.py). Any
+    # pool failure falls back to a cold spawn.
+    warm_spawn: bool = True
 
     def auto_configure_params(self) -> None:
         """Fill topology-dependent defaults from the environment

@@ -92,6 +92,16 @@ class MasterClient:
         )
         return resp.data["nodes"], resp.data["reason"]
 
+    def get_check_failures(self) -> List[int]:
+        """Ranks that already reported a FAILED check this session — a
+        pair-benchmark waiter polls this to stop waiting for a partner
+        whose failure is already on the books."""
+        resp = self._client.call(
+            "get_check_failures",
+            comm.NetworkReadyRequest(node_id=self._node_id),
+        )
+        return list(resp.data.get("nodes", []))
+
     def clear_node_check(self) -> None:
         """Start a fresh check session for THIS node (drops its sticky
         round results on the master)."""

@@ -78,6 +78,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tpu-timer", dest="tpu_timer", action="store_true",
                    help="enable the native profiler plane: workers patch "
                         "the PJRT table, agent aggregates on :18889")
+    p.add_argument("--no-warm-spawn", dest="warm_spawn",
+                   action="store_false",
+                   help="disable the pre-imported spare-interpreter pool "
+                        "(workers then pay the full numpy/jax import on "
+                        "every spawn/restart)")
     p.add_argument("entrypoint", help="training script")
     p.add_argument("args", nargs=argparse.REMAINDER)
     return p
@@ -104,6 +109,7 @@ def config_from_args(args) -> ElasticLaunchConfig:
         auto_tunning=args.auto_tunning,
         tpu_timer=args.tpu_timer,
         actor_host=args.actor_host,
+        warm_spawn=args.warm_spawn,
         entrypoint=args.entrypoint,
         args=args.args[1:] if args.args[:1] == ["--"] else list(args.args),
     )
@@ -215,10 +221,28 @@ def run(config: ElasticLaunchConfig) -> int:
     client = MasterClient(
         config.master_addr, config.node_id, config.node_rank
     )
+    warm_pool = None
     try:
         if config.actor_host:
             actor_host_proc = _launch_actor_host(config)
         _apply_master_run_config(client, config)
+        if config.warm_spawn and config.entrypoint:
+            # start the spare interpreters NOW so their numpy/jax imports
+            # overlap the pre-check and network-check phases — by the time
+            # the training agent gates on readiness, the pool is warm and
+            # every node leaves the gate together (a node whose gate runs
+            # long would otherwise miss its peers' rendezvous cut window)
+            from dlrover_tpu.agent.warm_spawn import WarmWorkerPool
+
+            # spares must see config.worker_env at IMPORT time: env vars
+            # jax reads on import (JAX_PLATFORMS, JAX_ENABLE_X64, ...)
+            # are too late to merge at release — a bare-os.environ spare
+            # would initialize a different backend than a cold spawn
+            warm_pool = WarmWorkerPool(
+                size=config.nproc_per_node,
+                base_env={**os.environ, **config.worker_env},
+            )
+            warm_pool.prewarm()
         wait_pre_check(client)
         if config.network_check:
             ok = _run_network_check(config, client)
@@ -241,9 +265,13 @@ def run(config: ElasticLaunchConfig) -> int:
                 expected_frames=config.min_nodes * config.nproc_per_node,
                 is_commit_leader=(config.node_rank == 0),
             )
-        agent = ElasticTrainingAgent(config, client, ckpt_saver=saver)
+        agent = ElasticTrainingAgent(
+            config, client, ckpt_saver=saver, warm_pool=warm_pool
+        )
         return agent.run()
     finally:
+        if warm_pool is not None:
+            warm_pool.stop()
         if actor_host_proc is not None:
             actor_host_proc.terminate()
             try:

@@ -146,6 +146,7 @@ class ElasticTrainingAgent:
         config: ElasticLaunchConfig,
         client: Optional[MasterClient] = None,
         ckpt_saver=None,
+        warm_pool=None,
     ):
         self._config = config
         self._client = client or MasterClient(
@@ -173,6 +174,18 @@ class ElasticTrainingAgent:
         )
         self._ckpt_saver = ckpt_saver
         self._hb_thread: Optional[threading.Thread] = None
+        # a caller-provided pool (dtpu-run creates it BEFORE the network
+        # check so spares finish importing during the check phase) wins;
+        # otherwise build one here
+        self._warm_pool = warm_pool
+        if (self._warm_pool is None and config.warm_spawn
+                and config.entrypoint):
+            from dlrover_tpu.agent.warm_spawn import WarmWorkerPool
+
+            self._warm_pool = WarmWorkerPool(
+                size=config.nproc_per_node,
+                base_env=self._base_worker_env(),
+            )
         self._last_global_step = 0
         self._last_step_ts = 0.0
         # node-side diagnosis: telemetry gauges for heartbeats + the
@@ -213,10 +226,9 @@ class ElasticTrainingAgent:
             )
         return coordinator, base_rank, world_size
 
-    def _worker_env(
-        self, local_rank: int, global_rank: int, world_size: int,
-        coordinator: str,
-    ) -> Dict[str, str]:
+    def _base_worker_env(self) -> Dict[str, str]:
+        """Job-static worker environment (also what warm spares inherit —
+        per-incarnation keys are merged at release, ``warm_spawn.py``)."""
         env = dict(os.environ)
         # make sure workers resolve the same dlrover_tpu the agent runs
         import dlrover_tpu
@@ -228,6 +240,13 @@ class ElasticTrainingAgent:
                 pkg_root + (os.pathsep + pythonpath if pythonpath else "")
             )
         env.update(self._config.worker_env)
+        return env
+
+    def _worker_env(
+        self, local_rank: int, global_rank: int, world_size: int,
+        coordinator: str,
+    ) -> Dict[str, str]:
+        env = self._base_worker_env()
         env.update({
             EnvKey.JOB_NAME: self._config.job_name,
             EnvKey.MASTER_ADDR: self._client.master_addr,
@@ -259,8 +278,17 @@ class ElasticTrainingAgent:
             env = self._worker_env(
                 local_rank, global_rank, world_size, coordinator
             )
-            cmd = [sys.executable, self._config.entrypoint, *self._config.args]
-            proc = subprocess.Popen(cmd, env=env)  # noqa: S603
+            proc = None
+            if self._warm_pool is not None:
+                proc = self._warm_pool.take(
+                    env, self._config.entrypoint, self._config.args
+                )
+            if proc is None:  # pool disabled/empty: cold spawn
+                cmd = [
+                    sys.executable, self._config.entrypoint,
+                    *self._config.args,
+                ]
+                proc = subprocess.Popen(cmd, env=env)  # noqa: S603
             self._workers.append(_Worker(local_rank, global_rank, proc))
         logger.info(
             "node %s spawned %s worker(s): pids=%s",
@@ -318,7 +346,8 @@ class ElasticTrainingAgent:
                 w.proc.kill()
                 w.proc.wait()
 
-    def _restart_workers(self, reason: str) -> None:
+    def _restart_workers(self, reason: str,
+                         grace_s: Optional[float] = None) -> None:
         """Soft restart: same host, new rendezvous round
         (reference ``_restart_workers``:1225)."""
         logger.info("restarting workers on node %s: %s",
@@ -326,7 +355,7 @@ class ElasticTrainingAgent:
         self._events.instant(AgentEvent.RESTART, reason=reason)
         # stop first: shm survives the workers, and persisting after they
         # die removes any chance of reading a frame mid-write
-        self._stop_workers()
+        self._stop_workers(grace_s=grace_s)
         self._save_breakpoint_checkpoint(reason)
         self._restart_count += 1
         # drop the stale step observation: heartbeats must not re-populate
@@ -372,16 +401,19 @@ class ElasticTrainingAgent:
                 continue
             if resp.action_type != DiagnosisActionType.NONE:
                 with self._action_lock:
-                    self._pending_action = resp.action_type
+                    self._pending_action = (
+                        resp.action_type, dict(resp.action_data or {})
+                    )
                 logger.info(
                     "received diagnosis action %s (%s)",
                     resp.action_type, resp.action_data,
                 )
 
-    def _take_pending_action(self) -> Optional[str]:
+    def _take_pending_action(self):
+        """Returns (action_type, action_data) or (None, {})."""
         with self._action_lock:
-            action, self._pending_action = self._pending_action, None
-            return action
+            pending, self._pending_action = self._pending_action, None
+            return pending if pending is not None else (None, {})
 
     def observe_global_step(self, step: int, ts: float) -> None:
         self._last_global_step = step
@@ -392,6 +424,17 @@ class ElasticTrainingAgent:
     def run(self) -> int:
         """(reference ``_invoke_run``:969)"""
         self._ipc_server.start()
+        if self._warm_pool is not None:
+            # spares import numpy/jax before this node joins rendezvous:
+            # a node joining a RUNNING job stops the world for every peer,
+            # so a bounded wait here (peers train meanwhile) is cheaper
+            # globally than joining cold and making everyone wait through
+            # this host's imports during the cutover
+            self._warm_pool.prewarm()
+            self._warm_pool.wait_ready(
+                n=self._config.nproc_per_node,
+                timeout_s=float(os.getenv("DLROVER_TPU_WARM_WAIT_S", "10")),
+            )
         if self._config.ckpt_replica > 1:
             # agent-hosted store for peers' shm frames; survives worker
             # crashes and serves a relaunched peer its frame back
@@ -479,6 +522,8 @@ class ElasticTrainingAgent:
                 self._replica_service.stop()
             if timer_daemon is not None:
                 timer_daemon.kill()
+            if self._warm_pool is not None:
+                self._warm_pool.stop()
             self._ipc_server.stop()
 
     def _monitor_loop(self) -> int:
@@ -497,9 +542,27 @@ class ElasticTrainingAgent:
                     return 1
                 continue
             # healthy: check diagnosis actions and membership changes
-            action = self._take_pending_action()
+            action, action_data = self._take_pending_action()
             if action == DiagnosisActionType.RESTART_WORKER:
-                self._restart_workers(f"diagnosis action {action}")
+                # a restart marked "wedged" (hang watchdog) means the
+                # workers are blocked in a dead collective and will not
+                # exit gracefully — waiting the full stop grace is pure
+                # downtime, and SIGKILLing fast is safe because shm frames
+                # are seal-written (a kill mid-write leaves an unreadable
+                # frame, not a torn one) and the ipc lock server releases
+                # a dead holder's locks. Unmarked restarts (e.g. the
+                # peer-left broadcast, master.py) target HEALTHY workers
+                # mid-cleanup: they keep the normal grace.
+                grace = None
+                if action_data.get("wedged"):
+                    from dlrover_tpu.common.config import get_context
+
+                    grace = get_context().wedged_kill_grace_s
+                self._restart_workers(
+                    f"diagnosis action {action} "
+                    f"({action_data.get('reason', '')})",
+                    grace_s=grace,
+                )
                 continue
             if action == DiagnosisActionType.RELAUNCH_WORKER:
                 # pod-level: exit so the master's relaunch ladder replaces

@@ -126,20 +126,33 @@ class SharedMemoryHandler:
         if not self._ensure(total):
             raise RuntimeError(f"cannot create shm segment {self._name}")
         buf = self._shm.buf
-        buf[: len(header)] = header
+        # crash-consistent write order: invalidate the frame (zero length
+        # word), write tensor data, write the meta bytes, then seal by
+        # writing the length word LAST. A writer killed at any point leaves
+        # an unreadable frame (read_meta -> None, callers fall back to the
+        # last persisted checkpoint) — never a parseable header over torn
+        # data. This is what makes it safe for the agent to SIGKILL a
+        # wedged worker without a long graceful-exit grace.
+        buf[:8] = _U64.pack(0)
         pos = data_start
         for b in buffers:
             flat = np.ascontiguousarray(b).view(np.uint8).reshape(-1)
             n = flat.nbytes
             buf[pos : pos + n] = flat.data
             pos += n
+        buf[8 : len(header)] = header[8:]
+        buf[:8] = header[:8]
 
     def write_raw(self, blob: bytes) -> None:
         """Write a complete pre-framed blob (e.g. a peer replica fetched
-        over TCP) into the segment verbatim."""
+        over TCP) into the segment verbatim (same seal order as
+        ``write_frame``: length word last)."""
         if not self._ensure(len(blob)):
             raise RuntimeError(f"cannot create shm segment {self._name}")
-        self._shm.buf[: len(blob)] = blob
+        buf = self._shm.buf
+        buf[:8] = _U64.pack(0)
+        buf[8 : len(blob)] = blob[8:]
+        buf[:8] = blob[:8]
 
     # -- read --------------------------------------------------------------
 

@@ -33,6 +33,11 @@ class DefaultValues:
     # so a worker wedged in a dead collective gets little grace — every
     # second here is direct fault-recovery latency
     worker_stop_grace_s: float = 3.0
+    # grace for workers the diagnosis plane already judged NOT to be making
+    # progress (hang watchdog, metric stall): they are blocked in a dead
+    # collective and never exit on SIGTERM — the frame-seal shm write order
+    # + ipc-lock auto-release make the immediate SIGKILL safe
+    wedged_kill_grace_s: float = 0.5
     node_max_relaunch: int = 3
     worker_max_restart: int = 100
     relaunch_on_worker_failure: int = 3

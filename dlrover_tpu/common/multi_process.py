@@ -115,11 +115,18 @@ class LocalIPCServer:
             t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        # each client connection gets a token; locks record the acquiring
+        # token so a client that dies HOLDING a lock (e.g. a worker
+        # SIGKILLed mid checkpoint write) releases it on disconnect instead
+        # of leaking it — otherwise every later persist of that frame would
+        # burn its full lock timeout (the frame-seal write order in
+        # shm_handler makes reading after such a death safe)
+        token = object()
         try:
             while True:
                 req = recv_msg(conn)
                 try:
-                    result = self._dispatch(req)
+                    result = self._dispatch(req, token)
                     send_msg(conn, {"ok": True, "result": result})
                 except Exception as e:  # noqa: BLE001 — report to client
                     send_msg(conn, {"ok": False, "error": repr(e)})
@@ -129,12 +136,28 @@ class LocalIPCServer:
             logger.warning("ipc connection dropped on bad frame: %r", e)
         finally:
             conn.close()
+            self._release_locks_of(token)
 
-    def _dispatch(self, req: Dict) -> Any:
+    def _release_locks_of(self, token: object) -> None:
+        with self._meta_lock:
+            states = list(self._locks.items())
+        for name, state in states:
+            if state.get("conn_token") is token and state["lock"].locked():
+                state["owner"] = None
+                state["conn_token"] = None
+                try:
+                    state["lock"].release()
+                except RuntimeError:
+                    continue
+                logger.warning(
+                    "ipc lock %r auto-released: holder disconnected", name
+                )
+
+    def _dispatch(self, req: Dict, token: object = None) -> Any:
         kind, name, method = req["kind"], req["name"], req["method"]
         args = req.get("args", {})
         if kind == "lock":
-            return self._lock_op(name, method, args)
+            return self._lock_op(name, method, args, token)
         if kind == "queue":
             return self._queue_op(name, method, args)
         if kind == "dict":
@@ -147,7 +170,8 @@ class LocalIPCServer:
                 self._locks[name] = {"lock": threading.Lock(), "owner": None}
             return self._locks[name]
 
-    def _lock_op(self, name: str, method: str, args: Dict) -> Any:
+    def _lock_op(self, name: str, method: str, args: Dict,
+                 token: object = None) -> Any:
         state = self._lock_state(name)
         owner = args.get("owner")
         if method == "acquire":
@@ -159,10 +183,12 @@ class LocalIPCServer:
                 acquired = state["lock"].acquire(blocking=blocking)
             if acquired:
                 state["owner"] = owner
+                state["conn_token"] = token
             return acquired
         if method == "release":
             if state["lock"].locked():
                 state["owner"] = None
+                state["conn_token"] = None
                 try:
                     state["lock"].release()
                 except RuntimeError:

@@ -93,6 +93,11 @@ class TrainingHangDiagnostician(Diagnostician):
             DiagnosisActionType.RESTART_WORKER,
             instance=DiagnosisConstant.ANY_INSTANCE,
             reason="training hang",
+            # tells agents the workers are known-wedged (blocked in a dead
+            # collective): skip the graceful-exit grace and SIGKILL fast.
+            # Other RESTART_WORKER sources (e.g. the peer-left broadcast,
+            # master.py) target HEALTHY workers and must keep full grace
+            data={"wedged": True},
         )
 
 

@@ -87,6 +87,7 @@ def tcp_pair_benchmark(
     group: Dict[int, NodeMeta],
     payload_mb: float = 4.0,
     timeout_s: float = 0.0,
+    partner_failed=None,
 ) -> float:
     """All-to-one echo over DCN within a pair group; returns seconds.
 
@@ -112,11 +113,27 @@ def tcp_pair_benchmark(
         server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         server.bind(("", leader_meta.free_port))
         server.listen(len(ranks))
-        server.settimeout(timeout_s)
+        # short accept slices so a partner whose failure is already on the
+        # master's books aborts the wait in ~a poll interval, not the full
+        # window (the outcome — this round reports failed — is identical
+        # to the timeout's; only the latency differs)
+        server.settimeout(1.0)
         served = 0
+        deadline = time.time() + timeout_s
         try:
             while served < len(ranks) - 1:
-                conn, _ = server.accept()
+                try:
+                    conn, _ = server.accept()
+                except socket.timeout:
+                    if partner_failed is not None and partner_failed():
+                        raise RuntimeError(
+                            "pair partner already reported a failed check"
+                        )
+                    if time.time() > deadline:
+                        raise socket.timeout(
+                            f"pair partner never connected in {timeout_s}s"
+                        )
+                    continue
                 conn.settimeout(timeout_s)
                 data = _recv_all(conn)
                 _send_all(conn, data)
@@ -134,6 +151,10 @@ def tcp_pair_benchmark(
                     timeout=2.0,
                 )
             except OSError:
+                if partner_failed is not None and partner_failed():
+                    raise RuntimeError(
+                        "pair partner already reported a failed check"
+                    )
                 if time.time() > deadline:
                     raise
                 time.sleep(0.2)
@@ -151,12 +172,16 @@ def run_check_workload(
     group: Dict[int, NodeMeta],
     matmul_size: int = 1024,
     payload_mb: float = 4.0,
+    partner_failed=None,
 ) -> float:
     """The full per-node check: fault injection hook → matmul → pair DCN
     echo. Returns total elapsed seconds; raises on failure."""
     mock_error(node_rank)
     mm = matmul_benchmark(size=matmul_size)
-    net = tcp_pair_benchmark(node_rank, group, payload_mb=payload_mb)
+    net = tcp_pair_benchmark(
+        node_rank, group, payload_mb=payload_mb,
+        partner_failed=partner_failed,
+    )
     logger.info(
         "node %s check: matmul=%.3fs net=%.3fs (group=%s)",
         node_rank, mm, net, sorted(group),

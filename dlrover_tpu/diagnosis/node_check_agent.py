@@ -34,10 +34,31 @@ def _one_check_round(
         timeout_s=config.rdzv_timeout_s,
     )
     _, group, _ = handler.next_rendezvous()
+    partners = [r for r in group if r != config.node_rank]
+    poll_state = {"ts": 0.0, "failed": False}
+
+    def partner_failed() -> bool:
+        # a partner whose failure THIS ROUND is already on the books is
+        # not coming — stop waiting for it (same failed-round outcome as
+        # the timeout, seconds earlier). The benchmark's wait loops call
+        # this every 0.2-1s; cap the master RPC at ~1/s so a large job's
+        # check phase doesn't multiply master load
+        now = time.time()
+        if now - poll_state["ts"] < 1.0:
+            return poll_state["failed"]
+        poll_state["ts"] = now
+        try:
+            failed = set(client.get_check_failures())
+        except (ConnectionError, RuntimeError):
+            return False  # version skew / blip: fall back to the timeout
+        poll_state["failed"] = any(r in failed for r in partners)
+        return poll_state["failed"]
+
     try:
         elapsed = run_check_workload(
             config.node_rank, group,
             matmul_size=matmul_size, payload_mb=payload_mb,
+            partner_failed=partner_failed,
         )
         client.report_network_check(normal=True, elapsed=elapsed)
     except Exception as e:  # noqa: BLE001 — a failed check is a data point

@@ -258,6 +258,13 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         super().__init__(RendezvousName.NODE_CHECK)
         self._node_status: Dict[int, bool] = {}
         self._node_times: Dict[int, float] = {}
+        # results reported for the CURRENT check round only (cleared at
+        # each round cut) — the early-bail poll must see these, never the
+        # session-sticky _node_status: a node that failed round 1 is
+        # actively RETRYING in round 2, and its healthy partner aborting
+        # on the stale round-1 failure would defeat the exoneration
+        # re-pairing outright
+        self._round_results: Dict[int, bool] = {}
         self._check_round = 0
         self._fault_nodes: List[int] = []
         self._straggler_nodes: List[int] = []
@@ -274,6 +281,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         with self._lock:
             self._node_status.pop(node_rank, None)
             self._node_times.pop(node_rank, None)
+            self._round_results.pop(node_rank, None)
 
     def get_comm_world(
         self, node_rank: int
@@ -286,6 +294,9 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 # check rounds for exactly this)
                 if self._check_rdzv_completed():
                     self._check_round += 1
+                    # a fresh round starts with no reports — failed_nodes()
+                    # answers "has my partner failed THIS round"
+                    self._round_results = {}
             if node_rank not in self._rdzv_nodes:
                 return self._rdzv_round, 0, {}
             groups = self._group_nodes(self._check_round)
@@ -318,6 +329,20 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             pairs[-2].extend(pairs.pop())
         return pairs
 
+    def failed_nodes(self) -> List[int]:
+        """Ranks that reported a failure in the CURRENT check round. A
+        checking node polls this about its PARTNERS: once a partner has
+        already reported this round failed, waiting out the pair-benchmark
+        timeout for it is pure latency — the poller aborts and reports the
+        same ``normal=False`` the timeout would have produced. Restricted
+        to the current round on purpose: session-sticky failures include
+        nodes that failed round 1 and are actively retrying in round 2,
+        and aborting on those would defeat the exoneration re-pairing."""
+        with self._lock:
+            return sorted(
+                r for r, ok in self._round_results.items() if not ok
+            )
+
     def report_network_check_result(
         self, node_rank: int, normal: bool, elapsed: float
     ) -> None:
@@ -325,6 +350,7 @@ class NetworkCheckRendezvousManager(RendezvousManager):
             prev = self._node_status.get(node_rank)
             # a node that passed in any round of this check is healthy
             self._node_status[node_rank] = bool(prev) or normal
+            self._round_results[node_rank] = normal
             if normal and elapsed > 0:
                 self._node_times[node_rank] = elapsed
 

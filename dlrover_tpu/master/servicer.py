@@ -106,6 +106,12 @@ class MasterServicer:
         manager.clear_node_check(req.node_id)
         return comm.BaseResponse()
 
+    def rpc_get_check_failures(
+        self, req: comm.NetworkReadyRequest
+    ) -> comm.BaseResponse:
+        manager = self._rdzv_managers[RendezvousName.NODE_CHECK]
+        return comm.BaseResponse(data={"nodes": manager.failed_nodes()})
+
     def rpc_check_straggler(
         self, req: comm.StragglerExistRequest
     ) -> comm.BaseResponse:

@@ -137,6 +137,44 @@ def test_replicated_array_saved_once(tmp_path, mesh):
     shm.close()
 
 
+def test_unsealed_frame_is_unreadable_not_torn():
+    """Crash-consistency contract of the seal write order: a writer killed
+    mid-write leaves the length word zeroed (write_frame zeroes it FIRST
+    and rewrites it LAST), so readers see `None` — never a parseable meta
+    over partial tensor bytes — and the next complete write recovers."""
+    import struct
+
+    name = shm_name(JOB, 0, 3)
+    shm = SharedMemoryHandler(name)
+    arr = np.arange(16, dtype=np.float32)
+    meta = {
+        "step": 4, "ts": time.time(), "job": JOB, "node_rank": 0,
+        "local_rank": 3,
+        "leaves": [{
+            "path": "w", "kind": "array", "dtype": "float32",
+            "gshape": [16],
+            "shards": [{"offset": 0, "nbytes": arr.nbytes,
+                        "lshape": [16], "start": [0]}],
+        }],
+    }
+    shm.write_frame(meta, [arr])
+    assert shm.read_meta()["step"] == 4
+    # simulate death mid-write: the invalidation happened, the seal didn't
+    shm._shm.buf[:8] = struct.pack("<Q", 0)
+    shm._shm.buf[64:80] = b"\xff" * 16  # scribbled partial data
+    assert shm.read_meta() is None
+    assert shm.read_frame_bytes() is None
+    assert shm.step == -1
+    # a complete write over the dead frame is readable again
+    meta["step"] = 5
+    for leaf in meta["leaves"]:
+        for s in leaf["shards"]:
+            s.pop("abs_offset", None)
+    shm.write_frame(meta, [arr])
+    assert shm.read_meta()["step"] == 5
+    shm.close()
+
+
 def test_storage_save_and_resharded_restore(tmp_path, mesh):
     engine = CheckpointEngine(
         str(tmp_path), job_name=JOB, node_rank=0, local_rank=0,

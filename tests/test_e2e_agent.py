@@ -192,8 +192,12 @@ def test_scale_up_mid_run(job, tmp_path):
     codes = {}
 
     def _run(rank):
+        # step_time gives agent 0 enough world-1 runway that agent 1's
+        # deliberate warm-pool readiness gate (it defers joining until it
+        # can spawn fast — agent/warm_spawn.py wait_ready) plus the
+        # membership poll land before agent 0's 10 steps run out
         codes[rank] = _make_agent(
-            master, job, rank, ckpt_dir, out_file, step_time=0.5).run()
+            master, job, rank, ckpt_dir, out_file, step_time=1.0).run()
 
     t0 = threading.Thread(target=_run, args=(0,), daemon=True)
     t1 = threading.Thread(target=_run, args=(1,), daemon=True)

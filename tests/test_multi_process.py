@@ -85,6 +85,56 @@ def test_lock_concurrent(ipc_server):
     assert sorted(results) == list(range(8))
 
 
+def test_lock_released_when_holder_process_dies(ipc_server, tmp_path):
+    """A worker SIGKILLed while holding the frame lock must not leak it:
+    the server releases locks whose owning connection dropped, so the
+    agent's next persist doesn't burn its whole lock timeout."""
+    import signal
+    import subprocess
+    import sys
+
+    marker = tmp_path / "acquired"
+    child = subprocess.Popen([
+        sys.executable, "-c",
+        "import sys, time\n"
+        "from dlrover_tpu.common.multi_process import SharedLock\n"
+        f"lock = SharedLock('dead', {ipc_server.path!r})\n"
+        "assert lock.acquire()\n"
+        f"open({str(marker)!r}, 'w').close()\n"
+        "time.sleep(60)\n",
+    ])
+    try:
+        deadline = time.time() + 20
+        while not marker.exists():
+            assert time.time() < deadline, "child never acquired"
+            assert child.poll() is None, "child died early"
+            time.sleep(0.05)
+        agent = SharedLock("dead", ipc_server.path)
+        assert not agent.acquire(blocking=False)
+        child.send_signal(signal.SIGKILL)
+        child.wait()
+        assert agent.acquire(timeout=5.0)
+        agent.release()
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+
+
+def test_lock_not_released_while_holder_alive(ipc_server):
+    """The disconnect cleanup must key on the ACQUIRING connection — a
+    different client disconnecting must not free the lock."""
+    holder = SharedLock("alive", ipc_server.path)
+    assert holder.acquire()
+    other = SharedLock("alive", ipc_server.path)
+    assert not other.acquire(blocking=False)
+    other._client._close()  # drop the non-holder's connection
+    time.sleep(0.2)
+    probe = SharedLock("alive", ipc_server.path)
+    assert not probe.acquire(blocking=False)
+    holder.release()
+
+
 def test_shared_memory_survives_close():
     name = f"dlrtpu_test_{os.getpid()}"
     unlink_shared_memory(name)

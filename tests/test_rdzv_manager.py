@@ -196,6 +196,35 @@ class TestNetworkCheck:
         faults, _ = m.check_fault_node()
         assert faults == [3]
 
+    def test_failed_nodes_is_round_scoped(self):
+        """The early-bail poll (``failed_nodes``) must report only the
+        CURRENT round's failures: a node that failed round 1 is actively
+        retrying in round 2, and its healthy partner aborting the pair
+        benchmark on the stale round-1 failure would defeat the
+        exoneration re-pairing (the round-2 property the manager itself
+        guarantees)."""
+        m = self._manager(4)
+        for r in range(4):
+            m.get_comm_world(r)
+        m.report_network_check_result(0, True, 1.0)
+        m.report_network_check_result(1, True, 1.0)
+        m.report_network_check_result(2, False, 0.0)  # collateral of 3
+        m.report_network_check_result(3, False, 0.0)  # actually bad
+        assert m.failed_nodes() == [2, 3]
+        # round 2 forms: node 2 is re-paired with a healthy partner — who
+        # must NOT see node 2 as "already failed" before it reports
+        for r in range(4):
+            m.join_rendezvous(_meta(r))
+        for r in range(4):
+            m.get_comm_world(r)
+        assert m.failed_nodes() == []
+        # node 3 fails again IN ROUND 2: now (and only now) its partner
+        # may bail early
+        m.report_network_check_result(3, False, 0.0)
+        assert m.failed_nodes() == [3]
+        m.report_network_check_result(2, True, 1.0)
+        assert m.failed_nodes() == [3]
+
     def test_verdict_stable_while_next_round_forms(self):
         """The verdict must judge against the last COMPLETED round's
         cohort: a fast node polling check_fault_node while a slow peer
