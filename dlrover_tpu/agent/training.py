@@ -157,7 +157,7 @@ class ElasticTrainingAgent:
         self._remaining_restarts = config.max_restarts
         self._stop_flag = threading.Event()
         self._action_lock = threading.Lock()
-        self._pending_action: Optional[str] = None
+        self._pending_action: Optional[Tuple[str, Dict]] = None
         self._rdzv_handler = MasterRendezvousHandler(
             RendezvousName.TRAINING,
             self._client,

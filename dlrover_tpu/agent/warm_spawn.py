@@ -45,15 +45,19 @@ _DEFAULT_PREIMPORTS = "numpy,jax,dlrover_tpu.worker"
 
 _BOOTSTRAP = r"""
 import json, os, runpy, sys
+_failed = []
 for _m in sys.argv[1].split(","):
     if _m:
         try:
             __import__(_m)
-        except Exception:
-            pass
+        except Exception as _e:
+            _failed.append("%s: %r" % (_m, _e))
 if len(sys.argv) > 2 and sys.argv[2]:
-    try:  # imports done: tell the pool this spare is actually warm
-        open(sys.argv[2], "w").close()
+    try:  # imports done: tell the pool this spare is ready; a non-empty
+        # marker records WHICH pre-imports failed (the spare still works —
+        # the worker script imports for real — but delivers no warm-up)
+        with open(sys.argv[2], "w") as _f:
+            _f.write("; ".join(_failed))
     except OSError:
         pass
 _line = sys.stdin.readline()
@@ -102,6 +106,7 @@ class WarmWorkerPool:
         self._ready_dir = tempfile.mkdtemp(prefix="dtpu_warm_")
         self._lock = threading.Lock()
         self._stopped = False
+        self._warned_unwarmed: set = set()
 
     def _spawn_spare(self) -> Optional[subprocess.Popen]:
         marker = os.path.join(self._ready_dir, uuid.uuid4().hex)
@@ -157,7 +162,27 @@ class WarmWorkerPool:
             self.ready_count(), n, time.time() - t0,
             "" if ok else " (timeout — spawning cold)",
         )
+        self._log_unwarmed()
         return ok
+
+    def _log_unwarmed(self) -> None:
+        """Surface spares whose ready marker records pre-import failures:
+        they pass the rendezvous gate but deliver zero warm-up benefit
+        (broken env, typo in DLROVER_TPU_WARM_PREIMPORT)."""
+        with self._lock:
+            markers = dict(self._ready_files)
+        for pid, marker in markers.items():
+            try:
+                with open(marker) as f:
+                    failures = f.read().strip()
+            except OSError:
+                continue
+            if failures and pid not in self._warned_unwarmed:
+                self._warned_unwarmed.add(pid)
+                logger.warning(
+                    "warm spawn pool: spare pid=%s is ready but UNWARMED — "
+                    "pre-imports failed: %s", pid, failures,
+                )
 
     def prewarm(self) -> None:
         with self._lock:
@@ -209,6 +234,10 @@ class WarmWorkerPool:
         except (OSError, ValueError) as e:
             logger.warning("warm spawn pool: release failed: %r", e)
             spare.kill()
+            try:  # reap: an unwaited kill leaves a zombie until agent exit
+                spare.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
             return None
         finally:
             self._cleanup_marker(spare)

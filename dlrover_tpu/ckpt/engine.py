@@ -588,9 +588,16 @@ class CheckpointEngine:
         except (ConnectionError, ValueError):
             return step
 
-    def load(self, target, path: str = "") -> Tuple[Any, int]:
+    def load(self, target, path: str = "",
+             in_place: bool = False) -> Tuple[Any, int]:
         """Restore into the structure of ``target`` (a pytree whose array
         leaves are jax.Arrays or ShapeDtypeStructs carrying shardings).
+
+        ``in_place=True`` fills writable numpy target leaves directly
+        (torch ``load_state_dict`` semantics) instead of materializing
+        fresh buffers — the fast path for host-resident states, where
+        fresh-page population, not the copy, is the bound. jax leaves are
+        immutable and unaffected.
 
         Returns (state, step); step == -1 when nothing was restored.
         """
@@ -605,13 +612,13 @@ class CheckpointEngine:
                 logger.warning("replica restore failed: %r", e)
         step = self._shm_step_consistent()
         if step is not None and step >= 0:
-            state = self._load_from_shm(target)
+            state = self._load_from_shm(target, in_place=in_place)
             if state is not None:
                 logger.info("restored step %s from shared memory", step)
                 return state, step
         return self._load_from_storage(target, path or self.ckpt_dir)
 
-    def _load_from_shm(self, target):
+    def _load_from_shm(self, target, in_place: bool = False):
         meta = self._shm.read_meta()
         if meta is None:
             return None
@@ -620,8 +627,13 @@ class CheckpointEngine:
         def reader(leaf_meta, shard_meta):
             return self._shm.read_shard_bytes(shard_meta)
 
+        reader_into = (
+            (lambda leaf_meta, shard_meta, out:
+             self._shm.read_shard_into(shard_meta, out))
+            if in_place else None
+        )
         try:
-            return _assemble(target, lookup, reader)
+            return _assemble(target, lookup, reader, reader_into=reader_into)
         except (KeyError, ValueError) as e:
             logger.warning("shm restore incomplete (%s) — trying storage", e)
             return None
@@ -766,7 +778,7 @@ def _unpack_program(layout):
     return jax.jit(unpack)
 
 
-def _assemble(target, lookup: Dict[str, Dict], reader):
+def _assemble(target, lookup: Dict[str, Dict], reader, reader_into=None):
     """Rebuild a pytree like ``target`` from saved leaf metas + a byte
     reader. Handles re-sharding: each needed addressable shard is cut from
     whichever saved shards cover its global index range.
@@ -774,11 +786,23 @@ def _assemble(target, lookup: Dict[str, Dict], reader):
     Two-phase: every (leaf, shard) read+transfer is submitted to a thread
     pool first (small regions coalesced per device by the packer), then
     finalized in tree order — so transfers overlap instead of running one
-    ``device_put`` at a time (VERDICT r1 weak #3, r2 weak #3)."""
+    ``device_put`` at a time (VERDICT r1 weak #3, r2 weak #3).
+
+    ``reader_into(leaf_meta, shard_meta, out) -> bool`` (optional): fill
+    a writable buffer in place; numpy target leaves that exactly match a
+    single saved shard are then restored without allocating. In-place
+    fills mutate the caller's buffers as they land, so all target paths
+    are validated against the frame UP FRONT — a structurally-mismatched
+    frame fails before any byte is written. (A mid-read failure can still
+    leave a partial fill; in-place callers own that trade.)"""
     import jax
     from concurrent.futures import ThreadPoolExecutor
 
     named, treedef = _tree_flatten_with_names(target)
+    if reader_into is not None:
+        missing = [path for path, _ in named if path not in lookup]
+        if missing:
+            raise KeyError(missing[0])
     with ThreadPoolExecutor(_RESTORE_THREADS) as pool:
         packer = _ShardPacker(pool)
         finalizers = []
@@ -796,20 +820,43 @@ def _assemble(target, lookup: Dict[str, Dict], reader):
                     pool, gshape, dtype, leaf.sharding, leaf_meta, reader,
                     packer,
                 ))
-            else:
-                # plain numpy target: reassemble the full global array
-                read_region = _make_region_reader(
-                    gshape, dtype, leaf_meta, reader
-                )
-                fut = pool.submit(
-                    read_region, tuple(slice(0, g) for g in gshape)
-                )
-                # the fast-path frombuffer view is read-only; numpy
-                # targets were historically writable — copy if needed
-                finalizers.append(lambda f=fut: (
-                    f.result() if f.result().flags.writeable
-                    else f.result().copy()
-                ))
+                continue
+            saved = leaf_meta["shards"]
+            if (
+                reader_into is not None
+                and isinstance(leaf, np.ndarray)
+                and leaf.flags.writeable
+                and leaf.flags["C_CONTIGUOUS"]
+                and leaf.dtype == dtype
+                and leaf.shape == gshape
+                and len(saved) == 1
+                and list(saved[0]["start"]) == [0] * len(gshape)
+                and tuple(saved[0]["lshape"]) == gshape
+            ):
+                # in-place fast path: one saved shard covers the whole
+                # target leaf — fill it where it sits
+                def fill(out=leaf, lm=leaf_meta, sm=saved[0]):
+                    if not reader_into(lm, sm, out):
+                        raise ValueError(f"in-place read failed for "
+                                         f"{lm['path']}")
+                    return out
+
+                fut = pool.submit(fill)
+                finalizers.append(fut.result)
+                continue
+            # plain numpy target: reassemble the full global array
+            read_region = _make_region_reader(
+                gshape, dtype, leaf_meta, reader
+            )
+            fut = pool.submit(
+                read_region, tuple(slice(0, g) for g in gshape)
+            )
+            # the fast-path frombuffer view is read-only; numpy
+            # targets were historically writable — copy if needed
+            finalizers.append(lambda f=fut: (
+                f.result() if f.result().flags.writeable
+                else f.result().copy()
+            ))
         packer.flush()
         # finalize inside the pool context so worker exceptions surface
         # here (future.result re-raises KeyError/ValueError for callers)

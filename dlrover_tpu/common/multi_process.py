@@ -27,6 +27,29 @@ from dlrover_tpu.common.log import logger
 _LEN = struct.Struct(">I")
 
 
+def _owner_alive(owner: Any) -> Optional[bool]:
+    """Liveness of a lock owner recorded as a pid string: True/False, or
+    None when the owner field isn't a verifiable pid."""
+    try:
+        pid = int(owner)
+    except (TypeError, ValueError):
+        return None
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        pass
+    try:
+        # a SIGKILLed-but-unreaped holder is a zombie: kill(pid, 0) still
+        # succeeds, but its lock must be treated as abandoned
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        return stat.rsplit(b")", 1)[1].split()[0] != b"Z"
+    except (OSError, IndexError):
+        return True
+
+
 def send_msg(sock: socket.socket, obj: Any) -> None:
     data = msgpack.packb(obj, use_bin_type=True)
     sock.sendall(_LEN.pack(len(data)) + data)
@@ -139,19 +162,57 @@ class LocalIPCServer:
             self._release_locks_of(token)
 
     def _release_locks_of(self, token: object) -> None:
+        # Each check-then-release runs under _meta_lock, serialized against
+        # _lock_op's state updates: without that, an interleaved explicit
+        # release + fresh acquire could make this cleanup release a lock now
+        # held by a live client. A connection can also die while its holder
+        # lives on (_IPCClient reconnects on transient OSError; the server
+        # drops conns on undecodable frames) — so only a verifiably-DEAD
+        # owner loses its lock. The kernel closes a dying process's fds
+        # before it turns zombie, so "alive" right after a disconnect may be
+        # exit-in-progress: re-check briefly before trusting it.
+        for _attempt in range(4):
+            holder_looks_alive = False
+            with self._meta_lock:
+                for name, state in self._locks.items():
+                    if not state["lock"].locked():
+                        continue
+                    owner = state.get("owner")
+                    if state.get("conn_token") is token:
+                        if _owner_alive(owner) is True:
+                            holder_looks_alive = True
+                            continue
+                    elif not (
+                        state.get("conn_token") is None
+                        and _owner_alive(owner) is False
+                    ):
+                        # sweep orphans from earlier live-at-disconnect
+                        # holders that have since died; leave the rest alone
+                        continue
+                    state["owner"] = None
+                    state["conn_token"] = None
+                    try:
+                        state["lock"].release()
+                    except RuntimeError:
+                        continue
+                    logger.warning(
+                        "ipc lock %r auto-released: holder (pid %s) gone",
+                        name, owner,
+                    )
+            if not holder_looks_alive:
+                return
+            time.sleep(0.05)
+        # the holder really is alive: its conn is gone, so detach the token
+        # — a later disconnect sweep or acquire-time reclaim frees the lock
+        # if the holder dies without releasing
         with self._meta_lock:
-            states = list(self._locks.items())
-        for name, state in states:
-            if state.get("conn_token") is token and state["lock"].locked():
-                state["owner"] = None
-                state["conn_token"] = None
-                try:
-                    state["lock"].release()
-                except RuntimeError:
-                    continue
-                logger.warning(
-                    "ipc lock %r auto-released: holder disconnected", name
-                )
+            for name, state in self._locks.items():
+                if state.get("conn_token") is token and state["lock"].locked():
+                    state["conn_token"] = None
+                    logger.warning(
+                        "ipc lock %r: holder conn dropped but pid %s is "
+                        "alive — keeping the lock", name, state.get("owner"),
+                    )
 
     def _dispatch(self, req: Dict, token: object = None) -> Any:
         kind, name, method = req["kind"], req["name"], req["method"]
@@ -177,24 +238,68 @@ class LocalIPCServer:
         if method == "acquire":
             blocking = args.get("blocking", True)
             timeout = args.get("timeout", -1)
-            if blocking and timeout and timeout > 0:
-                acquired = state["lock"].acquire(timeout=timeout)
+
+            def _reclaim_if_holder_dead() -> None:
+                # the blocker may be a dead holder whose conn never
+                # dropped (or dropped while it was still alive, detaching
+                # the conn token)
+                with self._meta_lock:
+                    holder = state.get("owner")
+                    if (state["lock"].locked()
+                            and _owner_alive(holder) is False):
+                        state["owner"] = None
+                        state["conn_token"] = None
+                        try:
+                            state["lock"].release()
+                        except RuntimeError:
+                            pass
+                        logger.warning(
+                            "ipc lock %r reclaimed from dead pid %s",
+                            name, holder,
+                        )
+
+            if not blocking:
+                acquired = state["lock"].acquire(blocking=False)
+                if not acquired:
+                    _reclaim_if_holder_dead()
+                    acquired = state["lock"].acquire(blocking=False)
             else:
-                acquired = state["lock"].acquire(blocking=blocking)
+                # blocking waits run in bounded slices with a dead-holder
+                # check between them — a holder that dies while we block
+                # (its conn already detached) must not deadlock us
+                deadline = (
+                    time.monotonic() + timeout
+                    if timeout and timeout > 0 else None
+                )
+                acquired = False
+                while not acquired:
+                    remain = (
+                        deadline - time.monotonic()
+                        if deadline is not None else 2.0
+                    )
+                    if deadline is not None and remain <= 0:
+                        break
+                    acquired = state["lock"].acquire(
+                        timeout=min(2.0, remain)
+                    )
+                    if not acquired:
+                        _reclaim_if_holder_dead()
             if acquired:
-                state["owner"] = owner
-                state["conn_token"] = token
+                with self._meta_lock:
+                    state["owner"] = owner
+                    state["conn_token"] = token
             return acquired
         if method == "release":
-            if state["lock"].locked():
-                state["owner"] = None
-                state["conn_token"] = None
-                try:
-                    state["lock"].release()
-                except RuntimeError:
-                    pass
-                return True
-            return False
+            with self._meta_lock:
+                if state["lock"].locked():
+                    state["owner"] = None
+                    state["conn_token"] = None
+                    try:
+                        state["lock"].release()
+                    except RuntimeError:
+                        pass
+                    return True
+                return False
         if method == "locked":
             return state["lock"].locked()
         raise ValueError(f"unknown lock method {method}")

@@ -60,11 +60,13 @@ def test_chaos_kill_shrink_resume_rejoin():
     # shrink/rejoin (collectives stayed correct at every world size)
     assert result["w_final"] == 60.0
     # fault DETECTION rides the heartbeat-connection drop (grace recheck),
-    # not the heartbeat timeout: ~conn_drop_grace_s (1.2s measured)
-    assert result["detect_s"] <= 2.0, result["detect_s"]
+    # not the heartbeat timeout: 1.2s measured, ~30% CI headroom
+    assert result["detect_s"] <= 1.6, result["detect_s"]
     # kill -> world-1 training resumed (detect + restart + re-rendezvous +
-    # re-init + restore + recompile): 4.6-4.8s measured, 2x CI headroom
-    assert result["shrink_detect_s"] <= 10.0, result["shrink_detect_s"]
+    # re-init + restore + recompile): 3.2s recorded in BENCH_r04 with the
+    # warm spawn pool (4.6-4.8s before it); bound = r4-verdict-prescribed
+    # 5.0 — ~55% over the warm-pool median
+    assert result["shrink_detect_s"] <= 5.0, result["shrink_detect_s"]
     # the goodput numbers exist and are sane
     assert 0 < result["goodput_pct"] <= 100
     # per-fault recovery cost at production scale clears the reference bar
@@ -100,10 +102,11 @@ def test_chaos_direct_goodput_two_faults():
     assert result["faults_injected"] == 2
     # the drill ran long enough that the direct number is meaningful
     assert result["wall_s"] >= 180.0, result["wall_s"]
-    # both recovery paths fired
-    assert result["detect_s"] <= 2.0, result["detect_s"]
+    # both recovery paths fired (hang recovery 7.3-11.9s measured,
+    # ~30% headroom over the top of that range)
+    assert result["detect_s"] <= 1.6, result["detect_s"]
     assert result["hang_recover_s"] is not None
-    assert result["hang_recover_s"] <= 30.0, result["hang_recover_s"]
+    assert result["hang_recover_s"] <= 15.0, result["hang_recover_s"]
     # every step completed exactly once across both faults
     assert result["final_step"] == 1099
     assert result["w_final"] == 1100.0

@@ -526,3 +526,47 @@ def test_packed_restore_many_small_leaves(tmp_path, mesh):
         assert len(puts) <= 2 * len(jax.devices()), len(puts)
     finally:
         unlink_shared_memory(shm_name(engine.job_name, 0, 0))
+
+
+def test_load_in_place_fills_numpy_targets(tmp_path):
+    """in_place=True restores writable numpy leaves where they sit (no
+    fresh allocation — the host-resident fast path) and still returns a
+    correct tree; non-matching leaves fall back to the regular path."""
+    rng = np.random.default_rng(0)
+    state = {
+        "big": rng.standard_normal((256, 1024)).astype(np.float32),
+        "small": rng.standard_normal((16,)).astype(np.float32),
+        "step_no": 7,
+    }
+    engine = CheckpointEngine(
+        str(tmp_path), job_name=f"inplace{os.getpid()}", node_rank=0,
+        local_rank=0, ipc_socket="/nonexistent", world_size=1, rank=0,
+    )
+    try:
+        assert engine.save_to_memory(5, state, blocking=True)
+        target = {
+            "big": np.zeros((256, 1024), np.float32),
+            "small": np.zeros((16,), np.float32),
+            "step_no": 0,
+        }
+        restored, step = engine.load(target, in_place=True)
+        assert step == 5
+        # the in-place path reused the target's own buffer...
+        assert restored["big"] is target["big"]
+        # ...and filled it with the saved bytes
+        np.testing.assert_array_equal(restored["big"], state["big"])
+        np.testing.assert_array_equal(restored["small"], state["small"])
+        assert restored["step_no"] == 7
+        # read-only targets must NOT be written in place
+        ro_target = {
+            "big": np.zeros((256, 1024), np.float32),
+            "small": np.zeros((16,), np.float32),
+            "step_no": 0,
+        }
+        ro_target["big"].flags.writeable = False
+        restored2, step2 = engine.load(ro_target, in_place=True)
+        assert step2 == 5
+        assert restored2["big"] is not ro_target["big"]
+        np.testing.assert_array_equal(restored2["big"], state["big"])
+    finally:
+        unlink_shared_memory(shm_name(engine.job_name, 0, 0))

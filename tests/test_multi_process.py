@@ -135,6 +135,25 @@ def test_lock_not_released_while_holder_alive(ipc_server):
     holder.release()
 
 
+def test_lock_kept_when_holder_conn_drops_but_holder_alive(ipc_server):
+    """A holder's CONNECTION can die while the holder lives (client
+    reconnect on transient OSError, server dropping a bad frame). The
+    cleanup must verify the recorded owner pid is dead before releasing —
+    this process is alive, so the lock stays held."""
+    holder = SharedLock("connloss", ipc_server.path)
+    assert holder.acquire()
+    holder._client._close()  # the HOLDER's conn drops; holder pid lives on
+    time.sleep(0.5)  # past the cleanup's exit-in-progress settle loop
+    probe = SharedLock("connloss", ipc_server.path)
+    assert not probe.acquire(blocking=False), (
+        "lock was auto-released although its owner process is alive"
+    )
+    # the holder (same pid, reconnected client) can still release it
+    assert holder.release()
+    assert probe.acquire(blocking=False)
+    probe.release()
+
+
 def test_shared_memory_survives_close():
     name = f"dlrtpu_test_{os.getpid()}"
     unlink_shared_memory(name)
