@@ -35,6 +35,7 @@ On non-TPU backends (CPU tests) the kernels run in pallas interpret mode.
 """
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -432,9 +433,14 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
     do, dlse = g
+    # the backward kernels' working set (5 dots/block, 2-3 f32 scratch
+    # accumulators) tiles differently from the forward's — let the bwd
+    # blocks be tuned independently (read at trace time)
+    bq = int(os.environ.get("DLROVER_TPU_FLASH_BWD_BLOCK_Q", 0)) or block_q
+    bk = int(os.environ.get("DLROVER_TPU_FLASH_BWD_BLOCK_K", 0)) or block_k
     dq, dk, dv = _bwd(
         q, k, v, o, lse, do, dlse, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=bq, block_k=bk, interpret=interpret,
     )
     return dq, dk, dv
 
