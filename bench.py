@@ -943,15 +943,21 @@ def bench_goodput(timeout_s: float = 300.0) -> dict:
     repo = os.path.dirname(os.path.abspath(__file__))
 
     def run_drill(args, drill_timeout_s):
-        proc = subprocess.run(
-            [
-                sys.executable,
-                os.path.join(repo, "examples", "chaos_goodput.py"),
-                *args,
-            ],
-            env=env, capture_output=True, text=True,
-            timeout=max(30.0, drill_timeout_s), cwd=repo,
-        )
+        budget = max(30.0, drill_timeout_s)
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(repo, "examples", "chaos_goodput.py"),
+                    *args,
+                ],
+                env=env, capture_output=True, text=True,
+                timeout=budget, cwd=repo,
+            )
+        except subprocess.TimeoutExpired:
+            # an error dict, not a raise: the outer handler would swallow
+            # the whole section and skip the short-drill fallback
+            return {"error": f"drill timed out after {budget:.0f}s"}
         if proc.returncode != 0:
             return {"error": proc.stderr[-500:]}
         out = json.loads(proc.stdout.strip().splitlines()[-1])
@@ -1067,7 +1073,10 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
             "flash_fwdbwd_ms"),
         "goodput": pick(goodput, (
             "goodput_pct", "faults_injected", "hang_recover_s", "detect_s",
-            "shrink_detect_s", "wall_s", "drill")),
+            "shrink_detect_s", "wall_s", "drill",
+            # journal-derived attribution (observability spine): the
+            # system's own /metrics phase gauges, not a bench re-derivation
+            "journal_goodput_pct", "metrics_scrape_ok", "phases")),
         "ckpt": pick(ckpt, (
             "state_gb", "t_block_s", "t_restore_s",
             "restore_link_efficiency", "restore_link_efficiency_met",

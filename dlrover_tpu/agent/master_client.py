@@ -8,7 +8,7 @@ named RPC method served by :class:`dlrover_tpu.master.servicer.MasterServicer`.
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.constants import EnvKey
@@ -215,6 +215,21 @@ class MasterClient:
                 restart_count=restart_count,
             ),
         )
+
+    def report_event(self, kind: str, data: Optional[Dict[str, Any]] = None
+                     ) -> None:
+        """Append a typed event to the master's journal. Telemetry: one
+        attempt, failures swallowed — must never stall or fail the agent."""
+        try:
+            self._client.call(
+                "report_event",
+                comm.EventReport(
+                    node_id=self._node_id, kind=kind, data=data or {}
+                ),
+                retries=1,
+            )
+        except Exception:  # noqa: BLE001
+            pass
 
     def report_global_step(self, step: int, timestamp: float = 0.0,
                            retries: Optional[int] = None,

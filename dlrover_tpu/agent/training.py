@@ -197,6 +197,55 @@ class ElasticTrainingAgent:
         self._events = get_emitter(f"agent_{config.node_rank}")
         self._training_monitor = None
         self._replica_service = None
+        # observability spine: local metrics (scraped via the optional
+        # per-agent /metrics server) + journal events reported to master
+        from dlrover_tpu.observability.registry import get_registry
+
+        reg = get_registry()
+        self._step_time_hist = reg.histogram(
+            "dlrover_agent_step_seconds",
+            "Wall time between consecutive observed global steps",
+        )
+        self._restarts_counter = reg.counter(
+            "dlrover_agent_restarts_total", "Soft worker restarts, by reason",
+            labelnames=("reason",),
+        )
+        self._worker_failures_counter = reg.counter(
+            "dlrover_agent_worker_failures_total",
+            "Worker process failures observed by the agent",
+        )
+        reg.gauge(
+            "dlrover_agent_global_step", "Last global step this agent saw"
+        ).set_function(lambda: self._last_global_step)
+        self._metrics_server = self._maybe_start_metrics_server()
+
+    def _maybe_start_metrics_server(self):
+        """Per-agent scrape surface, gated on
+        DLROVER_TPU_AGENT_METRICS_PORT (0 = pick a free port). The base
+        port is offset by node_rank so multi-agent hosts don't collide."""
+        port_env = os.getenv("DLROVER_TPU_AGENT_METRICS_PORT")
+        if not port_env:
+            return None
+        from dlrover_tpu.common.http_server import HTTPTransportServer
+        from dlrover_tpu.observability.registry import get_registry
+
+        try:
+            base = int(port_env)
+            port = base + self._config.node_rank if base else 0
+            server = HTTPTransportServer(port=port)
+        except (ValueError, OSError) as e:
+            logger.warning("agent metrics server disabled: %r", e)
+            return None
+        server.add_get_route(
+            "/metrics",
+            lambda: (
+                "text/plain; version=0.0.4; charset=utf-8",
+                get_registry().render(),
+            ),
+        )
+        server.start()
+        logger.info("agent metrics on :%s/metrics", server.port)
+        return server
 
     # -- rendezvous + spawn ------------------------------------------------
 
@@ -353,6 +402,7 @@ class ElasticTrainingAgent:
         logger.info("restarting workers on node %s: %s",
                     self._config.node_rank, reason)
         self._events.instant(AgentEvent.RESTART, reason=reason)
+        self._restarts_counter.labels(reason=reason).inc()
         # stop first: shm survives the workers, and persisting after they
         # die removes any chance of reading a frame mid-write
         self._stop_workers(grace_s=grace_s)
@@ -416,6 +466,12 @@ class ElasticTrainingAgent:
             return pending if pending is not None else (None, {})
 
     def observe_global_step(self, step: int, ts: float) -> None:
+        if self._last_step_ts == 0.0:
+            # first completed step of this incarnation: training is live
+            # again — the master closes its recompile/restore phase here
+            self._client.report_event("step_resumed", {"step": step})
+        elif ts > self._last_step_ts:
+            self._step_time_hist.observe(ts - self._last_step_ts)
         self._last_global_step = step
         self._last_step_ts = ts
 
@@ -601,6 +657,7 @@ class ElasticTrainingAgent:
             AgentEvent.WORKER_FAIL, failures=result.failures,
             restart_count=self._restart_count,
         )
+        self._worker_failures_counter.inc()
         try:
             self._client.report_failure(
                 error_data=str(result.failures),

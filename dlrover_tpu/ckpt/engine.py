@@ -167,6 +167,27 @@ class CheckpointEngine:
                     pass  # best-effort: the leak is bounded per incarnation
         self._drain_thread: Optional[threading.Thread] = None
         self._drain_ok = False
+        # observability spine: scraped via the agent/master /metrics route
+        from dlrover_tpu.observability.registry import get_registry
+
+        _reg = get_registry()
+        self._save_block_hist = _reg.histogram(
+            "dlrover_ckpt_save_block_seconds",
+            "Training pause per save (plan + D2H dispatch)",
+        )
+        self._drain_hist = _reg.histogram(
+            "dlrover_ckpt_drain_seconds",
+            "Background shm drain duration per snapshot",
+        )
+        self._restore_hist = _reg.histogram(
+            "dlrover_ckpt_restore_seconds",
+            "End-to-end restore latency, by source",
+            labelnames=("source",),
+        )
+        self._drain_rate_gauge = _reg.gauge(
+            "dlrover_ckpt_drain_bytes_per_second",
+            "Throughput of the most recent shm drain",
+        )
         # donation safety (see _plan_state): snapshot shards on-device
         # before the async drain unless explicitly disabled
         self._device_snapshot = os.getenv(
@@ -236,6 +257,7 @@ class CheckpointEngine:
                 "step %s: skip save, %s", step, why or "a peer rank is busy"
             )
             return False
+        block_t0 = time.monotonic()
         try:
             meta, pending = self._plan_state(step, state)
             if self._meta_dict is not None:
@@ -257,10 +279,19 @@ class CheckpointEngine:
                 self._save_lock.release()
             raise
 
+        self._save_block_hist.observe(time.monotonic() - block_t0)
+
         def _drain():
             try:
+                drain_t0 = time.monotonic()
                 buffers = [np.asarray(data) for _, data in pending]
                 self._shm.write_frame(meta, buffers)
+                drain_s = time.monotonic() - drain_t0
+                self._drain_hist.observe(drain_s)
+                if drain_s > 0:
+                    self._drain_rate_gauge.set(
+                        sum(b.nbytes for b in buffers) / drain_s
+                    )
                 self._latest_step = step
                 self._drain_ok = True
                 if self._replicas is not None:
@@ -603,6 +634,8 @@ class CheckpointEngine:
         """
         # an in-flight async snapshot must land before we read the frame
         self.wait_drained()
+        restore_t0 = time.monotonic()
+        self._report_event("restore_start")
         if self._replicas is not None:
             # a relaunched node's shm is empty — pull own frame from a
             # backup-group peer first (replica.py restore semantics)
@@ -615,8 +648,31 @@ class CheckpointEngine:
             state = self._load_from_shm(target, in_place=in_place)
             if state is not None:
                 logger.info("restored step %s from shared memory", step)
+                self._finish_restore(restore_t0, "shm", step)
                 return state, step
-        return self._load_from_storage(target, path or self.ckpt_dir)
+        state, step = self._load_from_storage(target, path or self.ckpt_dir)
+        self._finish_restore(restore_t0, "storage", step)
+        return state, step
+
+    def _report_event(self, kind: str, data: Optional[Dict] = None) -> None:
+        """Journal telemetry to the master; best-effort (stub clients in
+        tests may lack the method, and a dead master must not fail load)."""
+        report = getattr(self._master, "report_event", None)
+        if report is not None:
+            try:
+                report(kind, data or {})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _finish_restore(self, t0: float, source: str, step: int) -> None:
+        elapsed = time.monotonic() - t0
+        self._restore_hist.labels(source=source).observe(elapsed)
+        self._report_event(
+            "restore_complete",
+            # "medium", not "source": the journal reserves "source" for
+            # the reporting component's identity (agent_N)
+            {"medium": source, "step": step, "duration_s": elapsed},
+        )
 
     def _load_from_shm(self, target, in_place: bool = False):
         meta = self._shm.read_meta()
@@ -791,18 +847,19 @@ def _assemble(target, lookup: Dict[str, Dict], reader, reader_into=None):
     ``reader_into(leaf_meta, shard_meta, out) -> bool`` (optional): fill
     a writable buffer in place; numpy target leaves that exactly match a
     single saved shard are then restored without allocating. In-place
-    fills mutate the caller's buffers as they land, so all target paths
-    are validated against the frame UP FRONT — a structurally-mismatched
-    frame fails before any byte is written. (A mid-read failure can still
-    leave a partial fill; in-place callers own that trade.)"""
+    fills mutate the caller's buffers as they land, so the frame is
+    validated against the target UP FRONT: every target path must exist,
+    every numpy array leaf must match the frame's dtype and global shape,
+    and every array leaf's saved shards must cover its full global region
+    — a structurally-mismatched or incomplete frame fails before any byte
+    is written. (A mid-read I/O failure can still leave a partial fill;
+    in-place callers own that trade.)"""
     import jax
     from concurrent.futures import ThreadPoolExecutor
 
     named, treedef = _tree_flatten_with_names(target)
     if reader_into is not None:
-        missing = [path for path, _ in named if path not in lookup]
-        if missing:
-            raise KeyError(missing[0])
+        _validate_frame_against_target(named, lookup)
     with ThreadPoolExecutor(_RESTORE_THREADS) as pool:
         packer = _ShardPacker(pool)
         finalizers = []
@@ -862,6 +919,48 @@ def _assemble(target, lookup: Dict[str, Dict], reader, reader_into=None):
         # here (future.result re-raises KeyError/ValueError for callers)
         out_leaves = [f() for f in finalizers]
     return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+def _validate_frame_against_target(named, lookup) -> None:
+    """Up-front structural validation for in-place restores: missing
+    paths, numpy dtype/global-shape mismatches, and incomplete shard
+    coverage all raise BEFORE any target buffer is mutated, so a bad
+    frame falls through to the storage path with the caller's state
+    untouched. Coverage is checked by clipped-shard volume, which cannot
+    over-count disjoint shards (the save planner never overlaps shards);
+    the per-region check in ``_make_region_reader`` stays as the byte-
+    accurate backstop."""
+    for path, leaf in named:
+        leaf_meta = lookup.get(path)
+        if leaf_meta is None:
+            raise KeyError(path)
+        if leaf_meta["kind"] == "value":
+            continue
+        dtype = _np_dtype(leaf_meta["dtype"])
+        gshape = tuple(leaf_meta["gshape"])
+        if isinstance(leaf, np.ndarray):
+            if leaf.dtype != dtype:
+                raise ValueError(
+                    f"{path}: frame dtype {dtype} != target {leaf.dtype}"
+                )
+            if leaf.shape != gshape:
+                raise ValueError(
+                    f"{path}: frame gshape {gshape} != target {leaf.shape}"
+                )
+        total = int(np.prod(gshape)) if gshape else 1
+        covered = 0
+        for shard_meta in leaf_meta["shards"]:
+            vol = 1
+            for start, length, g in zip(
+                shard_meta["start"], shard_meta["lshape"], gshape
+            ):
+                vol *= max(0, min(start + length, g) - max(start, 0))
+            covered += vol if gshape else 1
+        if covered < total:
+            raise ValueError(
+                f"checkpoint incomplete for {path}: shards cover "
+                f"{covered}/{total} elements of gshape {gshape}"
+            )
 
 
 def _region_shape(index, gshape):

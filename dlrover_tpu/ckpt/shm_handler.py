@@ -194,6 +194,26 @@ class SharedMemoryHandler:
 
     # -- read --------------------------------------------------------------
 
+    @staticmethod
+    def _preadv_full(fd, buf, offset: int) -> bool:
+        """Read exactly ``len(buf)`` bytes at ``offset``, looping over
+        short reads: a single ``preadv`` caps at MAX_RW_COUNT (~2 GB on
+        Linux), so one-shot reads silently truncate on multi-GB frames
+        and would push them onto the 4-45x slower mmap walk."""
+        import os
+
+        mv = memoryview(buf).cast("B")
+        pos, n = 0, len(mv)
+        while pos < n:
+            try:
+                got = os.preadv(fd, [mv[pos:]], offset + pos)
+            except OSError:
+                return False
+            if got <= 0:
+                return False
+            pos += got
+        return True
+
     def read_meta(self) -> Optional[Dict]:
         if not self.open():
             return None
@@ -218,14 +238,9 @@ class SharedMemoryHandler:
         n = shard_meta["nbytes"]
         fd = self._shard_fd()
         if fd is not None:
-            import os
-
             buf = bytearray(n)
-            try:
-                if os.preadv(fd, [buf], off) == n:
-                    return buf
-            except OSError:
-                pass
+            if self._preadv_full(fd, buf, off):
+                return buf
         return bytes(self._shm.buf[off : off + n])
 
     def read_shard_into(self, shard_meta: Dict, out) -> bool:
@@ -235,8 +250,6 @@ class SharedMemoryHandler:
         that dominates fresh-buffer reads on VM hosts."""
         if not self.open():
             return False
-        import os
-
         off = shard_meta["abs_offset"]
         n = shard_meta["nbytes"]
         mv = memoryview(out)
@@ -246,12 +259,8 @@ class SharedMemoryHandler:
             return False
         mv = mv.cast("B")
         fd = self._shard_fd()
-        if fd is not None:
-            try:
-                if os.preadv(fd, [mv], off) == n:
-                    return True
-            except OSError:
-                pass
+        if fd is not None and self._preadv_full(fd, mv, off):
+            return True
         mv[:] = self._shm.buf[off : off + n]
         return True
 
@@ -267,16 +276,11 @@ class SharedMemoryHandler:
                 end = max(end, shard["abs_offset"] + shard["nbytes"])
         fd = self._shard_fd()
         if fd is not None:
-            import os
-
             buf = bytearray(end)
-            try:
-                if os.preadv(fd, [buf], 0) == end:
-                    # bytearray, not bytes: callers sendall/write it, and
-                    # a bytes() conversion would double multi-GB frames
-                    return buf
-            except OSError:
-                pass
+            if self._preadv_full(fd, buf, 0):
+                # bytearray, not bytes: callers sendall/write it, and
+                # a bytes() conversion would double multi-GB frames
+                return buf
         return bytes(self._shm.buf[:end])
 
     @property

@@ -250,6 +250,16 @@ class NodeFailureReport:
 
 
 @message
+class EventReport:
+    """Agent/worker → master journal event (observability/journal.py).
+    The master stamps arrival time; no timestamps cross the wire."""
+
+    node_id: int = 0
+    kind: str = ""
+    data: Dict[str, Any] = field(default_factory=dict)
+
+
+@message
 class NetworkCheckResult:
     node_id: int = 0
     normal: bool = True

@@ -37,6 +37,9 @@ class HTTPTransportServer:
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0):
         self.registry: Dict[str, Callable[[Any], Any]] = {}
+        # GET routes: path → () -> (content_type, body_bytes). /metrics and
+        # /events mount here; /healthz is built in.
+        self.get_routes: Dict[str, Callable[[], Any]] = {}
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -44,12 +47,23 @@ class HTTPTransportServer:
                 pass
 
             def do_GET(self):
-                if self.path == "/healthz":
-                    body = b"ok"
-                    self.send_response(200)
+                path = self.path.split("?", 1)[0]
+                route = outer.get_routes.get(path)
+                if path == "/healthz":
+                    ctype, body, code = "text/plain", b"ok", 200
+                elif route is not None:
+                    try:
+                        ctype, body = route()
+                        if isinstance(body, str):
+                            body = body.encode("utf-8")
+                        code = 200
+                    except Exception as e:  # noqa: BLE001 — report, don't die
+                        logger.exception("GET %s handler failed", path)
+                        ctype, body, code = "text/plain", repr(e).encode(), 500
                 else:
-                    body = b"not found"
-                    self.send_response(404)
+                    ctype, body, code = "text/plain", b"not found", 404
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -90,6 +104,12 @@ class HTTPTransportServer:
 
     def register(self, method: str, handler: Callable[[Any], Any]) -> None:
         self.registry[method] = handler
+
+    def add_get_route(self, path: str,
+                      handler: Callable[[], Any]) -> None:
+        """Mount a GET endpoint. ``handler`` returns ``(content_type,
+        body)`` where body is bytes or str."""
+        self.get_routes[path] = handler
 
     def register_object(self, obj: Any, prefix: str = "rpc_") -> None:
         """Mount every ``rpc_*`` method like RPCServer.register_object."""

@@ -1,0 +1,73 @@
+"""Version-compat shims over the jax API span the fleet actually ships.
+
+The framework targets current jax (``jax.shard_map``, elastic
+``shutdown_timeout_seconds``/``heartbeat_timeout_seconds`` kwargs on
+``jax.distributed.initialize``), but containers pin older 0.4.x wheels
+where ``shard_map`` still lives in ``jax.experimental`` and
+``initialize`` rejects the elastic kwargs.  Both gaps are pure API
+surface — the underlying behavior exists (shard_map) or degrades to the
+library default (the distributed-service timeouts) — so the shims keep
+one codebase running across the span instead of forking call sites.
+"""
+
+import inspect
+
+
+def install() -> None:
+    """Alias ``jax.experimental.shard_map.shard_map`` as ``jax.shard_map``
+    when the top-level name is missing, translating the renamed
+    ``check_vma`` kwarg (today's name) to the old ``check_rep``.
+    Idempotent; a no-op on jax versions that already export it."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return
+
+    from jax.experimental.shard_map import shard_map
+
+    params = inspect.signature(shard_map).parameters
+
+    def _shard_map(*args, **kwargs):
+        if "check_vma" in kwargs and "check_vma" not in params:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return shard_map(*args, **kwargs)
+
+    jax.shard_map = _shard_map
+
+
+def distributed_initialize(**kwargs) -> None:
+    """``jax.distributed.initialize`` minus the kwargs this jax build
+    doesn't know.  Elastic tuning knobs (shutdown/heartbeat timeouts)
+    silently fall back to the library defaults on old wheels — worse
+    reap latency, same correctness — rather than TypeError-ing the
+    worker out of the job."""
+    import os
+
+    import jax
+
+    # old wheels default the CPU backend to NO cross-process collectives
+    # (newer jax ships gloo by default): a multi-process CPU world then
+    # can't even device_put a global array.  Opt into gloo before the
+    # backend initializes; only for CPU worlds, and never overriding an
+    # explicit choice (e.g. mpi).
+    platforms = jax.config.jax_platforms or os.environ.get(
+        "JAX_PLATFORMS", ""
+    )
+    try:  # the option holder predates attribute-style config access
+        from jax._src import xla_bridge
+
+        current = xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION.value
+    except Exception:  # noqa: BLE001 — modern jax: gloo already default
+        current = "gloo"
+    if "cpu" in platforms and current in (None, "none"):
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo"
+            )
+        except Exception:  # noqa: BLE001 — never block worker bring-up
+            pass
+
+    supported = inspect.signature(jax.distributed.initialize).parameters
+    jax.distributed.initialize(
+        **{k: v for k, v in kwargs.items() if k in supported}
+    )

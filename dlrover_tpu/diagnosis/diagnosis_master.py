@@ -150,10 +150,19 @@ class DiagnosisMaster:
         perf_monitor=None,
         precheck_ops: Optional[List[str]] = None,
         metric_context=None,
+        event_journal=None,
     ):
         ctx = get_context()
         self._job_manager = job_manager
         self._perf_monitor = perf_monitor
+        self._event_journal = event_journal
+        from dlrover_tpu.observability.registry import get_registry
+
+        self._actions_counter = get_registry().counter(
+            "dlrover_diagnosis_actions_total",
+            "Diagnosis actions sunk, by action type and verdict",
+            labelnames=("type", "verdict"),
+        )
         # node_id → (latest profiler gauges, receive timestamp)
         self._node_gauges: Dict[int, tuple] = {}
         self._precheck = PreCheckRunner(
@@ -177,12 +186,28 @@ class DiagnosisMaster:
         """EVENT actions go to the event log; everything else rides to
         agents via the JobManager's delivery queue (which no EVENT consumer
         drains — queueing them there would only clog dedup)."""
+        verdict = (
+            action.data.get("event_type", "")
+            if action.action_type == DiagnosisActionType.EVENT
+            else (action.reason or "")
+        )
+        self._actions_counter.labels(
+            type=action.action_type, verdict=verdict
+        ).inc()
         if action.action_type == DiagnosisActionType.EVENT:
             logger.info(
                 "diagnosis event %s: %s %s",
                 action.data.get("event_type", ""), action.reason, action.data,
             )
             return
+        if (
+            self._event_journal is not None
+            and action.action_type == DiagnosisActionType.RESTART_WORKER
+        ):
+            # a hang restart is a detected fault even though no node died
+            self._event_journal.record(
+                "fault_detected", reason=action.reason or "diagnosis"
+            )
         self._job_manager.enqueue_action(action)
 
     # -- pre-check ---------------------------------------------------------

@@ -53,6 +53,19 @@ class JobMaster:
         )
         self.perf_monitor = PerfMonitor()
         self.task_manager = TaskManager()
+        # observability spine: one authoritative event sequence + the
+        # process metrics registry it exports phase attribution into
+        from dlrover_tpu.observability.journal import (
+            EventJournal,
+            JournalEvent,
+        )
+        from dlrover_tpu.observability.registry import get_registry
+
+        self.event_journal = EventJournal()
+        self.metrics_registry = get_registry()
+        self.event_journal.attach_gauges(self.metrics_registry)
+        # first step report after a recovery phase closes it (step_resumed)
+        self.perf_monitor.journal = self.event_journal
         self.metric_context = JobMetricContext()
         from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
         from dlrover_tpu.master.stats import JobMetricCollector
@@ -74,12 +87,18 @@ class JobMaster:
         max_n = node_num if max_nodes is None else max_nodes
         for manager in self.rdzv_managers.values():
             manager.update_rdzv_params(min_n, max_n, node_unit=node_unit)
+        # only the TRAINING rendezvous feeds goodput attribution; NODE_CHECK
+        # rounds are diagnostics and would pollute the phase timeline
+        self.rdzv_managers[RendezvousName.TRAINING].journal = (
+            self.event_journal
+        )
         if diagnosis_master is None:
             from dlrover_tpu.diagnosis.diagnosis_master import DiagnosisMaster
 
             diagnosis_master = DiagnosisMaster(
                 self.job_manager, self.perf_monitor,
                 metric_context=self.metric_context,
+                event_journal=self.event_journal,
             )
         self.diagnosis_master = diagnosis_master
         self.servicer = MasterServicer(
@@ -92,7 +111,17 @@ class JobMaster:
             diagnosis_master=diagnosis_master,
             metric_context=self.metric_context,
             strategy_generator=self.strategy_generator,
+            event_journal=self.event_journal,
         )
+        # bridge journal kinds into PerfMonitor's lost-time bookkeeping —
+        # fault_happened/fault_recovered get their (only) callers here
+        def _bridge_perf(event, _pm=self.perf_monitor):
+            if event["kind"] == JournalEvent.FAULT_DETECTED:
+                _pm.fault_happened()
+            elif event["kind"] == JournalEvent.STEP_RESUMED:
+                _pm.fault_recovered()
+
+        self.event_journal.add_listener(_bridge_perf)
         self._server = RPCServer(port=port)
         self._server.register_object(self.servicer)
         # fast fault detection: an agent's death closes its heartbeat TCP
@@ -145,6 +174,20 @@ class JobMaster:
             try:
                 self._http_server = HTTPTransportServer(port=int(http_port))
                 self._http_server.register_object(self.servicer)
+                self._http_server.add_get_route(
+                    "/metrics",
+                    lambda: (
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        self.metrics_registry.render(),
+                    ),
+                )
+                self._http_server.add_get_route(
+                    "/events",
+                    lambda: (
+                        "application/json",
+                        self.event_journal.to_json(),
+                    ),
+                )
             except ValueError:
                 logger.warning(
                     "DLROVER_TPU_HTTP_PORT=%r is not a port; http "
@@ -169,6 +212,11 @@ class JobMaster:
             ):
                 return
             self.task_manager.recover_tasks(event.node.id)
+            self.event_journal.record(
+                JournalEvent.FAULT_DETECTED,
+                node_id=event.node.id,
+                status=event.node.status,
+            )
             for manager in self.rdzv_managers.values():
                 manager.remove_alive_node(event.node.rank)
             for node in self.job_manager.list_nodes():

@@ -30,6 +30,25 @@ class PerfMonitor:
         self._fault_started: Optional[float] = None
         self._lost_seconds = 0.0
         self._min_round = -1
+        # master attaches its EventJournal here (master.py); the monitor
+        # closes recovery phases on the first step report after them
+        self.journal = None
+        self._register_metrics()
+
+    def _register_metrics(self) -> None:
+        from dlrover_tpu.observability.registry import get_registry
+
+        reg = get_registry()
+        reg.gauge(
+            "dlrover_goodput_ratio",
+            "Fraction of wall time spent training (perf_monitor view)",
+        ).set_function(self.goodput)
+        reg.gauge(
+            "dlrover_step_speed", "Global steps per second (recent window)"
+        ).set_function(self.running_speed)
+        reg.gauge(
+            "dlrover_global_step", "Last reported completed global step"
+        ).set_function(lambda: self.completed_global_step)
 
     def reset_running_speed_monitor(self, min_round: Optional[int] = None
                                     ) -> None:
@@ -56,6 +75,14 @@ class PerfMonitor:
             self._records.append(GlobalStepRecord(step, timestamp))
             if len(self._records) > self.MAX_RECORDS:
                 self._records.pop(0)
+        # a step completing while the journal still attributes time to a
+        # recovery phase means training is live again: close the phase.
+        # Outside self._lock — the journal's perf bridge listener calls
+        # back into fault_recovered(), which takes it.
+        journal = self.journal
+        if (journal is not None
+                and journal.current_phase() != "productive"):
+            journal.record("step_resumed", step=step)
 
     @property
     def completed_global_step(self) -> int:

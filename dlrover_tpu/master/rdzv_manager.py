@@ -71,6 +71,27 @@ class RendezvousManager(ABC):
         # the moment every SURVIVOR has joined instead of waiting out the
         # last-call window hoping the dead node returns
         self._dead_ranks: set = set()
+        # master attaches its EventJournal to the TRAINING manager only
+        # (NODE_CHECK rounds would pollute goodput attribution)
+        self.journal = None
+        from dlrover_tpu.observability.registry import get_registry
+
+        reg = get_registry()
+        self._round_duration_hist = reg.histogram(
+            "dlrover_rdzv_round_duration_seconds",
+            "First-join to world-cut latency per rendezvous round",
+            labelnames=("rdzv",),
+        ).labels(rdzv=name)
+        self._world_size_gauge = reg.gauge(
+            "dlrover_rdzv_world_size",
+            "Node count of the most recently cut world",
+            labelnames=("rdzv",),
+        ).labels(rdzv=name)
+        self._rounds_counter = reg.counter(
+            "dlrover_rdzv_rounds_total",
+            "Completed rendezvous rounds",
+            labelnames=("rdzv",),
+        ).labels(rdzv=name)
 
     @property
     def name(self) -> str:
@@ -112,6 +133,11 @@ class RendezvousManager(ABC):
         with self._lock:
             if not self._waiting_nodes:
                 self._start_rdzv_ts = time.time()
+                if self.journal is not None:
+                    self.journal.record(
+                        "rdzv_start", round=self._rdzv_round + 1,
+                        first_rank=meta.node_rank,
+                    )
             # a dead node re-joining is alive again: restore it to the
             # expected world so the cut waits for real stragglers only
             self._dead_ranks.discard(meta.node_rank)
@@ -192,8 +218,20 @@ class RendezvousManager(ABC):
         for r in ranks:
             del self._waiting_nodes[r]
         self._rdzv_round += 1
+        duration = (
+            time.time() - self._start_rdzv_ts if self._start_rdzv_ts > 0
+            else 0.0
+        )
         self._lastcall_time = 0.0
         self._start_rdzv_ts = 0.0
+        self._round_duration_hist.observe(duration)
+        self._world_size_gauge.set(world_size)
+        self._rounds_counter.inc()
+        if self.journal is not None:
+            self.journal.record(
+                "rdzv_complete", round=self._rdzv_round,
+                world_size=world_size, duration_s=duration,
+            )
         logger.info(
             "%s rdzv round %s completed: world=%s (waiting leftover=%s)",
             self._name, self._rdzv_round, ranks,

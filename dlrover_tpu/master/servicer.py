@@ -32,6 +32,7 @@ class MasterServicer:
         diagnosis_master=None,
         metric_context=None,
         strategy_generator=None,
+        event_journal=None,
     ):
         self._job_manager = job_manager
         self._rdzv_managers = rdzv_managers
@@ -42,6 +43,7 @@ class MasterServicer:
         self._diagnosis_master = diagnosis_master
         self._metric_context = metric_context
         self._strategy_generator = strategy_generator
+        self._event_journal = event_journal
         self._start_time = time.time()
 
     # -- rendezvous --------------------------------------------------------
@@ -111,6 +113,20 @@ class MasterServicer:
     ) -> comm.BaseResponse:
         manager = self._rdzv_managers[RendezvousName.NODE_CHECK]
         return comm.BaseResponse(data={"nodes": manager.failed_nodes()})
+
+    def rpc_report_event(self, req: comm.EventReport) -> comm.BaseResponse:
+        """Append an agent/worker event to the master's journal; the
+        master stamps arrival time (clock-free — see journal.py)."""
+        if self._event_journal is not None and req.kind:
+            data = dict(req.data or {})
+            # "source" is the journal's stamp of the reporting component;
+            # a payload key of the same name must not shadow (or crash) it
+            if "source" in data:
+                data["payload_source"] = data.pop("source")
+            self._event_journal.record(
+                req.kind, source=f"agent_{req.node_id}", **data
+            )
+        return comm.BaseResponse()
 
     def rpc_check_straggler(
         self, req: comm.StragglerExistRequest

@@ -5,6 +5,18 @@ TPU redesign of the reference xpu_timer stack (xpu_timer/: LD_PRELOAD CUDA
 hook + brpc daemon + py tools) — see tpu_timer/README.md for the mapping.
 """
 
+from dlrover_tpu.observability.journal import (
+    EventJournal,
+    JournalEvent,
+    Phase,
+    attribute_phases,
+    phase_segments,
+)
+from dlrover_tpu.observability.registry import (
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+)
 from dlrover_tpu.observability.tpu_timer import (
     TpuTimer,
     find_library,
@@ -14,4 +26,6 @@ from dlrover_tpu.observability.tpu_timer import (
 
 __all__ = [
     "TpuTimer", "find_library", "install_tracepoints", "trace_function",
+    "EventJournal", "JournalEvent", "Phase", "attribute_phases",
+    "phase_segments", "MetricsRegistry", "get_registry", "reset_registry",
 ]

@@ -1,0 +1,235 @@
+"""Cross-layer event journal + goodput attribution.
+
+The master holds ONE authoritative append-only sequence of typed job
+events (``fault_detected``, ``rdzv_start``/``rdzv_complete``,
+``restore_start``/``restore_complete``, ``recompile_start``/
+``recompile_complete``, ``step_resumed``). Master-side components record
+directly; agents and workers report over the existing RPC registry
+(``report_event``) and the master stamps the arrival time — timestamps are
+**job-relative monotonic seconds on the master's clock**, so agent and
+master wall clocks are never compared (same clock-free discipline as the
+rdzv_round staleness token in perf_monitor.py).
+
+From that sequence every second of wall time is classified into exactly
+one phase — productive / detect / rendezvous / restore / recompile — by a
+simple state machine (``phase_segments``). The classification is exposed
+as gauges in ``GET /metrics`` (``attribution_gauges``), as JSON via
+``GET /events``, and as a top-level "job phases" track in the chrome
+trace merged by observability/timeline.py — one perfetto load shows *why*
+goodput was lost.
+
+What the journal can and cannot see: detection latency BEFORE the fault
+is detected (kill → heartbeat-drop notice) is attributed to the phase the
+job was in when the fault hit — usually productive — because no event
+exists until detection. The ``detect`` phase measures detected-fault →
+first recovery action (rdzv_start), i.e. the control plane's reaction
+time, not the detector's blind window.
+"""
+
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class JournalEvent:
+    """Typed event kinds. Plain strings on the wire/in JSON."""
+
+    FAULT_DETECTED = "fault_detected"
+    RDZV_START = "rdzv_start"
+    RDZV_COMPLETE = "rdzv_complete"
+    RESTORE_START = "restore_start"
+    RESTORE_COMPLETE = "restore_complete"
+    RECOMPILE_START = "recompile_start"
+    RECOMPILE_COMPLETE = "recompile_complete"
+    STEP_RESUMED = "step_resumed"
+
+    ALL = (
+        FAULT_DETECTED, RDZV_START, RDZV_COMPLETE, RESTORE_START,
+        RESTORE_COMPLETE, RECOMPILE_START, RECOMPILE_COMPLETE, STEP_RESUMED,
+    )
+
+
+class Phase:
+    PRODUCTIVE = "productive"
+    DETECT = "detect"
+    RENDEZVOUS = "rendezvous"
+    RESTORE = "restore"
+    RECOMPILE = "recompile"
+
+    ALL = (PRODUCTIVE, DETECT, RENDEZVOUS, RESTORE, RECOMPILE)
+
+
+# event kind → the phase the job enters when the event lands. rdzv_complete
+# enters RESTORE (workers respawn and read the checkpoint next);
+# restore_complete enters RECOMPILE (the gap to the first completed step is
+# jit compilation + collective re-formation, even without explicit
+# recompile events from the worker).
+_TRANSITIONS: Dict[str, str] = {
+    JournalEvent.FAULT_DETECTED: Phase.DETECT,
+    JournalEvent.RDZV_START: Phase.RENDEZVOUS,
+    JournalEvent.RDZV_COMPLETE: Phase.RESTORE,
+    JournalEvent.RESTORE_START: Phase.RESTORE,
+    JournalEvent.RESTORE_COMPLETE: Phase.RECOMPILE,
+    JournalEvent.RECOMPILE_START: Phase.RECOMPILE,
+    JournalEvent.RECOMPILE_COMPLETE: Phase.PRODUCTIVE,
+    JournalEvent.STEP_RESUMED: Phase.PRODUCTIVE,
+}
+
+
+class EventJournal:
+    """Append-only bounded ring of typed events with job-relative
+    monotonic timestamps. Thread-safe; one instance per master."""
+
+    def __init__(self, capacity: int = 4096):
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._seq = 0
+        self._dropped = 0
+        self._t0 = time.monotonic()
+        self._wall0 = time.time()
+        self._listeners: List[Callable[[Dict[str, Any]], None]] = []
+        self._phase = Phase.PRODUCTIVE
+
+    @property
+    def start_wall_ts(self) -> float:
+        return self._wall0
+
+    def now(self) -> float:
+        """Current job-relative monotonic time (seconds since journal
+        creation — i.e. master start)."""
+        return time.monotonic() - self._t0
+
+    def add_listener(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        """Called (under no lock) for every recorded event — the master
+        bridges journal kinds into PerfMonitor fault bookkeeping here."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def record(self, kind: str, source: str = "master",
+               **data: Any) -> Dict[str, Any]:
+        """Append one event; returns the stored record. ``source`` names
+        the reporting component ("master", "agent_0", "worker_3")."""
+        with self._lock:
+            self._seq += 1
+            event = {
+                "seq": self._seq,
+                "t": time.monotonic() - self._t0,
+                "ts": time.time(),
+                "kind": str(kind),
+                "source": str(source),
+                "data": dict(data),
+            }
+            self._events.append(event)
+            self._phase = _TRANSITIONS.get(event["kind"], self._phase)
+            if len(self._events) > self._capacity:
+                drop = len(self._events) - self._capacity
+                del self._events[:drop]
+                self._dropped += drop
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(event)
+            except Exception:  # noqa: BLE001 — telemetry must not kill work
+                pass
+        return event
+
+    def current_phase(self) -> str:
+        """The phase the job is in right now (what the state machine's
+        last transition left in effect). The master uses this to emit
+        ``step_resumed`` when a global-step report arrives while the job
+        is still attributed to a recovery phase."""
+        with self._lock:
+            return self._phase
+
+    def events(self, since_seq: int = 0) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._events if e["seq"] > since_seq]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def to_json(self, since_seq: int = 0) -> str:
+        return json.dumps({
+            "start_wall_ts": self._wall0,
+            "now_t": self.now(),
+            "dropped": self.dropped,
+            "events": self.events(since_seq),
+        })
+
+    # -- attribution -------------------------------------------------------
+
+    def phase_seconds(self, now_t: Optional[float] = None
+                      ) -> Dict[str, float]:
+        return attribute_phases(self.events(), self.now() if now_t is None
+                                else now_t)
+
+    def attach_gauges(self, registry) -> None:
+        """Register the goodput-attribution gauges on ``registry``: one
+        gauge per phase plus wall seconds, refreshed atomically per scrape
+        (collect hook — all values come from one snapshot, so their sum
+        matches the wall gauge exactly)."""
+        gauges = {
+            phase: registry.gauge(
+                f"dlrover_goodput_{phase}_seconds",
+                f"Wall seconds attributed to the {phase} phase",
+            )
+            for phase in Phase.ALL
+        }
+        wall = registry.gauge(
+            "dlrover_goodput_wall_seconds",
+            "Wall seconds since master start (sum of the phase gauges)",
+        )
+        events_total = registry.gauge(
+            "dlrover_journal_events", "Events currently in the journal ring"
+        )
+
+        def collect() -> None:
+            now_t = self.now()
+            seconds = self.phase_seconds(now_t)
+            for phase, g in gauges.items():
+                g.set(seconds.get(phase, 0.0))
+            wall.set(now_t)
+            events_total.set(len(self))
+
+        registry.add_collect_hook(collect)
+
+
+def phase_segments(events: List[Dict[str, Any]], now_t: float,
+                   start_t: float = 0.0
+                   ) -> List[Tuple[str, float, float]]:
+    """Classify [start_t, now_t] into contiguous (phase, begin, end)
+    segments from the event sequence. Events outside known kinds are
+    ignored (they carry data but don't move the state machine)."""
+    segs: List[Tuple[str, float, float]] = []
+    phase = Phase.PRODUCTIVE
+    cursor = start_t
+    for e in sorted(events, key=lambda e: (e.get("t", 0.0), e.get("seq", 0))):
+        nxt = _TRANSITIONS.get(e.get("kind", ""))
+        if nxt is None:
+            continue
+        t = min(max(float(e.get("t", 0.0)), cursor), now_t)
+        if nxt != phase:
+            if t > cursor:
+                segs.append((phase, cursor, t))
+            phase, cursor = nxt, t
+    if now_t > cursor:
+        segs.append((phase, cursor, now_t))
+    return segs
+
+
+def attribute_phases(events: List[Dict[str, Any]], now_t: float,
+                     start_t: float = 0.0) -> Dict[str, float]:
+    """Seconds per phase over [start_t, now_t]; values sum to the window
+    length exactly (each instant is in exactly one phase)."""
+    out = {phase: 0.0 for phase in Phase.ALL}
+    for phase, begin, end in phase_segments(events, now_t, start_t):
+        out[phase] += end - begin
+    return out

@@ -1,0 +1,336 @@
+"""Lightweight in-process metrics registry with Prometheus text export.
+
+The observability spine's scrape surface: counters, gauges and histograms
+that every layer (agent loop, ckpt engine, rdzv manager, perf monitor,
+diagnosis) registers into, rendered in the Prometheus text exposition
+format by ``GET /metrics`` on the master/agent HTTP servers
+(common/http_server.py). Zero hard deps — stdlib + threading only — so the
+worker process, the agent and the master all share the same implementation
+without a client-library install.
+
+Reference shape: prometheus_client's Counter/Gauge/Histogram surface
+(labels() child pattern), reduced to what the job control plane needs.
+One registry per process by default (``get_registry()``); components that
+live in the same process as the master (LocalJobMaster, tests) share it.
+"""
+
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0,
+)
+
+
+def _validate_name(name: str) -> str:
+    if not name or not all(c.isalnum() or c in "_:" for c in name):
+        raise ValueError(f"invalid metric name {name!r}")
+    return name
+
+
+def _format_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n").replace(
+        '"', '\\"'
+    )
+
+
+def _render_labels(labels: Tuple[Tuple[str, str], ...],
+                   extra: Optional[List[Tuple[str, str]]] = None) -> str:
+    pairs = list(labels) + list(extra or [])
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """One metric family: name + help + label names; children per
+    label-value tuple. A family with no labels has one child keyed ()."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = _validate_name(name)
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], "_Metric"] = {}
+        if not self.labelnames:
+            self._init_value()
+
+    def _init_value(self) -> None:
+        raise NotImplementedError
+
+    def labels(self, *values, **kv) -> "_Metric":
+        if kv:
+            values = tuple(str(kv[n]) for n in self.labelnames)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {values}"
+            )
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = type(self)(self.name, self.help)
+                self._children[values] = child
+            return child
+
+    def _samples(self) -> List[Tuple[str, str, float]]:
+        """[(suffix, rendered_labels, value)] for this family."""
+        out: List[Tuple[str, str, float]] = []
+        if not self.labelnames:
+            out.extend(self._own_samples(()))
+        with self._lock:
+            children = list(self._children.items())
+        for values, child in children:
+            out.extend(
+                child._own_samples(tuple(zip(self.labelnames, values)))
+            )
+        return out
+
+    def _own_samples(self, labels) -> List[Tuple[str, str, float]]:
+        raise NotImplementedError
+
+    def render(self) -> str:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        for suffix, labels_str, value in self._samples():
+            lines.append(
+                f"{self.name}{suffix}{labels_str} {_format_value(value)}"
+            )
+        return "\n".join(lines)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _init_value(self) -> None:
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _own_samples(self, labels):
+        return [("", _render_labels(labels), self.value)]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _init_value(self) -> None:
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        """Compute the value at scrape time (live goodput, queue depths)."""
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception:  # noqa: BLE001 — a broken callback must not 500
+            return float("nan")
+
+    def _own_samples(self, labels):
+        return [("", _render_labels(labels), self.value)]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        self._buckets = tuple(sorted(buckets))
+        super().__init__(name, help_text, labelnames)
+
+    def _init_value(self) -> None:
+        if not hasattr(self, "_buckets"):
+            self._buckets = _DEFAULT_BUCKETS
+        self._counts = [0] * (len(self._buckets) + 1)  # +1 for +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def labels(self, *values, **kv):
+        child = super().labels(*values, **kv)
+        child._buckets = self._buckets
+        return child
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, b in enumerate(self._buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def _own_samples(self, labels):
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        out = []
+        cum = 0
+        for b, c in zip(self._buckets, counts[:-1]):
+            cum += c
+            out.append((
+                "_bucket",
+                _render_labels(labels, [("le", _format_value(b))]),
+                float(cum),
+            ))
+        out.append((
+            "_bucket", _render_labels(labels, [("le", "+Inf")]), float(total)
+        ))
+        out.append(("_sum", _render_labels(labels), s))
+        out.append(("_count", _render_labels(labels), float(total)))
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create metric families + Prometheus text rendering.
+
+    ``add_collect_hook`` registers a callable run at the start of every
+    ``render()`` — components use it to refresh scrape-time gauges from
+    live state (e.g. the journal's phase attribution) atomically, so one
+    scrape sees one consistent snapshot."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._hooks: List[Callable[[], None]] = []
+
+    def _get_or_create(self, cls, name, help_text, labelnames, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_text, labelnames, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name} already registered as {m.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help_text: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help_text, labelnames)
+
+    def gauge(self, name: str, help_text: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_text, labelnames)
+
+    def histogram(self, name: str, help_text: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help_text, labelnames, buckets=buckets
+        )
+
+    def add_collect_hook(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            self._hooks.append(fn)
+
+    def render(self) -> str:
+        """The full Prometheus text exposition for every family."""
+        with self._lock:
+            hooks = list(self._hooks)
+            metrics = sorted(self._metrics.items())
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a bad hook must not 500
+                pass
+        blocks = [m.render() for _, m in metrics]
+        body = "\n".join(b for b in blocks if b)
+        return body + "\n" if body else ""
+
+
+_default_registry: Optional[MetricsRegistry] = None
+_default_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """Process-wide default registry (what /metrics serves)."""
+    global _default_registry
+    with _default_lock:
+        if _default_registry is None:
+            _default_registry = MetricsRegistry()
+        return _default_registry
+
+
+def reset_registry() -> None:
+    """Drop the process default (tests; a LocalJobMaster rebuilt in the
+    same process would otherwise accumulate stale collect hooks)."""
+    global _default_registry
+    with _default_lock:
+        _default_registry = None
+
+
+class Timer:
+    """``with Timer(hist):`` — observe the block's duration."""
+
+    def __init__(self, histogram: Histogram):
+        self._hist = histogram
+        self._t0 = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *_):
+        self._hist.observe(time.monotonic() - self._t0)

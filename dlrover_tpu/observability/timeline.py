@@ -33,13 +33,74 @@ def fetch_trace(port: int, host: str = "127.0.0.1",
         return None
 
 
+def fetch_journal(master_http_addr: str,
+                  timeout: float = 3.0) -> Optional[dict]:
+    """The master's ``GET /events`` journal dump
+    (observability/journal.py), e.g. from ``127.0.0.1:8080``."""
+    addr = master_http_addr
+    if not addr.startswith("http://"):
+        addr = f"http://{addr}"
+    try:
+        with urllib.request.urlopen(
+            f"{addr}/events", timeout=timeout
+        ) as r:
+            return json.loads(r.read().decode())
+    except Exception as e:  # noqa: BLE001 — master HTTP may be disabled
+        logger.debug("journal fetch %s failed: %s", master_http_addr, e)
+        return None
+
+
+# pid for the synthetic "job phases" track — far above any worker rank
+_JOB_PHASES_PID = 9999
+
+
+def job_phase_events(journal: dict) -> List[dict]:
+    """Chrome-trace events for the journal's goodput attribution: one
+    top-level track of complete ("X") slices — productive / detect /
+    rendezvous / restore / recompile — plus an instant per raw journal
+    event. Timestamps are journal-relative microseconds, matching the
+    job-relative monotonic clock the master stamps."""
+    from dlrover_tpu.observability.journal import phase_segments
+
+    raw = journal.get("events", [])
+    now_t = float(journal.get("now_t", 0.0))
+    events: List[dict] = [
+        {
+            "ph": "M", "pid": _JOB_PHASES_PID, "name": "process_name",
+            "args": {"name": "job phases"},
+        },
+        {
+            "ph": "M", "pid": _JOB_PHASES_PID, "tid": 0,
+            "name": "thread_name", "args": {"name": "goodput attribution"},
+        },
+    ]
+    for phase, begin, end in phase_segments(raw, now_t):
+        events.append({
+            "ph": "X", "pid": _JOB_PHASES_PID, "tid": 0,
+            "name": phase, "cat": "job_phase",
+            "ts": begin * 1e6, "dur": (end - begin) * 1e6,
+        })
+    for e in raw:
+        events.append({
+            "ph": "i", "pid": _JOB_PHASES_PID, "tid": 0, "s": "p",
+            "name": e.get("kind", "?"), "cat": "journal",
+            "ts": float(e.get("t", 0.0)) * 1e6,
+            "args": {"source": e.get("source", ""), **e.get("data", {})},
+        })
+    return events
+
+
 def merge_timelines(
     out_path: str,
     ports: Optional[List[int]] = None,
     n_workers: int = 8,
     host: str = "127.0.0.1",
+    master_http_addr: Optional[str] = None,
 ) -> int:
-    """Fetch every worker's /trace and write one chrome trace file.
+    """Fetch every worker's /trace and write one chrome trace file; when
+    ``master_http_addr`` is given, the master's event journal rides along
+    as a top-level "job phases" track, so one perfetto load shows per-op
+    worker activity AND why wall time was lost.
 
     Returns the number of workers that contributed. Load in
     ui.perfetto.dev or chrome://tracing.
@@ -57,6 +118,10 @@ def merge_timelines(
             "ph": "M", "pid": rank, "name": "process_name",
             "args": {"name": f"rank{rank}"},
         })
+    if master_http_addr:
+        journal = fetch_journal(master_http_addr)
+        if journal is not None:
+            events.extend(job_phase_events(journal))
     with open(out_path, "w") as f:
         json.dump({"traceEvents": events}, f)
     return found

@@ -28,6 +28,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dlrover_tpu.parallel.sharding import clamp_spec
 
+from dlrover_tpu.common import jax_compat
+
+jax_compat.install()  # jax.shard_map alias on older 0.4.x wheels
+
+
 from dlrover_tpu.ops.flash_attention import flash_attention
 
 

@@ -13,6 +13,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import jax_compat
 from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import logger
 
@@ -167,11 +168,10 @@ def init(initialize_jax_distributed: bool = True) -> WorkerContext:
     rank = int(os.getenv(EnvKey.RANK, "0"))
     world_size = int(os.getenv(EnvKey.WORLD_SIZE, "1"))
     _enable_compilation_cache()
+    jax_compat.install()
     coordinator = os.getenv(EnvKey.COORDINATOR_ADDR, "")
     if initialize_jax_distributed and world_size > 1 and coordinator:
-        import jax
-
-        jax.distributed.initialize(
+        jax_compat.distributed_initialize(
             coordinator_address=coordinator,
             num_processes=world_size,
             process_id=rank,

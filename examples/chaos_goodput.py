@@ -166,6 +166,35 @@ def _wait(cond, timeout_s, what):
     raise TimeoutError(f"timed out waiting for {what}")
 
 
+def _scrape_metrics(master):
+    """GET /metrics off the master's HTTP server; returns the parsed
+    goodput-attribution gauges ({phase: seconds}, wall_seconds, raw_text)
+    or (None, None, "") when the scrape fails."""
+    import urllib.request
+
+    if master._http_server is None:
+        return None, None, ""
+    try:
+        url = f"http://127.0.0.1:{master._http_server.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            text = r.read().decode()
+    except Exception:  # noqa: BLE001 — drill must report, not die
+        return None, None, ""
+    phases, wall = {}, None
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, value = line.rsplit(" ", 1)
+        if name == "dlrover_goodput_wall_seconds":
+            wall = float(value)
+        elif (name.startswith("dlrover_goodput_")
+                and name.endswith("_seconds")):
+            phases[name[len("dlrover_goodput_"):-len("_seconds")]] = (
+                float(value)
+            )
+    return phases, wall, text
+
+
 def _merged_goodput(event_dir):
     from dlrover_tpu.common.event import compute_goodput, load_events
 
@@ -223,6 +252,9 @@ def main(argv=None) -> int:
         f.write(WORKER_SRC)
 
     job = f"chaos{os.getpid()}"
+    # the observability spine is part of the drill: the master's /metrics
+    # and /events must stay scrapeable through the faults (port 0 = free)
+    os.environ.setdefault("DLROVER_TPU_HTTP_PORT", "0")
     master = LocalJobMaster(
         job_name=job, node_num=2, min_nodes=1, max_nodes=2,
     )
@@ -313,6 +345,12 @@ def main(argv=None) -> int:
         )
         shrink_s = time.time() - kill_ts
         step_before_rejoin = master.perf_monitor.completed_global_step
+        # mid-drill scrape: /metrics must answer while the world is still
+        # re-forming, and the gauges must be one consistent snapshot
+        mid_phases, mid_wall, _ = _scrape_metrics(master)
+        mid_scrape_ok = bool(mid_phases) and mid_wall is not None and (
+            abs(sum(mid_phases.values()) - mid_wall) < 1.0
+        )
 
         # phase 3: the node comes back — world scales up again
         agents[1] = start_agent(1)
@@ -362,6 +400,15 @@ def main(argv=None) -> int:
             except subprocess.TimeoutExpired:
                 pass
         wall = time.time() - t_start
+        # final scrape: the journal's own attribution of the whole drill
+        end_phases, end_wall, _ = _scrape_metrics(master)
+        end_scrape_ok = bool(end_phases) and end_wall is not None and (
+            abs(sum(end_phases.values()) - end_wall) < 1.0
+        )
+        journal_goodput_pct = (
+            round(100.0 * end_phases.get("productive", 0.0) / end_wall, 2)
+            if end_scrape_ok and end_wall > 0 else None
+        )
         records = _read_log(log_path)
         segments = [r for r in records if r["event"] == "segment_start"]
         dones = [r for r in records if r["event"] == "done"]
@@ -391,6 +438,17 @@ def main(argv=None) -> int:
             ),
             "step_at_shrink": step_before_rejoin,
             "final_step": master.perf_monitor.completed_global_step,
+            # observability spine (journal-derived, via GET /metrics):
+            # scrapes must succeed mid-drill AND at the end, with the
+            # phase gauges summing to the wall gauge within 1 s
+            "metrics_scrape_ok": mid_scrape_ok and end_scrape_ok,
+            "phases": (
+                {k: round(v, 2) for k, v in end_phases.items()
+                 if k != "wall"}
+                if end_phases else None
+            ),
+            "journal_goodput_pct": journal_goodput_pct,
+            "journal_events": len(master.event_journal),
             "segments": segments,
             # distributed-core proof: every segment's psum equals its
             # world size (real collectives over the joint world), and the

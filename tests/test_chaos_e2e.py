@@ -14,6 +14,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -73,8 +75,33 @@ def test_chaos_kill_shrink_resume_rejoin():
     # — now including REAL restore + recompile + collective costs, not
     # sleep-loop orchestration overhead only
     assert result["goodput_1h_extrapolated_pct"] >= 95.0
+    # observability spine: GET /metrics answered mid-drill AND at the end,
+    # and the phase gauges each time summed to the wall gauge within 1 s
+    assert result["metrics_scrape_ok"] is True, result
+    phases = result["phases"]
+    assert phases is not None
+    assert set(phases) == {
+        "productive", "detect", "rendezvous", "restore", "recompile",
+    }
+    # the journal recorded the fault cycle: with one kill + one rejoin the
+    # job spent real time off the productive phase...
+    unproductive = sum(v for k, v in phases.items() if k != "productive")
+    assert unproductive > 0.0, phases
+    assert phases["rendezvous"] > 0.0, phases
+    # ...but attribution agrees with the drill's own windows: the
+    # journal's unproductive total stays in the order of the recorded
+    # recovery costs, not the whole drill (two rdzv cycles: fault +
+    # rejoin, plus the initial formation, each bounded by the shrink
+    # window's scale)
+    assert unproductive <= 6 * result["shrink_detect_s"] + 3.0, (
+        phases, result["shrink_detect_s"],
+    )
+    assert result["journal_goodput_pct"] is not None
+    assert 0 < result["journal_goodput_pct"] <= 100
+    assert result["journal_events"] >= 4, result["journal_events"]
 
 
+@pytest.mark.slow
 def test_chaos_direct_goodput_two_faults():
     """The reference's >=95% goodput bar measured DIRECTLY — no 1-hour
     extrapolation: a ~10-minute drill with TWO fault types (agent
@@ -84,7 +111,13 @@ def test_chaos_direct_goodput_two_faults():
 
     (Reference: 69%->95% goodput claim, README.md:55-57, proven there
     with multi-node chaos experiments,
-    docs/tech_report/fault_tolerance_exps.md.)"""
+    docs/tech_report/fault_tolerance_exps.md.)
+
+    Marked slow: the drill needs >=180s of measured wall time to make the
+    direct (non-extrapolated) goodput number meaningful, ~10 minutes in
+    practice — it alone would eat most of the tier-1 time budget. The
+    kill/shrink/rejoin drill above stays in tier-1 and covers the same
+    recovery machinery end-to-end."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("PALLAS_AXON_POOL_IPS", None)
     proc = subprocess.run(

@@ -1,0 +1,373 @@
+"""Observability spine: metrics registry semantics (including under
+concurrent writers), Prometheus text rendering, the /metrics and /events
+HTTP routes, event-journal ordering across a simulated
+fault→rdzv→restore→resume cycle, and goodput attribution summing to wall
+time. Also covers the master composition: a LocalJobMaster wires the
+journal into the servicer, the TRAINING rendezvous manager, and the
+PerfMonitor fault bridge.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.observability.journal import (
+    EventJournal,
+    JournalEvent,
+    Phase,
+    attribute_phases,
+    phase_segments,
+)
+from dlrover_tpu.observability.registry import (
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+)
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("t_requests_total", "requests")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = reg.gauge("t_depth", "queue depth")
+    g.set(7)
+    g.dec(2)
+    assert g.value == 5.0
+    g.set_function(lambda: 42.0)
+    assert g.value == 42.0
+
+    h = reg.histogram("t_latency_seconds", "latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count == 3
+    assert h.sum == pytest.approx(5.55)
+
+
+def test_registry_get_or_create_and_type_conflict():
+    reg = MetricsRegistry()
+    assert reg.counter("t_x") is reg.counter("t_x")
+    with pytest.raises(ValueError):
+        reg.gauge("t_x")
+
+
+def test_labeled_children_are_independent():
+    reg = MetricsRegistry()
+    c = reg.counter("t_err_total", "errors", labelnames=("kind",))
+    c.labels(kind="io").inc(3)
+    c.labels(kind="net").inc()
+    assert c.labels(kind="io").value == 3.0
+    assert c.labels(kind="net").value == 1.0
+    text = reg.render()
+    assert 't_err_total{kind="io"} 3' in text
+    assert 't_err_total{kind="net"} 1' in text
+
+
+def test_concurrent_writers_lose_no_increments():
+    reg = MetricsRegistry()
+    c = reg.counter("t_concurrent_total")
+    h = reg.histogram("t_concurrent_hist", buckets=(0.5,))
+    n_threads, n_incs = 8, 1000
+
+    def work():
+        for _ in range(n_incs):
+            c.inc()
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * n_incs
+    assert h.count == n_threads * n_incs
+
+
+def _parse_prometheus(text):
+    """Minimal exposition-format parser: {sample_name_with_labels: value};
+    raises on malformed lines — the validity check for render()."""
+    samples = {}
+    types = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            assert line.startswith("# HELP "), line
+            continue
+        name, value = line.rsplit(" ", 1)
+        float(value) if value not in ("+Inf", "-Inf", "NaN") else None
+        samples[name] = value
+    return samples, types
+
+
+def test_prometheus_text_parses():
+    reg = MetricsRegistry()
+    reg.counter("t_a_total", "a counter").inc(2)
+    reg.gauge("t_b", "a gauge").set(1.5)
+    reg.histogram("t_c_seconds", "a histogram", buckets=(1.0,)).observe(0.3)
+    samples, types = _parse_prometheus(reg.render())
+    assert types == {
+        "t_a_total": "counter", "t_b": "gauge", "t_c_seconds": "histogram",
+    }
+    assert samples["t_a_total"] == "2"
+    assert samples["t_b"] == "1.5"
+    assert samples['t_c_seconds_bucket{le="1"}'] == "1"
+    assert samples['t_c_seconds_bucket{le="+Inf"}'] == "1"
+    assert samples["t_c_seconds_count"] == "1"
+
+
+def test_collect_hook_runs_per_render():
+    reg = MetricsRegistry()
+    g = reg.gauge("t_hooked")
+    calls = []
+    reg.add_collect_hook(lambda: (calls.append(1), g.set(len(calls)))[0])
+    reg.render()
+    reg.render()
+    assert g.value == 2.0
+
+
+# -- journal ----------------------------------------------------------------
+
+
+def _cycle_journal():
+    j = EventJournal()
+    j.record(JournalEvent.FAULT_DETECTED, node_id=1)
+    j.record(JournalEvent.RDZV_START, round=2)
+    j.record(JournalEvent.RDZV_COMPLETE, round=2, world_size=1)
+    j.record(JournalEvent.RESTORE_START, source="agent_0")
+    j.record(JournalEvent.RESTORE_COMPLETE, source="agent_0")
+    j.record(JournalEvent.STEP_RESUMED, source="agent_0", step=11)
+    return j
+
+
+def test_journal_ordering_and_monotonic_stamps():
+    j = _cycle_journal()
+    events = j.events()
+    assert [e["kind"] for e in events] == [
+        JournalEvent.FAULT_DETECTED, JournalEvent.RDZV_START,
+        JournalEvent.RDZV_COMPLETE, JournalEvent.RESTORE_START,
+        JournalEvent.RESTORE_COMPLETE, JournalEvent.STEP_RESUMED,
+    ]
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    ts = [e["t"] for e in events]
+    assert ts == sorted(ts) and all(t >= 0 for t in ts)
+    assert events[0]["data"]["node_id"] == 1
+    assert events[-1]["source"] == "agent_0"
+    # incremental query
+    assert [e["kind"] for e in j.events(since_seq=seqs[-2])] == [
+        JournalEvent.STEP_RESUMED,
+    ]
+
+
+def test_journal_ring_caps_and_counts_drops():
+    j = EventJournal(capacity=10)
+    for i in range(25):
+        j.record(JournalEvent.STEP_RESUMED, step=i)
+    assert len(j) == 10
+    assert j.dropped == 15
+    assert [e["data"]["step"] for e in j.events()] == list(range(15, 25))
+
+
+def test_journal_listener_sees_events_and_errors_are_swallowed():
+    j = EventJournal()
+    seen = []
+    j.add_listener(lambda e: seen.append(e["kind"]))
+    j.add_listener(lambda e: 1 / 0)  # must not break recording
+    j.record(JournalEvent.FAULT_DETECTED)
+    j.record(JournalEvent.STEP_RESUMED)
+    assert seen == [JournalEvent.FAULT_DETECTED, JournalEvent.STEP_RESUMED]
+
+
+# -- attribution ------------------------------------------------------------
+
+
+def _ev(kind, t):
+    return {"kind": kind, "t": t, "seq": int(t * 1000)}
+
+
+def test_phase_segments_classify_the_cycle():
+    events = [
+        _ev(JournalEvent.FAULT_DETECTED, 10.0),
+        _ev(JournalEvent.RDZV_START, 11.0),
+        _ev(JournalEvent.RDZV_COMPLETE, 13.0),
+        _ev(JournalEvent.RESTORE_START, 13.5),
+        _ev(JournalEvent.RESTORE_COMPLETE, 15.0),
+        _ev(JournalEvent.STEP_RESUMED, 17.0),
+    ]
+    segs = phase_segments(events, now_t=20.0)
+    assert segs == [
+        (Phase.PRODUCTIVE, 0.0, 10.0),
+        (Phase.DETECT, 10.0, 11.0),
+        (Phase.RENDEZVOUS, 11.0, 13.0),
+        (Phase.RESTORE, 13.0, 15.0),
+        (Phase.RECOMPILE, 15.0, 17.0),
+        (Phase.PRODUCTIVE, 17.0, 20.0),
+    ]
+
+
+def test_attribution_sums_to_wall_time():
+    events = [
+        _ev(JournalEvent.FAULT_DETECTED, 3.0),
+        _ev(JournalEvent.RDZV_START, 4.0),
+        _ev(JournalEvent.RDZV_COMPLETE, 6.0),
+        _ev(JournalEvent.STEP_RESUMED, 8.5),
+    ]
+    for now_t in (2.0, 5.0, 8.5, 100.0):
+        seconds = attribute_phases(events, now_t)
+        assert set(seconds) == set(Phase.ALL)
+        assert sum(seconds.values()) == pytest.approx(now_t)
+    seconds = attribute_phases(events, 10.0)
+    assert seconds[Phase.DETECT] == pytest.approx(1.0)
+    assert seconds[Phase.RENDEZVOUS] == pytest.approx(2.0)
+    assert seconds[Phase.RESTORE] == pytest.approx(2.5)
+    assert seconds[Phase.PRODUCTIVE] == pytest.approx(4.5)
+
+
+def test_attribution_empty_journal_is_all_productive():
+    seconds = attribute_phases([], 7.0)
+    assert seconds[Phase.PRODUCTIVE] == pytest.approx(7.0)
+    assert sum(seconds.values()) == pytest.approx(7.0)
+
+
+def test_unknown_kinds_do_not_move_the_state_machine():
+    events = [
+        _ev("heartbeat_seen", 1.0),
+        _ev(JournalEvent.FAULT_DETECTED, 2.0),
+        _ev("some_future_kind", 3.0),
+    ]
+    seconds = attribute_phases(events, 4.0)
+    assert seconds[Phase.PRODUCTIVE] == pytest.approx(2.0)
+    assert seconds[Phase.DETECT] == pytest.approx(2.0)
+
+
+def test_attach_gauges_snapshot_sums_to_wall():
+    reg = MetricsRegistry()
+    j = _cycle_journal()
+    j.attach_gauges(reg)
+    samples, _ = _parse_prometheus(reg.render())
+    wall = float(samples["dlrover_goodput_wall_seconds"])
+    total = sum(
+        float(samples[f"dlrover_goodput_{p}_seconds"]) for p in Phase.ALL
+    )
+    assert total == pytest.approx(wall, abs=1e-6)
+    assert float(samples["dlrover_journal_events"]) == 6
+
+
+# -- master composition + HTTP endpoints ------------------------------------
+
+
+@pytest.fixture
+def local_master(monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_HTTP_PORT", "0")
+    reset_registry()
+    from dlrover_tpu.master.master import LocalJobMaster
+
+    master = LocalJobMaster(job_name="obs_test", node_num=2, min_nodes=1)
+    master.prepare()
+    yield master
+    master.stop()
+    reset_registry()
+
+
+def _http_get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+
+
+def test_master_metrics_and_events_endpoints(local_master):
+    master = local_master
+    from dlrover_tpu.common.comm import EventReport, NodeMeta
+    from dlrover_tpu.common.constants import RendezvousName
+
+    # drive a fault cycle through the real components: rdzv manager events
+    # ride the TRAINING manager, agent events ride the servicer RPC
+    manager = master.rdzv_managers[RendezvousName.TRAINING]
+    manager.join_rendezvous(NodeMeta(node_id=0, node_rank=0))
+    master.event_journal.record(JournalEvent.FAULT_DETECTED, node_id=1)
+    master.servicer.rpc_report_event(
+        EventReport(node_id=0, kind="restore_complete", data={"step": 9})
+    )
+    master.servicer.rpc_report_event(
+        EventReport(node_id=0, kind="step_resumed", data={"step": 10})
+    )
+
+    port = master._http_server.port
+    status, ctype, body = _http_get(port, "/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    samples, types = _parse_prometheus(body)
+    assert types["dlrover_goodput_productive_seconds"] == "gauge"
+    wall = float(samples["dlrover_goodput_wall_seconds"])
+    total = sum(
+        float(samples[f"dlrover_goodput_{p}_seconds"]) for p in Phase.ALL
+    )
+    assert total == pytest.approx(wall, abs=1.0)
+    # perf_monitor's scrape-time gauges ride the same registry
+    assert "dlrover_goodput_ratio" in samples
+    assert "dlrover_global_step" in samples
+
+    status, ctype, body = _http_get(port, "/events")
+    assert status == 200
+    assert ctype.startswith("application/json")
+    journal = json.loads(body)
+    kinds = [e["kind"] for e in journal["events"]]
+    assert kinds == [
+        "rdzv_start", "fault_detected", "restore_complete", "step_resumed",
+    ]
+    by_kind = {e["kind"]: e for e in journal["events"]}
+    assert by_kind["step_resumed"]["source"] == "agent_0"
+    assert by_kind["step_resumed"]["data"]["step"] == 10
+
+    # unknown routes still 404
+    with pytest.raises(urllib.error.HTTPError):
+        _http_get(port, "/nope")
+
+
+def test_master_bridges_journal_into_perf_monitor(local_master):
+    master = local_master
+    assert master.perf_monitor._fault_started is None
+    master.event_journal.record(JournalEvent.FAULT_DETECTED, node_id=1)
+    assert master.perf_monitor._fault_started is not None
+    master.event_journal.record(JournalEvent.STEP_RESUMED, step=3)
+    assert master.perf_monitor._fault_started is None
+    assert master.perf_monitor._lost_seconds >= 0.0
+
+
+def test_timeline_job_phases_track():
+    from dlrover_tpu.observability.timeline import job_phase_events
+
+    j = _cycle_journal()
+    journal = json.loads(j.to_json())
+    events = job_phase_events(journal)
+    names = {e["name"] for e in events if e.get("ph") == "X"}
+    assert Phase.RENDEZVOUS in names and Phase.RESTORE in names
+    meta = [e for e in events if e.get("ph") == "M"]
+    assert any(
+        e["args"]["name"] == "job phases" for e in meta
+        if e["name"] == "process_name"
+    )
+    # slices tile the journal window: sorted, non-overlapping, ending now
+    slices = sorted(
+        (e for e in events if e.get("ph") == "X"), key=lambda e: e["ts"]
+    )
+    for a, b in zip(slices, slices[1:]):
+        assert a["ts"] + a["dur"] == pytest.approx(b["ts"])
+    end_t = slices[-1]["ts"] + slices[-1]["dur"]
+    assert end_t == pytest.approx(journal["now_t"] * 1e6, rel=1e-6)
