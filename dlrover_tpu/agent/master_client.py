@@ -10,7 +10,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from dlrover_tpu.common import comm
+from dlrover_tpu.common import comm, retry
 from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.rpc import RPCClient
 
@@ -46,6 +46,8 @@ class MasterClient:
         from dlrover_tpu.master.net_topology import local_topology_attrs
 
         slice_id, tpu_worker_id = local_topology_attrs()
+        # patient: rendezvous must keep knocking while the master restarts,
+        # even when the client's circuit breaker is open
         resp = self._client.call(
             "join_rendezvous",
             comm.JoinRendezvousRequest(
@@ -59,6 +61,7 @@ class MasterClient:
                 slice_id=slice_id,
                 tpu_worker_id=tpu_worker_id,
             ),
+            policy=retry.RENDEZVOUS,
         )
         return resp.round
 
@@ -68,13 +71,18 @@ class MasterClient:
         resp = self._client.call(
             "get_comm_world",
             comm.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name),
+            policy=retry.RENDEZVOUS,
         )
         return resp.round, resp.group, resp.world, resp.coordinator_addr
 
     def num_nodes_waiting(self, rdzv_name: str) -> int:
+        # short budget: this is a 1 Hz poll from the monitor loop — during a
+        # partition it must fail fast (the caller treats failure as "no
+        # change"), not pin the loop on a patient backoff ladder
         resp = self._client.call(
             "num_nodes_waiting",
             comm.WaitingNodeNumRequest(node_id=self._node_id, rdzv_name=rdzv_name),
+            policy=retry.HEARTBEAT,
         )
         return resp.waiting_num
 
@@ -173,6 +181,7 @@ class MasterClient:
                 barrier_name=name, node_rank=node_rank,
                 world_size=world_size, timeout_s=timeout_s,
             ),
+            policy=retry.RENDEZVOUS,
         )
         return resp.passed
 
@@ -192,6 +201,9 @@ class MasterClient:
 
     def heartbeat(self, global_step: int = 0, step_timestamp: float = 0.0,
                   gauges=None, rdzv_round: int = -1) -> comm.HeartbeatResponse:
+        # bounded budget (2 attempts, ~3s deadline): a heartbeat that can't
+        # get through IS the partition signal the agent's degraded-mode
+        # detector consumes — the old 30-attempt default hid it for minutes
         return self._client.call(
             "heartbeat",
             comm.HeartbeatRequest(
@@ -202,6 +214,7 @@ class MasterClient:
                 gauges=gauges or {},
                 rdzv_round=rdzv_round,
             ),
+            policy=retry.HEARTBEAT,
         )
 
     def report_failure(self, error_data: str, level: str,
@@ -226,7 +239,7 @@ class MasterClient:
                 comm.EventReport(
                     node_id=self._node_id, kind=kind, data=data or {}
                 ),
-                retries=1,
+                policy=retry.TELEMETRY,
             )
         except Exception:  # noqa: BLE001
             pass
@@ -321,7 +334,8 @@ class MasterClient:
         # one-shot explicitly: the default retry budget (~minutes of
         # backoff) must not apply to a liveness probe
         try:
-            self._client.call("ping", comm.BaseRequest(), retries=1)
+            self._client.call("ping", comm.BaseRequest(),
+                              policy=retry.PROBE)
             return True
         except (ConnectionError, OSError, RuntimeError):
             return False

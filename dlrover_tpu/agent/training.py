@@ -148,6 +148,8 @@ class ElasticTrainingAgent:
         ckpt_saver=None,
         warm_pool=None,
     ):
+        import uuid
+
         self._config = config
         self._client = client or MasterClient(
             config.master_addr, config.node_id, config.node_rank
@@ -158,6 +160,20 @@ class ElasticTrainingAgent:
         self._stop_flag = threading.Event()
         self._action_lock = threading.Lock()
         self._pending_action: Optional[Tuple[str, Dict]] = None
+        # shm incarnation nonce: workers of THIS agent process name their
+        # checkpoint segments with it, so a restarted agent never reattaches
+        # to a dead predecessor's half-written segments (and can unlink
+        # them — cleanup_orphan_segments at run() start)
+        self._shm_incarnation = uuid.uuid4().hex[:8]
+        # partition-degraded mode: on master unreachability keep training
+        # on cached shard assignments for a bounded grace window, then
+        # save + exit cleanly if the master never comes back
+        self._partition_grace_s = float(
+            os.getenv(EnvKey.PARTITION_GRACE_S, "120")
+        )
+        self._partition_threshold = 3  # consecutive failed heartbeats
+        self._hb_consec_failures = 0
+        self._degraded_since: Optional[float] = None  # monotonic
         self._rdzv_handler = MasterRendezvousHandler(
             RendezvousName.TRAINING,
             self._client,
@@ -312,6 +328,7 @@ class ElasticTrainingAgent:
             EnvKey.RESTART_COUNT: str(self._restart_count),
             EnvKey.RDZV_ROUND: str(self._current_round),
             EnvKey.REPLICA_GROUP: str(self._config.ckpt_replica),
+            EnvKey.SHM_INCARNATION: self._shm_incarnation,
             "DLROVER_TPU_IPC_SOCKET": self._ipc_server.path,
         })
         if self._config.tpu_timer:
@@ -448,7 +465,9 @@ class ElasticTrainingAgent:
                     rdzv_round=self._current_round,
                 )
             except ConnectionError:
+                self._note_heartbeat_failure()
                 continue
+            self._note_heartbeat_success()
             if resp.action_type != DiagnosisActionType.NONE:
                 with self._action_lock:
                     self._pending_action = (
@@ -458,6 +477,45 @@ class ElasticTrainingAgent:
                     "received diagnosis action %s (%s)",
                     resp.action_type, resp.action_data,
                 )
+
+    def _note_heartbeat_failure(self) -> None:
+        """Consecutive heartbeat failures are THE partition signal: after
+        the threshold the agent enters partition-degraded mode — workers
+        keep training on their cached shard assignments (the membership
+        poll already treats connection errors as "no change"), and the
+        monitor loop bounds the degradation with a grace window."""
+        self._hb_consec_failures += 1
+        if (self._degraded_since is None
+                and self._hb_consec_failures >= self._partition_threshold):
+            self._degraded_since = time.monotonic()
+            logger.warning(
+                "master unreachable for %d consecutive heartbeats — "
+                "entering partition-degraded mode: training continues on "
+                "cached shard assignments for up to %.0fs",
+                self._hb_consec_failures, self._partition_grace_s,
+            )
+
+    def _note_heartbeat_success(self) -> None:
+        if self._degraded_since is not None:
+            outage_s = time.monotonic() - self._degraded_since
+            self._degraded_since = None
+            logger.info(
+                "master reachable again after %.1fs — resynced out of "
+                "partition-degraded mode", outage_s,
+            )
+            # journal the whole degradation episode now that the master
+            # can hear us (events during the partition could not land)
+            self._client.report_event(
+                "partition_resync",
+                {"outage_s": outage_s,
+                 "failed_heartbeats": self._hb_consec_failures},
+            )
+        self._hb_consec_failures = 0
+
+    def _partition_grace_expired(self) -> bool:
+        since = self._degraded_since
+        return (since is not None
+                and time.monotonic() - since > self._partition_grace_s)
 
     def _take_pending_action(self):
         """Returns (action_type, action_data) or (None, {})."""
@@ -479,6 +537,28 @@ class ElasticTrainingAgent:
 
     def run(self) -> int:
         """(reference ``_invoke_run``:969)"""
+        from dlrover_tpu.chaos import get_injector
+        from dlrover_tpu.ckpt.shm_handler import cleanup_orphan_segments
+
+        # a predecessor agent that died uncleanly leaves its incarnation's
+        # segments in /dev/shm; unlink them before any worker maps memory
+        removed = cleanup_orphan_segments(
+            self._config.job_name, self._config.node_rank,
+            self._shm_incarnation,
+        )
+        if removed:
+            self._client.report_event(
+                "shm_orphans_cleaned", {"segments": removed}
+            )
+        inj = get_injector()
+        if inj is not None:
+            # injected faults land in the master's journal via the
+            # best-effort telemetry path (never adds faults of its own)
+            inj.set_reporter(
+                lambda event: self._client.report_event(
+                    "fault_injected", event
+                )
+            )
         self._ipc_server.start()
         if self._warm_pool is not None:
             # spares import numpy/jax before this node joins rendezvous:
@@ -636,6 +716,29 @@ class ElasticTrainingAgent:
                 self._client.update_node_status(
                     NodeStatus.FAILED, exit_reason="job_abort"
                 )
+                return 1
+            if self._partition_grace_expired():
+                # the partition outlived the grace window: stop burning
+                # compute on a world the master may already have recut —
+                # persist state and exit cleanly so the relaunch ladder
+                # (or the operator) replaces this node
+                logger.error(
+                    "partition-degraded grace window (%.0fs) expired with "
+                    "master still unreachable — saving state and exiting",
+                    self._partition_grace_s,
+                )
+                self._stop_workers()
+                self._save_breakpoint_checkpoint("partition grace expired")
+                try:
+                    # best-effort: the open circuit breaker makes this fail
+                    # fast if the master is still gone
+                    self._client.update_node_status(
+                        NodeStatus.FAILED,
+                        exit_reason="partition_grace_expired",
+                        restart_count=self._restart_count,
+                    )
+                except ConnectionError:
+                    pass
                 return 1
             now = time.time()
             if now - membership_poll >= 1.0:

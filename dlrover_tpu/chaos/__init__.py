@@ -1,0 +1,29 @@
+"""Deterministic fault-injection plane (see docs/design/fault_injection.md).
+
+Usage at an injection site::
+
+    from dlrover_tpu.chaos import get_injector
+
+    inj = get_injector()
+    if inj is not None:
+        inj.fire("rpc.send", method=method)   # may sleep or raise
+
+``get_injector()`` returns None unless ``DLROVER_FAULT_SCHEDULE`` is set
+(or :func:`configure` was called), so production hot paths pay one cached
+function call.
+"""
+
+from dlrover_tpu.chaos.injector import (  # noqa: F401
+    SCHEDULE_ENV,
+    SEED_ENV,
+    FaultInjector,
+    FaultRule,
+    InjectedError,
+    InjectedFault,
+    active_repro,
+    configure,
+    get_injector,
+    parse_rule,
+    parse_schedule,
+    reset_injector,
+)

@@ -297,6 +297,17 @@ class AsyncCheckpointSaver:
             blob = shm.read_frame_bytes()
             if blob is None:
                 return False
+            # never persist bytes that already fail their shard CRCs: a
+            # corrupt frame on disk outlives the replica copies that could
+            # repair it (restore-time checks would only catch it later,
+            # after the good copies are gone)
+            bad = shm.verify_frame()
+            if bad:
+                logger.error(
+                    "refusing to persist %s step %s: corrupt shard(s) %s",
+                    shm.name, step, bad,
+                )
+                return False
         finally:
             if lock is not None:
                 lock.release()

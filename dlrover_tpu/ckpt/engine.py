@@ -577,15 +577,21 @@ class CheckpointEngine:
     def shm_step(self) -> int:
         return self._shm.step
 
-    def _shm_step_consistent(self) -> Optional[int]:
+    def _shm_step_consistent(self, step: Optional[int] = None
+                             ) -> Optional[int]:
         """All hosts must hold the same shm step to restore from memory
         (reference engine.py:375 step-consistency allgather).
 
         Keys and the barrier are scoped by the rendezvous round (set in the
         worker env by the agent) so values from an earlier incarnation of
         the job can never satisfy this incarnation's consistency check.
+
+        ``step`` overrides the locally observed shm step — a rank whose
+        frame failed its integrity check publishes -1 so every peer falls
+        back to storage consistently instead of electing the corrupt copy.
         """
-        step = self.shm_step()
+        if step is None:
+            step = self.shm_step()
         if self.world_size <= 1 or self._master is None:
             return step if step >= 0 else None
         # a rank with an EMPTY shm must still publish (-1) and join the
@@ -643,7 +649,8 @@ class CheckpointEngine:
                 self._replicas.try_restore_shm(self._shm, self.local_rank)
             except Exception as e:  # noqa: BLE001 — degrade to storage
                 logger.warning("replica restore failed: %r", e)
-        step = self._shm_step_consistent()
+        local_step = self._verify_shm_or_repair()
+        step = self._shm_step_consistent(local_step)
         if step is not None and step >= 0:
             state = self._load_from_shm(target, in_place=in_place)
             if state is not None:
@@ -653,6 +660,56 @@ class CheckpointEngine:
         state, step = self._load_from_storage(target, path or self.ckpt_dir)
         self._finish_restore(restore_t0, "storage", step)
         return state, step
+
+    def _verify_shm_or_repair(self) -> int:
+        """CRC-check the local shm frame before it can be elected for
+        restore. Returns the trustworthy local step: the frame's step when
+        intact (or repaired from a backup-group peer), -1 when corrupt and
+        unrepairable (⇒ every rank falls back to storage together)."""
+        local_step = self.shm_step()
+        if local_step < 0:
+            return local_step
+        corrupt = self._shm.verify_frame()
+        if not corrupt:
+            return local_step
+        logger.error(
+            "checkpoint integrity: shm frame %s (step %s) has corrupt "
+            "shard(s): %s", self._shm.name, local_step, corrupt,
+        )
+        self._report_event(
+            "ckpt_corrupt",
+            {"medium": "shm", "step": local_step, "shards": corrupt},
+        )
+        if self._replicas is not None:
+            # same-step repair: a peer's copy of OUR frame was pushed
+            # before the local bytes went bad, so force-overwrite with it
+            try:
+                got = self._replicas.try_restore_shm(
+                    self._shm, self.local_rank, force=True
+                )
+            except Exception as e:  # noqa: BLE001 — degrade to storage
+                logger.warning("replica repair failed: %r", e)
+                got = -1
+            if got >= 0:
+                still_bad = self._shm.verify_frame()
+                if not still_bad:
+                    logger.info(
+                        "corrupt shard(s) %s repaired from replica peer "
+                        "(step %s)", corrupt, got,
+                    )
+                    self._report_event(
+                        "ckpt_repaired", {"step": got, "shards": corrupt}
+                    )
+                    return got
+                logger.error(
+                    "replica repair left shard(s) still corrupt: %s",
+                    still_bad,
+                )
+        logger.error(
+            "shm frame unrepairable — excluded from restore; falling back "
+            "to persistent storage",
+        )
+        return -1
 
     def _report_event(self, kind: str, data: Optional[Dict] = None) -> None:
         """Journal telemetry to the master; best-effort (stub clients in
@@ -706,6 +763,31 @@ class CheckpointEngine:
         if step < 0:
             return None, -1
         frames = load_frames_for_step(path, step)
+        if not frames:
+            return None, -1
+        from dlrover_tpu.ckpt.shm_handler import verify_parsed_frame
+
+        intact = []
+        for frame in frames:
+            bad = verify_parsed_frame(frame)
+            if bad:
+                # fail LOUD with the shard named; excluding the frame either
+                # lets surviving frames cover the state or _assemble raises
+                # naming the uncovered leaf — never silently load garbage
+                logger.error(
+                    "checkpoint integrity: storage frame step %s (node %s "
+                    "local %s) has corrupt shard(s) %s — frame excluded "
+                    "from restore",
+                    step, frame.get("node_rank"), frame.get("local_rank"),
+                    bad,
+                )
+                self._report_event(
+                    "ckpt_corrupt",
+                    {"medium": "storage", "step": step, "shards": bad},
+                )
+            else:
+                intact.append(frame)
+        frames = intact
         if not frames:
             return None, -1
         from dlrover_tpu.ckpt.ckpt_saver import merge_frame_leaves

@@ -369,18 +369,35 @@ class ReplicaManager:
         return None
 
     def try_restore_shm(self, shm: SharedMemoryHandler,
-                        local_rank: int = 0) -> int:
+                        local_rank: int = 0, force: bool = False) -> int:
         """If a peer holds a newer frame than local shm, write it back into
-        the local segment. Returns the restored step (-1 if nothing)."""
+        the local segment. Returns the restored step (-1 if nothing).
+
+        ``force=True`` overwrites even when the peer's step is not newer —
+        the corruption-repair path: the local frame CRC-failed, so a
+        same-step replica copy is strictly better. A fetched blob that
+        fails its own CRC check is never written (repairing with a corrupt
+        replica would just move the damage)."""
         held = self.fetch(local_rank)
         if held is None:
             return -1
         step, blob = held
-        if step <= shm.step:
+        if not force and step <= shm.step:
             return shm.step
+        from dlrover_tpu.ckpt.shm_handler import verify_frame_blob
+
+        bad = verify_frame_blob(blob)
+        if bad:
+            logger.error(
+                "replica frame for node %s local %s (step %s) fails "
+                "integrity check (%s) — refusing to restore from it",
+                self.node_rank, local_rank, step, bad,
+            )
+            return -1
         shm.write_raw(blob)
         logger.info(
-            "restored node %s local %s shm frame (step %s) from replica",
+            "restored node %s local %s shm frame (step %s) from replica%s",
             self.node_rank, local_rank, step,
+            " [forced repair]" if force else "",
         )
         return step

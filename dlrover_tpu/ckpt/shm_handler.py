@@ -22,13 +22,16 @@ meta = {
 global array under any target topology.
 """
 
+import os
 import struct
 import time
-from typing import Any, Dict, List, Optional
+import zlib
+from typing import Any, Callable, Dict, List, Optional
 
 import msgpack
 import numpy as np
 
+from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.multi_process import (
     create_shared_memory,
@@ -36,10 +39,65 @@ from dlrover_tpu.common.multi_process import (
 )
 
 _U64 = struct.Struct("<Q")
+_CRC = struct.Struct(">I")
+
+# per-shard CRC32 stamping on frame writes; on by default, env-gated for
+# benchmarking the raw write path
+CRC_ENV = "DLROVER_TPU_CKPT_CRC"
 
 
-def shm_name(job_name: str, node_rank: int, local_rank: int) -> str:
-    return f"dlrtpu_{job_name}_{node_rank}_{local_rank}"
+def _crc_enabled() -> bool:
+    return os.getenv(CRC_ENV, "1").lower() not in ("0", "false", "no")
+
+
+def shm_name(job_name: str, node_rank: int, local_rank: int,
+             incarnation: Optional[str] = None) -> str:
+    """Segment name for one worker's frame.
+
+    ``incarnation`` (default: ``EnvKey.SHM_INCARNATION`` from the
+    environment) is a nonce the agent mints once per agent process and
+    passes to its workers: a restarted agent gets fresh segment names
+    instead of reattaching to a previous incarnation's possibly
+    half-written memory, and :func:`cleanup_orphan_segments` can tell the
+    old segments from the live ones."""
+    if incarnation is None:
+        incarnation = os.getenv(EnvKey.SHM_INCARNATION, "")
+    base = f"dlrtpu_{job_name}_{node_rank}_{local_rank}"
+    return f"{base}_i{incarnation}" if incarnation else base
+
+
+def cleanup_orphan_segments(job_name: str, node_rank: int,
+                            incarnation: Optional[str] = None) -> List[str]:
+    """Unlink this node's shm segments left by a previous agent
+    incarnation (different — or missing — nonce). Returns the names
+    removed. A crashed agent can't clean up after itself; without this its
+    segments leak /dev/shm until reboot and a same-name successor would
+    reattach to torn memory."""
+    if incarnation is None:
+        incarnation = os.getenv(EnvKey.SHM_INCARNATION, "")
+    prefix = f"dlrtpu_{job_name}_{node_rank}_"
+    keep_suffix = f"_i{incarnation}" if incarnation else None
+    removed: List[str] = []
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return removed
+    for name in sorted(names):
+        if not name.startswith(prefix):
+            continue
+        tail = name[len(prefix):]
+        if keep_suffix is not None and name.endswith(keep_suffix):
+            continue  # current incarnation
+        if keep_suffix is None and "_i" not in tail:
+            continue  # un-nonced segment and we run un-nonced: it's ours
+        unlink_shared_memory(name)
+        removed.append(name)
+    if removed:
+        logger.warning(
+            "unlinked %d orphan shm segment(s) from a previous agent "
+            "incarnation: %s", len(removed), removed,
+        )
+    return removed
 
 
 class TensorShard:
@@ -144,6 +202,21 @@ class SharedMemoryHandler:
     def write_frame(self, meta: Dict, buffers: List[np.ndarray]) -> None:
         """Write meta + tensor buffers. ``meta['leaves']`` offsets must match
         the order/sizes of ``buffers``."""
+        compute_crc = _crc_enabled()
+        if compute_crc:
+            # reserve fixed-width CRC slots for every shard that maps onto
+            # a buffer BEFORE sizing the header: real CRCs are stamped
+            # after the data pass, and a 4-byte bin always packs to the
+            # same length, so the header size (and thus every abs_offset)
+            # stays stable across the re-pack
+            rel, expected = 0, {}
+            for b in buffers:
+                expected[rel] = int(b.nbytes)
+                rel += int(b.nbytes)
+            for leaf in meta["leaves"]:
+                for shard in leaf.get("shards", []):
+                    if expected.get(shard["offset"]) == shard["nbytes"]:
+                        shard["crc"] = b"\x00\x00\x00\x00"
         header = pack_frame(meta)
         data_start = len(header)
         total = data_start + sum(int(b.nbytes) for b in buffers)
@@ -170,16 +243,71 @@ class SharedMemoryHandler:
         # an unreadable frame (read_meta -> None, callers fall back to the
         # last persisted checkpoint) — never a parseable header over torn
         # data. This is what makes it safe for the agent to SIGKILL a
-        # wedged worker without a long graceful-exit grace.
+        # wedged worker without a long graceful-exit grace. The length
+        # word is the frame's COMMIT MARKER; the per-shard CRCs stamped
+        # below cover what the marker can't: corruption that happens
+        # *after* a clean seal (bit rot, a stray writer) or a torn
+        # replica/storage copy of a sealed frame.
         buf[:8] = _U64.pack(0)
         pos = data_start
+        crcs: Dict[int, int] = {}
         for b in buffers:
             flat = np.ascontiguousarray(b).view(np.uint8).reshape(-1)
             n = flat.nbytes
             buf[pos : pos + n] = flat.data
+            if compute_crc:
+                crcs[pos - data_start] = zlib.crc32(flat.data) & 0xFFFFFFFF
             pos += n
+        if compute_crc:
+            for leaf in meta["leaves"]:
+                for shard in leaf.get("shards", []):
+                    crc = crcs.get(shard["offset"])
+                    if crc is not None and "crc" in shard:
+                        shard["crc"] = _CRC.pack(crc)
+            sealed = pack_frame(meta)
+            assert len(sealed) == len(header), "CRC stamp changed header size"
+            header = sealed
         buf[8 : len(header)] = header[8:]
         buf[:8] = header[:8]
+        self._maybe_inject_corruption(meta, data_start)
+
+    def _maybe_inject_corruption(self, meta: Dict, data_start: int) -> None:
+        """``shm.write`` injection site: mutate the sealed frame's data the
+        way bit rot or a torn copy would — the seal stays valid, only the
+        CRCs can catch it."""
+        from dlrover_tpu.chaos import get_injector
+
+        inj = get_injector()
+        if inj is None:
+            return
+        act = inj.fire("shm.write", step=meta.get("step"))
+        if act is None:
+            return
+        shards = [
+            (leaf.get("path", "?"), shard)
+            for leaf in meta.get("leaves", [])
+            for shard in leaf.get("shards", [])
+            if "abs_offset" in shard and shard.get("nbytes", 0) > 0
+        ]
+        if not shards:
+            return
+        buf = self._shm.buf
+        if act["kind"] == "torn":
+            # zero the tail half of the LAST shard: a write that stopped
+            # partway but was still sealed/copied as if complete
+            path, shard = shards[-1]
+            off, n = shard["abs_offset"], shard["nbytes"]
+            cut = n // 2
+            buf[off + cut : off + n] = bytes(n - cut)
+        else:  # bitflip
+            path, shard = shards[0]
+            off, n = shard["abs_offset"], shard["nbytes"]
+            at = off + int(act.get("rnd", 0.0) * max(1, n - 1))
+            buf[at] = buf[at] ^ 0xFF
+        logger.warning(
+            "chaos: injected %s into shm frame %s shard %r (step %s)",
+            act["kind"], self._name, path, meta.get("step"),
+        )
 
     def write_raw(self, blob: bytes) -> None:
         """Write a complete pre-framed blob (e.g. a peer replica fetched
@@ -288,6 +416,31 @@ class SharedMemoryHandler:
         meta = self.read_meta()
         return int(meta["step"]) if meta else -1
 
+    # -- integrity ---------------------------------------------------------
+
+    def verify_frame(self) -> List[str]:
+        """Names of shards whose stored CRC mismatches their bytes
+        (``leafpath@offset``). Empty list ⇒ frame intact, no sealed frame,
+        or a pre-CRC frame (no stamps to check).
+
+        CRCs stream zero-copy over the mapped segment (memoryview slices,
+        no ``read_shard_bytes`` allocation): the pre-restore check must
+        cost memory-bandwidth, not a second pass through the restore read
+        channel."""
+        meta = self.read_meta()
+        if meta is None:
+            return []
+        buf = self._shm.buf
+
+        def _view(shard_meta: Dict):
+            off = shard_meta["abs_offset"]
+            n = shard_meta["nbytes"]
+            if off + n > len(buf):
+                return None  # shard extends past the segment: torn
+            return buf[off : off + n]
+
+        return _verify_shards(meta, _view)
+
 
 def parse_frame(blob: bytes) -> Optional[Dict]:
     """Parse a persisted frame file back into (meta, memoryview-able bytes)."""
@@ -305,3 +458,37 @@ def frame_shard_bytes(meta: Dict, shard_meta: Dict) -> bytes:
     blob = meta["_blob"]
     off = shard_meta["abs_offset"]
     return blob[off : off + shard_meta["nbytes"]]
+
+
+def _verify_shards(meta: Dict, read: Callable[[Dict], Any]) -> List[str]:
+    bad: List[str] = []
+    for leaf in meta.get("leaves", []):
+        for shard in leaf.get("shards", []):
+            stamp = shard.get("crc")
+            if not stamp or "abs_offset" not in shard:
+                continue
+            data = read(shard)
+            if (data is None
+                    or (zlib.crc32(data) & 0xFFFFFFFF)
+                    != _CRC.unpack(stamp)[0]):
+                bad.append(f"{leaf.get('path', '?')}@{shard['offset']}")
+    return bad
+
+
+def verify_parsed_frame(meta: Dict) -> List[str]:
+    """CRC-check a :func:`parse_frame` result (storage/replica blob);
+    returns the corrupt shard names (``leafpath@offset``)."""
+    return _verify_shards(meta, lambda shard: frame_shard_bytes(meta, shard))
+
+
+def verify_frame_blob(blob) -> List[str]:
+    """CRC-check a raw frame blob end-to-end. An unparseable blob counts
+    as one corrupt '<frame>' entry (its seal/commit-marker is broken)."""
+    try:
+        meta = parse_frame(bytes(blob) if not isinstance(blob, bytes)
+                           else blob)
+    except Exception:  # noqa: BLE001 — torn header
+        meta = None
+    if meta is None:
+        return ["<frame>"]
+    return verify_parsed_frame(meta)

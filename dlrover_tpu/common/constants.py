@@ -169,6 +169,13 @@ class EnvKey:
     # fault injection for node-check benchmarks
     # (reference: trainer/torch/node_check/utils.py:52 MOCK_ERR_RANK)
     MOCK_ERR_RANK = "DLROVER_TPU_MOCK_ERR_RANK"
+    # per-agent-incarnation nonce suffixing shm segment names: a restarted
+    # agent never reattaches to a dead predecessor's half-written segments
+    # (ckpt/shm_handler.py shm_name / cleanup_orphan_segments)
+    SHM_INCARNATION = "DLROVER_TPU_SHM_INCARNATION"
+    # grace window (seconds) the agent keeps training on cached shard
+    # assignments while the master is unreachable (partition-degraded mode)
+    PARTITION_GRACE_S = "DLROVER_TPU_PARTITION_GRACE_S"
 
 
 class GRPC:

@@ -26,7 +26,8 @@ from typing import Any, Callable, Dict, Optional
 
 import msgpack
 
-from dlrover_tpu.common import comm
+from dlrover_tpu.chaos import get_injector
+from dlrover_tpu.common import comm, retry
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCError
 
@@ -133,51 +134,58 @@ class HttpRPCClient:
     """Drop-in for rpc.RPCClient over the HTTP transport."""
 
     def __init__(self, addr: str, timeout_s: float = 330.0,
-                 retries: int = 30):
+                 retries: int = 30,
+                 policy: Optional[retry.RetryPolicy] = None):
         if addr.startswith("http://"):
             addr = addr[len("http://"):]
         self._addr = addr.rstrip("/")
         self._timeout_s = timeout_s
-        self._retries = retries
+        self._policy = policy or retry.RetryPolicy.from_retries(retries)
+        self._breaker = retry.CircuitBreaker()
 
     @property
     def addr(self) -> str:
         return f"http://{self._addr}"
 
+    @property
+    def breaker(self) -> retry.CircuitBreaker:
+        return self._breaker
+
     def call(self, method: str, request: Any = None,
-             retries: Optional[int] = None) -> Any:
-        retries = self._retries if retries is None else retries
+             retries: Optional[int] = None,
+             policy: Optional[retry.RetryPolicy] = None) -> Any:
+        if policy is None:
+            policy = (retry.RetryPolicy.from_retries(retries)
+                      if retries is not None else self._policy)
         frame = msgpack.packb(
             {"m": method, "p": comm.serialize(request)}, use_bin_type=True
         )
-        last: Optional[Exception] = None
-        for attempt in range(retries):
-            try:
-                req = urllib.request.Request(
-                    f"http://{self._addr}/rpc", data=frame,
-                    headers={"Content-Type": "application/msgpack"},
-                )
-                with urllib.request.urlopen(
-                    req, timeout=self._timeout_s
-                ) as r:
-                    resp = msgpack.unpackb(r.read(), raw=False)
-                if not resp.get("ok"):
-                    raise RPCError(resp.get("err", "unknown error"))
-                return comm.deserialize(resp.get("p", b""))
-            except (urllib.error.URLError, ConnectionError, OSError) as e:
-                last = e
-                if attempt + 1 < retries:
-                    import time
+        inj = get_injector()
 
-                    time.sleep(min(5.0, 0.1 * (2 ** min(attempt, 5))))
-        raise ConnectionError(
-            f"http rpc to {self._addr} failed after {retries} "
-            f"attempts: {last!r}"
+        def attempt() -> Any:
+            if inj is not None:
+                inj.fire("rpc.send", method=method)
+            req = urllib.request.Request(
+                f"http://{self._addr}/rpc", data=frame,
+                headers={"Content-Type": "application/msgpack"},
+            )
+            with urllib.request.urlopen(req, timeout=self._timeout_s) as r:
+                resp = msgpack.unpackb(r.read(), raw=False)
+            if inj is not None:
+                inj.fire("rpc.recv", method=method)
+            if not resp.get("ok"):
+                raise RPCError(resp.get("err", "unknown error"))
+            return comm.deserialize(resp.get("p", b""))
+
+        return retry.retry_call(
+            attempt, policy, breaker=self._breaker,
+            retry_on=(urllib.error.URLError, ConnectionError, OSError),
+            describe=f"http rpc {method} to {self._addr}",
         )
 
     def try_call(self, method: str, request: Any = None) -> Any:
         try:
-            return self.call(method, request)
+            return self.call(method, request, policy=retry.PROBE)
         except (ConnectionError, RPCError):
             return None
 

@@ -1,0 +1,174 @@
+"""Unified retry policy for master↔agent RPC call classes.
+
+Before this module every transport had its own ad-hoc loop (RPCClient:
+0.1·1.6ⁿ capped at 5 s × 30 attempts; HttpRPCClient: a different power-of-2
+ladder) and every call used the same 30-attempt budget — so a liveness
+probe could block for minutes against a partitioned master while the
+heartbeat loop it was supposed to feed starved. This module gives each
+*call class* its own budget (reference: DLRover's ``@retry`` decorator
+grades retry counts per API, elastic_agent/master_client.py):
+
+=============  ==============================================================
+``DEFAULT``    control-plane calls that must ride through a master restart
+``PROBE``      one-shot liveness checks — never wait, never trip on breaker
+``HEARTBEAT``  2 quick attempts under a ~3 s deadline; failure is a signal
+               (it feeds partition detection), not something to hide
+``TELEMETRY``  one-shot best-effort reporting (events, metrics)
+``RENDEZVOUS`` patient: rendezvous MUST keep knocking while the master
+               restarts, breaker or not
+``BULK``       replica-frame transfers: few attempts, real work per attempt
+=============  ==============================================================
+
+A per-client :class:`CircuitBreaker` counts whole-call failures: after
+``threshold`` consecutive exhausted calls the breaker opens and subsequent
+breaker-respecting calls fail fast with :class:`CircuitOpenError` (a
+``ConnectionError``, so existing except-clauses treat it as unreachable)
+instead of each burning a full backoff ladder against a dead master. One
+trial call per ``cooldown_s`` probes for recovery (half-open).
+"""
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple, Type
+
+from dlrover_tpu.common.log import logger
+
+
+class CircuitOpenError(ConnectionError):
+    """Failing fast: the peer has been unreachable for enough consecutive
+    calls that retrying immediately is pointless."""
+
+
+class CircuitBreaker:
+    """Counts consecutive whole-call failures; thread-safe."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 5.0):
+        self._threshold = threshold
+        self._cooldown_s = cooldown_s
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._lock = threading.Lock()
+
+    @property
+    def is_open(self) -> bool:
+        with self._lock:
+            return self._opened_at is not None
+
+    def allow(self) -> bool:
+        """True if a call may proceed. While open, one half-open trial is
+        granted per cooldown period."""
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if time.monotonic() - self._opened_at >= self._cooldown_s:
+                # grant this trial; push the next one a full cooldown out
+                self._opened_at = time.monotonic()
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._failures >= self._threshold and self._opened_at is None:
+                self._opened_at = time.monotonic()
+                logger.warning(
+                    "circuit breaker OPEN after %d consecutive failed calls "
+                    "(cooldown %.1fs)", self._failures, self._cooldown_s,
+                )
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Budget for one call class. ``deadline_s`` bounds the whole call
+    (attempts + sleeps); ``respect_breaker=False`` means the call must try
+    even when the client's breaker is open (rendezvous, probes)."""
+
+    max_attempts: int = 30
+    base_backoff_s: float = 0.1
+    multiplier: float = 1.6
+    max_backoff_s: float = 5.0
+    jitter: float = 0.2
+    deadline_s: Optional[float] = None
+    respect_breaker: bool = True
+
+    def backoff_s(self, attempt: int) -> float:
+        b = min(self.base_backoff_s * self.multiplier ** attempt,
+                self.max_backoff_s)
+        if self.jitter:
+            b *= 1.0 + random.uniform(-self.jitter, self.jitter)
+        return max(0.0, b)
+
+    @classmethod
+    def from_retries(cls, retries: int) -> "RetryPolicy":
+        """Legacy ``retries=N`` shape (pre-policy RPCClient semantics)."""
+        if retries <= 1:
+            return PROBE
+        return RetryPolicy(max_attempts=retries)
+
+
+DEFAULT = RetryPolicy()
+PROBE = RetryPolicy(max_attempts=1, respect_breaker=False)
+HEARTBEAT = RetryPolicy(max_attempts=2, base_backoff_s=0.2,
+                        max_backoff_s=0.5, deadline_s=3.0,
+                        respect_breaker=False)
+TELEMETRY = RetryPolicy(max_attempts=1)
+RENDEZVOUS = RetryPolicy(max_attempts=600, base_backoff_s=0.1,
+                         max_backoff_s=2.0, respect_breaker=False)
+BULK = RetryPolicy(max_attempts=3, base_backoff_s=0.5, max_backoff_s=2.0)
+
+RetryPolicy.DEFAULT = DEFAULT  # type: ignore[attr-defined]
+RetryPolicy.PROBE = PROBE  # type: ignore[attr-defined]
+RetryPolicy.HEARTBEAT = HEARTBEAT  # type: ignore[attr-defined]
+RetryPolicy.TELEMETRY = TELEMETRY  # type: ignore[attr-defined]
+RetryPolicy.RENDEZVOUS = RENDEZVOUS  # type: ignore[attr-defined]
+RetryPolicy.BULK = BULK  # type: ignore[attr-defined]
+
+
+def retry_call(
+    fn: Callable[[], "object"],
+    policy: RetryPolicy,
+    breaker: Optional[CircuitBreaker] = None,
+    retry_on: Tuple[Type[BaseException], ...] = (ConnectionError, OSError),
+    describe: str = "call",
+):
+    """Run ``fn`` under ``policy``. The breaker is consulted once up front
+    (fail fast while open) and fed one verdict per whole call, so a patient
+    policy's in-flight retries are never aborted mid-ladder."""
+    if (breaker is not None and policy.respect_breaker
+            and not breaker.allow()):
+        raise CircuitOpenError(f"{describe}: circuit open, failing fast")
+    deadline = (time.monotonic() + policy.deadline_s
+                if policy.deadline_s is not None else None)
+    last: Optional[BaseException] = None
+    attempts = 0
+    for attempt in range(policy.max_attempts):
+        attempts = attempt + 1
+        try:
+            result = fn()
+        except retry_on as e:
+            last = e
+            if attempts >= policy.max_attempts:
+                break
+            delay = policy.backoff_s(attempt)
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                delay = min(delay, remaining)
+            time.sleep(delay)
+        else:
+            if breaker is not None:
+                breaker.record_success()
+            return result
+    if breaker is not None:
+        breaker.record_failure()
+    raise ConnectionError(
+        f"{describe} failed after {attempts} attempts: {last!r}"
+    )

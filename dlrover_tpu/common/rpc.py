@@ -15,10 +15,10 @@ Frame: 4-byte big-endian length + msgpack map
 import socket
 import socketserver
 import threading
-import time
 from typing import Any, Callable, Dict, Optional
 
-from dlrover_tpu.common import comm
+from dlrover_tpu.chaos import get_injector
+from dlrover_tpu.common import comm, retry
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.multi_process import recv_msg, send_msg
 
@@ -188,7 +188,13 @@ class RPCClient:
     from monitor threads don't interleave frames.
     """
 
-    def __init__(self, addr: str, timeout_s: float = 330.0, retries: int = 30):
+    def __init__(
+        self,
+        addr: str,
+        timeout_s: float = 330.0,
+        retries: int = 30,
+        policy: Optional[retry.RetryPolicy] = None,
+    ):
         # timeout must exceed the longest server-side blocking op (barrier:
         # 300s) or the client retries a call the server is still executing;
         # a dead master is detected fast anyway (connect() fails immediately)
@@ -197,11 +203,19 @@ class RPCClient:
         host, port = addr.rsplit(":", 1)
         self._host, self._port = host, int(port)
         self._timeout_s = timeout_s
-        self._retries = retries
+        self._policy = policy or retry.RetryPolicy.from_retries(retries)
+        # whole-call failures open the breaker so subsequent default-policy
+        # calls fail fast against a dead/partitioned master instead of each
+        # burning a full backoff ladder (rendezvous/probe policies opt out)
+        self._breaker = retry.CircuitBreaker()
         self._tls = threading.local()
         self._client_id = uuid.uuid4().hex
         self._seq = 0
         self._seq_lock = threading.Lock()
+
+    @property
+    def breaker(self) -> retry.CircuitBreaker:
+        return self._breaker
 
     @property
     def addr(self) -> str:
@@ -210,9 +224,15 @@ class RPCClient:
     def _conn(self) -> socket.socket:
         conn = getattr(self._tls, "conn", None)
         if conn is None:
+            # connect timeout is bounded separately: the long read timeout
+            # exists for server-side blocking ops (barrier), but a SYN into
+            # a blackholed/partitioned host must fail in seconds so retry
+            # policies and the partition detector actually see it
             conn = socket.create_connection(
-                (self._host, self._port), timeout=self._timeout_s
+                (self._host, self._port),
+                timeout=min(5.0, self._timeout_s),
             )
+            conn.settimeout(self._timeout_s)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._tls.conn = conn
         return conn
@@ -227,14 +247,23 @@ class RPCClient:
             self._tls.conn = None
 
     def call(
-        self, method: str, request: Any = None, retries: Optional[int] = None
+        self,
+        method: str,
+        request: Any = None,
+        retries: Optional[int] = None,
+        policy: Optional[retry.RetryPolicy] = None,
     ) -> Any:
         """Invoke ``method`` with a typed message; returns the typed reply.
 
-        Retries with backoff on transport errors — agents must ride through
-        brief master restarts (reference MasterClient retry decorator,
-        elastic_agent/master_client.py:30ish)."""
-        retries = self._retries if retries is None else retries
+        Retries under a :class:`~dlrover_tpu.common.retry.RetryPolicy` on
+        transport errors — agents must ride through brief master restarts
+        (reference MasterClient retry decorator,
+        elastic_agent/master_client.py:30ish). Per-call-class policies
+        override the client default; the legacy ``retries=N`` keyword maps
+        onto an equivalent policy."""
+        if policy is None:
+            policy = (retry.RetryPolicy.from_retries(retries)
+                      if retries is not None else self._policy)
         with self._seq_lock:
             self._seq += 1
             seq = self._seq
@@ -242,30 +271,38 @@ class RPCClient:
             "m": method, "p": comm.serialize(request),
             "id": seq, "c": self._client_id,
         }
-        backoff = 0.1
-        last_err: Optional[Exception] = None
-        for attempt in range(retries):
+        inj = get_injector()
+
+        def attempt() -> Any:
             try:
+                if inj is not None:
+                    inj.fire("rpc.send", method=method)
                 conn = self._conn()
                 send_msg(conn, frame)
                 resp = recv_msg(conn)
-                if not resp.get("ok"):
-                    raise RPCError(resp.get("err", "unknown rpc error"))
-                return comm.deserialize(resp.get("p", b""))
-            except (ConnectionError, OSError, socket.timeout) as e:
-                last_err = e
+                if inj is not None:
+                    inj.fire("rpc.recv", method=method)
+            except (ConnectionError, OSError, socket.timeout):
+                # reconnect on the next attempt; the server's dedup cache
+                # makes the retried frame exactly-once
                 self._close()
-                if attempt < retries - 1:
-                    time.sleep(min(backoff, 5.0))
-                    backoff *= 1.6
-        raise ConnectionError(
-            f"rpc {method} to {self.addr} failed after "
-            f"{retries} attempts: {last_err}"
+                raise
+            if not resp.get("ok"):
+                raise RPCError(resp.get("err", "unknown rpc error"))
+            return comm.deserialize(resp.get("p", b""))
+
+        return retry.retry_call(
+            attempt, policy, breaker=self._breaker,
+            retry_on=(ConnectionError, OSError),
+            describe=f"rpc {method} to {self.addr}",
         )
 
     def try_call(self, method: str, request: Any = None) -> Any:
-        """One-shot call without retries (for probes/liveness checks)."""
-        return self.call(method, request, retries=1)
+        """One-shot probe: None on transport/handler failure, never raises."""
+        try:
+            return self.call(method, request, policy=retry.PROBE)
+        except (ConnectionError, RPCError):
+            return None
 
 
 def find_free_port(host: str = "") -> int:

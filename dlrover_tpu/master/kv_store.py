@@ -5,11 +5,21 @@ sync_service.py:25. The reference's KV store backs the torch rendezvous
 ``Store``; here it is the generic control-plane KV agents/workers use for
 cross-host coordination that must work even when the device fabric is down
 (e.g. checkpoint replica bookkeeping).
+
+Blocking semantics: ``wait``/``join`` deadlines are computed against
+``time.monotonic()`` and re-derived on every wakeup, so spurious
+``Condition`` wakeups (and notify storms for other keys) can neither
+extend nor shrink the timeout. A ``clear()``/``reset()`` bumps an epoch
+and wakes every waiter so blocked calls return immediately during master
+failover instead of sitting out their full timeout against a store that
+no longer holds their key.
 """
 
 import threading
 import time
 from typing import Dict, List, Optional
+
+from dlrover_tpu.chaos import get_injector
 
 
 class KVStoreService:
@@ -17,6 +27,7 @@ class KVStoreService:
         self._store: Dict[str, bytes] = {}
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
+        self._epoch = 0  # bumped by clear(); waiters from an old epoch bail
 
     def set(self, key: str, value: bytes) -> None:
         with self._cond:
@@ -37,10 +48,19 @@ class KVStoreService:
             return cur
 
     def wait(self, key: str, timeout_s: float) -> Optional[bytes]:
-        deadline = time.time() + timeout_s
+        inj = get_injector()
+        if inj is not None:
+            inj.fire("kv.wait", key=key)
+        deadline = time.monotonic() + timeout_s
         with self._cond:
+            epoch = self._epoch
             while key not in self._store:
-                remaining = deadline - time.time()
+                if self._epoch != epoch:
+                    # store cleared mid-wait (failover): the key this
+                    # waiter was promised can no longer arrive in the
+                    # world it joined — fail fast, let the caller resync
+                    return None
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
                 self._cond.wait(remaining)
@@ -73,8 +93,10 @@ class KVStoreService:
             self._cond.notify_all()
 
     def clear(self) -> None:
-        with self._lock:
+        with self._cond:
             self._store.clear()
+            self._epoch += 1
+            self._cond.notify_all()
 
     def dump(self) -> Dict[str, bytes]:
         """Copy of the whole store (master state snapshots)."""
@@ -92,23 +114,31 @@ class SyncService:
 
     def __init__(self) -> None:
         self._barriers: Dict[str, set] = {}
+        self._epochs: Dict[str, int] = {}  # bumped by reset(name)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
 
     def join(self, name: str, node_rank: int, world_size: int,
              timeout_s: float = 300.0) -> bool:
-        deadline = time.time() + timeout_s
+        deadline = time.monotonic() + timeout_s
         with self._cond:
+            epoch = self._epochs.get(name, 0)
             members = self._barriers.setdefault(name, set())
             members.add(node_rank)
             self._cond.notify_all()
             while len(self._barriers.get(name, ())) < world_size:
-                remaining = deadline - time.time()
+                if self._epochs.get(name, 0) != epoch:
+                    # barrier reset mid-join (failover / world change):
+                    # this joiner's cohort is gone — fail, don't block
+                    return False
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
                 self._cond.wait(remaining)
             return True
 
     def reset(self, name: str) -> None:
-        with self._lock:
+        with self._cond:
             self._barriers.pop(name, None)
+            self._epochs[name] = self._epochs.get(name, 0) + 1
+            self._cond.notify_all()

@@ -122,6 +122,20 @@ class JobMaster:
                 _pm.fault_recovered()
 
         self.event_journal.add_listener(_bridge_perf)
+        # chaos drills: master-side injected faults (kv.wait, rdzv.join,
+        # its own rpc clients) land directly in the journal, so a drill's
+        # event sequence is complete and seed-reproducible
+        from dlrover_tpu.chaos import get_injector
+
+        _inj = get_injector()
+        if _inj is not None:
+            _inj.set_reporter(
+                lambda event, _j=self.event_journal: _j.record(
+                    "fault_injected", source="chaos", **event
+                )
+            )
+            logger.info("fault injection active on master: %s",
+                        _inj.describe())
         self._server = RPCServer(port=port)
         self._server.register_object(self.servicer)
         # fast fault detection: an agent's death closes its heartbeat TCP

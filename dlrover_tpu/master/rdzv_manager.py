@@ -130,6 +130,14 @@ class RendezvousManager(ABC):
 
     def join_rendezvous(self, meta: NodeMeta) -> int:
         """Register a node for the next world cut; returns the round."""
+        from dlrover_tpu.chaos import get_injector
+
+        inj = get_injector()
+        if inj is not None:
+            # delay models a slow-to-register master (the client's patient
+            # rendezvous policy must absorb it); error surfaces as an RPC
+            # handler fault to the joining agent
+            inj.fire("rdzv.join", rdzv=self._name, node_rank=meta.node_rank)
         with self._lock:
             if not self._waiting_nodes:
                 self._start_rdzv_ts = time.time()

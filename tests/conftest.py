@@ -27,3 +27,28 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402 — must follow the env setup above
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """On any chaos-marked failure, print the fault schedule + seed so the
+    run is replayable: export the printed env vars and re-run the test."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when != "call" or not rep.failed:
+        return
+    if item.get_closest_marker("chaos") is None:
+        return
+    try:
+        from dlrover_tpu.chaos import active_repro
+
+        repro = active_repro()
+    except Exception:  # noqa: BLE001 — reporting must not mask the failure
+        repro = None
+    if repro:
+        rep.sections.append((
+            "chaos repro",
+            f"replay this fault sequence with:\n  {repro}\n",
+        ))

@@ -12,7 +12,9 @@ import sys
 import time
 
 import numpy as np
+import pytest
 
+from dlrover_tpu import chaos
 from dlrover_tpu.common.multi_process import (
     LocalIPCServer,
     SharedLock,
@@ -117,3 +119,161 @@ def test_sigkill_mid_write_no_torn_frame_no_leaked_lock(tmp_path):
         shm.close()
         unlink_shared_memory(name)
         server.stop()
+
+
+# -- post-seal corruption (FaultInjector-driven) ----------------------------
+#
+# The seal order above covers writers that DIE; these cover sealed frames
+# whose BYTES go bad afterwards (bit rot, a torn replica copy) — invisible
+# to the commit marker, caught only by the per-shard CRCs. Restore must
+# either repair the frame from a backup-group peer or fail loudly, naming
+# the corrupt shard, and fall back to persistent storage.
+
+
+class _StubMaster:
+    """Records the engine's journal events; absorbs kv traffic."""
+
+    def __init__(self):
+        self.events = []
+
+    def kv_set(self, key, value):
+        pass
+
+    def report_event(self, kind, data=None):
+        self.events.append((kind, data or {}))
+
+
+def _rewrite_frame_in_place(shm: SharedMemoryHandler) -> None:
+    """Re-write the sealed frame byte-identically so an active ``shm.write``
+    fault rule gets a shot at corrupting it post-seal."""
+    meta = shm.read_meta()
+    shards = sorted(
+        (s for leaf in meta["leaves"] for s in leaf["shards"]),
+        key=lambda s: s["offset"],
+    )
+    bufs = [np.frombuffer(shm.read_shard_bytes(s), np.uint8).copy()
+            for s in shards]
+    shm.write_frame(meta, bufs)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    yield
+    chaos.reset_injector()
+
+
+@pytest.mark.chaos
+def test_bitflip_detected_and_repaired_from_replica(tmp_path):
+    """A bit flipped in the sealed shm frame after the replica backup: the
+    CRC check catches it on restore and the engine force-pulls its own
+    clean frame back from the backup-group peer."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.ckpt.engine import CheckpointEngine
+    from dlrover_tpu.ckpt.replica import ReplicaManager, ReplicaService
+    from dlrover_tpu.master.master import LocalJobMaster
+
+    job = f"bitflip{os.getpid()}"
+    master = LocalJobMaster(job_name=job, node_num=2)
+    master.prepare()
+    devices = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("data",))
+    w = jax.device_put(
+        jnp.arange(16, dtype=jnp.float32).reshape(4, 4),
+        NamedSharding(mesh, P("data")),
+    )
+    state = {"w": w}
+    svc0, svc1 = ReplicaService(), ReplicaService()
+    svc0.start()
+    svc1.start()
+    try:
+        c0 = MasterClient(master.addr, 0)
+        ReplicaManager(job, 1, 2, MasterClient(master.addr, 1), service=svc1)
+        m0 = ReplicaManager(job, 0, 2, c0, service=svc0)
+        engine = CheckpointEngine(
+            str(tmp_path), job_name=job, node_rank=0, local_rank=0,
+            ipc_socket="/nonexistent", world_size=1, rank=0,
+            replica_manager=m0,
+        )
+        assert engine.save_to_memory(11, state)
+        assert engine.wait_drained(60)
+        m0.wait_backup()  # the peer now holds the clean frame
+
+        chaos.configure("shm.write:bitflip@nth=1", seed=21)
+        _rewrite_frame_in_place(engine._shm)
+        chaos.reset_injector()
+        bad = engine._shm.verify_frame()
+        assert bad and all("w" in s and "@" in s for s in bad)
+
+        # relaunch: fresh engine, no local replica service — the only good
+        # copy of the frame lives on the peer
+        stub = _StubMaster()
+        m0c = ReplicaManager(job, 0, 2, c0, service=None)
+        engine2 = CheckpointEngine(
+            str(tmp_path), job_name=job, node_rank=0, local_rank=0,
+            ipc_socket="/nonexistent", world_size=1, rank=0,
+            master_client=stub, replica_manager=m0c,
+        )
+        restored, step = engine2.load(state)
+        assert step == 11
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(w))
+        kinds = [k for k, _ in stub.events]
+        assert "ckpt_corrupt" in kinds and "ckpt_repaired" in kinds
+        corrupt = dict(stub.events)["ckpt_corrupt"]
+        assert corrupt["medium"] == "shm" and corrupt["shards"] == bad
+        # the repaired frame passes verification
+        assert engine2._shm.verify_frame() == []
+    finally:
+        svc0.stop()
+        svc1.stop()
+        master.stop()
+        unlink_shared_memory(shm_name(job, 0, 0))
+        unlink_shared_memory(shm_name(job, 1, 0))
+
+
+@pytest.mark.chaos
+def test_torn_write_without_replica_fails_loudly(tmp_path):
+    """A torn (half-zeroed) shard with no replica peers to repair from:
+    restore must EXCLUDE the frame — naming the corrupt shard in the
+    journal — and fall back to persistent storage, never silently serve
+    the torn bytes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dlrover_tpu.ckpt.engine import CheckpointEngine
+
+    job = f"torn{os.getpid()}"
+    devices = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("data",))
+    w = jax.device_put(
+        jnp.arange(1, 17, dtype=jnp.float32).reshape(4, 4),  # nonzero tail
+        NamedSharding(mesh, P("data")),
+    )
+    state = {"w": w}
+    stub = _StubMaster()
+    engine = CheckpointEngine(
+        str(tmp_path), job_name=job, node_rank=0, local_rank=0,
+        ipc_socket="/nonexistent", world_size=1, rank=0,
+        master_client=stub,
+    )
+    try:
+        assert engine.save_to_memory(7, state)
+        assert engine.wait_drained(60)
+        chaos.configure("shm.write:torn@nth=1", seed=3)
+        _rewrite_frame_in_place(engine._shm)
+        chaos.reset_injector()
+        bad = engine._shm.verify_frame()
+        assert bad and all("w" in s and "@" in s for s in bad)
+
+        restored, step = engine.load(state)
+        assert step == -1  # torn frame excluded; storage is empty
+        corrupt = [d for k, d in stub.events if k == "ckpt_corrupt"]
+        assert corrupt and corrupt[0]["shards"] == bad
+        assert "ckpt_repaired" not in [k for k, _ in stub.events]
+    finally:
+        unlink_shared_memory(shm_name(job, 0, 0))

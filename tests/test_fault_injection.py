@@ -1,0 +1,528 @@
+"""Fault-injection plane + hardened recovery paths.
+
+Fast seeded subset (tier-1): schedule grammar, decision determinism, RPC
+drop/delay/partition ride-through on a real server, retry-policy /
+circuit-breaker budgets, kv wait semantics under clear()/reset(), shm
+incarnation-orphan cleanup, and CRC detection of injected corruption.
+The multi-seed matrix is additionally marked slow.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu import chaos
+from dlrover_tpu.common import comm, retry
+
+
+@pytest.fixture(autouse=True)
+def _reset_injector():
+    yield
+    chaos.reset_injector()
+
+
+# -- schedule grammar -------------------------------------------------------
+
+
+def test_schedule_grammar():
+    rules = chaos.parse_schedule(
+        "rpc.send:drop@p=0.05;rpc.recv:delay=2s;shm.write:torn@step=3;"
+        "kv.wait:partition@t=10s..25s;rpc.*:bitflip@nth=2,times=1"
+    )
+    assert [r.site for r in rules] == [
+        "rpc.send", "rpc.recv", "shm.write", "kv.wait", "rpc.*",
+    ]
+    assert rules[0].kind == "drop" and rules[0].p == 0.05
+    assert rules[1].kind == "delay" and rules[1].dur == 2.0
+    assert rules[2].kind == "torn" and rules[2].step == 3
+    assert rules[3].kind == "partition" and rules[3].window == (10.0, 25.0)
+    assert rules[4].nth == 2 and rules[4].times == 1
+    assert rules[4].matches_site("rpc.send")
+    assert not rules[4].matches_site("shm.write")
+    # durations parse ms/s/m
+    assert chaos.parse_rule("a:delay=250ms").dur == 0.25
+    assert chaos.parse_rule("a:delay=1m").dur == 60.0
+
+
+def test_schedule_grammar_json():
+    rules = chaos.parse_schedule(
+        '[{"site": "rpc.send", "kind": "drop", "p": 0.5},'
+        ' {"site": "kv.wait", "kind": "partition", "t": [1, 2]}]'
+    )
+    assert rules[0].p == 0.5
+    assert rules[1].window == (1.0, 2.0)
+
+
+def test_schedule_rejects_unknown_kind_and_param():
+    with pytest.raises(ValueError):
+        chaos.parse_rule("rpc.send:explode")
+    with pytest.raises(ValueError):
+        chaos.parse_rule("rpc.send:drop@bogus=1")
+
+
+# -- determinism ------------------------------------------------------------
+
+
+def _drive(seed: int, n: int = 64):
+    inj = chaos.configure("x.site:drop@p=0.5", seed=seed)
+    outcomes = []
+    for _ in range(n):
+        try:
+            inj.fire("x.site")
+            outcomes.append(False)
+        except chaos.InjectedFault:
+            outcomes.append(True)
+    return outcomes, list(inj.decisions)
+
+
+@pytest.mark.chaos
+def test_same_seed_same_fault_sequence():
+    out1, dec1 = _drive(seed=42)
+    out2, dec2 = _drive(seed=42)
+    assert out1 == out2
+    assert dec1 == dec2
+    assert any(out1) and not all(out1)  # p=0.5 actually fires sometimes
+    out3, _ = _drive(seed=43)
+    assert out1 != out3  # 2^-64 false-failure odds
+
+
+@pytest.mark.chaos
+def test_reporter_receives_fault_events():
+    inj = chaos.configure("x.y:drop@nth=1", seed=1)
+    events = []
+    inj.set_reporter(events.append)
+    with pytest.raises(chaos.InjectedFault):
+        inj.fire("x.y", step=7)
+    inj.fire("x.y", step=8)  # nth=1 already passed: no fire
+    assert events == [{"site": "x.y", "fault": "drop", "ordinal": 0,
+                       "step": 7}]
+    assert chaos.active_repro() == inj.describe()
+    assert "DLROVER_FAULT_SEED=1" in inj.describe()
+
+
+def test_get_injector_env_configuration(monkeypatch):
+    chaos.reset_injector()
+    monkeypatch.delenv(chaos.SCHEDULE_ENV, raising=False)
+    assert chaos.get_injector() is None
+    chaos.reset_injector()
+    monkeypatch.setenv(chaos.SCHEDULE_ENV, "a.b:delay=1ms")
+    monkeypatch.setenv(chaos.SEED_ENV, "9")
+    inj = chaos.get_injector()
+    assert inj is not None and inj.seed == 9
+    chaos.reset_injector()
+
+
+# -- retry policy / circuit breaker ----------------------------------------
+
+
+def test_retry_call_rides_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return 42
+
+    policy = retry.RetryPolicy(max_attempts=5, base_backoff_s=0.01,
+                               max_backoff_s=0.02)
+    assert retry.retry_call(flaky, policy) == 42
+    assert len(calls) == 3
+
+
+def test_retry_call_respects_deadline():
+    policy = retry.RetryPolicy(max_attempts=1000, base_backoff_s=0.05,
+                               max_backoff_s=0.05, deadline_s=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        retry.retry_call(lambda: (_ for _ in ()).throw(
+            ConnectionError("down")), policy)
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_circuit_breaker_opens_and_half_opens():
+    breaker = retry.CircuitBreaker(threshold=2, cooldown_s=0.2)
+    probe = retry.RetryPolicy(max_attempts=1, respect_breaker=True)
+
+    def down():
+        raise ConnectionError("down")
+
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            retry.retry_call(down, probe, breaker=breaker)
+    assert breaker.is_open
+    # open: fails fast WITHOUT invoking fn
+    called = []
+    with pytest.raises(retry.CircuitOpenError):
+        retry.retry_call(lambda: called.append(1), probe, breaker=breaker)
+    assert not called
+    # a policy that must keep knocking ignores the breaker
+    assert retry.retry_call(lambda: "ok", retry.RENDEZVOUS,
+                            breaker=breaker) == "ok"
+    # half-open trial after cooldown closes it on success
+    time.sleep(0.25)
+    assert retry.retry_call(lambda: "up", probe, breaker=breaker) == "up"
+    assert not breaker.is_open
+
+
+def test_from_retries_maps_legacy_budgets():
+    assert retry.RetryPolicy.from_retries(1).max_attempts == 1
+    assert retry.RetryPolicy.from_retries(30).max_attempts == 30
+    assert retry.HEARTBEAT.deadline_s is not None
+    assert not retry.RENDEZVOUS.respect_breaker
+
+
+# -- RPC transport under injection ------------------------------------------
+
+
+def _echo_server():
+    from dlrover_tpu.common.rpc import RPCServer
+
+    server = RPCServer(host="127.0.0.1")
+    calls = []
+
+    def echo(req):
+        calls.append(req.node_id)
+        return comm.BoolResponse(value=True)
+
+    server.register("echo", echo)
+    server.start()
+    return server, calls
+
+
+@pytest.mark.chaos
+def test_rpc_drop_is_retried_and_deduped():
+    """A response dropped AFTER the server executed is replayed from the
+    dedup cache on retry — the handler runs exactly once."""
+    from dlrover_tpu.common.rpc import RPCClient
+
+    chaos.configure("rpc.recv:drop@nth=1", seed=5)
+    server, calls = _echo_server()
+    try:
+        client = RPCClient(f"127.0.0.1:{server.port}")
+        assert client.call("echo", comm.BaseRequest(node_id=3)).value
+        assert calls == [3]
+    finally:
+        server.stop()
+
+
+@pytest.mark.chaos
+def test_rpc_delay_injected():
+    from dlrover_tpu.common.rpc import RPCClient
+
+    chaos.configure("rpc.send:delay=0.2@times=1", seed=5)
+    server, _ = _echo_server()
+    try:
+        client = RPCClient(f"127.0.0.1:{server.port}")
+        t0 = time.monotonic()
+        assert client.call("echo", comm.BaseRequest(node_id=1)).value
+        assert time.monotonic() - t0 >= 0.18
+    finally:
+        server.stop()
+
+
+@pytest.mark.chaos
+def test_rpc_partition_window_ridden_out():
+    """Every send fails during the partition window; a patient policy
+    rides it out and the call completes after the window closes."""
+    from dlrover_tpu.common.rpc import RPCClient
+
+    server, calls = _echo_server()
+    try:
+        client = RPCClient(f"127.0.0.1:{server.port}")
+        inj = chaos.configure("rpc.send:partition@t=0s..0.4s", seed=5)
+        t0 = time.monotonic()
+        policy = retry.RetryPolicy(max_attempts=60, base_backoff_s=0.03,
+                                   max_backoff_s=0.08, jitter=0.0)
+        assert client.call("echo", comm.BaseRequest(node_id=2),
+                           policy=policy).value
+        assert time.monotonic() - t0 >= 0.3
+        assert calls == [2]
+        assert len(inj.decisions) >= 3  # several sends were cut
+    finally:
+        server.stop()
+
+
+@pytest.mark.chaos
+def test_probe_fails_fast_under_partition():
+    from dlrover_tpu.common.rpc import RPCClient
+
+    server, _ = _echo_server()
+    try:
+        client = RPCClient(f"127.0.0.1:{server.port}")
+        chaos.configure("rpc.send:partition@t=0s..30s", seed=5)
+        t0 = time.monotonic()
+        assert client.try_call("echo", comm.BaseRequest()) is None \
+            or pytest.fail("probe should not succeed inside the window")
+        assert time.monotonic() - t0 < 1.0
+    finally:
+        server.stop()
+
+
+# -- kv store wait semantics -----------------------------------------------
+
+
+def test_kv_wait_returns_early_on_clear():
+    from dlrover_tpu.master.kv_store import KVStoreService
+
+    store = KVStoreService()
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(store.wait("k", timeout_s=30.0))
+    )
+    t0 = time.monotonic()
+    t.start()
+    time.sleep(0.15)
+    store.clear()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert results == [None]
+    assert time.monotonic() - t0 < 5.0  # nowhere near the 30s timeout
+
+
+def test_kv_wait_timeout_is_monotonic_under_notify_storm():
+    """notify_all storms for OTHER keys (spurious wakeups) must not extend
+    the deadline."""
+    from dlrover_tpu.master.kv_store import KVStoreService
+
+    store = KVStoreService()
+    stop = threading.Event()
+
+    def storm():
+        i = 0
+        while not stop.is_set():
+            store.set(f"other/{i % 7}", b"x")
+            i += 1
+            time.sleep(0.01)
+
+    spammer = threading.Thread(target=storm, daemon=True)
+    spammer.start()
+    t0 = time.monotonic()
+    assert store.wait("never", timeout_s=0.4) is None
+    elapsed = time.monotonic() - t0
+    stop.set()
+    spammer.join(timeout=2.0)
+    assert 0.35 <= elapsed < 2.0
+
+
+def test_kv_wait_still_delivers_values():
+    from dlrover_tpu.master.kv_store import KVStoreService
+
+    store = KVStoreService()
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(store.wait("k", timeout_s=5.0))
+    )
+    t.start()
+    time.sleep(0.1)
+    store.set("k", b"v")
+    t.join(timeout=5.0)
+    assert results == [b"v"]
+
+
+def test_sync_join_returns_early_on_reset():
+    from dlrover_tpu.master.kv_store import SyncService
+
+    sync = SyncService()
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(
+            sync.join("b", node_rank=0, world_size=2, timeout_s=30.0)
+        )
+    )
+    t.start()
+    time.sleep(0.15)
+    sync.reset("b")
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert results == [False]
+    # the barrier still works for a fresh cohort
+    ok = []
+    t1 = threading.Thread(
+        target=lambda: ok.append(sync.join("b", 0, 2, timeout_s=5.0))
+    )
+    t1.start()
+    assert sync.join("b", 1, 2, timeout_s=5.0) is True
+    t1.join(timeout=5.0)
+    assert ok == [True]
+
+
+@pytest.mark.chaos
+def test_kv_wait_injection_site():
+    from dlrover_tpu.master.kv_store import KVStoreService
+
+    chaos.configure("kv.wait:partition@times=1", seed=3)
+    store = KVStoreService()
+    with pytest.raises(chaos.InjectedFault):
+        store.wait("k", timeout_s=0.1)
+    # window passed (times=1): normal semantics return
+    assert store.wait("k", timeout_s=0.05) is None
+
+
+# -- shm incarnation orphan cleanup ----------------------------------------
+
+
+def test_orphan_segment_cleanup():
+    from dlrover_tpu.ckpt.shm_handler import (
+        cleanup_orphan_segments,
+        shm_name,
+    )
+    from dlrover_tpu.common.multi_process import (
+        create_shared_memory,
+        unlink_shared_memory,
+    )
+
+    job = f"itest{os.getpid()}"
+    old_name = shm_name(job, 0, 0, incarnation="aaa")
+    cur_name = shm_name(job, 0, 1, incarnation="bbb")
+    assert old_name.endswith("_iaaa")
+    old = create_shared_memory(old_name, create=True, size=128)
+    cur = create_shared_memory(cur_name, create=True, size=128)
+    assert old is not None and cur is not None
+    old.close()
+    try:
+        removed = cleanup_orphan_segments(job, 0, incarnation="bbb")
+        assert removed == [old_name]
+        assert not os.path.exists(f"/dev/shm/{old_name}")
+        assert os.path.exists(f"/dev/shm/{cur_name}")
+        # idempotent
+        assert cleanup_orphan_segments(job, 0, incarnation="bbb") == []
+        # other nodes' segments are never touched
+        assert cleanup_orphan_segments(job, 1, incarnation="zzz") == []
+    finally:
+        cur.close()
+        unlink_shared_memory(cur_name)
+        unlink_shared_memory(old_name)
+
+
+def test_orphan_cleanup_without_nonce_removes_nonced_leftovers():
+    from dlrover_tpu.ckpt.shm_handler import (
+        cleanup_orphan_segments,
+        shm_name,
+    )
+    from dlrover_tpu.common.multi_process import (
+        create_shared_memory,
+        unlink_shared_memory,
+    )
+
+    job = f"itestn{os.getpid()}"
+    nonced = shm_name(job, 0, 0, incarnation="dead")
+    plain = shm_name(job, 0, 0, incarnation="")
+    assert plain == f"dlrtpu_{job}_0_0"
+    seg1 = create_shared_memory(nonced, create=True, size=128)
+    seg2 = create_shared_memory(plain, create=True, size=128)
+    seg1.close()
+    try:
+        removed = cleanup_orphan_segments(job, 0, incarnation="")
+        assert removed == [nonced]
+        assert os.path.exists(f"/dev/shm/{plain}")
+    finally:
+        seg2.close()
+        unlink_shared_memory(plain)
+        unlink_shared_memory(nonced)
+
+
+# -- CRC integrity on shm frames -------------------------------------------
+
+
+def _frame_meta(step: int, nbytes: int, path: str = "w"):
+    return {
+        "step": step, "ts": 0.0, "job": "t", "node_rank": 0,
+        "local_rank": 0,
+        "leaves": [{
+            "path": path, "kind": "array", "dtype": "float32",
+            "gshape": [nbytes // 4],
+            "shards": [{"offset": 0, "nbytes": nbytes,
+                        "lshape": [nbytes // 4], "start": [0]}],
+        }],
+    }
+
+
+@pytest.mark.chaos
+def test_injected_bitflip_detected_by_crc():
+    from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler
+
+    chaos.configure("shm.write:bitflip@nth=1", seed=11)
+    handler = SharedMemoryHandler(f"test_bf_{os.getpid()}")
+    buf = np.arange(16, dtype=np.float32)
+    try:
+        handler.write_frame(_frame_meta(1, buf.nbytes), [buf])
+        # seal is intact (the commit marker can't see post-seal rot)...
+        assert handler.read_meta() is not None
+        # ...but the CRC names the corrupt shard
+        assert handler.verify_frame() == ["w@0"]
+    finally:
+        handler.unlink()
+
+
+@pytest.mark.chaos
+def test_injected_torn_write_detected_by_crc():
+    from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler
+
+    chaos.configure("shm.write:torn@step=3", seed=11)
+    handler = SharedMemoryHandler(f"test_torn_{os.getpid()}")
+    buf = np.arange(1, 65, dtype=np.float32)  # nonzero tail
+    try:
+        handler.write_frame(_frame_meta(2, buf.nbytes), [buf])
+        assert handler.verify_frame() == []  # step=2: rule doesn't match
+        handler.write_frame(_frame_meta(3, buf.nbytes), [buf])
+        assert handler.verify_frame() == ["w@0"]
+    finally:
+        handler.unlink()
+
+
+def test_clean_frame_passes_crc_and_roundtrips_blob():
+    from dlrover_tpu.ckpt.shm_handler import (
+        SharedMemoryHandler,
+        verify_frame_blob,
+    )
+
+    handler = SharedMemoryHandler(f"test_ok_{os.getpid()}")
+    buf = np.arange(32, dtype=np.float32)
+    try:
+        handler.write_frame(_frame_meta(5, buf.nbytes), [buf])
+        assert handler.verify_frame() == []
+        blob = bytes(handler.read_frame_bytes())
+        assert verify_frame_blob(blob) == []
+        # flip one data byte in the blob: caught end-to-end
+        torn = bytearray(blob)
+        torn[-1] ^= 0xFF
+        assert verify_frame_blob(bytes(torn)) == ["w@0"]
+        # a torn header counts as a broken frame
+        assert verify_frame_blob(b"\x00" * 4) == ["<frame>"]
+    finally:
+        handler.unlink()
+
+
+# -- multi-seed matrix (slow) ----------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_fault_matrix_deterministic_across_seeds():
+    """Full matrix: every kind × several seeds replays identically."""
+    schedule = ("a.send:drop@p=0.3;a.send:delay=1ms@p=0.2;"
+                "a.write:bitflip@p=0.2;a.wait:error@p=0.1")
+    for seed in range(8):
+        runs = []
+        for _ in range(2):
+            inj = chaos.configure(schedule, seed=seed)
+            outcomes = []
+            for i in range(200):
+                try:
+                    act = inj.fire("a.send")
+                    outcomes.append(("send", act and act["kind"]))
+                except chaos.InjectedFault:
+                    outcomes.append(("send", "drop"))
+                act = inj.fire("a.write")
+                outcomes.append(("write", act and act["kind"]))
+                try:
+                    inj.fire("a.wait")
+                    outcomes.append(("wait", None))
+                except chaos.InjectedError:
+                    outcomes.append(("wait", "error"))
+            runs.append((outcomes, list(inj.decisions)))
+        assert runs[0] == runs[1], f"seed {seed} not reproducible"
