@@ -12,6 +12,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import comm, retry
 from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCClient
 
 
@@ -241,8 +242,8 @@ class MasterClient:
                 ),
                 policy=retry.TELEMETRY,
             )
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — telemetry must not stall the agent
+            logger.debug("report_event %r dropped", kind, exc_info=True)
 
     def report_global_step(self, step: int, timestamp: float = 0.0,
                            retries: Optional[int] = None,

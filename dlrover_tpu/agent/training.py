@@ -38,6 +38,7 @@ from dlrover_tpu.common.constants import (
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.event import AgentEvent, get_emitter
 from dlrover_tpu.common.multi_process import LocalIPCServer, ipc_socket_path
+from dlrover_tpu.observability.journal import JournalEvent
 from dlrover_tpu.common.rpc import find_free_port
 from dlrover_tpu.diagnosis.diagnosis_agent import DiagnosisAgent
 
@@ -506,7 +507,7 @@ class ElasticTrainingAgent:
             # journal the whole degradation episode now that the master
             # can hear us (events during the partition could not land)
             self._client.report_event(
-                "partition_resync",
+                JournalEvent.PARTITION_RESYNC,
                 {"outage_s": outage_s,
                  "failed_heartbeats": self._hb_consec_failures},
             )
@@ -527,7 +528,9 @@ class ElasticTrainingAgent:
         if self._last_step_ts == 0.0:
             # first completed step of this incarnation: training is live
             # again — the master closes its recompile/restore phase here
-            self._client.report_event("step_resumed", {"step": step})
+            self._client.report_event(
+                JournalEvent.STEP_RESUMED, {"step": step}
+            )
         elif ts > self._last_step_ts:
             self._step_time_hist.observe(ts - self._last_step_ts)
         self._last_global_step = step
@@ -548,7 +551,7 @@ class ElasticTrainingAgent:
         )
         if removed:
             self._client.report_event(
-                "shm_orphans_cleaned", {"segments": removed}
+                JournalEvent.SHM_ORPHANS_CLEANED, {"segments": removed}
             )
         inj = get_injector()
         if inj is not None:
@@ -556,7 +559,7 @@ class ElasticTrainingAgent:
             # best-effort telemetry path (never adds faults of its own)
             inj.set_reporter(
                 lambda event: self._client.report_event(
-                    "fault_injected", event
+                    JournalEvent.FAULT_INJECTED, event
                 )
             )
         self._ipc_server.start()

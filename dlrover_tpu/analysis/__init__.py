@@ -1,0 +1,54 @@
+"""Self-hosted static analysis: the control plane's concurrency and
+robustness discipline, enforced mechanically.
+
+PR 2 fixed two instances of classic elastic-control-plane failure classes
+by hand — an RPC call fired inside a ``with lock:`` body (the fault-injector
+deadlock) and kv/sync waits computing deadlines from ``time.time()`` (a
+wall-clock step during NTP slew silently stretches or collapses every
+timeout). Both bug classes are invisible in tests and fatal at 1k-chip
+scale, so this package encodes them (and their siblings) as AST lint rules
+that run over ``dlrover_tpu/`` in CI:
+
+=========  ==============================================================
+DLR001     ``time.time()`` flowing into deadline/timeout arithmetic
+           instead of ``time.monotonic()``
+DLR002     raw ``os.environ`` / ``os.getenv`` reads outside
+           ``common/constants.py`` (env names must live in the registry)
+DLR003     broad/bare ``except`` that swallows without journaling,
+           logging, or re-raising
+DLR004     blocking call (RPC, ``sleep``, socket IO, ``.result()``)
+           inside a ``with <lock>:`` body
+DLR005     raw urlopen/socket retry loops bypassing
+           ``common/retry.py`` RetryPolicy
+DLR006     journaled event kinds / metric names as ad-hoc string
+           literals instead of declared constants
+=========  ==============================================================
+
+Suppression is explicit: an inline ``# noqa: DLR00X`` (with a reason) on
+the flagged line, or an entry in the checked-in baseline
+(``dlrover_tpu/analysis/baseline.txt``) for violations deliberately
+deferred. ``python -m dlrover_tpu.analysis --check`` exits non-zero on any
+violation not covered by either.
+
+The runtime half (:mod:`dlrover_tpu.analysis.lock_order`) instruments
+``threading.Lock``/``RLock`` under pytest (opt-in ``lock_order_guard``
+fixture) to build a lock-acquisition-order graph and fails tests whose
+threads acquire locks in inverted orders — the deadlocks DLR004 cannot see
+because the two acquisitions live in different functions.
+"""
+
+from dlrover_tpu.analysis.engine import (  # noqa: F401
+    AnalysisReport,
+    Violation,
+    analyze_package,
+    analyze_paths,
+    analyze_source,
+    default_baseline_path,
+    load_baseline,
+    write_baseline,
+)
+from dlrover_tpu.analysis.lock_order import (  # noqa: F401
+    LockOrderDetector,
+    LockOrderViolation,
+)
+from dlrover_tpu.analysis.rules import ALL_RULES  # noqa: F401

@@ -1,0 +1,104 @@
+"""``python -m dlrover_tpu.analysis`` — run the invariant analyzer.
+
+Exit status is non-zero whenever violations NOT covered by an inline
+``# noqa: DLR00X`` or the baseline exist, so the same invocation gates CI
+and local pre-commit runs. Typical flows::
+
+    python -m dlrover_tpu.analysis --check          # CI gate
+    python -m dlrover_tpu.analysis                  # full listing
+    python -m dlrover_tpu.analysis --update-baseline  # accept current state
+    python -m dlrover_tpu.analysis --list-rules
+"""
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from dlrover_tpu.analysis.engine import (
+    analyze_paths,
+    check,
+    default_baseline_path,
+    load_baseline,
+    package_root,
+    write_baseline,
+)
+from dlrover_tpu.analysis.rules import ALL_RULES
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m dlrover_tpu.analysis",
+        description="dlrover_tpu control-plane invariant analyzer "
+                    "(rules DLR001-DLR006; see docs/design/"
+                    "static_analysis.md)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to analyze (default: the dlrover_tpu "
+             "package)",
+    )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="print only NEW violations (not baselined/noqa'd); exit 1 "
+             "if any exist",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: {default_baseline_path()})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: every violation counts as new",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline to exactly the current violations",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            summary = (rule.__doc__ or rule.__name__).strip().splitlines()[0]
+            print(f"{rule.rule_id}  {rule.__name__}: {summary}")
+        return 0
+
+    root = package_root()
+    paths = args.paths or [os.path.join(root, "dlrover_tpu")]
+    violations = analyze_paths(paths, root=root)
+
+    if args.update_baseline:
+        path = write_baseline(violations, args.baseline)
+        print(f"baseline updated: {len(violations)} entr(y/ies) -> {path}")
+        return 0
+
+    baseline = (None if args.no_baseline
+                else load_baseline(args.baseline))
+    report = check(violations, baseline)
+
+    shown = report.new if args.check else report.violations
+    baselined_fps = {id(v) for v in report.baselined}
+    for v in shown:
+        tag = "" if id(v) not in baselined_fps else "  [baselined]"
+        print(v.render() + tag)
+    for fp in report.stale_baseline:
+        print(f"stale baseline entry (violation fixed — prune it): "
+              f"{fp[0]} {fp[1]} | {fp[2]}")
+    print(report.summary())
+    if report.new:
+        print(
+            "\nnew violations. Fix them, add an inline "
+            "`# noqa: DLR00X — reason`, or (for deliberate deferral) "
+            "re-run with --update-baseline.\n"
+            "repro: python -m dlrover_tpu.analysis --check"
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

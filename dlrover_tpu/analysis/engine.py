@@ -1,0 +1,217 @@
+"""Analyzer engine: file walking, noqa suppression, baseline accounting.
+
+Suppression layers, in order:
+
+1. ``# noqa: DLR00X`` on the flagged line (codes must be listed
+   explicitly — a bare ``# noqa`` or a foreign code like ``BLE001`` does
+   NOT suppress DLR rules; every suppression should carry its reason).
+2. The checked-in baseline (``dlrover_tpu/analysis/baseline.txt``):
+   violations deliberately deferred. Entries match on
+   ``(rule, path, stripped-line-text)`` so they survive line-number
+   drift; an edit to the offending line invalidates its entry and the
+   violation resurfaces.
+
+``check()`` reports *new* violations (not in the baseline) and *stale*
+baseline entries (baselined lines that no longer trip — prune them).
+"""
+
+import ast
+import os
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.analysis.rules import (
+    ALL_RULES,
+    RuleFn,
+    Violation,
+    attach_parents,
+)
+
+_NOQA_RE = re.compile(r"#\s*noqa\s*:\s*([A-Z0-9_,\s]+)", re.IGNORECASE)
+
+
+def noqa_codes(line: str) -> frozenset:
+    m = _NOQA_RE.search(line)
+    if not m:
+        return frozenset()
+    return frozenset(
+        code.strip().upper() for code in m.group(1).split(",") if code.strip()
+    )
+
+
+def analyze_source(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Sequence[RuleFn]] = None,
+) -> List[Violation]:
+    """Run the rules over one source blob; returns noqa-filtered
+    violations sorted by (path, line, rule). A syntax error surfaces as a
+    single DLR000 violation so a broken file fails --check loudly instead
+    of being skipped silently."""
+    lines = source.splitlines()
+    try:
+        tree = attach_parents(ast.parse(source))
+    except SyntaxError as e:
+        return [Violation(
+            rule="DLR000", path=path, line=e.lineno or 1,
+            col=(e.offset or 0) + 1,
+            message=f"file does not parse: {e.msg}",
+            line_text=(lines[e.lineno - 1].strip()
+                       if e.lineno and e.lineno <= len(lines) else ""),
+        )]
+    out: List[Violation] = []
+    for rule in (rules if rules is not None else ALL_RULES):
+        for v in rule(tree, path, lines):
+            if 0 < v.line <= len(lines) and v.rule in noqa_codes(
+                lines[v.line - 1]
+            ):
+                continue
+            out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            files.extend(
+                os.path.join(dirpath, f) for f in sorted(filenames)
+                if f.endswith(".py")
+            )
+    return files
+
+
+def analyze_paths(
+    paths: Iterable[str],
+    root: Optional[str] = None,
+    rules: Optional[Sequence[RuleFn]] = None,
+) -> List[Violation]:
+    """Analyze every .py file under ``paths``; violation paths are
+    reported relative to ``root`` (default: cwd) in posix form so the
+    baseline is machine-independent."""
+    root = os.path.abspath(root or os.getcwd())
+    out: List[Violation] = []
+    for fpath in iter_python_files(paths):
+        rel = os.path.relpath(os.path.abspath(fpath), root)
+        rel = rel.replace(os.sep, "/")
+        with open(fpath, "r", encoding="utf-8") as f:
+            source = f.read()
+        out.extend(analyze_source(source, path=rel, rules=rules))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+def package_root() -> str:
+    """Directory containing the ``dlrover_tpu`` package (the repo root in
+    a source checkout)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+
+
+def analyze_package(
+    rules: Optional[Sequence[RuleFn]] = None,
+    baseline_path: Optional[str] = None,
+) -> "AnalysisReport":
+    """Analyze the whole ``dlrover_tpu`` package against the checked-in
+    baseline — the programmatic equivalent of ``--check``."""
+    root = package_root()
+    violations = analyze_paths([os.path.join(root, "dlrover_tpu")],
+                               root=root, rules=rules)
+    return check(violations, load_baseline(baseline_path))
+
+
+# -- baseline ----------------------------------------------------------------
+
+BASELINE_HEADER = (
+    "# dlrover_tpu static-analysis baseline — violations deliberately\n"
+    "# deferred. One line per instance:  RULE path | stripped source line\n"
+    "# Matching ignores line numbers; editing the offending line\n"
+    "# invalidates its entry. Regenerate: python -m dlrover_tpu.analysis "
+    "--update-baseline\n"
+)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.txt")
+
+
+def load_baseline(path: Optional[str] = None) -> Counter:
+    """Multiset of (rule, path, line_text) fingerprints."""
+    path = path or default_baseline_path()
+    entries: Counter = Counter()
+    if not os.path.exists(path):
+        return entries
+    with open(path, "r", encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            head, _, text = line.partition(" | ")
+            rule, _, vpath = head.strip().partition(" ")
+            if rule and vpath:
+                entries[(rule, vpath.strip(), text.strip())] += 1
+    return entries
+
+
+def write_baseline(violations: Sequence[Violation],
+                   path: Optional[str] = None) -> str:
+    path = path or default_baseline_path()
+    lines = sorted(
+        f"{v.rule} {v.path} | {v.line_text}" for v in violations
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(BASELINE_HEADER)
+        for line in lines:
+            f.write(line + "\n")
+    return path
+
+
+@dataclass
+class AnalysisReport:
+    violations: List[Violation] = field(default_factory=list)
+    new: List[Violation] = field(default_factory=list)
+    baselined: List[Violation] = field(default_factory=list)
+    stale_baseline: List[Tuple[str, str, str]] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+    def summary(self) -> str:
+        return (
+            f"{len(self.violations)} violation(s): {len(self.new)} new, "
+            f"{len(self.baselined)} baselined, "
+            f"{len(self.stale_baseline)} stale baseline entr(y/ies)"
+        )
+
+
+def check(
+    violations: Sequence[Violation],
+    baseline: Optional[Counter] = None,
+) -> AnalysisReport:
+    """Split violations into new vs baselined; surplus baseline entries
+    (fixed since they were recorded) come back as ``stale_baseline``."""
+    remaining = Counter(baseline or Counter())
+    report = AnalysisReport(violations=list(violations))
+    for v in violations:
+        if remaining.get(v.fingerprint, 0) > 0:
+            remaining[v.fingerprint] -= 1
+            report.baselined.append(v)
+        else:
+            report.new.append(v)
+    report.stale_baseline = sorted(
+        fp for fp, n in remaining.items() for _ in range(n)
+    )
+    return report

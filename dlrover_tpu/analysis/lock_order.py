@@ -1,0 +1,287 @@
+"""Runtime lock-acquisition-order detector (the dynamic half of the
+analyzer).
+
+DLR004 catches a blocking call textually inside one ``with lock:`` body,
+but the deadlocks that actually take down control planes are *order
+inversions* whose two acquisitions live in different functions (or
+modules): thread 1 takes A then B, thread 2 takes B then A, and nothing
+on either line looks wrong. This module instruments
+``threading.Lock``/``RLock`` (opt-in, test-time only — the
+``lock_order_guard`` fixture in tests/conftest.py) so every lock created
+while installed records *where it was created* and *in which order each
+thread acquires it relative to the locks it already holds*. Edges feed a
+global acquired-before graph; any cycle is an inversion that CAN
+deadlock, reported with both lock names and both acquisition stacks even
+when the interleaving in this particular run never actually deadlocked.
+
+Edges are recorded at acquire *attempt* (before blocking), so an
+inversion that does deadlock in the instrumented run still gets recorded
+before the hang — the test times out with the explanation already in the
+detector.
+
+Reentrant acquisition of the same RLock adds no edge. ``Condition``
+objects built while installed wrap an instrumented lock transparently
+(the wrapper delegates the private ``_release_save``/``_acquire_restore``
+/``_is_owned`` protocol and keeps per-thread bookkeeping coherent across
+``Condition.wait``).
+"""
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+# real factories, captured at import time: the detector's own internals
+# must never run through instrumented locks
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+class LockOrderViolation(AssertionError):
+    """Raised by :meth:`LockOrderDetector.check` when the acquired-before
+    graph contains a cycle."""
+
+
+class _Edge:
+    __slots__ = ("a_name", "b_name", "a_stack", "b_stack")
+
+    def __init__(self, a_name: str, b_name: str,
+                 a_stack: str, b_stack: str):
+        self.a_name = a_name
+        self.b_name = b_name
+        self.a_stack = a_stack  # where the already-held lock was acquired
+        self.b_stack = b_stack  # where the new lock is being acquired
+
+
+def _site(skip_internal: bool = True) -> str:
+    """'file:line in func' of the outermost non-internal caller frame."""
+    for frame in reversed(traceback.extract_stack()[:-1]):
+        if skip_internal and frame.filename.endswith("lock_order.py"):
+            continue
+        return f"{frame.filename}:{frame.lineno} in {frame.name}"
+    return "<unknown>"
+
+
+def _stack(limit: int = 8) -> str:
+    frames = [
+        f for f in traceback.extract_stack()[:-2]
+        if not f.filename.endswith("lock_order.py")
+    ]
+    return "".join(traceback.format_list(frames[-limit:]))
+
+
+class _InstrumentedLock:
+    """Duck-typed stand-in for a ``threading.Lock``/``RLock`` that feeds
+    the detector. Identity (``id(self)``) is the graph node."""
+
+    def __init__(self, detector: "LockOrderDetector", inner, kind: str,
+                 name: Optional[str] = None):
+        self._detector = detector
+        self._inner = inner
+        self._kind = kind
+        self.name = name or f"{kind}@{_site()}"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._detector._on_attempt(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._detector._on_acquired(self)
+        return got
+
+    def release(self) -> None:
+        self._detector._on_released(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        locked = getattr(self._inner, "locked", None)
+        return locked() if locked is not None else False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition-protocol delegation. Only RLock defines _release_save /
+    # _acquire_restore / _is_owned; for a plain Lock, Condition must see
+    # AttributeError so it binds its acquire/release fallbacks — hence
+    # __getattr__ (a plain method would always exist and break Condition
+    # over an instrumented Lock).
+    def __getattr__(self, name: str):
+        if name in ("_release_save", "_acquire_restore", "_is_owned"):
+            inner_fn = getattr(self._inner, name)  # AttributeError for Lock
+            if name == "_release_save":
+                def _release_save():
+                    self._detector._on_released(self, full=True)
+                    return inner_fn()
+                return _release_save
+            if name == "_acquire_restore":
+                def _acquire_restore(state):
+                    inner_fn(state)
+                    self._detector._on_acquired(self)
+                return _acquire_restore
+            return inner_fn
+        raise AttributeError(name)
+
+    def __repr__(self) -> str:
+        return f"<Instrumented{self._kind} {self.name}>"
+
+
+class LockOrderDetector:
+    """Builds the acquired-before graph; thread-safe via a REAL lock."""
+
+    def __init__(self, stack_limit: int = 8):
+        self._glock = _REAL_LOCK()
+        self._tls = threading.local()
+        self._stack_limit = stack_limit
+        # id(a) -> {id(b) -> _Edge}: a was held while b was acquired
+        self._edges: Dict[int, Dict[int, _Edge]] = {}
+        self._names: Dict[int, str] = {}
+        self._cycles: List[List[_Edge]] = []
+        self._installed = False
+        self.locks_created = 0
+
+    # -- instrumentation lifecycle ----------------------------------------
+
+    def install(self) -> "LockOrderDetector":
+        if self._installed:
+            return self
+        threading.Lock = self.make_lock  # type: ignore[assignment]
+        threading.RLock = self.make_rlock  # type: ignore[assignment]
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        threading.Lock = _REAL_LOCK  # type: ignore[assignment]
+        threading.RLock = _REAL_RLOCK  # type: ignore[assignment]
+        self._installed = False
+
+    def __enter__(self) -> "LockOrderDetector":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def make_lock(self, name: Optional[str] = None) -> _InstrumentedLock:
+        return self._register(_InstrumentedLock(self, _REAL_LOCK(),
+                                                "Lock", name))
+
+    def make_rlock(self, name: Optional[str] = None) -> _InstrumentedLock:
+        return self._register(_InstrumentedLock(self, _REAL_RLOCK(),
+                                                "RLock", name))
+
+    def _register(self, lock: _InstrumentedLock) -> _InstrumentedLock:
+        with self._glock:
+            self._names[id(lock)] = lock.name
+            self.locks_created += 1
+        return lock
+
+    # -- per-thread bookkeeping -------------------------------------------
+
+    def _held(self) -> List[list]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held  # list of [lock, count, acquire_stack]
+
+    def _on_attempt(self, lock: _InstrumentedLock) -> None:
+        held = self._held()
+        for entry in held:
+            if entry[0] is lock:
+                return  # reentrant: no ordering information
+        b_stack = _stack(self._stack_limit)
+        for entry in held:
+            self._add_edge(entry[0], lock, entry[2], b_stack)
+
+    def _on_acquired(self, lock: _InstrumentedLock) -> None:
+        held = self._held()
+        for entry in held:
+            if entry[0] is lock:
+                entry[1] += 1
+                return
+        held.append([lock, 1, _stack(self._stack_limit)])
+
+    def _on_released(self, lock: _InstrumentedLock,
+                     full: bool = False) -> None:
+        held = self._held()
+        for i, entry in enumerate(held):
+            if entry[0] is lock:
+                entry[1] = 0 if full else entry[1] - 1
+                if entry[1] <= 0:
+                    held.pop(i)
+                return
+        # a plain Lock may legally be released by a thread that never
+        # acquired it (handoff patterns); no bookkeeping to undo
+
+    # -- graph -------------------------------------------------------------
+
+    def _add_edge(self, a: _InstrumentedLock, b: _InstrumentedLock,
+                  a_stack: str, b_stack: str) -> None:
+        with self._glock:
+            row = self._edges.setdefault(id(a), {})
+            if id(b) in row:
+                return
+            row[id(b)] = _Edge(a.name, b.name, a_stack, b_stack)
+            cycle = self._find_cycle_through(id(b), id(a))
+            if cycle is not None:
+                self._cycles.append(cycle + [row[id(b)]])
+
+    def _find_cycle_through(self, start: int,
+                            target: int) -> Optional[List[_Edge]]:
+        """Edge path start→…→target, i.e. adding target→start closed a
+        cycle. Iterative DFS; graph is tiny (test-scoped)."""
+        if start == target:
+            return []
+        stack: List[Tuple[int, List[_Edge]]] = [(start, [])]
+        seen = {start}
+        while stack:
+            node, path = stack.pop()
+            for nxt, edge in self._edges.get(node, {}).items():
+                if nxt == target:
+                    return path + [edge]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [edge]))
+        return None
+
+    # -- reporting ---------------------------------------------------------
+
+    @property
+    def violations(self) -> List[List[_Edge]]:
+        with self._glock:
+            return [list(c) for c in self._cycles]
+
+    def report(self) -> str:
+        out: List[str] = []
+        for i, cycle in enumerate(self.violations, 1):
+            names = " -> ".join(e.b_name for e in cycle)
+            out.append(
+                f"lock-order inversion #{i}: cycle {names} -> "
+                f"{cycle[0].a_name if cycle else '?'}"
+            )
+            for e in cycle:
+                out.append(
+                    f"  {e.a_name} (held) acquired at:\n"
+                    + _indent(e.a_stack)
+                    + f"  then {e.b_name} acquired at:\n"
+                    + _indent(e.b_stack)
+                )
+        return "\n".join(out)
+
+    def check(self) -> None:
+        """Raise :class:`LockOrderViolation` if any inversion was seen.
+        Call after the exercised code ran (the conftest fixture does this
+        at teardown)."""
+        if self.violations:
+            raise LockOrderViolation(
+                "lock acquisition order inversion(s) detected — two "
+                "threads acquire the same locks in opposite orders, "
+                "which deadlocks under the right interleaving:\n"
+                + self.report()
+            )
+
+
+def _indent(text: str, prefix: str = "    ") -> str:
+    return "".join(prefix + ln + "\n" for ln in text.rstrip().splitlines())

@@ -1,0 +1,455 @@
+"""AST lint rules encoding the control plane's robustness invariants.
+
+Each rule is a callable ``rule(tree, path, lines) -> Iterator[Violation]``
+registered in :data:`ALL_RULES`. Rules are deliberately *heuristic*: they
+run over our own codebase, so precision is tuned against the violations
+that actually occur here, and the escape hatches (``# noqa: DLR00X`` with
+a reason, or the checked-in baseline) make the residual false positives
+cheap. The point is that every NEW instance of a known-fatal pattern needs
+an explicit human decision to ship.
+
+Rule catalogue (motivating incidents in docs/design/static_analysis.md):
+
+- DLR001: ``time.time()`` in deadline/timeout arithmetic. Wall clocks
+  step under NTP slew; a stepped clock stretches or collapses every
+  timeout derived from it (the PR 2 kv/sync wait bug).
+- DLR002: raw env reads outside ``common/constants.py``. Env names are
+  control-plane API surface — fault drills and docs enumerate them from
+  the constants registry, so a stray literal silently forks that truth.
+- DLR003: broad/bare ``except`` that swallows without logging/journal/
+  re-raise. Silent swallow of a checkpoint or RPC error is how a 1k-chip
+  job hangs with a clean log.
+- DLR004: blocking call under a held lock — the exact class of the PR 2
+  fault-injector deadlock (RPC fired inside ``with lock:``).
+- DLR005: hand-rolled urlopen/socket retry loops instead of
+  ``common/retry.py`` RetryPolicy (per-call-class budgets, breaker).
+- DLR006: journaled event kinds / metric names as ad-hoc literals. A
+  typo'd event string forks the observability spine's stream without any
+  error.
+"""
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, List, Optional, Tuple
+
+RuleFn = Callable[[ast.AST, str, List[str]], Iterator["Violation"]]
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    # stripped source text of the flagged line: the baseline matches on
+    # (rule, path, line_text) so entries survive line-number drift
+    line_text: str = ""
+
+    @property
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.line_text)
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        )
+
+
+ALL_RULES: List[RuleFn] = []
+
+
+def _rule(fn: RuleFn) -> RuleFn:
+    match = re.search(r"dlr(\d{3})", fn.__name__)
+    if match is None:
+        raise ValueError(f"rule function {fn.__name__} must embed its id")
+    fn.rule_id = "DLR" + match.group(1)  # type: ignore[attr-defined]
+    ALL_RULES.append(fn)
+    return fn
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+def attach_parents(tree: ast.AST) -> ast.AST:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._dlr_parent = node  # type: ignore[attr-defined]
+    return tree
+
+
+def _parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_dlr_parent", None)
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ("os.environ.get",
+    "self._cond.wait"); "" for anything non-name-like."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("<expr>")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def _violation(rule: str, path: str, node: ast.AST, message: str,
+               lines: List[str]) -> Violation:
+    line = getattr(node, "lineno", 1)
+    text = lines[line - 1].strip() if 0 < line <= len(lines) else ""
+    return Violation(rule=rule, path=path, line=line,
+                     col=getattr(node, "col_offset", 0) + 1,
+                     message=message, line_text=text)
+
+
+def _scopes(tree: ast.AST) -> Iterator[Tuple[ast.AST, List[ast.stmt]]]:
+    """Yield (scope_node, body) for the module and every function —
+    DLR001's name-flow heuristic is per-scope."""
+    yield tree, getattr(tree, "body", [])
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            body = node.body if isinstance(node.body, list) else [node.body]
+            yield node, body
+
+
+def _walk_scope(body: List[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function scopes."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # nested scope: _scopes() visits it separately
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# -- DLR001: wall-clock deadlines --------------------------------------------
+
+_DEADLINE_NAME_RE = re.compile(
+    r"(deadline|timeout|timed?_?out|expir|due|cooldown|grace|cutoff)",
+    re.IGNORECASE,
+)
+
+
+def _in_time_math(node: ast.AST) -> bool:
+    """True if ``node`` sits inside +/- arithmetic or a comparison — the
+    shapes deadline math takes (``time.time() + t``, ``now - start > t``,
+    ``while time.time() < deadline``)."""
+    cur = _parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.BinOp) and isinstance(
+            cur.op, (ast.Add, ast.Sub)
+        ):
+            return True
+        if isinstance(cur, ast.Compare):
+            return True
+        if isinstance(cur, (ast.stmt, ast.Lambda)):
+            return False
+        cur = _parent(cur)
+    return False
+
+
+@_rule
+def rule_dlr001_wall_clock_deadline(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """time.time() in deadline/timeout arithmetic (use time.monotonic())."""
+    msg = (
+        "wall-clock time.time() in deadline/timeout arithmetic — use "
+        "time.monotonic() (wall clocks step under NTP; keep time.time() "
+        "only for reported timestamps, with a # noqa: DLR001 reason)"
+    )
+    for scope, body in _scopes(tree):
+        time_calls: List[ast.Call] = []
+        assigned: dict = {}  # var name -> assignment Call node
+        for node in _walk_scope(body):
+            if (isinstance(node, ast.Call)
+                    and _dotted(node.func) == "time.time"):
+                time_calls.append(node)
+                par = _parent(node)
+                if isinstance(par, ast.Assign):
+                    for tgt in par.targets:
+                        name = _dotted(tgt).rsplit(".", 1)[-1]
+                        if name:
+                            assigned[name] = node
+        if not time_calls:
+            continue
+        # direct: the call itself participates in arithmetic/comparison
+        flagged: set = set()
+        for call in time_calls:
+            if _in_time_math(call):
+                flagged.add(id(call))
+                yield _violation("DLR001", path, call, msg, lines)
+        # assigned to a deadline-ish name: deadline math by declaration
+        for name, call in assigned.items():
+            if id(call) in flagged:
+                continue
+            if _DEADLINE_NAME_RE.search(name):
+                flagged.add(id(call))
+                yield _violation("DLR001", path, call, msg, lines)
+        # one-hop flow: x = time.time() ... later x is used in +/- or a
+        # comparison within the same scope
+        pending = {n: c for n, c in assigned.items()
+                   if id(c) not in flagged}
+        if not pending:
+            continue
+        for node in _walk_scope(body):
+            if not pending:
+                break
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                call = pending.get(node.id)
+                if call is not None and _in_time_math(node):
+                    del pending[node.id]
+                    yield _violation("DLR001", path, call, msg, lines)
+
+
+# -- DLR002: raw env access --------------------------------------------------
+
+DLR002_ALLOWED_SUFFIXES = ("common/constants.py",)
+
+
+@_rule
+def rule_dlr002_raw_env_access(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """raw os.environ/os.getenv outside the common/constants.py registry."""
+    if path.replace("\\", "/").endswith(DLR002_ALLOWED_SUFFIXES):
+        return
+    msg = (
+        "raw environment read outside common/constants.py — use the "
+        "constants env accessors (env_str/env_int/env_float/env_flag) so "
+        "every env name lives in the registry"
+    )
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name in ("os.getenv", "os.environ.get",
+                        "os.environ.setdefault"):
+                yield _violation("DLR002", path, node, msg, lines)
+        elif isinstance(node, ast.Subscript):
+            # reads only: os.environ[k] = v (child-env plumbing) is a
+            # write and stays legal
+            if (_dotted(node.value) == "os.environ"
+                    and isinstance(node.ctx, ast.Load)):
+                yield _violation("DLR002", path, node, msg, lines)
+
+
+# -- DLR003: silent broad except ---------------------------------------------
+
+_LOGGING_ATTRS = {
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+    "record", "report_event", "_report_event", "record_event", "journal",
+}
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    for node in [t] + (list(t.elts) if isinstance(t, ast.Tuple) else []):
+        d = _dotted(node)
+        if d:
+            names.append(d.rsplit(".", 1)[-1])
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+@_rule
+def rule_dlr003_silent_swallow(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """broad/bare except that neither re-raises, logs, nor journals."""
+    msg = (
+        "broad except swallows the error without re-raising, logging, or "
+        "journaling — a silently eaten checkpoint/RPC error is a hang at "
+        "scale; log it, journal it, or re-raise"
+    )
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_handler(node):
+            continue
+        observed = False
+        for sub in node.body:
+            for inner in ast.walk(sub):
+                if isinstance(inner, ast.Raise):
+                    observed = True
+                elif isinstance(inner, ast.Call):
+                    fname = _dotted(inner.func).rsplit(".", 1)[-1]
+                    if fname in _LOGGING_ATTRS:
+                        observed = True
+                if observed:
+                    break
+            if observed:
+                break
+        if not observed:
+            yield _violation("DLR003", path, node, msg, lines)
+
+
+# -- DLR004: blocking call under a lock --------------------------------------
+
+_LOCKISH_RE = re.compile(r"(lock|mutex|cond)", re.IGNORECASE)
+# call-name tails that block the calling thread. ``wait``/``notify`` are
+# deliberately absent: Condition.wait RELEASES the lock it rides on (the
+# kv_store/sync_service pattern is correct); Event.wait under a lock is
+# caught by the runtime lock-order/hold instrumentation instead.
+_BLOCKING_TAILS = {
+    "sleep", "urlopen", "result", "recv", "recv_into", "sendall",
+    "getresponse", "accept", "connect", "create_connection", "select",
+    "retry_call", "fire",
+}
+# an IO-ish method on a receiver named like an RPC/socket/pipe client
+# blocks; container ops on e.g. a dict named ``conns`` do not
+_BLOCKING_RECEIVER_RE = re.compile(
+    r"(^|[._])(client|stub|sock|socket|conn|channel)s?$", re.IGNORECASE
+)
+_IO_TAILS = {
+    "send", "recv", "poll", "close", "read", "write", "readline",
+    "request", "call", "invoke", "rpc", "flush", "shutdown",
+}
+
+
+@_rule
+def rule_dlr004_blocking_under_lock(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """blocking call (RPC, sleep, socket/pipe IO, .result()) inside a lock body."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lock_names = [
+            _dotted(item.context_expr.func
+                    if isinstance(item.context_expr, ast.Call)
+                    else item.context_expr)
+            for item in node.items
+        ]
+        lock_names = [n for n in lock_names if n and _LOCKISH_RE.search(n)]
+        if not lock_names:
+            continue
+        for stmt in node.body:
+            for inner in ast.walk(stmt):
+                if isinstance(inner, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if not isinstance(inner, ast.Call):
+                    continue
+                name = _dotted(inner.func)
+                if not name:
+                    continue
+                tail = name.rsplit(".", 1)[-1]
+                receiver = name.rsplit(".", 1)[0] if "." in name else ""
+                blocking = tail in _BLOCKING_TAILS or (
+                    receiver and tail in _IO_TAILS
+                    and _BLOCKING_RECEIVER_RE.search(receiver)
+                )
+                if blocking:
+                    yield _violation(
+                        "DLR004", path, inner,
+                        f"blocking call {name}() inside `with "
+                        f"{lock_names[0]}:` — the PR 2 injector-deadlock "
+                        "class; move the blocking work outside the lock",
+                        lines,
+                    )
+
+
+# -- DLR005: ad-hoc network retry loops --------------------------------------
+
+DLR005_ALLOWED_SUFFIXES = ("common/retry.py",)
+_NET_TAILS = {"urlopen", "create_connection", "getresponse"}
+
+
+@_rule
+def rule_dlr005_raw_retry_loop(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """hand-rolled network retry loop bypassing common/retry.py RetryPolicy."""
+    if path.replace("\\", "/").endswith(DLR005_ALLOWED_SUFFIXES):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        has_net = has_sleep = False
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.Call):
+                name = _dotted(inner.func)
+                tail = name.rsplit(".", 1)[-1]
+                if tail in _NET_TAILS or (
+                    "socket" in name and tail == "connect"
+                ):
+                    has_net = True
+                elif name in ("time.sleep", "sleep"):
+                    has_sleep = True
+        if has_net and has_sleep:
+            yield _violation(
+                "DLR005", path, node,
+                "hand-rolled network retry loop — use common/retry.py "
+                "retry_call with a per-call-class RetryPolicy (budgets, "
+                "jitter, circuit breaker)",
+                lines,
+            )
+
+
+# -- DLR006: ad-hoc event / metric names --------------------------------------
+
+_METRIC_NAME_RE = re.compile(r"^dlrover_[a-z0-9_]+$")
+_JOURNAL_RECEIVER_RE = re.compile(r"journal", re.IGNORECASE)
+
+
+@_rule
+def rule_dlr006_adhoc_event_names(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """journaled event kinds / metric names must be declared constants."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        attr = node.func.attr
+        receiver = _dotted(node.func.value)
+        first = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "kind":
+                first = kw.value
+        if attr == "record" and _JOURNAL_RECEIVER_RE.search(receiver):
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ):
+                yield _violation(
+                    "DLR006", path, first,
+                    f"journal event kind {first.value!r} is an ad-hoc "
+                    "string — declare it on JournalEvent (a typo'd kind "
+                    "silently forks the observability stream)",
+                    lines,
+                )
+        elif attr in ("report_event", "_report_event"):
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ):
+                yield _violation(
+                    "DLR006", path, first,
+                    f"reported event kind {first.value!r} is an ad-hoc "
+                    "string — declare it on JournalEvent",
+                    lines,
+                )
+        elif attr in ("counter", "gauge", "histogram") and (
+            "registry" in receiver.lower() or "metrics" in receiver.lower()
+        ):
+            if (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                    and not _METRIC_NAME_RE.match(first.value)):
+                yield _violation(
+                    "DLR006", path, first,
+                    f"metric name {first.value!r} must be "
+                    "dlrover_*-prefixed snake_case (one namespace, "
+                    "grep-able, no typo forks)",
+                    lines,
+                )

@@ -38,12 +38,10 @@ class Checkpointer:
     ):
         if master_client is None:
             # workers launched by the agent have the master in env
-            import os
-
             from dlrover_tpu.agent.master_client import MasterClient
-            from dlrover_tpu.common.constants import EnvKey
+            from dlrover_tpu.common.constants import EnvKey, env_str
 
-            if os.getenv(EnvKey.MASTER_ADDR):
+            if env_str(EnvKey.MASTER_ADDR):
                 master_client = MasterClient.singleton()
         self._engine = CheckpointEngine(
             ckpt_dir, master_client=master_client, **engine_kwargs

@@ -31,10 +31,19 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from dlrover_tpu.common.constants import EnvKey, SharedResourceName
+from dlrover_tpu.common.constants import (
+    ConfigKey,
+    EnvKey,
+    SharedResourceName,
+    env_flag,
+    env_float,
+    env_int,
+    env_str,
+)
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.multi_process import SharedDict, SharedLock, SharedQueue
 from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler, shm_name
+from dlrover_tpu.observability.journal import JournalEvent
 
 
 def _tree_flatten_with_names(state) -> Tuple[List[Tuple[str, Any]], Any]:
@@ -89,27 +98,27 @@ class CheckpointEngine:
         saving_ranks: Optional[Sequence[int]] = None,
     ):
         self.ckpt_dir = ckpt_dir
-        self.job_name = job_name or os.getenv(EnvKey.JOB_NAME, "local")
+        self.job_name = job_name or env_str(EnvKey.JOB_NAME, "local")
         self.node_rank = (
             node_rank
             if node_rank is not None
-            else int(os.getenv(EnvKey.NODE_RANK, "0"))
+            else env_int(EnvKey.NODE_RANK, 0)
         )
         self.local_rank = (
             local_rank
             if local_rank is not None
-            else int(os.getenv(EnvKey.LOCAL_RANK, "0"))
+            else env_int(EnvKey.LOCAL_RANK, 0)
         )
-        self.rank = rank if rank is not None else int(os.getenv(EnvKey.RANK, "0"))
+        self.rank = rank if rank is not None else env_int(EnvKey.RANK, 0)
         self.world_size = (
             world_size
             if world_size is not None
-            else int(os.getenv(EnvKey.WORLD_SIZE, "1"))
+            else env_int(EnvKey.WORLD_SIZE, 1)
         )
         self._shm = SharedMemoryHandler(
             shm_name(self.job_name, self.node_rank, self.local_rank)
         )
-        socket_path = ipc_socket or os.getenv("DLROVER_TPU_IPC_SOCKET", "")
+        socket_path = ipc_socket or env_str(ConfigKey.IPC_SOCKET)
         self._has_agent = bool(socket_path) and os.path.exists(socket_path)
         if self._has_agent:
             # one lock per shm frame (this worker's), shared with the agent
@@ -159,7 +168,7 @@ class CheckpointEngine:
                 and self.rank == self.saving_ranks[0]):
             gc = getattr(self._master, "kv_delete_prefix", None)
             if gc is not None:
-                cur_round = int(os.getenv(EnvKey.RDZV_ROUND, "0") or 0)
+                cur_round = env_int(EnvKey.RDZV_ROUND, 0)
                 try:
                     for i in range(cur_round):
                         gc(f"ckpt/{self.job_name}/ready/r{i}/")
@@ -190,15 +199,15 @@ class CheckpointEngine:
         )
         # donation safety (see _plan_state): snapshot shards on-device
         # before the async drain unless explicitly disabled
-        self._device_snapshot = os.getenv(
-            "DLROVER_TPU_CKPT_DEVICE_SNAPSHOT", "1"
-        ) != "0"
+        self._device_snapshot = env_flag(
+            ConfigKey.CKPT_DEVICE_SNAPSHOT, default=True
+        )
 
     def _replica_manager_from_env(self):
         """Workers under an agent with ``--ckpt-replica`` build their push
         side automatically (peer addresses resolve via the master KV)."""
-        group = int(os.getenv(EnvKey.REPLICA_GROUP, "0"))
-        node_num = int(os.getenv(EnvKey.NODE_NUM, "1"))
+        group = env_int(EnvKey.REPLICA_GROUP, 0)
+        node_num = env_int(EnvKey.NODE_NUM, 1)
         if group <= 1 or node_num <= 1 or self._master is None:
             return None
         from dlrover_tpu.ckpt.replica import ReplicaManager
@@ -370,9 +379,9 @@ class CheckpointEngine:
         # worker incarnation while the master KV (and its failover
         # snapshot) survives — unscoped, a fresh attempt could read a
         # previous incarnation's stale b"1" for a dead peer and split
-        incarnation = os.getenv(EnvKey.RDZV_ROUND, "0")
+        incarnation = env_str(EnvKey.RDZV_ROUND, "0")
         base = f"ckpt/{self.job_name}/ready/r{incarnation}/{self._save_seq}"
-        cooling = time.time() < self._ready_cooldown_until
+        cooling = time.monotonic() < self._ready_cooldown_until
         try:
             self._master.kv_set(
                 f"{base}/{self.rank}",
@@ -385,17 +394,17 @@ class CheckpointEngine:
             # the poll must outlast peer skew: storage-save attempts wait
             # out their drains first, so peers arrive up to min_wait later
             timeout_s = max(
-                float(os.getenv("DLROVER_TPU_CKPT_READY_TIMEOUT", "10")),
+                env_float(ConfigKey.CKPT_READY_TIMEOUT, 10.0),
                 min_wait,
             )
             keys = [f"{base}/{r}" for r in group]
-            deadline = time.time() + timeout_s
+            deadline = time.monotonic() + timeout_s
             while True:
                 vals = self._master.kv_multi_get(keys)
                 if all(vals):
                     ok = all(v == b"1" for v in vals)
                     break
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     logger.warning(
                         "save attempt %s (step %s): readiness exchange "
                         "timed out (%d/%d saver ranks posted) — skipping "
@@ -403,8 +412,9 @@ class CheckpointEngine:
                         self._save_seq, step,
                         sum(bool(v) for v in vals), len(group),
                     )
-                    self._ready_cooldown_until = time.time() + float(
-                        os.getenv("DLROVER_TPU_CKPT_READY_COOLDOWN", "30")
+                    self._ready_cooldown_until = (
+                        time.monotonic()
+                        + env_float(ConfigKey.CKPT_READY_COOLDOWN, 30.0)
                     )
                     ok = False
                     break
@@ -456,7 +466,7 @@ class CheckpointEngine:
         # Storage saves are rare and durability-bearing — wait out a busy
         # drain (bounded) instead of skipping, so fast-stepping jobs can't
         # starve the disk cadence.
-        wait_s = float(os.getenv("DLROVER_TPU_CKPT_STORAGE_WAIT", "60"))
+        wait_s = env_float(ConfigKey.CKPT_STORAGE_WAIT, 60.0)
         return self.save_to_memory(
             step, state, blocking=not self._has_agent,
             _on_drained=_request_persist, _wait_busy_s=wait_s,
@@ -505,7 +515,7 @@ class CheckpointEngine:
                     # start async D2H for overlap; drained later
                     try:
                         data.copy_to_host_async()
-                    except Exception:  # noqa: BLE001 — CPU backend no-op
+                    except Exception:  # noqa: BLE001,DLR003 — CPU backend no-op
                         pass
                     datas.append(data)
                 shard_metas = []
@@ -597,7 +607,7 @@ class CheckpointEngine:
         # a rank with an EMPTY shm must still publish (-1) and join the
         # barrier: returning early would leave its peers blocking the full
         # barrier timeout before they fall back to storage
-        scope = os.getenv(EnvKey.RDZV_ROUND, "0")
+        scope = env_str(EnvKey.RDZV_ROUND, "0")
         prefix = f"ckpt/{self.job_name}/restore_step/r{scope}"
         try:
             self._master.kv_set(f"{prefix}/{self.rank}", str(step).encode())
@@ -641,7 +651,7 @@ class CheckpointEngine:
         # an in-flight async snapshot must land before we read the frame
         self.wait_drained()
         restore_t0 = time.monotonic()
-        self._report_event("restore_start")
+        self._report_event(JournalEvent.RESTORE_START)
         if self._replicas is not None:
             # a relaunched node's shm is empty — pull own frame from a
             # backup-group peer first (replica.py restore semantics)
@@ -677,7 +687,7 @@ class CheckpointEngine:
             "shard(s): %s", self._shm.name, local_step, corrupt,
         )
         self._report_event(
-            "ckpt_corrupt",
+            JournalEvent.CKPT_CORRUPT,
             {"medium": "shm", "step": local_step, "shards": corrupt},
         )
         if self._replicas is not None:
@@ -698,7 +708,8 @@ class CheckpointEngine:
                         "(step %s)", corrupt, got,
                     )
                     self._report_event(
-                        "ckpt_repaired", {"step": got, "shards": corrupt}
+                        JournalEvent.CKPT_REPAIRED,
+                        {"step": got, "shards": corrupt},
                     )
                     return got
                 logger.error(
@@ -718,14 +729,14 @@ class CheckpointEngine:
         if report is not None:
             try:
                 report(kind, data or {})
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — telemetry must not fail load
+                logger.debug("journal report %r failed", kind, exc_info=True)
 
     def _finish_restore(self, t0: float, source: str, step: int) -> None:
         elapsed = time.monotonic() - t0
         self._restore_hist.labels(source=source).observe(elapsed)
         self._report_event(
-            "restore_complete",
+            JournalEvent.RESTORE_COMPLETE,
             # "medium", not "source": the journal reserves "source" for
             # the reporting component's identity (agent_N)
             {"medium": source, "step": step, "duration_s": elapsed},
@@ -782,7 +793,7 @@ class CheckpointEngine:
                     bad,
                 )
                 self._report_event(
-                    "ckpt_corrupt",
+                    JournalEvent.CKPT_CORRUPT,
                     {"medium": "storage", "step": step, "shards": bad},
                 )
             else:

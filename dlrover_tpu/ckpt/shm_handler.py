@@ -31,7 +31,7 @@ from typing import Any, Callable, Dict, List, Optional
 import msgpack
 import numpy as np
 
-from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.constants import ConfigKey, EnvKey, env_flag, env_str
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.multi_process import (
     create_shared_memory,
@@ -43,11 +43,11 @@ _CRC = struct.Struct(">I")
 
 # per-shard CRC32 stamping on frame writes; on by default, env-gated for
 # benchmarking the raw write path
-CRC_ENV = "DLROVER_TPU_CKPT_CRC"
+CRC_ENV = ConfigKey.CKPT_CRC
 
 
 def _crc_enabled() -> bool:
-    return os.getenv(CRC_ENV, "1").lower() not in ("0", "false", "no")
+    return env_flag(CRC_ENV, default=True)
 
 
 def shm_name(job_name: str, node_rank: int, local_rank: int,
@@ -61,7 +61,7 @@ def shm_name(job_name: str, node_rank: int, local_rank: int,
     half-written memory, and :func:`cleanup_orphan_segments` can tell the
     old segments from the live ones."""
     if incarnation is None:
-        incarnation = os.getenv(EnvKey.SHM_INCARNATION, "")
+        incarnation = env_str(EnvKey.SHM_INCARNATION)
     base = f"dlrtpu_{job_name}_{node_rank}_{local_rank}"
     return f"{base}_i{incarnation}" if incarnation else base
 
@@ -74,7 +74,7 @@ def cleanup_orphan_segments(job_name: str, node_rank: int,
     segments leak /dev/shm until reboot and a same-name successor would
     reattach to torn memory."""
     if incarnation is None:
-        incarnation = os.getenv(EnvKey.SHM_INCARNATION, "")
+        incarnation = env_str(EnvKey.SHM_INCARNATION)
     prefix = f"dlrtpu_{job_name}_{node_rank}_"
     keep_suffix = f"_i{incarnation}" if incarnation else None
     removed: List[str] = []
@@ -352,7 +352,7 @@ class SharedMemoryHandler:
             return msgpack.unpackb(
                 bytes(self._shm.buf[8 : 8 + meta_len]), raw=False
             )
-        except Exception:  # noqa: BLE001 — torn/empty frame
+        except Exception:  # noqa: BLE001,DLR003 — torn/empty frame → None is the contract
             return None
 
     def read_shard_bytes(self, shard_meta: Dict):
@@ -487,7 +487,7 @@ def verify_frame_blob(blob) -> List[str]:
     try:
         meta = parse_frame(bytes(blob) if not isinstance(blob, bytes)
                            else blob)
-    except Exception:  # noqa: BLE001 — torn header
+    except Exception:  # noqa: BLE001,DLR003 — torn header counted as corrupt below
         meta = None
     if meta is None:
         return ["<frame>"]

@@ -3,7 +3,51 @@
 Reference surface: dlrover/python/common/constants.py (node types, statuses,
 accelerators, rendezvous names, timeouts). Re-designed for TPU: accelerators
 are TPU generations, node-check runs over ICI/DCN, HCCL/NCCL specifics dropped.
+
+This module is also the **environment-variable registry**: every env name
+the stack reads lives here (:class:`EnvKey` for the agent→worker fork
+boundary, :class:`ConfigKey` for operator-facing knobs) and every read
+goes through the ``env_*`` accessors below. The static analyzer enforces
+this (rule DLR002): a raw ``os.environ``/``os.getenv`` read anywhere else
+fails ``python -m dlrover_tpu.analysis --check`` — otherwise fault drills
+and docs that enumerate the knobs from this registry silently go stale.
 """
+
+import os
+
+
+def get_env(name: str, default=None):
+    """Raw accessor (``os.environ.get``). Prefer the typed variants."""
+    return os.environ.get(name, default)
+
+
+def env_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def env_int(name: str, default: int = 0) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float = 0.0) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Truthiness of an env toggle: unset → ``default``; set → anything
+    except 0/false/no/off/empty is True."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("", "0", "false", "no", "off")
 
 
 class PlatformType:
@@ -176,6 +220,42 @@ class EnvKey:
     # grace window (seconds) the agent keeps training on cached shard
     # assignments while the master is unreachable (partition-degraded mode)
     PARTITION_GRACE_S = "DLROVER_TPU_PARTITION_GRACE_S"
+
+
+class ConfigKey:
+    """Operator-facing env knobs (everything that is not part of the
+    agent→worker fork contract in :class:`EnvKey`). Grouped by the layer
+    that reads them; reads go through the ``env_*`` accessors above."""
+
+    # master
+    MASTER_STATE_DIR = "DLROVER_TPU_MASTER_STATE_DIR"
+    MASTER_SNAPSHOT_S = "DLROVER_TPU_MASTER_SNAPSHOT_S"
+    HTTP_PORT = "DLROVER_TPU_HTTP_PORT"
+    JOB_UID = "DLROVER_TPU_JOB_UID"
+    RUN_CONFIG = "DLROVER_TPU_RUN_CONFIG"
+    # ckpt
+    IPC_SOCKET = "DLROVER_TPU_IPC_SOCKET"
+    CKPT_CRC = "DLROVER_TPU_CKPT_CRC"
+    CKPT_DEVICE_SNAPSHOT = "DLROVER_TPU_CKPT_DEVICE_SNAPSHOT"
+    CKPT_READY_TIMEOUT = "DLROVER_TPU_CKPT_READY_TIMEOUT"
+    CKPT_READY_COOLDOWN = "DLROVER_TPU_CKPT_READY_COOLDOWN"
+    CKPT_STORAGE_WAIT = "DLROVER_TPU_CKPT_STORAGE_WAIT"
+    # agent / worker
+    HOST_IP = "DLROVER_TPU_HOST_IP"
+    AGENT_METRICS_PORT = "DLROVER_TPU_AGENT_METRICS_PORT"
+    WARM_WAIT_S = "DLROVER_TPU_WARM_WAIT_S"
+    WARM_PREIMPORT = "DLROVER_TPU_WARM_PREIMPORT"
+    COMPILE_CACHE = "DLROVER_TPU_COMPILE_CACHE"
+    DIST_SHUTDOWN_S = "DLROVER_TPU_DIST_SHUTDOWN_S"
+    DIST_HEARTBEAT_S = "DLROVER_TPU_DIST_HEARTBEAT_S"
+    TRACE_FUNCS = "DLROVER_TPU_TRACE_FUNCS"
+    # diagnosis
+    CHECK_TIMEOUT_S = "DLROVER_TPU_CHECK_TIMEOUT_S"
+    # chaos / observability
+    FAULT_SCHEDULE = "DLROVER_FAULT_SCHEDULE"
+    FAULT_SEED = "DLROVER_FAULT_SEED"
+    EVENT_DIR = "DLROVER_TPU_EVENT_DIR"
+    LOG_LEVEL = "DLROVER_TPU_LOG_LEVEL"
 
 
 class GRPC:

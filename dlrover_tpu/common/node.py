@@ -92,14 +92,21 @@ class Node:
     is_released: bool = False
     config_resource: NodeResource = field(default_factory=NodeResource)
     used_resource: NodeResource = field(default_factory=NodeResource)
-    create_time: float = field(default_factory=time.time)
+    # node lifecycle stamps are MASTER-MONOTONIC seconds (time.monotonic):
+    # they exist only to be subtracted (pending timeout, heartbeat timeout,
+    # uptime) and a wall clock stepping under NTP would stretch/collapse
+    # those windows. Nothing here is a reportable wall timestamp.
+    create_time: float = field(default_factory=time.monotonic)
     start_time: Optional[float] = None
     finish_time: Optional[float] = None
     heartbeat_time: float = 0.0
-    # master-clock contact stamp: heartbeat_time carries the AGENT's
-    # timestamp (clock skew!), so second-scale liveness comparisons
-    # (connection-drop grace recheck) use this instead
+    # master-clock stamp of ANY contact (heartbeats plus non-heartbeat
+    # RPCs) — second-scale liveness comparisons (connection-drop grace
+    # recheck) use this
     contact_time: float = 0.0
+    # wall-clock timestamp as reported by the agent's heartbeat — kept for
+    # display/debug only, never compared against master-side stamps
+    agent_report_ts: float = 0.0
     # rendezvous participation
     local_world_size: int = 1
     paral_config_version: int = 0
@@ -108,9 +115,9 @@ class Node:
         if transition_allowed(self.status, status):
             self.status = status
             if status == NodeStatus.RUNNING and self.start_time is None:
-                self.start_time = time.time()
+                self.start_time = time.monotonic()
             if NodeStatus.terminal(status):
-                self.finish_time = time.time()
+                self.finish_time = time.monotonic()
             return True
         return False
 

@@ -32,6 +32,7 @@ from dlrover_tpu.diagnosis.diagnostician import (
     DiagnosticianRegistry,
     Observation,
 )
+from dlrover_tpu.observability.journal import JournalEvent
 from dlrover_tpu.diagnosis.precheck import (
     PreCheckRunner,
     get_precheck_operators,
@@ -206,7 +207,8 @@ class DiagnosisMaster:
         ):
             # a hang restart is a detected fault even though no node died
             self._event_journal.record(
-                "fault_detected", reason=action.reason or "diagnosis"
+                JournalEvent.FAULT_DETECTED,
+                reason=action.reason or "diagnosis",
             )
         self._job_manager.enqueue_action(action)
 

@@ -21,13 +21,18 @@ import time
 from typing import Dict, List
 
 from dlrover_tpu.common.comm import NodeMeta
-from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.constants import (
+    ConfigKey,
+    EnvKey,
+    env_float,
+    env_str,
+)
 from dlrover_tpu.common.log import logger
 
 
 def mock_error(node_rank: int) -> None:
     """Raise if fault injection targets this node (reference utils.py:52)."""
-    mock = os.getenv(EnvKey.MOCK_ERR_RANK)
+    mock = env_str(EnvKey.MOCK_ERR_RANK) or None
     if mock is not None and int(mock) == node_rank:
         raise RuntimeError(f"mock error on node {node_rank}")
 
@@ -51,11 +56,11 @@ def matmul_benchmark(size: int = 1024, rounds: int = 4) -> float:
     key = jax.random.PRNGKey(0)
     x = jax.random.normal(key, (size, size), dtype=jnp.bfloat16)
     _mm(x).block_until_ready()  # compile outside the timed region
-    start = time.time()
+    start = time.monotonic()
     for _ in range(rounds):
         x = _mm(x)
     x.block_until_ready()
-    return time.time() - start
+    return time.monotonic() - start
 
 
 _LEN = struct.Struct(">Q")
@@ -103,11 +108,11 @@ def tcp_pair_benchmark(
         # a pair whose partner died pre-connect costs this whole window;
         # chaos/e2e drills shrink it (default matches the reference's
         # 60s gloo store timeout)
-        timeout_s = float(os.getenv("DLROVER_TPU_CHECK_TIMEOUT_S", "60"))
+        timeout_s = env_float(ConfigKey.CHECK_TIMEOUT_S, 60.0)
     payload = os.urandom(int(payload_mb * 1024 * 1024))
     leader = ranks[0]
     leader_meta = group[leader]
-    start = time.time()
+    start = time.monotonic()
     if node_rank == leader:
         server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -119,7 +124,7 @@ def tcp_pair_benchmark(
         # to the timeout's; only the latency differs)
         server.settimeout(1.0)
         served = 0
-        deadline = time.time() + timeout_s
+        deadline = time.monotonic() + timeout_s
         try:
             while served < len(ranks) - 1:
                 try:
@@ -129,7 +134,7 @@ def tcp_pair_benchmark(
                         raise RuntimeError(
                             "pair partner already reported a failed check"
                         )
-                    if time.time() > deadline:
+                    if time.monotonic() > deadline:
                         raise socket.timeout(
                             f"pair partner never connected in {timeout_s}s"
                         )
@@ -142,9 +147,11 @@ def tcp_pair_benchmark(
         finally:
             server.close()
     else:
-        deadline = time.time() + timeout_s
+        deadline = time.monotonic() + timeout_s
         conn = None
-        while conn is None:
+        # connect-retry kept inline: the abort predicate (partner_failed,
+        # polled between attempts) is not expressible as a RetryPolicy
+        while conn is None:  # noqa: DLR005
             try:
                 conn = socket.create_connection(
                     (leader_meta.host or "127.0.0.1", leader_meta.free_port),
@@ -155,7 +162,7 @@ def tcp_pair_benchmark(
                     raise RuntimeError(
                         "pair partner already reported a failed check"
                     )
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     raise
                 time.sleep(0.2)
         conn.settimeout(timeout_s)
@@ -164,7 +171,7 @@ def tcp_pair_benchmark(
         conn.close()
         if echoed != payload:
             raise RuntimeError("tcp echo payload corrupted")
-    return time.time() - start
+    return time.monotonic() - start
 
 
 def run_check_workload(

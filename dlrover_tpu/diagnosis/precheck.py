@@ -49,10 +49,10 @@ class PreCheckOperator:
 
     def run(self, job_manager) -> PreCheckResult:
         """Poll check() until pass or timeout."""
-        deadline = time.time() + self.timeout_s
+        deadline = time.monotonic() + self.timeout_s
         while True:
             result = self.check(job_manager)
-            if result.passed or time.time() >= deadline:
+            if result.passed or time.monotonic() >= deadline:
                 return result
             time.sleep(self.retry_interval_s)
 
@@ -116,7 +116,7 @@ class ConnectionPreCheckOperator(PreCheckOperator):
         self._max_silence_s = max_silence_s
 
     def check(self, job_manager) -> PreCheckResult:
-        now = time.time()
+        now = time.monotonic()  # heartbeat_time is master-monotonic
         silent = [
             n.id
             for n in job_manager.nodes.values()

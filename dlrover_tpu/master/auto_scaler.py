@@ -86,7 +86,7 @@ class JobAutoScaler:
     # -- one planning round ------------------------------------------------
 
     def collect_stats(self) -> ScalingStats:
-        now = time.time()
+        now = time.monotonic()  # vs node.create_time (master-monotonic)
         running = pending = 0
         oldest_pending = 0.0
         for node in self._job_manager.nodes.values():
@@ -125,7 +125,7 @@ class JobAutoScaler:
     def execute(self, plan: ResourcePlan) -> None:
         if plan.paral_config is not None and self._strategy_generator:
             scale = plan.paral_config.micro_batch_scale
-            now = time.time()
+            now = time.monotonic()  # cooldown window arithmetic
             if (scale and scale != 1.0
                     and now - self._last_paral_apply
                     >= self.paral_cooldown_s):

@@ -241,15 +241,20 @@ class JobManager:
             node.update_status(NodeStatus.RUNNING)
         # stamp AFTER the RUNNING promotion so the first heartbeat lands
         # >= start_time — otherwise the stale-heartbeat guard in
-        # check_heartbeats would exempt a node that heartbeat exactly once
-        node.heartbeat_time = timestamp or time.time()
-        node.contact_time = time.time()  # master clock, skew-free
+        # check_heartbeats would exempt a node that heartbeat exactly once.
+        # Both stamps are MASTER monotonic: the agent's reported wall
+        # timestamp (``timestamp``) crosses machines AND clocks, so it is
+        # kept for display only and never enters timeout arithmetic.
+        node.heartbeat_time = time.monotonic()
+        node.contact_time = time.monotonic()  # master clock, skew-free
+        if timestamp:
+            node.agent_report_ts = timestamp
 
     def record_raw_contact(self, node_id: int) -> None:
         """Transport-level proof of life (e.g. a dedup-replayed RPC frame
         whose handler never ran): bump only the master-clock contact
         stamp the connection-drop recheck reads."""
-        self.get_node(node_id).contact_time = time.time()
+        self.get_node(node_id).contact_time = time.monotonic()
 
     def report_connection_lost(self, node_id: int) -> None:
         """The node's heartbeat TCP connection died (rpc.py on_disconnect).
@@ -267,7 +272,7 @@ class JobManager:
         node = self.get_node(node_id)
         if node.status != NodeStatus.RUNNING or node.is_released:
             return
-        drop_ts = time.time()
+        drop_ts = time.monotonic()
         ctx = get_context()
         # the grace must outlast one full heartbeat cadence: an IDLE
         # connection reset (conntrack timeout, proxy blip) re-contacts
@@ -311,7 +316,7 @@ class JobManager:
                         return
                     continue
                 due, node_id, drop_ts = self._recheck_heap[0]
-                delay = due - time.time()
+                delay = due - time.monotonic()
                 if delay > 0:
                     self._recheck_cond.wait(timeout=delay)
                     continue  # re-read the heap: a nearer entry may exist
@@ -518,7 +523,7 @@ class JobManager:
             peer.heartbeat_time = 0.0
             peer.start_time = None
             # the pending-timeout clock must restart for the new pod
-            peer.create_time = time.time()
+            peer.create_time = time.monotonic()
             self._scaler.relaunch_node(peer)
 
     def release_node(self, node: Node, reason: str = "") -> None:
@@ -552,7 +557,7 @@ class JobManager:
 
     def check_heartbeats(self, now: Optional[float] = None) -> None:
         ctx = get_context()
-        now = now or time.time()
+        now = now or time.monotonic()
         for node in self.list_nodes():
             if node.status != NodeStatus.RUNNING:
                 continue
@@ -586,7 +591,7 @@ class JobManager:
         diagnoses."""
         if self._pending_strategy == PendingStrategy.WAIT:
             return
-        now = now or time.time()
+        now = now or time.monotonic()
         for node in self.list_nodes():
             if node.status != NodeStatus.PENDING or node.is_released:
                 continue

@@ -13,7 +13,13 @@ import threading
 import time
 from typing import Dict, Optional
 
-from dlrover_tpu.common.constants import JobStage, RendezvousName
+from dlrover_tpu.common.constants import (
+    ConfigKey,
+    JobStage,
+    RendezvousName,
+    env_float,
+    env_str,
+)
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCServer
 from dlrover_tpu.master.job_manager import JobManager
@@ -159,7 +165,7 @@ class JobMaster:
         # master failover: snapshot durable control-plane state (KV,
         # shard queues, global step) so a restarted master with the same
         # --state-dir resumes instead of losing data position
-        state_dir = state_dir or os.getenv("DLROVER_TPU_MASTER_STATE_DIR")
+        state_dir = state_dir or env_str(ConfigKey.MASTER_STATE_DIR)
         self._snapshot_loop = None
         self._state_store = None
         if state_dir:
@@ -171,9 +177,7 @@ class JobMaster:
             self._state_store = MasterStateStore(state_dir)
             self._snapshot_loop = SnapshotLoop(
                 self._state_store, self,
-                interval_s=float(
-                    os.getenv("DLROVER_TPU_MASTER_SNAPSHOT_S", "30")
-                ),
+                interval_s=env_float(ConfigKey.MASTER_SNAPSHOT_S, 30.0),
             )
             # dataset registration snapshots immediately: a crash in the
             # periodic window would otherwise lose the dataset for good
@@ -181,7 +185,7 @@ class JobMaster:
             self.task_manager.on_new_dataset = (
                 lambda: self._snapshot_loop.save_now("dataset-registered")
             )
-        http_port = os.getenv("DLROVER_TPU_HTTP_PORT")
+        http_port = env_str(ConfigKey.HTTP_PORT)
         if http_port:  # unset OR empty (un-templated manifest) disables
             from dlrover_tpu.common.http_server import HTTPTransportServer
 
@@ -395,9 +399,7 @@ class DistributedJobMaster(JobMaster):
             # phase routing sees the job already ran. The operator provides
             # the stable instance id (k8s CR uid) via DLROVER_TPU_JOB_UID;
             # without one, fall back to a random per-process suffix.
-            instance = os.getenv(
-                "DLROVER_TPU_JOB_UID", _uuid.uuid4().hex[:8]
-            )
+            instance = env_str(ConfigKey.JOB_UID, _uuid.uuid4().hex[:8])
             brain_client = BrainClient(
                 brain_addr,
                 job_uuid=f"{job_name}-{instance}",

@@ -18,11 +18,11 @@ rendezvous manager stamps the resulting order into ``NodeMeta.comm_rank``
 at world-cut time, and the agent assigns worker ranks in that order.
 """
 
-import os
 from abc import ABC, abstractmethod
 from typing import Dict, List, Tuple
 
 from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import env_str
 
 ENV_SLICE_ID = ("MEGASCALE_SLICE_ID", "TPU_SLICE_ID")
 ENV_WORKER_ID = ("TPU_WORKER_ID", "CLOUD_TPU_TASK_ID")
@@ -34,14 +34,14 @@ def local_topology_attrs() -> Tuple[str, int]:
     node-rank order)."""
     slice_id = ""
     for key in ENV_SLICE_ID:
-        if os.getenv(key):
-            slice_id = os.environ[key]
+        if env_str(key):
+            slice_id = env_str(key)
             break
     worker_id = -1
     for key in ENV_WORKER_ID:
-        if os.getenv(key):
+        if env_str(key):
             try:
-                worker_id = int(os.environ[key])
+                worker_id = int(env_str(key))
             except ValueError:
                 pass
             break

@@ -10,11 +10,19 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from dlrover_tpu.observability.journal import JournalEvent, Phase
+
 
 class GlobalStepRecord:
-    def __init__(self, step: int, timestamp: float):
+    def __init__(self, step: int, timestamp: float,
+                 arrival: Optional[float] = None):
         self.step = step
+        # agent-reported wall timestamp: only ever compared against other
+        # reported timestamps (speed windows), never against master clocks
         self.timestamp = timestamp
+        # master-monotonic arrival stamp: the clock-skew-free basis for
+        # staleness checks (step_stalled)
+        self.arrival = time.monotonic() if arrival is None else arrival
 
 
 class PerfMonitor:
@@ -23,7 +31,8 @@ class PerfMonitor:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._records: List[GlobalStepRecord] = []
-        self._start_time = time.time()
+        # master-monotonic: exists only for elapsed-time subtraction
+        self._start_time = time.monotonic()
         self._init_step = 0
         self._init_time = self._start_time
         # goodput accounting: accumulated unproductive seconds
@@ -62,7 +71,8 @@ class PerfMonitor:
                 self._min_round = min_round
 
     def collect_global_step(self, step: int, timestamp: float,
-                            rdzv_round: int = -1) -> None:
+                            rdzv_round: int = -1,
+                            arrival: Optional[float] = None) -> None:
         with self._lock:
             if 0 <= rdzv_round < self._min_round:
                 # a pre-restart report delivered late (agent retry storm)
@@ -72,7 +82,7 @@ class PerfMonitor:
                 return
             if self._records and step <= self._records[-1].step:
                 return
-            self._records.append(GlobalStepRecord(step, timestamp))
+            self._records.append(GlobalStepRecord(step, timestamp, arrival))
             if len(self._records) > self.MAX_RECORDS:
                 self._records.pop(0)
         # a step completing while the journal still attributes time to a
@@ -81,8 +91,8 @@ class PerfMonitor:
         # back into fault_recovered(), which takes it.
         journal = self.journal
         if (journal is not None
-                and journal.current_phase() != "productive"):
-            journal.record("step_resumed", step=step)
+                and journal.current_phase() != Phase.PRODUCTIVE):
+            journal.record(JournalEvent.STEP_RESUMED, step=step)
 
     @property
     def completed_global_step(self) -> int:
@@ -104,30 +114,35 @@ class PerfMonitor:
             return self._records[-1].timestamp if self._records else 0.0
 
     def step_stalled(self, timeout_s: float) -> bool:
-        """True when steps stopped advancing for ``timeout_s`` (hang signal)."""
-        last = self.last_step_time()
-        if last <= 0:
-            return False
-        return time.time() - last > timeout_s
+        """True when steps stopped advancing for ``timeout_s`` (hang signal).
+
+        Compares the master-monotonic ARRIVAL stamp, not the agent-reported
+        timestamp — an agent with a skewed wall clock must not look hung.
+        """
+        with self._lock:
+            if not self._records:
+                return False
+            last = self._records[-1].arrival
+        return time.monotonic() - last > timeout_s
 
     # -- goodput -----------------------------------------------------------
 
     def fault_happened(self) -> None:
         with self._lock:
             if self._fault_started is None:
-                self._fault_started = time.time()
+                self._fault_started = time.monotonic()
 
     def fault_recovered(self) -> None:
         with self._lock:
             if self._fault_started is not None:
-                self._lost_seconds += time.time() - self._fault_started
+                self._lost_seconds += time.monotonic() - self._fault_started
                 self._fault_started = None
 
     def goodput(self) -> float:
         """Fraction of wall time spent training (1.0 = no lost time)."""
         with self._lock:
-            wall = time.time() - self._start_time
+            wall = time.monotonic() - self._start_time
             lost = self._lost_seconds
             if self._fault_started is not None:
-                lost += time.time() - self._fault_started
+                lost += time.monotonic() - self._fault_started
             return max(0.0, (wall - lost) / wall) if wall > 0 else 1.0

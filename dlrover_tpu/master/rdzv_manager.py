@@ -31,6 +31,7 @@ from dlrover_tpu.common.comm import NodeMeta
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.constants import NetworkFailureReason, RendezvousName
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.journal import JournalEvent
 
 
 class RendezvousParameters:
@@ -140,10 +141,10 @@ class RendezvousManager(ABC):
             inj.fire("rdzv.join", rdzv=self._name, node_rank=meta.node_rank)
         with self._lock:
             if not self._waiting_nodes:
-                self._start_rdzv_ts = time.time()
+                self._start_rdzv_ts = time.monotonic()
                 if self.journal is not None:
                     self.journal.record(
-                        "rdzv_start", round=self._rdzv_round + 1,
+                        JournalEvent.RDZV_START, round=self._rdzv_round + 1,
                         first_rank=meta.node_rank,
                     )
             # a dead node re-joining is alive again: restore it to the
@@ -155,7 +156,7 @@ class RendezvousManager(ABC):
             # agents mid-training notice via num_nodes_waiting (reference
             # join_rendezvous clears the node cache the same way)
             self._rdzv_nodes = {}
-            self._lastcall_time = time.time()
+            self._lastcall_time = time.monotonic()
         return self._rdzv_round
 
     def num_nodes_waiting(self) -> int:
@@ -190,7 +191,7 @@ class RendezvousManager(ABC):
         elif (
             waiting >= params.min_nodes
             and self._lastcall_time > 0
-            and time.time() - self._lastcall_time >= params.waiting_timeout
+            and time.monotonic() - self._lastcall_time >= params.waiting_timeout
         ):
             completed = True
         if not completed:
@@ -198,7 +199,7 @@ class RendezvousManager(ABC):
             if (
                 self._start_rdzv_ts > 0
                 and waiting > 0
-                and time.time() - self._start_rdzv_ts > timeout
+                and time.monotonic() - self._start_rdzv_ts > timeout
             ):
                 logger.warning(
                     "%s rdzv round %s timed out with %s/%s nodes",
@@ -227,7 +228,7 @@ class RendezvousManager(ABC):
             del self._waiting_nodes[r]
         self._rdzv_round += 1
         duration = (
-            time.time() - self._start_rdzv_ts if self._start_rdzv_ts > 0
+            time.monotonic() - self._start_rdzv_ts if self._start_rdzv_ts > 0
             else 0.0
         )
         self._lastcall_time = 0.0
@@ -237,7 +238,7 @@ class RendezvousManager(ABC):
         self._rounds_counter.inc()
         if self.journal is not None:
             self.journal.record(
-                "rdzv_complete", round=self._rdzv_round,
+                JournalEvent.RDZV_COMPLETE, round=self._rdzv_round,
                 world_size=world_size, duration_s=duration,
             )
         logger.info(

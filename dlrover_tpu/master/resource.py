@@ -116,7 +116,7 @@ class LocalOptimizer(ResourceOptimizer):
                     ),
                 )
         # 3) recovery grow
-        now = time.time()
+        now = time.monotonic()  # grow-cooldown window arithmetic
         if (
             stats.pending_nodes == 0
             and stats.target_nodes < stats.max_nodes

@@ -44,7 +44,7 @@ class MasterServicer:
         self._metric_context = metric_context
         self._strategy_generator = strategy_generator
         self._event_journal = event_journal
-        self._start_time = time.time()
+        self._start_time = time.monotonic()  # uptime base
 
     # -- rendezvous --------------------------------------------------------
 
@@ -328,9 +328,10 @@ class MasterServicer:
         Source: DLROVER_TPU_RUN_CONFIG env on the master, a JSON object of
         ElasticLaunchConfig field overrides."""
         import json
-        import os
 
-        raw = os.getenv("DLROVER_TPU_RUN_CONFIG", "")
+        from dlrover_tpu.common.constants import ConfigKey, env_str
+
+        raw = env_str(ConfigKey.RUN_CONFIG)
         overrides = {}
         if raw:
             try:
@@ -340,4 +341,6 @@ class MasterServicer:
         return comm.BaseResponse(data=overrides)
 
     def rpc_ping(self, req) -> comm.BaseResponse:
-        return comm.BaseResponse(data={"uptime": time.time() - self._start_time})
+        return comm.BaseResponse(
+            data={"uptime": time.monotonic() - self._start_time}
+        )

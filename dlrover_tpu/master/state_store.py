@@ -111,7 +111,10 @@ class MasterStateStore:
             "master state restored from %s: %d kv keys, %d datasets, "
             "step %s (snapshot age %.1fs)",
             self.path, len(snap.get("kv", {})), len(snap.get("datasets", [])),
-            step, time.time() - snap.get("ts", time.time()),
+            # snapshot ts is a PERSISTED wall stamp from the previous
+            # master process — monotonic does not survive restarts, so a
+            # wall-wall age estimate is the only option here
+            step, time.time() - snap.get("ts", time.time()),  # noqa: DLR001
         )
         return True
 

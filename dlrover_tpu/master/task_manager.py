@@ -24,7 +24,7 @@ class _PendingTask:
     def __init__(self, task: TaskMessage, node_id: int):
         self.task = task
         self.node_id = node_id
-        self.start_time = time.time()
+        self.start_time = time.monotonic()  # hang-detection stamp
 
 
 class _DatasetManager:
@@ -154,7 +154,7 @@ class TaskManager:
     def _check_hanged_tasks(self) -> None:
         timeout = get_context().task_timeout_s
         while not self._stopped.wait(30.0):
-            now = time.time()
+            now = time.monotonic()
             with self._lock:
                 for ds in self._datasets.values():
                     hanged = [

@@ -43,10 +43,19 @@ class JournalEvent:
     RECOMPILE_START = "recompile_start"
     RECOMPILE_COMPLETE = "recompile_complete"
     STEP_RESUMED = "step_resumed"
+    # agent/ckpt-reported kinds: informational (no phase transition), but
+    # declared here so every journaled kind has exactly one spelling
+    FAULT_INJECTED = "fault_injected"
+    CKPT_CORRUPT = "ckpt_corrupt"
+    CKPT_REPAIRED = "ckpt_repaired"
+    PARTITION_RESYNC = "partition_resync"
+    SHM_ORPHANS_CLEANED = "shm_orphans_cleaned"
 
     ALL = (
         FAULT_DETECTED, RDZV_START, RDZV_COMPLETE, RESTORE_START,
         RESTORE_COMPLETE, RECOMPILE_START, RECOMPILE_COMPLETE, STEP_RESUMED,
+        FAULT_INJECTED, CKPT_CORRUPT, CKPT_REPAIRED, PARTITION_RESYNC,
+        SHM_ORPHANS_CLEANED,
     )
 
 

@@ -114,7 +114,9 @@ def sample(daemon_port: int = 18889, rounds: int = 20,
     profile of every worker's python threads with zero dependencies.
     Only stacks dumped during THIS run are counted."""
     offsets = snapshot_offsets()
-    for _ in range(rounds):
+    # a fixed-cadence sampling loop, not a retry: failures are expected
+    # while the daemon warms up and must not trigger backoff/jitter
+    for _ in range(rounds):  # noqa: DLR005
         try:
             urllib.request.urlopen(
                 f"http://127.0.0.1:{daemon_port}/dump_stack", timeout=3

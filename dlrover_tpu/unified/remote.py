@@ -482,7 +482,9 @@ class CallHomeListener:
             pass
         with self._cond:
             for conn in self._conns.values():
-                conn.close()
+                # socket close() does not block on peer IO; holding the
+                # cond keeps accept() from registering into a dying map
+                conn.close()  # noqa: DLR004
             self._conns.clear()
 
 

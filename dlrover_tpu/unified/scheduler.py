@@ -75,20 +75,23 @@ class ActorHandle:
 
     def call(self, method: str, *args, timeout: Optional[float] = None,
              **kwargs) -> Any:
+        # pipe IO deliberately happens under the lock: it serializes the
+        # request/response protocol (interleaved sends would mis-pair
+        # responses), and every blocking call is timeout-bounded
         with self._lock:
             if not self.proc.is_alive():
                 raise ActorDiedError(self.vertex.name,
                                      f"(exitcode {self.proc.exitcode})")
             try:
-                self._conn.send((method, args, kwargs))
-                if timeout is not None and not self._conn.poll(timeout):
+                self._conn.send((method, args, kwargs))  # noqa: DLR004
+                if timeout is not None and not self._conn.poll(timeout):  # noqa: DLR004
                     # the pipe now has a response in flight that no caller
                     # will match — the actor is unusable, so kill it rather
                     # than let a retry read the stale result
                     self.proc.kill()
                     raise ActorDiedError(self.vertex.name,
                                          f"(call {method} timed out)")
-                status, payload = self._conn.recv()
+                status, payload = self._conn.recv()  # noqa: DLR004
             except (EOFError, BrokenPipeError, ConnectionResetError) as e:
                 # reap before raising so alive/dead_vertices is settled the
                 # moment the caller sees the death
@@ -103,8 +106,8 @@ class ActorHandle:
         if self.proc.is_alive():
             try:
                 with self._lock:
-                    self._conn.send(("__stop__",))
-                    self._conn.poll(grace_s)
+                    self._conn.send(("__stop__",))  # noqa: DLR004 — bounded
+                    self._conn.poll(grace_s)  # noqa: DLR004 — bounded
             except (OSError, EOFError, BrokenPipeError):
                 pass
         self.proc.join(timeout=grace_s)
@@ -145,16 +148,18 @@ class RemoteActorHandle(ActorHandle):
 
     def call(self, method: str, *args, timeout: Optional[float] = None,
              **kwargs) -> Any:
+        # same vetted pattern as _LocalActorHandle.call: the lock IS the
+        # pipe-protocol serializer and every blocking call is bounded
         with self._lock:
             if self._dead:
                 raise ActorDiedError(self.vertex.name, "(known dead)")
             try:
-                self._conn.send((method, args, kwargs))
-                if timeout is not None and not self._conn.poll(timeout):
+                self._conn.send((method, args, kwargs))  # noqa: DLR004
+                if timeout is not None and not self._conn.poll(timeout):  # noqa: DLR004
                     self.kill()
                     raise ActorDiedError(self.vertex.name,
                                          f"(call {method} timed out)")
-                status, payload = self._conn.recv()
+                status, payload = self._conn.recv()  # noqa: DLR004
             except (EOFError, ConnectionError, OSError) as e:
                 self._dead = True
                 raise ActorDiedError(self.vertex.name, f"({e!r})") from e
@@ -167,8 +172,8 @@ class RemoteActorHandle(ActorHandle):
         if not self._dead:
             try:
                 with self._lock:
-                    self._conn.send(("__stop__",))
-                    self._conn.poll(grace_s)
+                    self._conn.send(("__stop__",))  # noqa: DLR004 — bounded
+                    self._conn.poll(grace_s)  # noqa: DLR004 — bounded
             except (OSError, EOFError, ConnectionError):
                 pass
         self.kill()

@@ -34,11 +34,21 @@ import pytest  # noqa: E402
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
     """On any chaos-marked failure, print the fault schedule + seed so the
-    run is replayable: export the printed env vars and re-run the test."""
+    run is replayable: export the printed env vars and re-run the test.
+    On any analysis-marked failure, print the analyzer repro command."""
     outcome = yield
     rep = outcome.get_result()
     if rep.when != "call" or not rep.failed:
         return
+    if item.get_closest_marker("analysis") is not None:
+        rep.sections.append((
+            "analysis repro",
+            "reproduce / triage the lint findings with:\n"
+            "  python -m dlrover_tpu.analysis --check\n"
+            "fix the new violations, add an inline `# noqa: DLR00X — reason`"
+            " for vetted sites, or (deliberate deferral) re-run with"
+            " --update-baseline\n",
+        ))
     if item.get_closest_marker("chaos") is None:
         return
     try:
@@ -52,3 +62,21 @@ def pytest_runtest_makereport(item, call):
             "chaos repro",
             f"replay this fault sequence with:\n  {repro}\n",
         ))
+
+
+@pytest.fixture
+def lock_order_guard():
+    """Opt-in runtime lock-order detector: instruments threading.Lock/RLock
+    for the duration of the test and fails it if two locks were ever taken
+    in contradictory orders (the PR 2 injector-deadlock class). The fixture
+    yields the detector so tests can also name locks explicitly via
+    ``guard.make_lock("name")``."""
+    from dlrover_tpu.analysis.lock_order import LockOrderDetector
+
+    detector = LockOrderDetector()
+    detector.install()
+    try:
+        yield detector
+    finally:
+        detector.uninstall()
+    detector.check()

@@ -72,7 +72,7 @@ def test_recovery_grow_with_cooldown():
     plan = opt.plan(stats(running_nodes=4, target_nodes=4))
     assert plan.node_num == 6  # one unit step toward max
     opt2 = LocalOptimizer(grow_cooldown_s=3600.0)
-    opt2._last_grow = time.time()
+    opt2._last_grow = time.monotonic()
     assert opt2.plan(stats(running_nodes=4, target_nodes=4)).empty()
 
 
@@ -107,7 +107,7 @@ def make_nodes(running, pending, pending_age_s=0.0):
         i += 1
     for _ in range(pending):
         n = Node(id=i, status=NodeStatus.PENDING)
-        n.create_time = time.time() - pending_age_s
+        n.create_time = time.monotonic() - pending_age_s
         nodes[i] = n
         i += 1
     return nodes

@@ -90,7 +90,7 @@ class TestPreCheck:
         jm = self._manager()
         op = ConnectionPreCheckOperator(timeout_s=0, max_silence_s=30)
         assert not op.run(jm).passed
-        now = time.time()
+        now = time.monotonic()
         for node in jm.nodes.values():
             node.heartbeat_time = now
         assert op.run(jm).passed
@@ -98,7 +98,7 @@ class TestPreCheck:
     def test_runner_chain_and_status(self):
         jm = self._manager(1)
         jm.nodes[0].update_status(NodeStatus.RUNNING)
-        jm.nodes[0].heartbeat_time = time.time()
+        jm.nodes[0].heartbeat_time = time.monotonic()
         runner = PreCheckRunner(get_precheck_operators(
             ["scheduling", "connection"]
         ))
@@ -136,7 +136,7 @@ class TestPreCheck:
         jm_box.append(jm)
         jm._job_stage = "running"
         jm.nodes[0].update_status(NodeStatus.RUNNING)
-        jm.nodes[0].heartbeat_time = time.time()
+        jm.nodes[0].heartbeat_time = time.monotonic()
         runner = PreCheckRunner([SchedulingPreCheckOperator(timeout_s=0)])
         assert runner.run(jm)
         assert scaler.relaunched == [1]
@@ -155,7 +155,8 @@ class TestHangDetection:
         ctx.set("hang_restart_workers", True)
         try:
             pm = PerfMonitor()
-            pm.collect_global_step(10, time.time() - 100)
+            pm.collect_global_step(10, time.time() - 100,
+                                   arrival=time.monotonic() - 100)
             now = time.time()
             gauges = {0: ({HANG_GAUGE: 1.0}, now), 1: ({HANG_GAUGE: 1.0}, now)}
             d = TrainingHangDiagnostician(pm, gauges)
@@ -171,7 +172,8 @@ class TestHangDetection:
         ctx.set("hang_restart_workers", True)
         try:
             pm = PerfMonitor()
-            pm.collect_global_step(10, time.time() - 100)
+            pm.collect_global_step(10, time.time() - 100,
+                                   arrival=time.monotonic() - 100)
             now = time.time()
             gauges = {0: ({HANG_GAUGE: 1.0}, now), 1: ({HANG_GAUGE: 0.0}, now)}
             d = TrainingHangDiagnostician(pm, gauges)
@@ -185,7 +187,8 @@ class TestHangDetection:
         ctx.set("hang_downtime_s", 0.01)
         try:
             pm = PerfMonitor()
-            pm.collect_global_step(10, time.time() - 100)
+            pm.collect_global_step(10, time.time() - 100,
+                                   arrival=time.monotonic() - 100)
             d = TrainingHangDiagnostician(pm, {})
             action = d.diagnose()
             assert action.action_type == DiagnosisActionType.EVENT
@@ -213,7 +216,8 @@ class TestDiagnosisMaster:
         try:
             jm = JobManager("t", 1)
             pm = PerfMonitor()
-            pm.collect_global_step(5, time.time() - 100)
+            pm.collect_global_step(5, time.time() - 100,
+                                   arrival=time.monotonic() - 100)
             dm = DiagnosisMaster(jm, pm, precheck_ops=[])
             dm.diagnose_once()
             action = jm.report_heartbeat(0, time.time())
@@ -282,7 +286,8 @@ class TestPreCheckOverRpc:
         ctx.set("hang_restart_workers", True)
         try:
             pm = PerfMonitor()
-            pm.collect_global_step(10, time.time() - 100)
+            pm.collect_global_step(10, time.time() - 100,
+                                   arrival=time.monotonic() - 100)
             now = time.time()
             gauges = {
                 0: ({"node_cpu_percent": 50.0}, now),
@@ -323,7 +328,8 @@ class TestDiagnosisAgent:
         ctx.set("hang_restart_workers", True)
         try:
             pm = PerfMonitor()
-            pm.collect_global_step(10, time.time() - 100)
+            pm.collect_global_step(10, time.time() - 100,
+                                   arrival=time.monotonic() - 100)
             # node 1's snapshot is ancient (daemon died holding HANG=0):
             # it must not veto the live nodes' unanimous hang vote
             gauges = {

@@ -133,7 +133,7 @@ def test_pending_timeout_skip_releases_node():
     node = jm.nodes[2]
     node.update_status(NodeStatus.FAILED)
     node.update_status(NodeStatus.PENDING)
-    node.create_time = time.time() - 100
+    node.create_time = time.monotonic() - 100
     jm.check_pending_nodes()
     assert node.is_released
     assert scaler.removed == [2]
@@ -148,7 +148,7 @@ def test_pending_timeout_fails_job_below_min_nodes():
     node = jm.nodes[1]
     node.update_status(NodeStatus.FAILED)
     node.update_status(NodeStatus.PENDING)
-    node.create_time = time.time() - 100
+    node.create_time = time.monotonic() - 100
     jm.check_pending_nodes()
     assert jm.job_stage == JobStage.FAILED
 
@@ -160,7 +160,7 @@ def test_pending_wait_strategy_does_nothing():
     node = jm.nodes[1]
     node.update_status(NodeStatus.FAILED)
     node.update_status(NodeStatus.PENDING)
-    node.create_time = time.time() - 100
+    node.create_time = time.monotonic() - 100
     jm.check_pending_nodes()
     assert not node.is_released
     assert jm.job_stage == JobStage.RUNNING
@@ -169,7 +169,7 @@ def test_pending_wait_strategy_does_nothing():
 def test_stale_heartbeat_before_start_is_not_dead():
     jm, _ = make_manager()
     node = jm.nodes[0]
-    node.start_time = time.time()
+    node.start_time = time.monotonic()
     node.heartbeat_time = node.start_time - 50  # predates the restart
     jm.check_heartbeats(now=node.start_time + 10_000)
     assert node.status == NodeStatus.RUNNING
@@ -178,8 +178,8 @@ def test_stale_heartbeat_before_start_is_not_dead():
 def test_heartbeat_timeout_marks_no_heartbeat():
     jm, scaler = make_manager()
     node = jm.nodes[0]
-    node.start_time = time.time() - 500
-    node.heartbeat_time = time.time() - 400
+    node.start_time = time.monotonic() - 500
+    node.heartbeat_time = time.monotonic() - 400
     jm.check_heartbeats()
     assert node.exit_reason == NodeExitReason.NO_HEARTBEAT
     assert scaler.relaunched == [0]  # budget-consuming relaunch
@@ -197,7 +197,7 @@ def test_connection_drop_declares_death_after_grace():
     try:
         jm, scaler = make_manager()
         node = jm.nodes[0]
-        node.contact_time = time.time()
+        node.contact_time = time.monotonic()
         jm.report_connection_lost(0)
         time.sleep(0.3)
         assert node.exit_reason == NodeExitReason.NO_HEARTBEAT
@@ -217,7 +217,7 @@ def test_connection_drop_with_recontact_is_benign():
     try:
         jm, _ = make_manager()
         node = jm.nodes[0]
-        node.contact_time = time.time()
+        node.contact_time = time.monotonic()
         jm.report_connection_lost(0)
         jm.record_node_contact(0, running=True)  # reconnected heartbeat
         time.sleep(0.4)
@@ -236,7 +236,7 @@ def test_connection_drop_grace_covers_idle_heartbeat_cadence():
     get_context().set("heartbeat_interval_s", 15.0)
     jm, _ = make_manager()
     node = jm.nodes[0]
-    node.contact_time = time.time()
+    node.contact_time = time.monotonic()
     jm.report_connection_lost(0)
     time.sleep(1.5)  # > conn_drop_grace_s default; << 1.5 * interval
     assert node.status == NodeStatus.RUNNING
@@ -252,7 +252,7 @@ def test_raw_contact_defuses_drop_recheck():
     try:
         jm, _ = make_manager()
         node = jm.nodes[0]
-        node.contact_time = time.time()
+        node.contact_time = time.monotonic()
         jm.report_connection_lost(0)
         jm.record_raw_contact(0)
         time.sleep(0.4)
@@ -276,7 +276,7 @@ def test_mass_connection_drops_share_one_recheck_thread():
         jm, scaler = make_manager(n=16)
         before = _threading.active_count()
         for node in jm.nodes.values():
-            node.contact_time = time.time()
+            node.contact_time = time.monotonic()
         for node_id in jm.nodes:
             jm.report_connection_lost(node_id)
         # all 16 drops ride the single recheck thread
@@ -330,7 +330,7 @@ def test_first_heartbeat_then_crash_is_detected():
     node = jm.nodes[0]
     assert node.status == NodeStatus.RUNNING
     assert node.heartbeat_time >= node.start_time
-    jm.check_heartbeats(now=time.time() + 10_000)
+    jm.check_heartbeats(now=time.monotonic() + 10_000)
     assert node.exit_reason == NodeExitReason.NO_HEARTBEAT
 
 
@@ -356,7 +356,7 @@ def test_crash_exit_code_consumes_budget():
 def test_relaunch_resets_pending_clock():
     jm, scaler = make_manager(n=2, pending_timeout_s=10)
     node = jm.nodes[0]
-    node.create_time = time.time() - 7200  # job has run for hours
+    node.create_time = time.monotonic() - 7200  # job has run for hours
     fail_node(jm, 0, NodeExitReason.PREEMPTED)
     assert node.status == NodeStatus.PENDING
     # freshly relaunched: the pending clock restarted, so the next

@@ -1,0 +1,561 @@
+"""Tests for dlrover_tpu.analysis: per-rule fixtures, the suppression
+machinery (noqa + baseline), the CLI gate, the runtime lock-order
+detector, and the whole-package CI run (`-m analysis`)."""
+
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.analysis import (
+    LockOrderDetector,
+    LockOrderViolation,
+    analyze_package,
+    analyze_source,
+    load_baseline,
+    write_baseline,
+)
+from dlrover_tpu.analysis.engine import check as engine_check
+from dlrover_tpu.analysis.engine import noqa_codes
+
+
+def rules_of(source: str):
+    return [v.rule for v in analyze_source(source)]
+
+
+# -- DLR001: wall-clock deadlines -------------------------------------------
+
+
+class TestDLR001:
+    def test_flags_deadline_arithmetic(self):
+        src = (
+            "import time\n"
+            "def f(timeout_s):\n"
+            "    deadline = time.time() + timeout_s\n"
+        )
+        assert rules_of(src) == ["DLR001"]
+
+    def test_flags_comparison(self):
+        src = (
+            "import time\n"
+            "def f(deadline):\n"
+            "    while time.time() < deadline:\n"
+            "        pass\n"
+        )
+        assert "DLR001" in rules_of(src)
+
+    def test_flags_one_hop_flow(self):
+        # x carries the wall clock into arithmetic two statements later
+        src = (
+            "import time\n"
+            "def f(start_allowed_s):\n"
+            "    now = time.time()\n"
+            "    print('hi')\n"
+            "    return now - start_allowed_s > 5\n"
+        )
+        assert "DLR001" in rules_of(src)
+
+    def test_monotonic_is_clean(self):
+        src = (
+            "import time\n"
+            "def f(timeout_s):\n"
+            "    deadline = time.monotonic() + timeout_s\n"
+            "    return time.monotonic() > deadline\n"
+        )
+        assert rules_of(src) == []
+
+    def test_reported_timestamp_is_clean(self):
+        # a bare wall timestamp that never enters arithmetic is the
+        # sanctioned use (journal/report payloads)
+        src = (
+            "import time\n"
+            "def f(report):\n"
+            "    report['ts'] = time.time()\n"
+        )
+        assert rules_of(src) == []
+
+
+# -- DLR002: raw env access ---------------------------------------------------
+
+
+class TestDLR002:
+    def test_flags_getenv_and_environ(self):
+        src = (
+            "import os\n"
+            "a = os.getenv('DLROVER_TPU_X')\n"
+            "b = os.environ['DLROVER_TPU_Y']\n"
+            "c = os.environ.get('DLROVER_TPU_Z')\n"
+        )
+        assert rules_of(src) == ["DLR002", "DLR002", "DLR002"]
+
+    def test_registry_module_is_exempt(self):
+        src = "import os\nx = os.getenv('ANY')\n"
+        path = "dlrover_tpu/common/constants.py"
+        assert [v.rule for v in analyze_source(src, path=path)] == []
+
+    def test_env_writes_are_exempt(self):
+        # tests and launchers legitimately SET env for children; only
+        # reads fork the registry's truth
+        src = "import os\nos.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        assert rules_of(src) == []
+
+    def test_accessor_is_clean(self):
+        src = (
+            "from dlrover_tpu.common.constants import ConfigKey, env_str\n"
+            "x = env_str(ConfigKey.HOST_IP)\n"
+        )
+        assert rules_of(src) == []
+
+
+# -- DLR003: silent swallow ---------------------------------------------------
+
+
+class TestDLR003:
+    def test_flags_bare_swallow(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        assert rules_of(src) == ["DLR003"]
+
+    def test_logging_handler_is_clean(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        logger.warning('g failed', exc_info=True)\n"
+        )
+        assert rules_of(src) == []
+
+    def test_reraise_is_clean(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception as e:\n"
+            "        raise RuntimeError('ctx') from e\n"
+        )
+        assert rules_of(src) == []
+
+    def test_narrow_except_is_clean(self):
+        # DLR003 polices BROAD handlers; a typed handler is a decision
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except KeyError:\n"
+            "        pass\n"
+        )
+        assert rules_of(src) == []
+
+
+# -- DLR004: blocking under lock ---------------------------------------------
+
+
+class TestDLR004:
+    def test_flags_sleep_under_lock(self):
+        src = (
+            "import time\n"
+            "def f(self):\n"
+            "    with self._lock:\n"
+            "        time.sleep(1)\n"
+        )
+        assert rules_of(src) == ["DLR004"]
+
+    def test_flags_rpc_result_under_lock(self):
+        src = (
+            "def f(self):\n"
+            "    with self._state_lock:\n"
+            "        self._future.result()\n"
+        )
+        assert rules_of(src) == ["DLR004"]
+
+    def test_cond_wait_is_exempt(self):
+        # Condition.wait RELEASES the lock while blocking — flagging it
+        # would poison every condition variable in the codebase
+        src = (
+            "def f(self):\n"
+            "    with self._cond:\n"
+            "        self._cond.wait(1.0)\n"
+        )
+        assert rules_of(src) == []
+
+    def test_plain_mutation_under_lock_is_clean(self):
+        src = (
+            "def f(self):\n"
+            "    with self._lock:\n"
+            "        self._conns.pop('k', None)\n"
+            "        self._count += 1\n"
+        )
+        assert rules_of(src) == []
+
+
+# -- DLR005: hand-rolled retry loops -----------------------------------------
+
+
+class TestDLR005:
+    def test_flags_urlopen_retry_loop(self):
+        src = (
+            "import time, urllib.request\n"
+            "def f(url):\n"
+            "    for _ in range(5):\n"
+            "        try:\n"
+            "            return urllib.request.urlopen(url)\n"
+            "        except OSError:\n"
+            "            time.sleep(1)\n"
+        )
+        assert "DLR005" in rules_of(src)
+
+    def test_retry_module_is_exempt(self):
+        src = (
+            "import time, urllib.request\n"
+            "def f(url):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return urllib.request.urlopen(url)\n"
+            "        except OSError:\n"
+            "            time.sleep(1)\n"
+        )
+        path = "dlrover_tpu/common/retry.py"
+        assert [v.rule for v in analyze_source(src, path=path)] == []
+
+    def test_loop_without_sleep_is_clean(self):
+        # no backoff = not a retry loop shape (e.g. iterating URLs once)
+        src = (
+            "import urllib.request\n"
+            "def f(urls):\n"
+            "    for u in urls:\n"
+            "        urllib.request.urlopen(u)\n"
+        )
+        assert rules_of(src) == []
+
+
+# -- DLR006: ad-hoc event/metric names ---------------------------------------
+
+
+class TestDLR006:
+    def test_flags_literal_journal_kind(self):
+        src = (
+            "def f(self):\n"
+            "    self._journal.record('rdzv_start', round=1)\n"
+        )
+        assert rules_of(src) == ["DLR006"]
+
+    def test_flags_literal_report_event(self):
+        src = (
+            "def f(self):\n"
+            "    self._client.report_event('my_event', {})\n"
+        )
+        assert rules_of(src) == ["DLR006"]
+
+    def test_constant_kind_is_clean(self):
+        src = (
+            "from dlrover_tpu.observability.journal import JournalEvent\n"
+            "def f(self):\n"
+            "    self._journal.record(JournalEvent.RDZV_START, round=1)\n"
+        )
+        assert rules_of(src) == []
+
+    def test_flags_off_prefix_metric_name(self):
+        src = (
+            "def f(registry):\n"
+            "    registry.counter('my-metric', 'help text')\n"
+        )
+        assert rules_of(src) == ["DLR006"]
+
+    def test_prefixed_metric_name_is_clean(self):
+        src = (
+            "def f(registry):\n"
+            "    registry.counter('dlrover_rdzv_rounds', 'help text')\n"
+        )
+        assert rules_of(src) == []
+
+
+# -- suppression machinery ----------------------------------------------------
+
+
+class TestSuppression:
+    def test_noqa_requires_explicit_code(self):
+        flagged = (
+            "import time\n"
+            "def f(t):\n"
+            "    deadline = time.time() + t  # noqa\n"
+        )
+        suppressed = (
+            "import time\n"
+            "def f(t):\n"
+            "    deadline = time.time() + t  # noqa: DLR001 — wall on purpose\n"
+        )
+        assert rules_of(flagged) == ["DLR001"]  # bare noqa does NOT count
+        assert rules_of(suppressed) == []
+
+    def test_noqa_code_parsing(self):
+        assert noqa_codes("x = 1  # noqa: DLR001,DLR004") == {
+            "DLR001", "DLR004"
+        }
+        assert noqa_codes("x = 1  # noqa") == frozenset()
+        assert noqa_codes("x = 1") == frozenset()
+
+    def test_baseline_roundtrip_and_staleness(self, tmp_path):
+        src = (
+            "import time\n"
+            "def f(t):\n"
+            "    deadline = time.time() + t\n"
+        )
+        violations = analyze_source(src, path="pkg/mod.py")
+        assert len(violations) == 1
+        path = str(tmp_path / "baseline.txt")
+        write_baseline(violations, path)
+
+        baseline = load_baseline(path)
+        report = engine_check(violations, baseline)
+        assert report.ok and not report.new and not report.stale_baseline
+
+        # a NEW violation (different line text) is not covered
+        src2 = src + "    cutoff = time.time() + 2 * t\n"
+        report2 = engine_check(
+            analyze_source(src2, path="pkg/mod.py"), baseline
+        )
+        assert not report2.ok and len(report2.new) == 1
+
+        # fixing the baselined line leaves a stale entry to prune
+        report3 = engine_check([], baseline)
+        assert report3.ok and len(report3.stale_baseline) == 1
+
+    def test_syntax_error_surfaces_as_dlr000(self):
+        assert rules_of("def broken(:\n") == ["DLR000"]
+
+
+# -- whole-package CI gate ----------------------------------------------------
+
+
+@pytest.mark.analysis
+def test_package_passes_static_analysis():
+    """The tier-1 gate: the analyzer over the whole dlrover_tpu package
+    must report zero violations beyond the checked-in baseline. On
+    failure, conftest prints the triage/repro instructions."""
+    report = analyze_package()
+    assert report.ok, (
+        f"{len(report.new)} new static-analysis violation(s):\n"
+        + "\n".join(v.render() for v in report.new)
+        + "\nrepro: python -m dlrover_tpu.analysis --check"
+    )
+
+
+@pytest.mark.analysis
+def test_baseline_has_no_stale_entries():
+    """A fixed violation must also be pruned from the baseline, or the
+    suppression set rots into covering future regressions."""
+    report = analyze_package()
+    assert not report.stale_baseline, (
+        "stale baseline entries (violations already fixed — regenerate "
+        "with python -m dlrover_tpu.analysis --update-baseline):\n"
+        + "\n".join(f"{r} {p} | {t}" for r, p, t in report.stale_baseline)
+    )
+
+
+def test_cli_check_gate_and_exit_codes(tmp_path):
+    # the shipped tree passes --check against the shipped baseline
+    proc = subprocess.run(
+        [sys.executable, "-m", "dlrover_tpu.analysis", "--check"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # a file with a fresh violation fails --check with the repro hint
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "def f(t):\n"
+        "    deadline = time.time() + t\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "dlrover_tpu.analysis", "--check",
+         "--no-baseline", str(bad)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "DLR001" in proc.stdout
+    assert "repro: python -m dlrover_tpu.analysis --check" in proc.stdout
+
+
+def test_cli_stays_import_light():
+    """The CLI must be runnable in pre-commit/CI contexts without jax —
+    importing the analyzer must not drag in the heavy runtime."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.modules['jax'] = None\n"
+         "import dlrover_tpu.analysis.cli\n"
+         "import dlrover_tpu.analysis.rules\n"
+         "import dlrover_tpu.analysis.lock_order\n"
+         "print('ok')"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0 and "ok" in proc.stdout, proc.stderr
+
+
+# -- runtime lock-order detector ---------------------------------------------
+
+
+class TestLockOrderDetector:
+    def _inversion(self, detector):
+        """Drive a textbook A→B / B→A inversion across two threads,
+        sequentially so it records the order without deadlocking."""
+        lock_a = detector.make_lock("lock_a")
+        lock_b = detector.make_lock("lock_b")
+
+        def ab():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def ba():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        for fn in (ab, ba):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+
+    def test_inversion_names_both_locks_and_stacks(self):
+        detector = LockOrderDetector()
+        detector.install()
+        try:
+            self._inversion(detector)
+        finally:
+            detector.uninstall()
+        assert detector.violations
+        with pytest.raises(LockOrderViolation) as exc:
+            detector.check()
+        msg = str(exc.value)
+        assert "lock_a" in msg and "lock_b" in msg
+        # both acquisition stacks are part of the report
+        assert "acquired at" in msg
+        assert "test_static_analysis.py" in msg
+
+    def test_consistent_order_is_clean(self):
+        detector = LockOrderDetector()
+        detector.install()
+        try:
+            lock_a = detector.make_lock("a")
+            lock_b = detector.make_lock("b")
+
+            def ab():
+                with lock_a:
+                    with lock_b:
+                        pass
+
+            threads = [threading.Thread(target=ab) for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            detector.uninstall()
+        detector.check()  # must not raise
+
+    def test_patched_threading_lock_is_tracked(self):
+        # code under test creates locks via threading.Lock() — the
+        # installed detector must see those too
+        detector = LockOrderDetector()
+        detector.install()
+        try:
+            lock_a = threading.Lock()
+            lock_b = threading.Lock()
+            with lock_a:
+                with lock_b:
+                    pass
+
+            def ba():
+                with lock_b:
+                    with lock_a:
+                        pass
+
+            t = threading.Thread(target=ba)
+            t.start()
+            t.join()
+        finally:
+            detector.uninstall()
+        assert detector.violations
+
+    def test_rlock_reentry_is_not_an_edge(self):
+        detector = LockOrderDetector()
+        detector.install()
+        try:
+            rlock = detector.make_rlock("re")
+
+            def re_enter():
+                with rlock:
+                    with rlock:
+                        pass
+
+            t = threading.Thread(target=re_enter)
+            t.start()
+            t.join()
+        finally:
+            detector.uninstall()
+        detector.check()  # reentrancy must not self-cycle
+
+    def test_condition_wait_works_under_instrumentation(self):
+        # Condition delegates to the lock's private _release_save/
+        # _acquire_restore/_is_owned protocol — the wrapper must honor it
+        detector = LockOrderDetector()
+        detector.install()
+        try:
+            cond = threading.Condition(threading.Lock())
+            done = []
+
+            def waiter():
+                with cond:
+                    while not done:
+                        cond.wait(timeout=5.0)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cond:
+                done.append(True)
+                cond.notify_all()
+            t.join(timeout=5.0)
+            assert not t.is_alive()
+        finally:
+            detector.uninstall()
+        detector.check()
+
+    def test_uninstall_restores_factories(self):
+        real_lock = threading.Lock
+        detector = LockOrderDetector()
+        detector.install()
+        assert threading.Lock is not real_lock
+        detector.uninstall()
+        assert threading.Lock is real_lock
+
+    def test_fixture_provokes_failure(self, request):
+        """The conftest `lock_order_guard` fixture must fail a test that
+        inverts lock order. Exercised directly (getfixturevalue) so the
+        failure is observable instead of failing THIS test."""
+        detector = LockOrderDetector()
+        detector.install()
+        try:
+            self._inversion(detector)
+        finally:
+            detector.uninstall()
+        with pytest.raises(LockOrderViolation):
+            detector.check()
+
+
+def test_lock_order_guard_fixture_clean_path(lock_order_guard):
+    """The opt-in fixture: consistent ordering passes teardown check."""
+    a = lock_order_guard.make_lock("fixture_a")
+    b = lock_order_guard.make_lock("fixture_b")
+    with a:
+        with b:
+            pass
