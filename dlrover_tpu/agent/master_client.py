@@ -201,7 +201,8 @@ class MasterClient:
         )
 
     def heartbeat(self, global_step: int = 0, step_timestamp: float = 0.0,
-                  gauges=None, rdzv_round: int = -1) -> comm.HeartbeatResponse:
+                  gauges=None, rdzv_round: int = -1,
+                  op_telemetry=None) -> comm.HeartbeatResponse:
         # bounded budget (2 attempts, ~3s deadline): a heartbeat that can't
         # get through IS the partition signal the agent's degraded-mode
         # detector consumes — the old 30-attempt default hid it for minutes
@@ -214,6 +215,7 @@ class MasterClient:
                 step_timestamp=step_timestamp,
                 gauges=gauges or {},
                 rdzv_round=rdzv_round,
+                op_telemetry=op_telemetry or {},
             ),
             policy=retry.HEARTBEAT,
         )

@@ -23,6 +23,9 @@ TRAINING_METRICS_DICT = "training_metrics"
 # SharedDict key prefix for worker-published device memory
 # (worker.publish_step writes f"{HBM_KEY_PREFIX}{local_rank}")
 HBM_KEY_PREFIX = "hbm/"
+# SharedDict key prefix for worker-published cumulative op-class telemetry
+# snapshots (worker.publish_step writes f"{OPTEL_KEY_PREFIX}{local_rank}")
+OPTEL_KEY_PREFIX = "optel/"
 
 
 def collect_host_usage() -> Dict[str, float]:
@@ -68,6 +71,42 @@ def device_stats_from_ipc(ipc_server) -> Dict[int, Dict[str, float]]:
             # must not take down the whole resource report
             logger.warning("ignoring malformed device-memory entry %r", key)
     return stats
+
+
+class OpTelemetryCollector:
+    """Scrape the ``optel/<local_rank>`` snapshots workers publish through
+    the SharedDict and re-key them by *global* rank for the heartbeat
+    uplink — the master's skew monitor compares ranks across hosts, so the
+    local-rank keying of the IPC dict is an implementation detail that
+    stops here. Stateless: workers publish cumulative histograms, the
+    master does the windowing."""
+
+    def __init__(self, ipc_server):
+        self._ipc_server = ipc_server
+
+    def collect(self) -> Dict[str, Dict]:
+        """``{str(global_rank): snapshot}`` — string keys survive msgpack
+        map encoding unambiguously. Empty dict when nothing published yet
+        (heartbeat then omits the field)."""
+        out: Dict[str, Dict] = {}
+        try:
+            metrics = dict(self._ipc_server.local_dict(TRAINING_METRICS_DICT))
+        except Exception:  # noqa: DLR003 — IPC briefly down (worker
+            # restart in flight) means one heartbeat without telemetry;
+            # logging every beat of an outage would flood the agent log
+            return out
+        for key, value in metrics.items():
+            if not isinstance(key, str) or \
+                    not key.startswith(OPTEL_KEY_PREFIX):
+                continue
+            try:
+                snap = dict(value)
+                rank = int(snap.get("rank", key[len(OPTEL_KEY_PREFIX):]))
+                out[str(rank)] = snap
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed op-telemetry entry %r",
+                               key)
+        return out
 
 
 class ResourceMonitor:

@@ -211,6 +211,11 @@ class ElasticTrainingAgent:
             ipc_server=self._ipc_server,
             local_world_size=config.nproc_per_node,
         )
+        # worker-published op-class histograms re-keyed by global rank for
+        # the heartbeat uplink (master/skew_monitor.py consumes them)
+        from dlrover_tpu.agent.monitor import OpTelemetryCollector
+
+        self._op_telemetry = OpTelemetryCollector(self._ipc_server)
         self._events = get_emitter(f"agent_{config.node_rank}")
         self._training_monitor = None
         self._replica_service = None
@@ -464,6 +469,7 @@ class ElasticTrainingAgent:
                     step_timestamp=self._last_step_ts,
                     gauges=self._diagnosis.collect_gauges(),
                     rdzv_round=self._current_round,
+                    op_telemetry=self._op_telemetry.collect(),
                 )
             except ConnectionError:
                 self._note_heartbeat_failure()
@@ -523,6 +529,31 @@ class ElasticTrainingAgent:
         with self._action_lock:
             pending, self._pending_action = self._pending_action, None
             return pending if pending is not None else (None, {})
+
+    def _capture_stack_dump(self, action_data: dict) -> None:
+        """Serve a master-requested STACK_DUMP (RuntimeStragglerDiagnostician
+        flagged one of this node's ranks): xprof requests to every local
+        worker plus the daemon's stack RPC, then acknowledge via the journal
+        so the operator can correlate verdict → evidence."""
+        import threading as _threading
+
+        def _capture():
+            try:
+                self._diagnosis._request_worker_profiles()
+                path = self._diagnosis.capture_worker_stacks()
+                self._client.report_event(
+                    JournalEvent.STACK_DUMP_CAPTURED,
+                    {"rank": action_data.get("rank", -1),
+                     "cause": action_data.get("cause", ""),
+                     "path": path},
+                )
+            except Exception:  # noqa: BLE001 — evidence capture is
+                # best-effort; the training plane must stay untouched
+                logger.warning("stack-dump capture failed", exc_info=True)
+
+        _threading.Thread(
+            target=_capture, name="stack-dump", daemon=True
+        ).start()
 
     def observe_global_step(self, step: int, ts: float) -> None:
         if self._last_step_ts == 0.0:
@@ -702,6 +733,13 @@ class ElasticTrainingAgent:
                     f"({action_data.get('reason', '')})",
                     grace_s=grace,
                 )
+                continue
+            if action == DiagnosisActionType.STACK_DUMP:
+                # skew monitor flagged one of this node's ranks as a
+                # straggler: capture evidence (xprof + py/native stacks)
+                # WITHOUT restarting anything — runs on a background
+                # thread because gdb attach can take ~20s per worker
+                self._capture_stack_dump(action_data)
                 continue
             if action == DiagnosisActionType.RELAUNCH_WORKER:
                 # pod-level: exit so the master's relaunch ladder replaces

@@ -232,6 +232,10 @@ class HeartbeatRequest:
     # profiler-plane gauges (tpu_timer hang/latency families) forwarded so
     # the master's hang diagnostician can require all-node agreement
     gauges: Dict[str, float] = field(default_factory=dict)
+    # cumulative per-rank op-class telemetry snapshots, keyed by
+    # str(global_rank) (observability/op_telemetry.py wire format) —
+    # consumed by master/skew_monitor.py for skew/hang attribution
+    op_telemetry: Dict[str, Any] = field(default_factory=dict)
 
 
 @message

@@ -133,6 +133,8 @@ class DiagnosisActionType:
     # agent-level
     RESTART_WORKER = "restart_worker"
     RELAUNCH_WORKER = "relaunch_worker"
+    # capture py-stacks / xprof from a straggling rank without restarting it
+    STACK_DUMP = "stack_dump"
     # master-level
     MASTER_RELAUNCH_WORKER = "master_relaunch_worker"
     JOB_ABORT = "job_abort"
@@ -249,8 +251,17 @@ class ConfigKey:
     DIST_SHUTDOWN_S = "DLROVER_TPU_DIST_SHUTDOWN_S"
     DIST_HEARTBEAT_S = "DLROVER_TPU_DIST_HEARTBEAT_S"
     TRACE_FUNCS = "DLROVER_TPU_TRACE_FUNCS"
+    # tpu_timer / profiler (observability/)
+    TPU_TIMER_LIB = "TPU_TIMER_LIB"
+    TPU_TIMER_PORT = "TPU_TIMER_PORT"
+    TPU_TIMER_DAEMON_PATH = "TPU_TIMER_DAEMON_PATH"
+    TPU_LIBRARY_PATH = "TPU_LIBRARY_PATH"
+    PROFILE_DIR = "DLROVER_TPU_PROFILE_DIR"
     # diagnosis
     CHECK_TIMEOUT_S = "DLROVER_TPU_CHECK_TIMEOUT_S"
+    # skew / hang attribution (master/skew_monitor.py)
+    SKEW_THRESHOLD = "DLROVER_TPU_SKEW_THRESHOLD"
+    SKEW_WINDOW = "DLROVER_TPU_SKEW_WINDOW"
     # chaos / observability
     FAULT_SCHEDULE = "DLROVER_FAULT_SCHEDULE"
     FAULT_SEED = "DLROVER_FAULT_SEED"

@@ -141,6 +141,58 @@ class MetricStallDiagnostician(Diagnostician):
         )
 
 
+class RuntimeStragglerDiagnostician(Diagnostician):
+    """Act on the SkewMonitor's verdicts (master/skew_monitor.py): a
+    straggler verdict becomes a STACK_DUMP action targeted at the culprit
+    rank's node — the agent captures py/native stacks plus an xprof trace
+    via the existing profiler signal path, so the evidence of *why* the
+    rank is slow lands next to the verdict that flagged it. A hang verdict
+    is evidence-only (the journal already carries the attribution; the
+    hang *restart* policy stays with TrainingHangDiagnostician).
+
+    Deduped per verdict episode: a straggler that persists across
+    diagnosis periods triggers one dump, re-armed only when the verdict
+    clears and re-fires."""
+
+    name = "runtime_straggler"
+
+    def __init__(self, skew_monitor):
+        self._skew_monitor = skew_monitor
+        self._acted: set = set()
+
+    def observe(self, **kwargs) -> Observation:
+        if self._skew_monitor is None:
+            return Observation()
+        verdicts = self._skew_monitor.current_verdicts()
+        current = {(s["rank"], s["cause"]) for s in verdicts["stragglers"]}
+        self._acted &= current  # re-arm cleared verdicts
+        fresh = [s for s in verdicts["stragglers"]
+                 if (s["rank"], s["cause"]) not in self._acted]
+        if not fresh:
+            return Observation()
+        return Observation("runtime_straggler", {"stragglers": fresh})
+
+    def resolve(self, observation: Observation, **kwargs) -> DiagnosisAction:
+        # worst offender first; one dump request per diagnosis period is
+        # enough (the queue dedups per (action, instance) anyway)
+        worst = max(observation.data["stragglers"],
+                    key=lambda s: s.get("ratio", 0.0))
+        self._acted.add((worst["rank"], worst["cause"]))
+        logger.warning(
+            "runtime straggler rank %s (%s %.2fx median) — requesting "
+            "stack dump from node %s",
+            worst["rank"], worst["cause"], worst.get("ratio", 0.0),
+            worst.get("node_id", -1),
+        )
+        return DiagnosisAction(
+            DiagnosisActionType.STACK_DUMP,
+            instance=worst.get("node_id", DiagnosisConstant.ANY_INSTANCE),
+            reason=f"straggler rank {worst['rank']} ({worst['cause']})",
+            data={"rank": worst["rank"], "cause": worst["cause"],
+                  "ratio": worst.get("ratio", 0.0)},
+        )
+
+
 class DiagnosisMaster:
     """Composes pre-check + periodic diagnosis (reference
     diagnosis_master.py:72)."""
@@ -152,6 +204,7 @@ class DiagnosisMaster:
         precheck_ops: Optional[List[str]] = None,
         metric_context=None,
         event_journal=None,
+        skew_monitor=None,
     ):
         ctx = get_context()
         self._job_manager = job_manager
@@ -181,6 +234,11 @@ class DiagnosisMaster:
             MetricStallDiagnostician(metric_context),
             period_s=ctx.diagnosis_interval_s,
         )
+        if skew_monitor is not None:
+            self._registry.register(
+                RuntimeStragglerDiagnostician(skew_monitor),
+                period_s=ctx.diagnosis_interval_s,
+            )
         self._precheck_thread: Optional[threading.Thread] = None
 
     def _sink_action(self, action: DiagnosisAction) -> None:

@@ -98,6 +98,19 @@ class JobMaster:
         self.rdzv_managers[RendezvousName.TRAINING].journal = (
             self.event_journal
         )
+        # cross-worker skew & hang attribution over the op-telemetry the
+        # agents ship on their heartbeats (master/skew_monitor.py): feeds
+        # the journal, /metrics gauges, the RuntimeStragglerDiagnostician,
+        # and rdzv world-cut straggler history
+        from dlrover_tpu.master.skew_monitor import SkewMonitor
+
+        self.skew_monitor = SkewMonitor(
+            event_journal=self.event_journal,
+            registry=self.metrics_registry,
+        )
+        self.rdzv_managers[RendezvousName.TRAINING].straggler_history = (
+            self.skew_monitor.node_straggler_counts
+        )
         if diagnosis_master is None:
             from dlrover_tpu.diagnosis.diagnosis_master import DiagnosisMaster
 
@@ -105,6 +118,7 @@ class JobMaster:
                 self.job_manager, self.perf_monitor,
                 metric_context=self.metric_context,
                 event_journal=self.event_journal,
+                skew_monitor=self.skew_monitor,
             )
         self.diagnosis_master = diagnosis_master
         self.servicer = MasterServicer(
@@ -118,6 +132,7 @@ class JobMaster:
             metric_context=self.metric_context,
             strategy_generator=self.strategy_generator,
             event_journal=self.event_journal,
+            skew_monitor=self.skew_monitor,
         )
         # bridge journal kinds into PerfMonitor's lost-time bookkeeping —
         # fault_happened/fault_recovered get their (only) callers here

@@ -75,6 +75,10 @@ class RendezvousManager(ABC):
         # master attaches its EventJournal to the TRAINING manager only
         # (NODE_CHECK rounds would pollute goodput attribution)
         self.journal = None
+        # master attaches SkewMonitor.node_straggler_counts here: when a
+        # cut must drop nodes (node_unit truncation), repeat-offender
+        # stragglers go first instead of blindly keeping the lowest ranks
+        self.straggler_history = None
         from dlrover_tpu.observability.registry import get_registry
 
         reg = get_registry()
@@ -212,7 +216,7 @@ class RendezvousManager(ABC):
         world_size = (world_size // unit) * unit
         if world_size < max(params.min_nodes, unit):
             return False
-        ranks = sorted(self._waiting_nodes.keys())[:world_size]
+        ranks = self._select_world_ranks(world_size)
         self._rdzv_nodes = {r: self._waiting_nodes[r] for r in ranks}
         # topology-aware comm order: slice-contiguous, torus order within
         # a slice (net_topology.py; the reference's asw/psw DpTopologySorter
@@ -247,6 +251,41 @@ class RendezvousManager(ABC):
             sorted(self._waiting_nodes),
         )
         return True
+
+    def _select_world_ranks(self, world_size: int) -> List[int]:
+        """Which waiting nodes make the cut. Caller holds ``self._lock``.
+
+        Default (and whenever nothing must be dropped): the lowest node
+        ranks, as in the reference. When truncation drops nodes AND the
+        master wired in runtime straggler history
+        (``self.straggler_history``, SkewMonitor.node_straggler_counts),
+        repeat offenders are dropped first — a chronically slow node
+        should be the one left waiting, not a healthy one."""
+        waiting = sorted(self._waiting_nodes.keys())
+        if len(waiting) <= world_size or self.straggler_history is None:
+            return waiting[:world_size]
+        try:
+            counts = dict(self.straggler_history())
+        except Exception:  # noqa: BLE001 — history is advisory only
+            logger.warning("straggler history unavailable for world cut",
+                           exc_info=True)
+            return waiting[:world_size]
+        if not any(counts.values()):
+            return waiting[:world_size]
+
+        def straggles(rank: int) -> int:
+            meta = self._waiting_nodes[rank]
+            return int(counts.get(getattr(meta, "node_id", rank), 0))
+
+        ranks = sorted(waiting, key=lambda r: (straggles(r), r))[:world_size]
+        excluded = [r for r in waiting if r not in ranks]
+        if excluded:
+            logger.warning(
+                "%s world cut dropped straggler-history nodes %s "
+                "(counts %s)", self._name, excluded,
+                {r: straggles(r) for r in excluded},
+            )
+        return sorted(ranks)
 
     @abstractmethod
     def get_comm_world(

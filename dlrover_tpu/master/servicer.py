@@ -33,6 +33,7 @@ class MasterServicer:
         metric_context=None,
         strategy_generator=None,
         event_journal=None,
+        skew_monitor=None,
     ):
         self._job_manager = job_manager
         self._rdzv_managers = rdzv_managers
@@ -44,6 +45,7 @@ class MasterServicer:
         self._metric_context = metric_context
         self._strategy_generator = strategy_generator
         self._event_journal = event_journal
+        self._skew_monitor = skew_monitor
         self._start_time = time.monotonic()  # uptime base
 
     # -- rendezvous --------------------------------------------------------
@@ -207,6 +209,8 @@ class MasterServicer:
             )
         if self._diagnosis_master is not None:
             self._diagnosis_master.observe_heartbeat(req)
+        if self._skew_monitor is not None and req.op_telemetry:
+            self._skew_monitor.observe(req.node_id, req.op_telemetry)
         return comm.HeartbeatResponse(
             action_type=action.action_type,
             action_data={"reason": action.reason, **action.data},

@@ -12,6 +12,13 @@ from dlrover_tpu.observability.journal import (
     attribute_phases,
     phase_segments,
 )
+from dlrover_tpu.observability.op_telemetry import (
+    OpClass,
+    OpClassHistogram,
+    OpTelemetryAccumulator,
+    get_accumulator,
+    reset_accumulator,
+)
 from dlrover_tpu.observability.registry import (
     MetricsRegistry,
     get_registry,
@@ -28,4 +35,6 @@ __all__ = [
     "TpuTimer", "find_library", "install_tracepoints", "trace_function",
     "EventJournal", "JournalEvent", "Phase", "attribute_phases",
     "phase_segments", "MetricsRegistry", "get_registry", "reset_registry",
+    "OpClass", "OpClassHistogram", "OpTelemetryAccumulator",
+    "get_accumulator", "reset_accumulator",
 ]

@@ -50,12 +50,18 @@ class JournalEvent:
     CKPT_REPAIRED = "ckpt_repaired"
     PARTITION_RESYNC = "partition_resync"
     SHM_ORPHANS_CLEANED = "shm_orphans_cleaned"
+    # skew/hang attribution (master/skew_monitor.py verdicts + the agent's
+    # acknowledgement that a requested stack dump landed on disk)
+    STRAGGLER_DETECTED = "straggler_detected"
+    HANG_ATTRIBUTED = "hang_attributed"
+    STACK_DUMP_CAPTURED = "stack_dump_captured"
 
     ALL = (
         FAULT_DETECTED, RDZV_START, RDZV_COMPLETE, RESTORE_START,
         RESTORE_COMPLETE, RECOMPILE_START, RECOMPILE_COMPLETE, STEP_RESUMED,
         FAULT_INJECTED, CKPT_CORRUPT, CKPT_REPAIRED, PARTITION_RESYNC,
-        SHM_ORPHANS_CLEANED,
+        SHM_ORPHANS_CLEANED, STRAGGLER_DETECTED, HANG_ATTRIBUTED,
+        STACK_DUMP_CAPTURED,
     )
 
 

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Optional
 
+from dlrover_tpu.common.constants import ConfigKey, env_str
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.multi_process import SharedDict
 
@@ -48,8 +49,8 @@ class ProfileListener:
                  out_root: Optional[str] = None, poll_s: float = 1.0):
         self._dict = SharedDict(PROFILE_DICT, ipc_socket)
         self._local_rank = local_rank
-        self._out_root = out_root or os.getenv(
-            "DLROVER_TPU_PROFILE_DIR", "/tmp/dlrover_tpu_profiles"
+        self._out_root = out_root or env_str(
+            ConfigKey.PROFILE_DIR, "/tmp/dlrover_tpu_profiles"
         )
         self._poll_s = poll_s
         self._last_id = None
@@ -138,8 +139,8 @@ def request_profile(profile_dict, local_rank: int,
 
 def await_profile(profile_dict, local_rank: int, req_id: str,
                   timeout_s: float = 60.0) -> Optional[dict]:
-    deadline = time.time() + timeout_s
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
         done = profile_dict.get(done_key(local_rank))
         if done and done.get("id") == req_id:
             return done

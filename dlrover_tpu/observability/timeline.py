@@ -14,6 +14,7 @@ import subprocess
 import urllib.request
 from typing import List, Optional
 
+from dlrover_tpu.common.constants import ConfigKey, env_str
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability.tpu_timer import (
     DAEMON_PORT,
@@ -50,8 +51,9 @@ def fetch_journal(master_http_addr: str,
         return None
 
 
-# pid for the synthetic "job phases" track — far above any worker rank
+# pids for the synthetic tracks — far above any worker rank
 _JOB_PHASES_PID = 9999
+_SKEW_TRACK_PID = 9998
 
 
 def job_phase_events(journal: dict) -> List[dict]:
@@ -90,6 +92,52 @@ def job_phase_events(journal: dict) -> List[dict]:
     return events
 
 
+def skew_track_events(journal: dict) -> List[dict]:
+    """Chrome-trace events for the skew monitor's verdicts: a per-rank
+    counter ("C") track of the skew ratio at each ``straggler_detected``
+    verdict, plus an instant per ``hang_attributed`` verdict — so the
+    moment a rank fell behind lines up with its kernel/collective slices
+    in the same perfetto load."""
+    from dlrover_tpu.observability.journal import JournalEvent
+
+    raw = journal.get("events", [])
+    events: List[dict] = [
+        {
+            "ph": "M", "pid": _SKEW_TRACK_PID, "name": "process_name",
+            "args": {"name": "cross-worker skew"},
+        },
+        {
+            "ph": "M", "pid": _SKEW_TRACK_PID, "tid": 0,
+            "name": "thread_name", "args": {"name": "skew verdicts"},
+        },
+    ]
+    for e in raw:
+        kind = e.get("kind", "")
+        data = e.get("data", {}) or {}
+        ts_us = float(e.get("t", 0.0)) * 1e6
+        if kind == JournalEvent.STRAGGLER_DETECTED:
+            events.append({
+                "ph": "C", "pid": _SKEW_TRACK_PID, "tid": 0,
+                "name": "skew_ratio", "cat": "skew", "ts": ts_us,
+                "args": {f"rank{data.get('rank', '?')}":
+                         float(data.get("ratio", 0.0))},
+            })
+            events.append({
+                "ph": "i", "pid": _SKEW_TRACK_PID, "tid": 0, "s": "p",
+                "name": (f"straggler rank{data.get('rank', '?')} "
+                         f"({data.get('cause', '?')})"),
+                "cat": "skew", "ts": ts_us, "args": dict(data),
+            })
+        elif kind == JournalEvent.HANG_ATTRIBUTED:
+            events.append({
+                "ph": "i", "pid": _SKEW_TRACK_PID, "tid": 0, "s": "p",
+                "name": (f"hang in {data.get('collective', '?')} "
+                         f"missing={data.get('missing_ranks', [])}"),
+                "cat": "skew", "ts": ts_us, "args": dict(data),
+            })
+    return events
+
+
 def merge_timelines(
     out_path: str,
     ports: Optional[List[int]] = None,
@@ -122,13 +170,14 @@ def merge_timelines(
         journal = fetch_journal(master_http_addr)
         if journal is not None:
             events.extend(job_phase_events(journal))
+            events.extend(skew_track_events(journal))
     with open(out_path, "w") as f:
         json.dump({"traceEvents": events}, f)
     return found
 
 
 def find_daemon_binary() -> Optional[str]:
-    cand = os.environ.get("TPU_TIMER_DAEMON_PATH")
+    cand = env_str(ConfigKey.TPU_TIMER_DAEMON_PATH)
     if cand and os.path.exists(cand):
         return cand
     here = os.path.dirname(os.path.dirname(os.path.dirname(

@@ -27,10 +27,11 @@ import signal
 import time
 from typing import Optional
 
+from dlrover_tpu.common.constants import ConfigKey, env_int, env_str
 from dlrover_tpu.common.log import logger
 
-ENV_LIB = "TPU_TIMER_LIB"
-ENV_PORT = "TPU_TIMER_PORT"
+ENV_LIB = ConfigKey.TPU_TIMER_LIB
+ENV_PORT = ConfigKey.TPU_TIMER_PORT
 DEFAULT_WORKER_PORT_BASE = 18900
 DAEMON_PORT = 18889
 
@@ -41,7 +42,7 @@ KIND_MEMORY = 2
 
 def find_library() -> Optional[str]:
     """Locate libtpu_timer.so: $TPU_TIMER_LIB, then the in-repo build."""
-    cand = os.environ.get(ENV_LIB)
+    cand = env_str(ConfigKey.TPU_TIMER_LIB)
     if cand and os.path.exists(cand):
         return cand
     here = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -65,7 +66,7 @@ def find_libtpu() -> Optional[str]:
             return p
     except ImportError:
         pass
-    return os.environ.get("TPU_LIBRARY_PATH")
+    return env_str(ConfigKey.TPU_LIBRARY_PATH) or None
 
 
 class TpuTimer:
@@ -95,6 +96,9 @@ class TpuTimer:
                 logger.warning("tpu_timer native lib load failed: %s", e)
                 self._lib = None
         self._gc_t0 = 0.0
+        self._installed = False
+        self._stack_file = None
+        self._stack_signal = 0
 
     @property
     def available(self) -> bool:
@@ -119,8 +123,13 @@ class TpuTimer:
         """
         if not self._lib:
             return False
+        if self._installed:
+            # elastic re-init calls install() again; the engine, port, and
+            # faulthandler registration are already live — re-registering
+            # would leak another stack file per restart.
+            return True
         if port is None:
-            base = int(os.environ.get(ENV_PORT, DEFAULT_WORKER_PORT_BASE))
+            base = env_int(ConfigKey.TPU_TIMER_PORT, DEFAULT_WORKER_PORT_BASE)
             port = base + local_rank
         if hang_timeout_s is not None:
             self._lib.tt_set_hang_timeout(float(hang_timeout_s))
@@ -130,9 +139,11 @@ class TpuTimer:
             faulthandler.register(
                 stack_dump_signal, file=self._stack_file, all_threads=True
             )
+            self._stack_signal = int(stack_dump_signal)
             self._lib.tt_set_hang_signal(int(stack_dump_signal))
         self._lib.tt_init(int(rank), int(world_size), int(local_rank),
                           int(port))
+        self._installed = True
         if patch_pjrt:
             plugin = find_libtpu()
             if plugin:
@@ -161,12 +172,26 @@ class TpuTimer:
         return True
 
     def shutdown(self) -> None:
+        if self._stack_signal:
+            try:
+                faulthandler.unregister(self._stack_signal)
+            except (ValueError, OSError):
+                pass
+            self._stack_signal = 0
+        if self._stack_file is not None:
+            try:
+                self._stack_file.close()
+            except OSError:
+                pass
+            self._stack_file = None
+        self._installed = False
         if self._lib:
             self._lib.tt_shutdown()
 
     # -- recording ----------------------------------------------------------
     def record(self, kind: int, name: str, dur_us: float,
                payload: float = 0.0) -> None:
+        _accumulator().observe_span(kind, name, dur_us)
         if self._lib:
             self._lib.tt_record(kind, name.encode(), float(dur_us),
                                 float(payload))
@@ -184,13 +209,24 @@ class TpuTimer:
             self._t, self._kind, self._name = timer, kind, name
             self._payload = payload
             self._tok = 0
+            self._t0 = 0.0
 
         def __enter__(self):
+            # feed the pure-python op-telemetry accumulator in BOTH the
+            # native and the fallback path: collective entry is marked
+            # before the op runs (a hung collective never exits, and the
+            # skew monitor's hang verdict keys off entry markers).
+            acc = _accumulator()
+            if self._kind == KIND_COLL:
+                acc.enter_collective(self._name)
+            self._t0 = time.monotonic()
             self._tok = self._t.begin(self._kind, self._name)
             return self
 
         def __exit__(self, *exc):
             self._t.end(self._tok, self._payload)
+            dur_us = (time.monotonic() - self._t0) * 1e6
+            _accumulator().observe_span(self._kind, self._name, dur_us)
             return False
 
     def span(self, name: str, kind: int = KIND_MM,
@@ -244,6 +280,14 @@ class TpuTimer:
         return bool(self._lib) and self._lib.tt_pjrt_patched() == 1
 
 
+def _accumulator():
+    """Process-wide op-telemetry accumulator (import deferred: the two
+    modules reference each other for KIND_COLL / span feeding)."""
+    from dlrover_tpu.observability.op_telemetry import get_accumulator
+
+    return get_accumulator()
+
+
 _global_timer: Optional[TpuTimer] = None
 
 
@@ -282,10 +326,9 @@ def trace_function(fn=None, *, name: Optional[str] = None,
 
         @functools.wraps(f)
         def inner(*args, **kwargs):
-            t = get_timer()
-            if not t.available:
-                return f(*args, **kwargs)
-            with t.span(label, kind=kind):
+            # span() also feeds the pure-python accumulator, so traced
+            # functions stay visible on CPU dev boxes without the lib
+            with get_timer().span(label, kind=kind):
                 return f(*args, **kwargs)
 
         inner.__tracepoint__ = True
@@ -306,7 +349,7 @@ def install_tracepoints(specs=None) -> int:
     import importlib
 
     if specs is None:
-        env = os.getenv("DLROVER_TPU_TRACE_FUNCS", "")
+        env = env_str(ConfigKey.TRACE_FUNCS, "")
         specs = [s for s in (p.strip() for p in env.split(",")) if s]
     installed = 0
     for spec in specs:

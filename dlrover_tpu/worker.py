@@ -75,9 +75,11 @@ class WorkerContext:
             return
         from dlrover_tpu.agent.monitor import (
             HBM_KEY_PREFIX,
+            OPTEL_KEY_PREFIX,
             TRAINING_METRICS_DICT,
         )
         from dlrover_tpu.common.multi_process import SharedDict
+        from dlrover_tpu.observability.op_telemetry import get_accumulator
 
         if not hasattr(self, "_metrics_dict"):
             self._metrics_dict = SharedDict(
@@ -91,6 +93,14 @@ class WorkerContext:
             hbm = self._collect_hbm()
             if hbm:
                 payload[f"{HBM_KEY_PREFIX}{self.local_rank}"] = hbm
+        acc = get_accumulator()
+        if acc.seq:
+            # cumulative op-class histograms for the master's skew monitor;
+            # keyed by local rank in the dict, stamped with the global rank
+            # the master attributes against
+            snap = acc.snapshot()
+            snap["rank"] = self.rank
+            payload[f"{OPTEL_KEY_PREFIX}{self.local_rank}"] = snap
         try:
             self._metrics_dict.update(payload)
         except OSError:

@@ -5,7 +5,12 @@ experiments (docs/tech_report/fault_tolerance_exps.md), as one runnable
 script:
 
 1. a master (min_nodes=1, max_nodes=2) and two real agent processes
-   train a toy job at world=2;
+   train a toy job at world=2; agent 1's worker carries an injected
+   per-step compute delay (the chaos plane's ``step.compute`` site),
+   and the master's skew monitor must attribute
+   ``straggler(rank=1, cause=compute)`` from the op-telemetry uplink —
+   journal event + live ``dlrover_skew_ratio`` gauge — while both
+   nodes are still alive;
 2. one agent is SIGKILLed mid-training — the master's heartbeat monitor
    declares the node dead, shrinks the job elastically, and tells the
    survivor to re-rendezvous; the survivor resumes from checkpoint at
@@ -107,6 +112,16 @@ with open(log_path, "a") as f:
                         "w_at_start": float(np.asarray(state["w"])[0]),
                         }) + "\\n")
 em = get_emitter(f"worker_{ctx.rank}")
+# op-telemetry uplink: TpuTimer spans (pure-python fallback on CPU) feed
+# the per-class histograms that publish_step ships to the agent and the
+# agent heartbeats to the master's SkewMonitor. The drill schedules a
+# step.compute delay fault on agent 1 only, so its worker sleeps inside
+# a compute-class span — the master must attribute straggler(rank=1,
+# cause=compute) from telemetry alone, mid-drill, before the kill.
+from dlrover_tpu.chaos import get_injector
+from dlrover_tpu.observability.tpu_timer import KIND_COLL, get_timer
+timer = get_timer()
+inj = get_injector()
 # second fault type: a WEDGED worker (drill --hang-at-step). Rank 0 stops
 # stepping OUTSIDE any span (so the stall is unproductive time, honestly
 # accounted); its peer then blocks inside the next step's collective. The
@@ -117,10 +132,22 @@ hang_at = int(os.environ.get("DTPU_CHAOS_HANG_AT_STEP", "0"))
 hang_marker = os.environ.get("DTPU_CHAOS_HANG_MARKER", "")
 for s in range(start, steps):
     with em.span(TrainEvent.TRAINING, step=s, world=world):
-        w = train_step(w, x)
-        w.block_until_ready()
+        # the injected delay sits in its OWN compute-class span and the
+        # psum barrier right after it in a collective span: the slow
+        # rank's lost time lands in ITS compute histogram while its
+        # peers' matching wait lands in THEIR collective histograms —
+        # the separation the skew monitor needs to name the culprit
+        with timer.span("injected_compute"):
+            if inj is not None:
+                inj.fire("step.compute", step=s)
+        with timer.span("step_psum", kind=KIND_COLL):
+            jax.block_until_ready(psum_check(ones))
+        with timer.span("train_step"):
+            w = train_step(w, x)
+            w.block_until_ready()
         if step_time:
             time.sleep(step_time)  # pace the drill (kill timing)
+    ctx.publish_step(s)  # SharedDict: step + op-telemetry snapshot
     if ctx.rank == 0:
         ckpt.save_checkpoint(
             s, {"w": np.asarray(jax.device_get(w)), "step": s},
@@ -264,6 +291,13 @@ def main(argv=None) -> int:
 
     def start_agent(rank):
         env = dict(os.environ)
+        if rank == 1:
+            # straggler fault: agent 1's worker sleeps 0.25s inside a
+            # compute-class timer span for the first 30 steps of each
+            # incarnation (times is per-process). The skew monitor must
+            # attribute it from op telemetry BEFORE the kill lands.
+            env["DLROVER_FAULT_SCHEDULE"] = \
+                "step.compute:delay=0.25@times=30"
         if args.hang_at_step:
             env["DTPU_CHAOS_HANG_AT_STEP"] = str(args.hang_at_step)
             env["DTPU_CHAOS_HANG_MARKER"] = hang_marker
@@ -321,6 +355,32 @@ def main(argv=None) -> int:
             lambda: master.perf_monitor.completed_global_step
             >= args.kill_at_step,
             90, f"step {args.kill_at_step}",
+        )
+
+        # skew attribution: the injected slow rank must surface as a
+        # straggler_detected journal verdict naming rank 1 / compute
+        # while BOTH nodes are still alive — attribution from telemetry,
+        # not from the death the heartbeat monitor sees next
+        from dlrover_tpu.observability.journal import JournalEvent
+
+        def _compute_stragglers():
+            return [
+                e for e in master.event_journal.events()
+                if e["kind"] == JournalEvent.STRAGGLER_DETECTED
+                and e["data"].get("cause") == "compute"
+            ]
+
+        _wait(
+            lambda: bool(_compute_stragglers()),
+            60, "skew monitor attributes the injected straggler",
+        )
+        straggler = _compute_stragglers()[0]["data"]
+        _, _, skew_text = _scrape_metrics(master)
+        skew_ratio_mid = max(
+            (float(line.rsplit(" ", 1)[1])
+             for line in skew_text.splitlines()
+             if line.startswith("dlrover_skew_ratio{")),
+            default=0.0,
         )
 
         # phase 2: kill agent 1 (whole process group: agent + its worker)
@@ -430,7 +490,8 @@ def main(argv=None) -> int:
             "productive_s": round(goodput["productive_s"], 2),
             "detect_s": round(detect_s, 2),
             "shrink_detect_s": round(shrink_s, 2),
-            "faults_injected": 2 if args.hang_at_step else 1,
+            # straggler delay + SIGKILL (+ wedge when enabled)
+            "faults_injected": 3 if args.hang_at_step else 2,
             # wedge -> watchdog stall detection -> broadcast restart ->
             # training resumed past the hang step (None = fault disabled)
             "hang_recover_s": (
@@ -449,6 +510,14 @@ def main(argv=None) -> int:
             ),
             "journal_goodput_pct": journal_goodput_pct,
             "journal_events": len(master.event_journal),
+            # skew attribution (op-telemetry uplink -> SkewMonitor): the
+            # injected slow rank was named, with cause and ratio, while
+            # it was still alive — and the gauge was live on the same
+            # mid-drill scrape
+            "straggler": {
+                k: straggler.get(k) for k in ("rank", "cause", "ratio")
+            },
+            "skew_ratio_mid": round(skew_ratio_mid, 3),
             "segments": segments,
             # distributed-core proof: every segment's psum equals its
             # world size (real collectives over the joint world), and the

@@ -1,0 +1,446 @@
+"""Skew/hang attribution math (master/skew_monitor.py) driven with
+synthetic per-rank histograms — all CPU-only through the pure-Python
+op-telemetry accumulator (observability/op_telemetry.py), no native lib.
+
+Scenarios from the issue: uniform (no verdict), one slow-compute rank,
+one slow-collective rank, a missing-rank hang, and a flapping straggler;
+plus the uplink plumbing (accumulator ← TpuTimer spans, agent collector,
+heartbeat wire format) and the consumers (diagnostician action, rdzv
+world-cut history, gauges, timeline track).
+"""
+
+import pytest
+
+from dlrover_tpu.diagnosis.diagnosis_master import (
+    RuntimeStragglerDiagnostician,
+)
+from dlrover_tpu.common.constants import DiagnosisActionType
+from dlrover_tpu.observability.journal import EventJournal, JournalEvent
+from dlrover_tpu.observability.op_telemetry import (
+    BUCKET_BOUNDS_US,
+    NUM_BUCKETS,
+    OpClass,
+    OpClassHistogram,
+    OpTelemetryAccumulator,
+    classify,
+    get_accumulator,
+    reset_accumulator,
+)
+from dlrover_tpu.observability.registry import MetricsRegistry
+from dlrover_tpu.master.skew_monitor import SkewMonitor
+
+
+# -- synthetic snapshot helpers ---------------------------------------------
+
+
+def make_snapshot(
+    n: int,
+    mean_us: float = 100.0,
+    op_class: str = OpClass.COMPUTE,
+    coll_seq: int = 0,
+    coll_name: str = "all_reduce_0",
+    extra_classes: dict = None,
+):
+    """A cumulative wire snapshot with ``n`` observations of ``mean_us``."""
+    h = OpClassHistogram()
+    for _ in range(n):
+        h.observe(mean_us)
+    classes = {op_class: h.to_wire()}
+    for cls, (cn, cmean) in (extra_classes or {}).items():
+        ch = OpClassHistogram()
+        for _ in range(cn):
+            ch.observe(cmean)
+        classes[cls] = ch.to_wire()
+    return {
+        "seq": n + coll_seq,
+        "classes": classes,
+        "last_collective": {"name": coll_name, "seq": coll_seq},
+    }
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_monitor(**kw):
+    clock = FakeClock()
+    journal = EventJournal()
+    registry = MetricsRegistry()
+    kw.setdefault("window", 8)
+    monitor = SkewMonitor(
+        event_journal=journal, registry=registry, monotonic=clock, **kw
+    )
+    return monitor, journal, registry, clock
+
+
+def feed(monitor, clock, beats, step_s=1.0):
+    """``beats``: list of dicts rank → snapshot; one observe() per rank
+    per beat (each rank on its own node: node_id == rank)."""
+    for beat in beats:
+        clock.t += step_s
+        for rank, snap in beat.items():
+            monitor.observe(node_id=rank, op_telemetry={str(rank): snap})
+
+
+def journal_kinds(journal):
+    return [e["kind"] for e in journal.events()]
+
+
+# -- histogram / accumulator -------------------------------------------------
+
+
+def test_histogram_buckets_sum_max_and_wire_roundtrip():
+    h = OpClassHistogram()
+    h.observe(5.0)          # bucket 0 (≤10)
+    h.observe(100.0)        # ≤160
+    h.observe(1e9)          # overflow
+    assert sum(h.buckets) == h.count == 3
+    assert h.buckets[-1] == 1
+    assert h.max_us == 1e9
+    assert h.mean_us == pytest.approx((5.0 + 100.0 + 1e9) / 3)
+    rt = OpClassHistogram.from_wire(h.to_wire())
+    assert rt.buckets == h.buckets
+    assert rt.sum_us == h.sum_us
+    assert rt.count == h.count
+    assert len(h.buckets) == NUM_BUCKETS == len(BUCKET_BOUNDS_US) + 1
+
+
+def test_histogram_merge():
+    a, b = OpClassHistogram(), OpClassHistogram()
+    a.observe(50.0)
+    b.observe(500.0)
+    a.merge(b)
+    assert a.count == 2
+    assert a.max_us == 500.0
+    assert a.sum_us == pytest.approx(550.0)
+
+
+def test_classify_routes_kinds_and_names():
+    from dlrover_tpu.observability.tpu_timer import KIND_COLL, KIND_MM
+
+    assert classify(KIND_COLL, "whatever") == OpClass.COLLECTIVE
+    assert classify(KIND_MM, "train_step") == OpClass.COMPUTE
+    assert classify(KIND_MM, "input_fetch") == OpClass.HOST_INPUT
+    assert classify(KIND_MM, "ckpt_save") == OpClass.CKPT
+
+
+def test_accumulator_snapshot_is_cumulative_and_marks_entry():
+    acc = OpTelemetryAccumulator()
+    acc.observe(OpClass.COMPUTE, 100.0)
+    acc.enter_collective("psum_grads")
+    snap1 = acc.snapshot()
+    assert snap1["classes"][OpClass.COMPUTE]["n"] == 1
+    # entry marker is visible even though the collective never "exited"
+    assert snap1["last_collective"] == {"name": "psum_grads", "seq": 1}
+    acc.observe(OpClass.COMPUTE, 100.0)
+    snap2 = acc.snapshot()
+    assert snap2["classes"][OpClass.COMPUTE]["n"] == 2
+    assert snap2["seq"] > snap1["seq"]
+
+
+def test_timer_span_feeds_accumulator_without_native_lib():
+    from dlrover_tpu.observability.tpu_timer import KIND_COLL, TpuTimer
+
+    reset_accumulator()
+    try:
+        t = TpuTimer(lib_path="/nonexistent/libtpu_timer.so")
+        assert not t.available
+        with t.span("train_step"):
+            pass
+        with t.span("all_gather_x", kind=KIND_COLL):
+            pass
+        t.record(0, "input_fetch", 123.0)
+        snap = get_accumulator().snapshot()
+        assert snap["classes"][OpClass.COMPUTE]["n"] == 1
+        assert snap["classes"][OpClass.COLLECTIVE]["n"] == 1
+        assert snap["classes"][OpClass.HOST_INPUT]["n"] == 1
+        assert snap["last_collective"]["name"] == "all_gather_x"
+        t.shutdown()  # no lib, no stack file: must be a clean no-op
+    finally:
+        reset_accumulator()
+
+
+# -- verdicts ----------------------------------------------------------------
+
+
+def test_uniform_ranks_no_verdict():
+    monitor, journal, _, clock = make_monitor()
+    feed(monitor, clock, [
+        {r: make_snapshot(10 * b, 100.0, coll_seq=b) for r in range(4)}
+        for b in (1, 2, 3)
+    ])
+    v = monitor.current_verdicts()
+    assert v["stragglers"] == []
+    assert v["hang"] is None
+    assert journal_kinds(journal) == []
+
+
+def test_slow_compute_rank_flagged_within_two_beats():
+    monitor, journal, registry, clock = make_monitor()
+    feed(monitor, clock, [
+        {r: make_snapshot(10 * b, 350.0 if r == 3 else 100.0, coll_seq=b)
+         for r in range(4)}
+        for b in (1, 2)
+    ])
+    v = monitor.current_verdicts()
+    assert len(v["stragglers"]) == 1
+    s = v["stragglers"][0]
+    assert s["rank"] == 3
+    assert s["cause"] == OpClass.COMPUTE
+    assert s["ratio"] == pytest.approx(3.5)
+    assert s["node_id"] == 3
+    events = journal.events()
+    assert [e["kind"] for e in events] == [JournalEvent.STRAGGLER_DETECTED]
+    assert events[0]["data"]["rank"] == 3
+    text = registry.render()
+    assert 'dlrover_skew_ratio{op_class="compute"} 3.5' in text
+    assert 'dlrover_skew_straggler_rank{cause="compute"} 3' in text
+    assert 'dlrover_skew_verdicts_total{cause="compute"} 1' in text
+
+
+def test_slow_collective_rank_flagged():
+    monitor, journal, _, clock = make_monitor()
+    feed(monitor, clock, [
+        {r: make_snapshot(
+            10 * b, 100.0, coll_seq=b,
+            extra_classes={OpClass.COLLECTIVE:
+                           (10 * b, 900.0 if r == 1 else 200.0)})
+         for r in range(4)}
+        for b in (1, 2)
+    ])
+    v = monitor.current_verdicts()
+    causes = {(s["rank"], s["cause"]) for s in v["stragglers"]}
+    assert causes == {(1, OpClass.COLLECTIVE)}
+
+
+def test_two_rank_world_can_attribute():
+    # lower-median choice: with the UPPER median (rdzv get_stragglers
+    # convention) a 2-rank world could never flag anyone
+    monitor, _, _, clock = make_monitor()
+    feed(monitor, clock, [
+        {0: make_snapshot(10 * b, 100.0, coll_seq=b),
+         1: make_snapshot(10 * b, 300.0, coll_seq=b)}
+        for b in (1, 2)
+    ])
+    v = monitor.current_verdicts()
+    assert [s["rank"] for s in v["stragglers"]] == [1]
+
+
+def test_missing_rank_hang_names_collective_and_ranks():
+    monitor, journal, registry, clock = make_monitor(hang_min_samples=3)
+    # ranks 0-2 entered all_reduce_17 (seq 18); rank 3 never did (seq 17);
+    # nobody advances over 3 beats → hang verdict
+    beats = []
+    for _ in range(3):
+        beat = {
+            r: make_snapshot(30, 100.0, coll_seq=18,
+                             coll_name="all_reduce_17")
+            for r in range(3)
+        }
+        beat[3] = make_snapshot(30, 100.0, coll_seq=17,
+                                coll_name="all_reduce_16")
+        beats.append(beat)
+    feed(monitor, clock, beats)
+    v = monitor.current_verdicts()
+    assert v["hang"] == {
+        "collective": "all_reduce_17",
+        "entered_ranks": [0, 1, 2],
+        "missing_ranks": [3],
+    }
+    events = [e for e in journal.events()
+              if e["kind"] == JournalEvent.HANG_ATTRIBUTED]
+    assert len(events) == 1
+    assert events[0]["data"]["missing_ranks"] == [3]
+    text = registry.render()
+    assert "dlrover_hang_suspected 1" in text
+    assert "dlrover_hang_missing_ranks 1" in text
+    assert "dlrover_hang_verdicts_total 1" in text
+
+
+def test_equal_stalled_collective_seqs_is_not_a_hang():
+    monitor, journal, _, clock = make_monitor(hang_min_samples=3)
+    feed(monitor, clock, [
+        {r: make_snapshot(30, 100.0, coll_seq=9) for r in range(4)}
+        for _ in range(4)
+    ])
+    assert monitor.current_verdicts()["hang"] is None
+    assert JournalEvent.HANG_ATTRIBUTED not in journal_kinds(journal)
+
+
+def test_progressing_collectives_is_not_a_hang():
+    monitor, _, _, clock = make_monitor(hang_min_samples=3)
+    feed(monitor, clock, [
+        {r: make_snapshot(10 * b, 100.0, coll_seq=b + (0 if r else 1))
+         for r in range(4)}
+        for b in (1, 2, 3, 4)
+    ])
+    assert monitor.current_verdicts()["hang"] is None
+
+
+def test_flapping_straggler_journals_once_per_episode():
+    monitor, journal, registry, clock = make_monitor()
+    slow = [
+        {r: make_snapshot(10 * b, 400.0 if r == 2 else 100.0, coll_seq=b)
+         for r in range(4)}
+        for b in (1, 2, 3)
+    ]
+    feed(monitor, clock, slow)
+    # persisting straggler: repeated evaluation, ONE journal event
+    assert journal_kinds(journal).count(JournalEvent.STRAGGLER_DETECTED) == 1
+    # rank 2 recovers: window refills with uniform deltas
+    feed(monitor, clock, [
+        {r: make_snapshot(10 * b, 100.0, coll_seq=b) for r in range(4)}
+        for b in (4, 5, 6, 7, 8, 9, 10, 11, 12)
+    ])
+    assert monitor.current_verdicts()["stragglers"] == []
+    # relapse: a NEW episode journals again and grows the history count
+    feed(monitor, clock, [
+        {r: make_snapshot(10 * b, 400.0 if r == 2 else 100.0, coll_seq=b)
+         for r in range(4)}
+        for b in (13, 14, 15, 16, 17, 18, 19, 20)
+    ])
+    assert journal_kinds(journal).count(JournalEvent.STRAGGLER_DETECTED) == 2
+    assert monitor.node_straggler_counts() == {2: 2}
+    assert ('dlrover_skew_verdicts_total{cause="compute"} 2'
+            in registry.render())
+
+
+def test_worker_restart_resets_window_instead_of_negative_delta():
+    monitor, journal, _, clock = make_monitor()
+    feed(monitor, clock, [
+        {r: make_snapshot(10 * b, 100.0, coll_seq=b) for r in range(2)}
+        for b in (1, 2, 3)
+    ])
+    # rank 1 restarts: cumulative counters fall back to near zero
+    feed(monitor, clock, [{1: make_snapshot(1, 100.0, coll_seq=0)}])
+    v = monitor.current_verdicts()  # must not crash or flag anyone
+    assert v["stragglers"] == []
+    assert journal_kinds(journal) == []
+
+
+def test_stale_rank_excluded_from_comparison():
+    monitor, _, _, clock = make_monitor(stale_s=30.0)
+    feed(monitor, clock, [
+        {r: make_snapshot(10 * b, 500.0 if r == 0 else 100.0, coll_seq=b)
+         for r in range(3)}
+        for b in (1, 2)
+    ])
+    assert [s["rank"] for s in monitor.current_verdicts()["stragglers"]] \
+        == [0]
+    # rank 0's agent goes silent past stale_s: its window no longer votes
+    clock.t += 100.0
+    feed(monitor, clock, [
+        {r: make_snapshot(30 + 10 * b, 100.0, coll_seq=2 + b)
+         for r in (1, 2)}
+        for b in (1, 2)
+    ])
+    assert monitor.current_verdicts()["stragglers"] == []
+
+
+# -- consumers ----------------------------------------------------------------
+
+
+def test_runtime_straggler_diagnostician_emits_stack_dump_once():
+    monitor, _, _, clock = make_monitor()
+    feed(monitor, clock, [
+        {r: make_snapshot(10 * b, 400.0 if r == 2 else 100.0, coll_seq=b)
+         for r in range(4)}
+        for b in (1, 2)
+    ])
+    diag = RuntimeStragglerDiagnostician(monitor)
+    obs = diag.observe()
+    assert obs.problem == "runtime_straggler"
+    action = diag.resolve(obs)
+    assert action.action_type == DiagnosisActionType.STACK_DUMP
+    assert action.instance == 2  # the culprit's node
+    assert action.data["rank"] == 2
+    assert action.data["cause"] == OpClass.COMPUTE
+    # the same persisting verdict does not re-trigger a dump
+    assert diag.observe().is_healthy
+
+
+def test_rdzv_world_cut_prefers_dropping_straggler_history():
+    from dlrover_tpu.common.comm import NodeMeta
+    from dlrover_tpu.master.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    manager = ElasticTrainingRendezvousManager()
+    manager.update_rdzv_params(min_nodes=3, max_nodes=3, node_unit=1)
+    manager.straggler_history = lambda: {1: 4}  # node_id 1 is a repeater
+    for rank in range(4):
+        manager.join_rendezvous(NodeMeta(node_id=rank, node_rank=rank))
+    _, _, world = manager.get_comm_world(0)
+    assert sorted(world) == [0, 2, 3]  # rank 1 dropped, not rank 3
+
+
+def test_rdzv_world_cut_default_keeps_lowest_ranks():
+    from dlrover_tpu.common.comm import NodeMeta
+    from dlrover_tpu.master.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    manager = ElasticTrainingRendezvousManager()
+    manager.update_rdzv_params(min_nodes=3, max_nodes=3, node_unit=1)
+    for rank in range(4):
+        manager.join_rendezvous(NodeMeta(node_id=rank, node_rank=rank))
+    _, _, world = manager.get_comm_world(0)
+    assert sorted(world) == [0, 1, 2]
+
+
+def test_op_telemetry_collector_rekeys_by_global_rank():
+    from dlrover_tpu.agent.monitor import (
+        OPTEL_KEY_PREFIX,
+        OpTelemetryCollector,
+        TRAINING_METRICS_DICT,
+    )
+
+    snap = make_snapshot(5, 100.0)
+    snap["rank"] = 7  # global rank stamped by the worker
+
+    class FakeIpc:
+        def local_dict(self, name):
+            assert name == TRAINING_METRICS_DICT
+            return {
+                "step": 42,
+                f"{OPTEL_KEY_PREFIX}1": snap,
+                f"{OPTEL_KEY_PREFIX}broken": "not-a-dict",
+            }
+
+    out = OpTelemetryCollector(FakeIpc()).collect()
+    assert list(out) == ["7"]
+    assert out["7"]["classes"][OpClass.COMPUTE]["n"] == 5
+
+
+def test_heartbeat_request_carries_op_telemetry():
+    from dlrover_tpu.common.comm import HeartbeatRequest, deserialize, serialize
+
+    req = HeartbeatRequest(node_id=1, op_telemetry={"0": make_snapshot(3)})
+    rt = deserialize(serialize(req))
+    assert rt.op_telemetry["0"]["classes"][OpClass.COMPUTE]["n"] == 3
+    # default stays wire-compatible with agents that never send the field
+    assert HeartbeatRequest().op_telemetry == {}
+
+
+def test_timeline_skew_track_renders_verdicts():
+    from dlrover_tpu.observability.timeline import (
+        _SKEW_TRACK_PID,
+        skew_track_events,
+    )
+
+    monitor, journal, _, clock = make_monitor(hang_min_samples=2)
+    feed(monitor, clock, [
+        {r: make_snapshot(10 * b, 400.0 if r == 1 else 100.0, coll_seq=b)
+         for r in range(4)}
+        for b in (1, 2)
+    ])
+    events = skew_track_events({"events": journal.events(), "now_t": 10.0})
+    assert all(e["pid"] == _SKEW_TRACK_PID for e in events)
+    counters = [e for e in events if e["ph"] == "C"]
+    assert counters and counters[0]["args"]["rank1"] == pytest.approx(4.0)
+    instants = [e for e in events if e["ph"] == "i"]
+    assert any("straggler rank1" in e["name"] for e in instants)
