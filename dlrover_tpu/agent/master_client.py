@@ -11,9 +11,10 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import comm, retry
-from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.constants import EnvKey, SpanName
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCClient
+from dlrover_tpu.observability import tracing
 
 
 class MasterClient:
@@ -49,31 +50,37 @@ class MasterClient:
         slice_id, tpu_worker_id = local_topology_attrs()
         # patient: rendezvous must keep knocking while the master restarts,
         # even when the client's circuit breaker is open
-        resp = self._client.call(
-            "join_rendezvous",
-            comm.JoinRendezvousRequest(
-                node_id=self._node_id,
-                node_rank=node_rank,
-                local_world_size=local_world_size,
-                rdzv_name=rdzv_name,
-                node_unit=node_unit,
-                host=host,
-                free_port=free_port,
-                slice_id=slice_id,
-                tpu_worker_id=tpu_worker_id,
-            ),
-            policy=retry.RENDEZVOUS,
-        )
+        with tracing.span(SpanName.RDZV_JOIN,
+                          source=f"agent_{self._node_id}",
+                          rdzv_name=rdzv_name, node_rank=node_rank):
+            resp = self._client.call(
+                "join_rendezvous",
+                comm.JoinRendezvousRequest(
+                    node_id=self._node_id,
+                    node_rank=node_rank,
+                    local_world_size=local_world_size,
+                    rdzv_name=rdzv_name,
+                    node_unit=node_unit,
+                    host=host,
+                    free_port=free_port,
+                    slice_id=slice_id,
+                    tpu_worker_id=tpu_worker_id,
+                ),
+                policy=retry.RENDEZVOUS,
+            )
         return resp.round
 
     def get_comm_world(
         self, rdzv_name: str, node_rank: int
     ) -> Tuple[int, int, Dict[int, comm.NodeMeta], str]:
-        resp = self._client.call(
-            "get_comm_world",
-            comm.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name),
-            policy=retry.RENDEZVOUS,
-        )
+        with tracing.span(SpanName.RDZV_WORLD_WAIT,
+                          source=f"agent_{self._node_id}",
+                          rdzv_name=rdzv_name, node_rank=node_rank):
+            resp = self._client.call(
+                "get_comm_world",
+                comm.CommWorldRequest(node_id=node_rank, rdzv_name=rdzv_name),
+                policy=retry.RENDEZVOUS,
+            )
         return resp.round, resp.group, resp.world, resp.coordinator_addr
 
     def num_nodes_waiting(self, rdzv_name: str) -> int:

@@ -21,6 +21,7 @@ import subprocess
 import sys
 import threading
 import time
+import traceback
 from dataclasses import dataclass
 from enum import Enum
 from typing import Dict, List, Optional, Tuple
@@ -29,15 +30,20 @@ from dlrover_tpu.agent.config import ElasticLaunchConfig
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.comm import NodeMeta
 from dlrover_tpu.common.constants import (
+    ConfigKey,
     DiagnosisActionType,
     EnvKey,
     NodeStatus,
     RendezvousName,
+    SpanName,
     TrainingExceptionLevel,
+    env_float,
+    env_str,
 )
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.event import AgentEvent, get_emitter
 from dlrover_tpu.common.multi_process import LocalIPCServer, ipc_socket_path
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.observability.journal import JournalEvent
 from dlrover_tpu.common.rpc import find_free_port
 from dlrover_tpu.diagnosis.diagnosis_agent import DiagnosisAgent
@@ -79,7 +85,7 @@ class MasterRendezvousHandler:
             self._name,
             self._node_rank,
             self._local_world_size,
-            host=os.getenv("DLROVER_TPU_HOST_IP", "127.0.0.1"),
+            host=env_str(ConfigKey.HOST_IP, "127.0.0.1"),
             free_port=free_port,
             node_unit=self._node_unit,
         )
@@ -169,9 +175,7 @@ class ElasticTrainingAgent:
         # partition-degraded mode: on master unreachability keep training
         # on cached shard assignments for a bounded grace window, then
         # save + exit cleanly if the master never comes back
-        self._partition_grace_s = float(
-            os.getenv(EnvKey.PARTITION_GRACE_S, "120")
-        )
+        self._partition_grace_s = env_float(EnvKey.PARTITION_GRACE_S, 120.0)
         self._partition_threshold = 3  # consecutive failed heartbeats
         self._hb_consec_failures = 0
         self._degraded_since: Optional[float] = None  # monotonic
@@ -239,13 +243,20 @@ class ElasticTrainingAgent:
         reg.gauge(
             "dlrover_agent_global_step", "Last global step this agent saw"
         ).set_function(lambda: self._last_global_step)
+        # crash flight recorder: bundles on unhandled agent exceptions,
+        # partition-degraded exits, injected chaos, or GET /debug/bundle
+        from dlrover_tpu.observability.flight_recorder import FlightRecorder
+
+        self._flight_recorder = FlightRecorder(
+            source=f"agent_{config.node_rank}", registry=reg
+        )
         self._metrics_server = self._maybe_start_metrics_server()
 
     def _maybe_start_metrics_server(self):
         """Per-agent scrape surface, gated on
         DLROVER_TPU_AGENT_METRICS_PORT (0 = pick a free port). The base
         port is offset by node_rank so multi-agent hosts don't collide."""
-        port_env = os.getenv("DLROVER_TPU_AGENT_METRICS_PORT")
+        port_env = env_str(ConfigKey.AGENT_METRICS_PORT)
         if not port_env:
             return None
         from dlrover_tpu.common.http_server import HTTPTransportServer
@@ -265,6 +276,9 @@ class ElasticTrainingAgent:
                 get_registry().render(),
             ),
         )
+        server.add_get_route(
+            "/debug/bundle", self._flight_recorder.http_handler()
+        )
         server.start()
         logger.info("agent metrics on :%s/metrics", server.port)
         return server
@@ -273,7 +287,15 @@ class ElasticTrainingAgent:
 
     def _rendezvous(self) -> Tuple[str, int, int]:
         """(reference ``_rendezvous``:604)"""
-        with self._events.span(AgentEvent.RENDEZVOUS):
+        # the causal root of a rendezvous round on this node: the join/
+        # world-wait RPC spans (master_client.py) and the master-side
+        # join/world-cut spans all nest under this trace
+        with tracing.span(
+            SpanName.RDZV_CLIENT_ROUND,
+            source=f"agent_{self._config.node_rank}",
+            node_rank=self._config.node_rank,
+            restart_count=self._restart_count,
+        ), self._events.span(AgentEvent.RENDEZVOUS):
             rdzv_round, world, coordinator = (
                 self._rdzv_handler.next_rendezvous()
             )
@@ -537,10 +559,19 @@ class ElasticTrainingAgent:
         so the operator can correlate verdict → evidence."""
         import threading as _threading
 
+        # master-originated action: restore its trace context on the
+        # capture thread so the evidence span joins the master's arc
+        carried = tracing.extract_wire(action_data.get(tracing.WIRE_KEY))
+
         def _capture():
             try:
-                self._diagnosis._request_worker_profiles()
-                path = self._diagnosis.capture_worker_stacks()
+                with tracing.activate(carried), tracing.span(
+                    SpanName.AGENT_STACK_DUMP,
+                    source=f"agent_{self._config.node_rank}",
+                    rank=action_data.get("rank", -1),
+                ):
+                    self._diagnosis._request_worker_profiles()
+                    path = self._diagnosis.capture_worker_stacks()
                 self._client.report_event(
                     JournalEvent.STACK_DUMP_CAPTURED,
                     {"rank": action_data.get("rank", -1),
@@ -587,12 +618,14 @@ class ElasticTrainingAgent:
         inj = get_injector()
         if inj is not None:
             # injected faults land in the master's journal via the
-            # best-effort telemetry path (never adds faults of its own)
-            inj.set_reporter(
+            # best-effort telemetry path (never adds faults of its own);
+            # the flight recorder then snapshots a local bundle so the
+            # drill leaves an artifact even when recovery succeeds
+            inj.set_reporter(self._flight_recorder.wrap_fault_reporter(
                 lambda event: self._client.report_event(
                     JournalEvent.FAULT_INJECTED, event
                 )
-            )
+            ))
         self._ipc_server.start()
         if self._warm_pool is not None:
             # spares import numpy/jax before this node joins rendezvous:
@@ -603,7 +636,7 @@ class ElasticTrainingAgent:
             self._warm_pool.prewarm()
             self._warm_pool.wait_ready(
                 n=self._config.nproc_per_node,
-                timeout_s=float(os.getenv("DLROVER_TPU_WARM_WAIT_S", "10")),
+                timeout_s=env_float(ConfigKey.WARM_WAIT_S, 10.0),
             )
         if self._config.ckpt_replica > 1:
             # agent-hosted store for peers' shm frames; survives worker
@@ -679,6 +712,16 @@ class ElasticTrainingAgent:
         try:
             self._initialize_workers()
             return self._monitor_loop()
+        except Exception:
+            # post-mortem artifact before the exception unwinds the agent
+            from dlrover_tpu.observability.flight_recorder import (
+                REASON_CRASH,
+            )
+
+            self._flight_recorder.capture(REASON_CRASH, extra={
+                "error": traceback.format_exc(limit=20),
+            })
+            raise
         finally:
             self._stop_flag.set()
             resource_monitor.stop()
@@ -728,11 +771,22 @@ class ElasticTrainingAgent:
                     from dlrover_tpu.common.config import get_context
 
                     grace = get_context().wedged_kill_grace_s
-                self._restart_workers(
-                    f"diagnosis action {action} "
-                    f"({action_data.get('reason', '')})",
-                    grace_s=grace,
+                # a master-originated action carries the trace context of
+                # the arc that caused it (e.g. fault.relaunch): restoring
+                # it here joins this restart to that trace_id
+                carried = tracing.extract_wire(
+                    action_data.get(tracing.WIRE_KEY)
                 )
+                with tracing.activate(carried), tracing.span(
+                    SpanName.AGENT_RESTART_WORKERS,
+                    source=f"agent_{self._config.node_rank}",
+                    reason=action_data.get("reason", ""),
+                ):
+                    self._restart_workers(
+                        f"diagnosis action {action} "
+                        f"({action_data.get('reason', '')})",
+                        grace_s=grace,
+                    )
                 continue
             if action == DiagnosisActionType.STACK_DUMP:
                 # skew monitor flagged one of this node's ranks as a
@@ -770,6 +824,16 @@ class ElasticTrainingAgent:
                 )
                 self._stop_workers()
                 self._save_breakpoint_checkpoint("partition grace expired")
+                # the bundle is the only evidence that survives this exit:
+                # the master is unreachable, so nothing else gets reported
+                from dlrover_tpu.observability.flight_recorder import (
+                    REASON_PARTITION,
+                )
+
+                self._flight_recorder.capture(REASON_PARTITION, extra={
+                    "grace_s": self._partition_grace_s,
+                    "failed_heartbeats": self._hb_consec_failures,
+                })
                 try:
                     # best-effort: the open circuit breaker makes this fail
                     # fast if the master is still gone

@@ -30,7 +30,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m dlrover_tpu.analysis",
         description="dlrover_tpu control-plane invariant analyzer "
-                    "(rules DLR001-DLR006; see docs/design/"
+                    "(rules DLR001-DLR007; see docs/design/"
                     "static_analysis.md)",
     )
     parser.add_argument(

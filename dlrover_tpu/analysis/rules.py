@@ -26,6 +26,10 @@ Rule catalogue (motivating incidents in docs/design/static_analysis.md):
 - DLR006: journaled event kinds / metric names as ad-hoc literals. A
   typo'd event string forks the observability spine's stream without any
   error.
+- DLR007: trace span names as ad-hoc literals. Cross-process trace arcs
+  are correlated BY NAME (agent join ↔ master join ↔ world cut); a typo'd
+  span name silently drops the arc from every flight-recorder bundle —
+  declare names on ``constants.SpanName``.
 """
 
 import ast
@@ -453,3 +457,41 @@ def rule_dlr006_adhoc_event_names(
                     "grep-able, no typo forks)",
                     lines,
                 )
+
+
+# -- DLR007: ad-hoc trace span names ------------------------------------------
+
+# matches tracing / tracer / self._tracer receivers; NOT timer, emitter,
+# self._events (those .span() calls are the event-emitter plane, DLR006's
+# domain)
+_TRACER_RECEIVER_RE = re.compile(r"trac", re.IGNORECASE)
+
+
+@_rule
+def rule_dlr007_adhoc_span_names(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """trace span names must be declared constants (constants.SpanName)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr not in ("span", "start_span"):
+            continue
+        receiver = _dotted(node.func.value)
+        if not _TRACER_RECEIVER_RE.search(receiver):
+            continue
+        first = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                first = kw.value
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            yield _violation(
+                "DLR007", path, first,
+                f"span name {first.value!r} is an ad-hoc string — declare "
+                "it on constants.SpanName (cross-process arcs correlate by "
+                "name; a typo silently drops the arc from every trace "
+                "bundle)",
+                lines,
+            )

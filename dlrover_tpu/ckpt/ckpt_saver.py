@@ -28,8 +28,13 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 from dlrover_tpu.common.config import get_context
-from dlrover_tpu.common.constants import CheckpointConstant, SharedResourceName
+from dlrover_tpu.common.constants import (
+    CheckpointConstant,
+    SharedResourceName,
+    SpanName,
+)
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.common.storage import (
     CheckpointDeletionStrategy,
     CheckpointStorage,
@@ -243,32 +248,50 @@ class AsyncCheckpointSaver:
         if not path:
             logger.warning("save event without a checkpoint dir — dropped")
             return
-        self.save_step_checkpoint(step, path)
+        # the worker engine stamped its trace context onto the event
+        # (engine.save_to_storage): restore it so the persist/commit spans
+        # join the save_to_storage trace across the SharedQueue boundary
+        carried = tracing.extract_wire(event.get(tracing.WIRE_KEY))
+        with tracing.activate(carried):
+            self.save_step_checkpoint(step, path)
 
     def save_step_checkpoint(self, step: int, path: str) -> None:
         """Persist every local frame for ``step``, then commit
         (reference ``save_step_checkpoint``:925)."""
-        handlers = self._local_shm_handlers()
-        futures = [
-            (shm, self._executor.submit(self._persist_one, shm, path, step))
-            for shm in handlers
-        ]
-        persisted = [shm for shm, f in futures if f.result()]
-        if not persisted:
-            logger.warning("no shm frame matched step %s — nothing persisted",
-                           step)
-            return
-        # done markers ONLY for frames that really landed — a skipped or
-        # stale frame must hold the commit quorum open
-        self._write_done_files(path, step, persisted)
-        if self._is_commit_leader:
-            # quorum size rides in the frame meta (engine._plan_state):
-            # a single-writer job's commit must wait for its one frame,
-            # not one per host
-            meta = persisted[0].read_meta() or {}
-            self.commit_checkpoint(
-                path, step, expected_frames=meta.get("expected_frames"),
-            )
+        with tracing.span(
+            SpanName.CKPT_PERSIST, source=f"saver_{self._node_rank}",
+            step=step,
+        ) as sp:
+            handlers = self._local_shm_handlers()
+            futures = [
+                (shm,
+                 self._executor.submit(self._persist_one, shm, path, step))
+                for shm in handlers
+            ]
+            persisted = [shm for shm, f in futures if f.result()]
+            sp.add_event("persisted", frames=len(persisted),
+                         handlers=len(handlers))
+            if not persisted:
+                logger.warning(
+                    "no shm frame matched step %s — nothing persisted", step
+                )
+                return
+            # done markers ONLY for frames that really landed — a skipped
+            # or stale frame must hold the commit quorum open
+            self._write_done_files(path, step, persisted)
+            if self._is_commit_leader:
+                # quorum size rides in the frame meta (engine._plan_state):
+                # a single-writer job's commit must wait for its one frame,
+                # not one per host
+                meta = persisted[0].read_meta() or {}
+                with tracing.span(
+                    SpanName.CKPT_COMMIT,
+                    source=f"saver_{self._node_rank}", step=step,
+                ):
+                    self.commit_checkpoint(
+                        path, step,
+                        expected_frames=meta.get("expected_frames"),
+                    )
 
     def _frame_lock(self, shm: SharedMemoryHandler):
         """The per-frame lock the worker writes under — the agent takes it

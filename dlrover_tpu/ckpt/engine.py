@@ -35,6 +35,7 @@ from dlrover_tpu.common.constants import (
     ConfigKey,
     EnvKey,
     SharedResourceName,
+    SpanName,
     env_flag,
     env_float,
     env_int,
@@ -43,6 +44,7 @@ from dlrover_tpu.common.constants import (
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.multi_process import SharedDict, SharedLock, SharedQueue
 from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler, shm_name
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.observability.journal import JournalEvent
 
 
@@ -221,6 +223,20 @@ class CheckpointEngine:
 
     def save_to_memory(self, step: int, state, blocking: bool = False,
                        _on_drained=None, _wait_busy_s: float = 0.0) -> bool:
+        """Traced entry point — see :meth:`_save_to_memory`."""
+        with tracing.span(
+            SpanName.CKPT_SAVE_MEMORY, source=f"worker_{self.rank}",
+            step=step, blocking=blocking,
+        ) as sp:
+            ok = self._save_to_memory(
+                step, state, blocking=blocking, _on_drained=_on_drained,
+                _wait_busy_s=_wait_busy_s,
+            )
+            sp.add_event("result", saved=ok)
+            return ok
+
+    def _save_to_memory(self, step: int, state, blocking: bool = False,
+                        _on_drained=None, _wait_busy_s: float = 0.0) -> bool:
         """Snapshot ``state`` into shm. Returns False if skipped (previous
         snapshot still draining, or agent busy persisting — reference
         engine.py:340 skips rather than blocks).
@@ -290,43 +306,17 @@ class CheckpointEngine:
 
         self._save_block_hist.observe(time.monotonic() - block_t0)
 
+        # the drain thread continues the save arc: carry the caller's
+        # trace context over the thread boundary explicitly
+        drain_parent = tracing.current_context()
+
         def _drain():
             try:
-                drain_t0 = time.monotonic()
-                buffers = [np.asarray(data) for _, data in pending]
-                self._shm.write_frame(meta, buffers)
-                drain_s = time.monotonic() - drain_t0
-                self._drain_hist.observe(drain_s)
-                if drain_s > 0:
-                    self._drain_rate_gauge.set(
-                        sum(b.nbytes for b in buffers) / drain_s
-                    )
-                self._latest_step = step
-                self._drain_ok = True
-                if self._replicas is not None:
-                    # overlaps with training; reference replica.py:116
-                    # blocks on a gloo allgather here instead
-                    self._replicas.backup_async(self._shm, self.local_rank)
-                if self._meta_dict is not None:
-                    self._meta_dict.set(
-                        f"{self.node_rank}:{self.local_rank}",
-                        {
-                            "shm": self._shm.name,
-                            "step": step,
-                            "ts": time.time(),
-                            "persisted": False,
-                        },
-                    )
-                if self._master is not None:
-                    try:
-                        self._master.kv_set(
-                            f"ckpt/{self.job_name}/shm_step/{self.rank}",
-                            str(step).encode(),
-                        )
-                    except ConnectionError:
-                        pass
-                if _on_drained is not None:
-                    _on_drained()
+                with tracing.activate(drain_parent), tracing.span(
+                    SpanName.CKPT_DRAIN, source=f"worker_{self.rank}",
+                    step=step,
+                ):
+                    self._drain_frame(step, meta, pending, _on_drained)
             except Exception:  # noqa: BLE001 — a lost snapshot must be LOUD
                 self._drain_ok = False
                 logger.error(
@@ -349,6 +339,43 @@ class CheckpointEngine:
             )
             self._drain_thread.start()
         return True
+
+    def _drain_frame(self, step, meta, pending, _on_drained) -> None:
+        drain_t0 = time.monotonic()
+        buffers = [np.asarray(data) for _, data in pending]
+        self._shm.write_frame(meta, buffers)
+        drain_s = time.monotonic() - drain_t0
+        self._drain_hist.observe(drain_s)
+        if drain_s > 0:
+            self._drain_rate_gauge.set(
+                sum(b.nbytes for b in buffers) / drain_s
+            )
+        self._latest_step = step
+        self._drain_ok = True
+        if self._replicas is not None:
+            # overlaps with training; reference replica.py:116
+            # blocks on a gloo allgather here instead
+            self._replicas.backup_async(self._shm, self.local_rank)
+        if self._meta_dict is not None:
+            self._meta_dict.set(
+                f"{self.node_rank}:{self.local_rank}",
+                {
+                    "shm": self._shm.name,
+                    "step": step,
+                    "ts": time.time(),
+                    "persisted": False,
+                },
+            )
+        if self._master is not None:
+            try:
+                self._master.kv_set(
+                    f"ckpt/{self.job_name}/shm_step/{self.rank}",
+                    str(step).encode(),
+                )
+            except ConnectionError:
+                pass
+        if _on_drained is not None:
+            _on_drained()
 
     def _all_ranks_ready(self, step: int, local_ready: bool,
                          min_wait: float = 0.0) -> bool:
@@ -451,26 +478,38 @@ class CheckpointEngine:
         half-written frame)."""
         path = path or self.ckpt_dir
 
-        def _request_persist():
-            if self._event_queue is not None:
-                self._event_queue.put(CheckpointEvent.save(step, path))
-            else:
-                # no agent (bare worker): persist in the drain thread
-                from dlrover_tpu.ckpt.ckpt_saver import persist_shm_frame
+        with tracing.span(
+            SpanName.CKPT_PERSIST_REQUEST, source=f"worker_{self.rank}",
+            step=step,
+        ):
+            # the persist request crosses the SharedQueue into the agent
+            # saver process: the trace context rides the event dict so the
+            # saver's persist/commit spans join this trace
+            carry = tracing.inject_wire()
 
-                persist_shm_frame(self._shm, path, step)
+            def _request_persist():
+                if self._event_queue is not None:
+                    event = CheckpointEvent.save(step, path)
+                    if carry is not None:
+                        event[tracing.WIRE_KEY] = carry
+                    self._event_queue.put(event)
+                else:
+                    # no agent (bare worker): persist in the drain thread
+                    from dlrover_tpu.ckpt.ckpt_saver import persist_shm_frame
 
-        # bare workers (no agent) persist in-process: stay synchronous so
-        # "save returned" keeps meaning "bytes durable", as before; with an
-        # agent the persist is its job and only the drain rides our thread.
-        # Storage saves are rare and durability-bearing — wait out a busy
-        # drain (bounded) instead of skipping, so fast-stepping jobs can't
-        # starve the disk cadence.
-        wait_s = env_float(ConfigKey.CKPT_STORAGE_WAIT, 60.0)
-        return self.save_to_memory(
-            step, state, blocking=not self._has_agent,
-            _on_drained=_request_persist, _wait_busy_s=wait_s,
-        )
+                    persist_shm_frame(self._shm, path, step)
+
+            # bare workers (no agent) persist in-process: stay synchronous
+            # so "save returned" keeps meaning "bytes durable", as before;
+            # with an agent the persist is its job and only the drain rides
+            # our thread. Storage saves are rare and durability-bearing —
+            # wait out a busy drain (bounded) instead of skipping, so
+            # fast-stepping jobs can't starve the disk cadence.
+            wait_s = env_float(ConfigKey.CKPT_STORAGE_WAIT, 60.0)
+            return self.save_to_memory(
+                step, state, blocking=not self._has_agent,
+                _on_drained=_request_persist, _wait_busy_s=wait_s,
+            )
 
     def _plan_state(self, step: int, state) -> Tuple[Dict, List]:
         """Planning pass: build frame metadata and dispatch async work for
@@ -648,28 +687,39 @@ class CheckpointEngine:
 
         Returns (state, step); step == -1 when nothing was restored.
         """
-        # an in-flight async snapshot must land before we read the frame
-        self.wait_drained()
-        restore_t0 = time.monotonic()
-        self._report_event(JournalEvent.RESTORE_START)
-        if self._replicas is not None:
-            # a relaunched node's shm is empty — pull own frame from a
-            # backup-group peer first (replica.py restore semantics)
-            try:
-                self._replicas.try_restore_shm(self._shm, self.local_rank)
-            except Exception as e:  # noqa: BLE001 — degrade to storage
-                logger.warning("replica restore failed: %r", e)
-        local_step = self._verify_shm_or_repair()
-        step = self._shm_step_consistent(local_step)
-        if step is not None and step >= 0:
-            state = self._load_from_shm(target, in_place=in_place)
-            if state is not None:
-                logger.info("restored step %s from shared memory", step)
-                self._finish_restore(restore_t0, "shm", step)
-                return state, step
-        state, step = self._load_from_storage(target, path or self.ckpt_dir)
-        self._finish_restore(restore_t0, "storage", step)
-        return state, step
+        with tracing.span(
+            SpanName.CKPT_RESTORE, source=f"worker_{self.rank}",
+        ) as sp:
+            # an in-flight async snapshot must land before we read the frame
+            self.wait_drained()
+            restore_t0 = time.monotonic()
+            self._report_event(JournalEvent.RESTORE_START)
+            if self._replicas is not None:
+                # a relaunched node's shm is empty — pull own frame from a
+                # backup-group peer first (replica.py restore semantics)
+                try:
+                    self._replicas.try_restore_shm(
+                        self._shm, self.local_rank
+                    )
+                except Exception as e:  # noqa: BLE001 — degrade to storage
+                    logger.warning("replica restore failed: %r", e)
+            local_step = self._verify_shm_or_repair()
+            step = self._shm_step_consistent(local_step)
+            if step is not None and step >= 0:
+                state = self._load_from_shm(target, in_place=in_place)
+                if state is not None:
+                    logger.info(
+                        "restored step %s from shared memory", step
+                    )
+                    sp.add_event("restored", medium="shm", step=step)
+                    self._finish_restore(restore_t0, "shm", step)
+                    return state, step
+            state, step = self._load_from_storage(
+                target, path or self.ckpt_dir
+            )
+            sp.add_event("restored", medium="storage", step=step)
+            self._finish_restore(restore_t0, "storage", step)
+            return state, step
 
     def _verify_shm_or_repair(self) -> int:
         """CRC-check the local shm frame before it can be elected for

@@ -267,6 +267,46 @@ class ConfigKey:
     FAULT_SEED = "DLROVER_FAULT_SEED"
     EVENT_DIR = "DLROVER_TPU_EVENT_DIR"
     LOG_LEVEL = "DLROVER_TPU_LOG_LEVEL"
+    # tracing / flight recorder (observability/tracing.py,
+    # observability/flight_recorder.py)
+    TRACE = "DLROVER_TPU_TRACE"
+    TRACE_RING = "DLROVER_TPU_TRACE_RING"
+    TRACE_DIR = "DLROVER_TPU_TRACE_DIR"
+    TRACE_BUNDLE_COOLDOWN_S = "DLROVER_TPU_TRACE_BUNDLE_COOLDOWN_S"
+
+
+class SpanName:
+    """Span and span-event names for observability/tracing.py. Like
+    journal kinds (JournalEvent) and metric names, span names are
+    registry constants — rule DLR007 rejects ad-hoc string literals at
+    ``.span(...)`` call sites so a typo can't fork a trace arc into two
+    names that never correlate."""
+
+    # rendezvous arc (agent/master_client.py client side,
+    # master/rdzv_manager.py server side)
+    RDZV_CLIENT_ROUND = "rdzv.client_round"
+    RDZV_JOIN = "rdzv.join"
+    RDZV_WORLD_WAIT = "rdzv.world_wait"
+    RDZV_WORLD_CUT = "rdzv.world_cut"
+    # flash-checkpoint arc (ckpt/engine.py worker side,
+    # ckpt/ckpt_saver.py agent side)
+    CKPT_SAVE_MEMORY = "ckpt.save_to_memory"
+    CKPT_DRAIN = "ckpt.drain"
+    CKPT_PERSIST_REQUEST = "ckpt.persist_request"
+    CKPT_PERSIST = "ckpt.persist"
+    CKPT_COMMIT = "ckpt.commit"
+    CKPT_RESTORE = "ckpt.restore"
+    # scale-plan arc (master/auto_scaler.py → master/job_manager.py)
+    SCALE_APPLY = "scale.apply"
+    SCALE_RDZV_PARAMS = "scale.update_rdzv_params"
+    # failure-detect → relaunch arc (master/master.py → agent/training.py)
+    FAULT_RELAUNCH = "fault.relaunch"
+    AGENT_RESTART_WORKERS = "agent.restart_workers"
+    AGENT_STACK_DUMP = "agent.stack_dump"
+    # span events (retry plane, chaos plane)
+    EVT_RPC_RETRY = "rpc.retry"
+    EVT_BREAKER_OPEN = "rpc.breaker_open"
+    EVT_FAULT_INJECTED = "chaos.fault_injected"
 
 
 class GRPC:

@@ -30,6 +30,7 @@ from dlrover_tpu.chaos import get_injector
 from dlrover_tpu.common import comm, retry
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCError
+from dlrover_tpu.observability import tracing
 
 
 class HTTPTransportServer:
@@ -83,7 +84,16 @@ class HTTPTransportServer:
                         resp = {"ok": False,
                                 "err": f"unknown rpc method {method!r}"}
                     else:
-                        result = handler(comm.deserialize(frame.get("p", b"")))
+                        # same trace-context restore as the TCP transport
+                        trace_ctx = tracing.extract_wire(
+                            frame.get(tracing.WIRE_KEY)
+                        )
+                        request = comm.deserialize(frame.get("p", b""))
+                        if trace_ctx is not None:
+                            with tracing.activate(trace_ctx):
+                                result = handler(request)
+                        else:
+                            result = handler(request)
                         resp = {"ok": True, "p": comm.serialize(result)}
                 except Exception as e:  # noqa: BLE001 — report to caller
                     logger.exception("http rpc failed")
@@ -157,9 +167,11 @@ class HttpRPCClient:
         if policy is None:
             policy = (retry.RetryPolicy.from_retries(retries)
                       if retries is not None else self._policy)
-        frame = msgpack.packb(
-            {"m": method, "p": comm.serialize(request)}, use_bin_type=True
-        )
+        envelope = {"m": method, "p": comm.serialize(request)}
+        trace_ctx = tracing.inject_wire()
+        if trace_ctx is not None:
+            envelope[tracing.WIRE_KEY] = trace_ctx
+        frame = msgpack.packb(envelope, use_bin_type=True)
         inj = get_injector()
 
         def attempt() -> Any:
@@ -174,7 +186,13 @@ class HttpRPCClient:
             if inj is not None:
                 inj.fire("rpc.recv", method=method)
             if not resp.get("ok"):
-                raise RPCError(resp.get("err", "unknown error"))
+                ctx = tracing.current_context()
+                trace_id = ctx.trace_id if ctx is not None else "-"
+                raise RPCError(
+                    f"http rpc {method} to {self._addr} failed "
+                    f"(trace_id={trace_id}): "
+                    f"{resp.get('err', 'unknown error')}"
+                )
             return comm.deserialize(resp.get("p", b""))
 
         return retry.retry_call(

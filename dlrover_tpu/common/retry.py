@@ -33,7 +33,22 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Tuple, Type
 
+from dlrover_tpu.common.constants import SpanName
 from dlrover_tpu.common.log import logger
+
+_tracing = None
+
+
+def _trace_event(name: str, **attrs) -> None:
+    """Attach a span event to the caller's active trace span (lazy import
+    keeps this module import-light; no-op when tracing is off or no span
+    is open)."""
+    global _tracing
+    if _tracing is None:
+        from dlrover_tpu.observability import tracing as _t
+
+        _tracing = _t
+    _tracing.add_span_event(name, **attrs)
 
 
 class CircuitOpenError(ConnectionError):
@@ -154,6 +169,10 @@ def retry_call(
             result = fn()
         except retry_on as e:
             last = e
+            # visible in the causal trace: each failed attempt becomes a
+            # span event on whatever arc this call serves
+            _trace_event(SpanName.EVT_RPC_RETRY, describe=describe,
+                         attempt=attempts, error=repr(e))
             if attempts >= policy.max_attempts:
                 break
             delay = policy.backoff_s(attempt)
@@ -169,6 +188,8 @@ def retry_call(
             return result
     if breaker is not None:
         breaker.record_failure()
+        if breaker.is_open:
+            _trace_event(SpanName.EVT_BREAKER_OPEN, describe=describe)
     raise ConnectionError(
         f"{describe} failed after {attempts} attempts: {last!r}"
     )

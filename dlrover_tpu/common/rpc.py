@@ -9,7 +9,11 @@ components speak.
 
 Frame: 4-byte big-endian length + msgpack map
 ``{"m": method, "p": <serialized message>, "id": seq}`` → response
-``{"ok": bool, "p": <serialized message>, "err": str}``.
+``{"ok": bool, "p": <serialized message>, "err": str}``. When a trace
+context is active (observability/tracing.py) the request frame also
+carries ``{"tc": {"t": trace_id, "s": span_id}}`` and the server restores
+it into the handler thread's context — one trace_id follows a causal arc
+across the agent→master hop. Peers that don't know the key ignore it.
 """
 
 import socket
@@ -19,8 +23,10 @@ from typing import Any, Callable, Dict, Optional
 
 from dlrover_tpu.chaos import get_injector
 from dlrover_tpu.common import comm, retry
+from dlrover_tpu.common.constants import ConfigKey, env_str
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.multi_process import recv_msg, send_msg
+from dlrover_tpu.observability import tracing
 
 
 class RPCError(RuntimeError):
@@ -108,7 +114,17 @@ class _Handler(socketserver.BaseRequestHandler):
             else:
                 try:
                     request = comm.deserialize(frame.get("p", b""))
-                    result = handler(request)
+                    # restore the caller's trace context (if it sent one)
+                    # for the dispatch, alongside connection_ctx() — the
+                    # handler's spans then join the caller's trace
+                    trace_ctx = tracing.extract_wire(
+                        frame.get(tracing.WIRE_KEY)
+                    )
+                    if trace_ctx is not None:
+                        with tracing.activate(trace_ctx):
+                            result = handler(request)
+                    else:
+                        result = handler(request)
                     resp = {"ok": True, "p": comm.serialize(result)}
                 except Exception as e:  # noqa: BLE001 — report to caller
                     logger.exception("rpc handler %s failed", method)
@@ -271,6 +287,11 @@ class RPCClient:
             "m": method, "p": comm.serialize(request),
             "id": seq, "c": self._client_id,
         }
+        # inject_wire() is None when tracing is off or no span is open —
+        # a single cached-bool check, so the disabled path costs nothing
+        trace_ctx = tracing.inject_wire()
+        if trace_ctx is not None:
+            frame[tracing.WIRE_KEY] = trace_ctx
         inj = get_injector()
 
         def attempt() -> Any:
@@ -288,7 +309,15 @@ class RPCClient:
                 self._close()
                 raise
             if not resp.get("ok"):
-                raise RPCError(resp.get("err", "unknown rpc error"))
+                # name the method and the active trace so client-side
+                # logs correlate with master-side spans without grepping
+                ctx = tracing.current_context()
+                trace_id = ctx.trace_id if ctx is not None else "-"
+                raise RPCError(
+                    f"rpc {method} to {self.addr} failed "
+                    f"(trace_id={trace_id}): "
+                    f"{resp.get('err', 'unknown rpc error')}"
+                )
             return comm.deserialize(resp.get("p", b""))
 
         return retry.retry_call(
@@ -317,9 +346,7 @@ def local_host_ip() -> str:
     ``DLROVER_TPU_HOST_IP`` (set by the operator/pod spec) wins; otherwise
     the kernel's routing choice toward a public address (no packet is sent —
     UDP connect only selects a source address)."""
-    import os
-
-    env = os.getenv("DLROVER_TPU_HOST_IP")
+    env = env_str(ConfigKey.HOST_IP)
     if env:
         return env
     try:

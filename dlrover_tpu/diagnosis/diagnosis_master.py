@@ -19,8 +19,13 @@ import time
 from typing import Dict, List, Optional
 
 from dlrover_tpu.common.config import get_context
-from dlrover_tpu.common.constants import DiagnosisActionType, DiagnosisConstant
+from dlrover_tpu.common.constants import (
+    DiagnosisActionType,
+    DiagnosisConstant,
+    SpanName,
+)
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.diagnosis.action import (
     DiagnosisAction,
     EventAction,
@@ -259,16 +264,27 @@ class DiagnosisMaster:
                 action.data.get("event_type", ""), action.reason, action.data,
             )
             return
-        if (
-            self._event_journal is not None
-            and action.action_type == DiagnosisActionType.RESTART_WORKER
+        # root the verdict→action arc in a trace and stamp its context
+        # onto the action: when the agent executes it, the restart /
+        # stack-dump span over there joins this trace_id
+        with tracing.span(
+            SpanName.FAULT_RELAUNCH, source="master",
+            action=action.action_type, reason=action.reason or "",
         ):
-            # a hang restart is a detected fault even though no node died
-            self._event_journal.record(
-                JournalEvent.FAULT_DETECTED,
-                reason=action.reason or "diagnosis",
-            )
-        self._job_manager.enqueue_action(action)
+            if (
+                self._event_journal is not None
+                and action.action_type == DiagnosisActionType.RESTART_WORKER
+            ):
+                # a hang restart is a detected fault even though no node
+                # died
+                self._event_journal.record(
+                    JournalEvent.FAULT_DETECTED,
+                    reason=action.reason or "diagnosis",
+                )
+            carry = tracing.inject_wire()
+            if carry is not None:
+                action.data.setdefault(tracing.WIRE_KEY, carry)
+            self._job_manager.enqueue_action(action)
 
     # -- pre-check ---------------------------------------------------------
 

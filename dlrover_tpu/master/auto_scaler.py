@@ -12,8 +12,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.common.constants import NodeStatus, SpanName
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.master.resource import (
     ScalingStats,
     LocalOptimizer,
@@ -140,14 +141,22 @@ class JobAutoScaler:
             "auto-scale %s → %s nodes (%s)",
             self.target_nodes, target, plan.reason,
         )
-        self.target_nodes = target
-        # the next re-rendezvous must cut a world of the new size
-        for manager in self._rdzv_managers.values():
-            manager.update_rdzv_params(
-                min_nodes=min(self.min_nodes, target), max_nodes=target,
-                node_unit=self.node_unit,
-            )
-        if self._scaler is not None:
-            from dlrover_tpu.k8s.scaler import ScalePlan
+        # one trace per applied plan: rdzv-param refresh + the k8s scale
+        # call are children of the same arc
+        with tracing.span(SpanName.SCALE_APPLY, source="master",
+                          target=target, prev=self.target_nodes,
+                          reason=str(plan.reason)):
+            self.target_nodes = target
+            # the next re-rendezvous must cut a world of the new size
+            for manager in self._rdzv_managers.values():
+                with tracing.span(SpanName.SCALE_RDZV_PARAMS,
+                                  source="master", target=target):
+                    manager.update_rdzv_params(
+                        min_nodes=min(self.min_nodes, target),
+                        max_nodes=target,
+                        node_unit=self.node_unit,
+                    )
+            if self._scaler is not None:
+                from dlrover_tpu.k8s.scaler import ScalePlan
 
-            self._scaler.scale(ScalePlan(worker_num=target))
+                self._scaler.scale(ScalePlan(worker_num=target))

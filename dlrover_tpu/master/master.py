@@ -17,11 +17,13 @@ from dlrover_tpu.common.constants import (
     ConfigKey,
     JobStage,
     RendezvousName,
+    SpanName,
     env_float,
     env_str,
 )
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCServer
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.master.job_manager import JobManager
 from dlrover_tpu.master.kv_store import KVStoreService, SyncService
 from dlrover_tpu.master.perf_monitor import PerfMonitor
@@ -70,6 +72,19 @@ class JobMaster:
         self.event_journal = EventJournal()
         self.metrics_registry = get_registry()
         self.event_journal.attach_gauges(self.metrics_registry)
+        # crash flight recorder: post-mortem bundles (chrome trace +
+        # journal tail + metrics + config + stacks) on node faults,
+        # injected chaos, or GET /debug/bundle
+        from dlrover_tpu.observability.flight_recorder import (
+            REASON_NODE_FAULT as _FR_REASON_NODE_FAULT,
+            FlightRecorder,
+        )
+
+        self.flight_recorder = FlightRecorder(
+            source="master",
+            journal=self.event_journal,
+            registry=self.metrics_registry,
+        )
         # first step report after a recovery phase closes it (step_resumed)
         self.perf_monitor.journal = self.event_journal
         self.metric_context = JobMetricContext()
@@ -150,11 +165,14 @@ class JobMaster:
 
         _inj = get_injector()
         if _inj is not None:
-            _inj.set_reporter(
+            # journal the fault, then let the flight recorder snapshot a
+            # (rate-limited) bundle — the drill artifact survives even
+            # when recovery succeeds
+            _inj.set_reporter(self.flight_recorder.wrap_fault_reporter(
                 lambda event, _j=self.event_journal: _j.record(
                     "fault_injected", source="chaos", **event
                 )
-            )
+            ))
             logger.info("fault injection active on master: %s",
                         _inj.describe())
         self._server = RPCServer(port=port)
@@ -221,6 +239,10 @@ class JobMaster:
                         self.event_journal.to_json(),
                     ),
                 )
+                self._http_server.add_get_route(
+                    "/debug/bundle",
+                    self.flight_recorder.http_handler(),
+                )
             except ValueError:
                 logger.warning(
                     "DLROVER_TPU_HTTP_PORT=%r is not a port; http "
@@ -244,21 +266,43 @@ class JobMaster:
                 _NS.FAILED, _NS.DELETED, _NS.BREAKDOWN,
             ):
                 return
-            self.task_manager.recover_tasks(event.node.id)
-            self.event_journal.record(
-                JournalEvent.FAULT_DETECTED,
-                node_id=event.node.id,
-                status=event.node.status,
+            # one trace roots the whole detect→relaunch arc; its context
+            # rides down to every survivor inside the restart action, so
+            # the agents' restart spans join this trace_id
+            with tracing.span(
+                SpanName.FAULT_RELAUNCH, source="master",
+                node_id=event.node.id, status=event.node.status,
+            ):
+                self.task_manager.recover_tasks(event.node.id)
+                self.event_journal.record(
+                    JournalEvent.FAULT_DETECTED,
+                    node_id=event.node.id,
+                    status=event.node.status,
+                )
+                for manager in self.rdzv_managers.values():
+                    manager.remove_alive_node(event.node.rank)
+                carry = tracing.inject_wire()
+                for node in self.job_manager.list_nodes():
+                    if (node.id != event.node.id
+                            and node.status == _NS.RUNNING):
+                        data = (
+                            {tracing.WIRE_KEY: carry}
+                            if carry is not None else None
+                        )
+                        self.job_manager.enqueue_action(DiagnosisAction(
+                            _DA.RESTART_WORKER,
+                            instance=node.id,
+                            reason=(f"peer node {event.node.id} left "
+                                    "the world"),
+                            data=data,
+                        ))
+            # the post-mortem artifact for a real node death (rate-limited
+            # so a flapping node can't flood the trace dir)
+            self.flight_recorder.capture(
+                _FR_REASON_NODE_FAULT,
+                extra={"node_id": event.node.id,
+                       "status": event.node.status},
             )
-            for manager in self.rdzv_managers.values():
-                manager.remove_alive_node(event.node.rank)
-            for node in self.job_manager.list_nodes():
-                if node.id != event.node.id and node.status == _NS.RUNNING:
-                    self.job_manager.enqueue_action(DiagnosisAction(
-                        _DA.RESTART_WORKER,
-                        instance=node.id,
-                        reason=f"peer node {event.node.id} left the world",
-                    ))
 
         self.job_manager.add_event_callback(_on_node_event)
 

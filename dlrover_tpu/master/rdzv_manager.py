@@ -29,8 +29,13 @@ from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.comm import NodeMeta
 from dlrover_tpu.common.config import get_context
-from dlrover_tpu.common.constants import NetworkFailureReason, RendezvousName
+from dlrover_tpu.common.constants import (
+    NetworkFailureReason,
+    RendezvousName,
+    SpanName,
+)
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.observability.journal import JournalEvent
 
 
@@ -143,7 +148,11 @@ class RendezvousManager(ABC):
             # rendezvous policy must absorb it); error surfaces as an RPC
             # handler fault to the joining agent
             inj.fire("rdzv.join", rdzv=self._name, node_rank=meta.node_rank)
-        with self._lock:
+        # the servicer restored the joining agent's trace context, so this
+        # span lands inside the agent's rdzv.join arc
+        with tracing.span(SpanName.RDZV_JOIN, source="master",
+                          rdzv_name=self._name,
+                          node_rank=meta.node_rank), self._lock:
             if not self._waiting_nodes:
                 self._start_rdzv_ts = time.monotonic()
                 if self.journal is not None:
@@ -216,40 +225,46 @@ class RendezvousManager(ABC):
         world_size = (world_size // unit) * unit
         if world_size < max(params.min_nodes, unit):
             return False
-        ranks = self._select_world_ranks(world_size)
-        self._rdzv_nodes = {r: self._waiting_nodes[r] for r in ranks}
-        # topology-aware comm order: slice-contiguous, torus order within
-        # a slice (net_topology.py; the reference's asw/psw DpTopologySorter
-        # dual) — agents assign worker ranks by comm_rank
-        from dlrover_tpu.master.net_topology import (
-            TpuSliceTopologySorter,
-            stamp_comm_ranks,
-        )
-
-        stamp_comm_ranks(self._rdzv_nodes, TpuSliceTopologySorter())
-        self._latest_rdzv_nodes = ranks
-        for r in ranks:
-            del self._waiting_nodes[r]
-        self._rdzv_round += 1
-        duration = (
-            time.monotonic() - self._start_rdzv_ts if self._start_rdzv_ts > 0
-            else 0.0
-        )
-        self._lastcall_time = 0.0
-        self._start_rdzv_ts = 0.0
-        self._round_duration_hist.observe(duration)
-        self._world_size_gauge.set(world_size)
-        self._rounds_counter.inc()
-        if self.journal is not None:
-            self.journal.record(
-                JournalEvent.RDZV_COMPLETE, round=self._rdzv_round,
-                world_size=world_size, duration_s=duration,
+        # the cut runs on whichever agent's poll tipped the round over —
+        # its restored trace context ties the world commit to that arc
+        with tracing.span(SpanName.RDZV_WORLD_CUT, source="master",
+                          rdzv_name=self._name,
+                          round=self._rdzv_round + 1):
+            ranks = self._select_world_ranks(world_size)
+            self._rdzv_nodes = {r: self._waiting_nodes[r] for r in ranks}
+            # topology-aware comm order: slice-contiguous, torus order
+            # within a slice (net_topology.py; the reference's asw/psw
+            # DpTopologySorter dual) — agents assign worker ranks by
+            # comm_rank
+            from dlrover_tpu.master.net_topology import (
+                TpuSliceTopologySorter,
+                stamp_comm_ranks,
             )
-        logger.info(
-            "%s rdzv round %s completed: world=%s (waiting leftover=%s)",
-            self._name, self._rdzv_round, ranks,
-            sorted(self._waiting_nodes),
-        )
+
+            stamp_comm_ranks(self._rdzv_nodes, TpuSliceTopologySorter())
+            self._latest_rdzv_nodes = ranks
+            for r in ranks:
+                del self._waiting_nodes[r]
+            self._rdzv_round += 1
+            duration = (
+                time.monotonic() - self._start_rdzv_ts
+                if self._start_rdzv_ts > 0 else 0.0
+            )
+            self._lastcall_time = 0.0
+            self._start_rdzv_ts = 0.0
+            self._round_duration_hist.observe(duration)
+            self._world_size_gauge.set(world_size)
+            self._rounds_counter.inc()
+            if self.journal is not None:
+                self.journal.record(
+                    JournalEvent.RDZV_COMPLETE, round=self._rdzv_round,
+                    world_size=world_size, duration_s=duration,
+                )
+            logger.info(
+                "%s rdzv round %s completed: world=%s (waiting leftover=%s)",
+                self._name, self._rdzv_round, ranks,
+                sorted(self._waiting_nodes),
+            )
         return True
 
     def _select_world_ranks(self, world_size: int) -> List[int]:

@@ -55,13 +55,16 @@ class JournalEvent:
     STRAGGLER_DETECTED = "straggler_detected"
     HANG_ATTRIBUTED = "hang_attributed"
     STACK_DUMP_CAPTURED = "stack_dump_captured"
+    # flight recorder (observability/flight_recorder.py) wrote a
+    # post-mortem bundle — informational, no phase transition
+    TRACE_BUNDLE_CAPTURED = "trace_bundle_captured"
 
     ALL = (
         FAULT_DETECTED, RDZV_START, RDZV_COMPLETE, RESTORE_START,
         RESTORE_COMPLETE, RECOMPILE_START, RECOMPILE_COMPLETE, STEP_RESUMED,
         FAULT_INJECTED, CKPT_CORRUPT, CKPT_REPAIRED, PARTITION_RESYNC,
         SHM_ORPHANS_CLEANED, STRAGGLER_DETECTED, HANG_ATTRIBUTED,
-        STACK_DUMP_CAPTURED,
+        STACK_DUMP_CAPTURED, TRACE_BUNDLE_CAPTURED,
     )
 
 

@@ -282,6 +282,9 @@ def main(argv=None) -> int:
     # the observability spine is part of the drill: the master's /metrics
     # and /events must stay scrapeable through the faults (port 0 = free)
     os.environ.setdefault("DLROVER_TPU_HTTP_PORT", "0")
+    # flight recorder: the dead agent must leave a post-mortem bundle here
+    bundle_dir = os.path.join(workdir, "bundles")
+    os.environ["DLROVER_TPU_TRACE_DIR"] = bundle_dir
     master = LocalJobMaster(
         job_name=job, node_num=2, min_nodes=1, max_nodes=2,
     )
@@ -396,6 +399,21 @@ def main(argv=None) -> int:
             30, "master detects the dead agent",
         )
         detect_s = time.time() - kill_ts
+        # the flight recorder auto-captures a node_fault bundle on the
+        # same callback that detected the death — a post-mortem artifact
+        # exists even though recovery succeeds
+        _wait(
+            lambda: any(
+                "node_fault" in b for b in (
+                    os.listdir(bundle_dir)
+                    if os.path.isdir(bundle_dir) else []
+                )
+            ),
+            15, "flight-recorder node_fault bundle",
+        )
+        fault_bundle = os.path.join(bundle_dir, next(
+            b for b in sorted(os.listdir(bundle_dir)) if "node_fault" in b
+        ))
         _wait(
             lambda: any(
                 r["event"] == "segment_start" and r["world"] == 1
@@ -473,6 +491,21 @@ def main(argv=None) -> int:
         segments = [r for r in records if r["event"] == "segment_start"]
         dones = [r for r in records if r["event"] == "done"]
         goodput = _merged_goodput(event_dir)
+        # flight-recorder bundle: traces.json must be a valid chrome
+        # trace whose span track includes the rendezvous arc (the kill
+        # froze the ring with the world-formation spans still in it)
+        bundle_files = sorted(os.listdir(fault_bundle))
+        with open(os.path.join(fault_bundle, "traces.json")) as f:
+            trace_events = json.load(f)["traceEvents"]
+        rdzv_spans = [
+            e for e in trace_events
+            if e.get("ph") == "X" and e.get("cat") == "span"
+            and str(e.get("name", "")).startswith("rdzv.")
+        ]
+        trace_ids = {
+            e["args"]["trace_id"] for e in rdzv_spans
+            if "trace_id" in e.get("args", {})
+        }
         # this scenario packs one kill + one rejoin into a ~20 s toy job,
         # so the raw fraction is dominated by the fixed recovery cost; the
         # extrapolated figure charges the same measured unproductive time
@@ -523,6 +556,13 @@ def main(argv=None) -> int:
             # world size (real collectives over the joint world), and the
             # final weight equals the step count (grad=1/step by
             # construction — no step lost or doubled across shrink/rejoin)
+            # flight recorder (observability/flight_recorder.py): the
+            # node death auto-captured a post-mortem bundle whose chrome
+            # trace carries the rendezvous arc
+            "trace_bundle": os.path.basename(fault_bundle),
+            "trace_bundle_files": bundle_files,
+            "trace_rdzv_spans": len(rdzv_spans),
+            "trace_rdzv_trace_ids": len(trace_ids),
             "w_final": max(
                 (d.get("w_final", -1.0) for d in dones), default=-1.0
             ),

@@ -108,6 +108,17 @@ def test_chaos_kill_shrink_resume_rejoin():
     assert result["straggler"]["cause"] == "compute", result["straggler"]
     assert result["straggler"]["ratio"] > 2.0, result["straggler"]
     assert result["skew_ratio_mid"] > 0.0, result["skew_ratio_mid"]
+    # flight recorder: killing the agent left a post-mortem bundle with a
+    # parseable chrome trace (the drill itself json.load()s traces.json)
+    # whose span track still holds the rendezvous arc, plus the journal
+    # tail, metrics snapshot, config fingerprint, and thread stacks
+    assert "node_fault" in result["trace_bundle"], result["trace_bundle"]
+    assert set(result["trace_bundle_files"]) >= {
+        "traces.json", "journal.json", "metrics.prom", "config.json",
+        "stacks.txt", "manifest.json",
+    }, result["trace_bundle_files"]
+    assert result["trace_rdzv_spans"] >= 2, result["trace_rdzv_spans"]
+    assert result["trace_rdzv_trace_ids"] >= 1, result
 
 
 @pytest.mark.slow

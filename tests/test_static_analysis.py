@@ -277,6 +277,58 @@ class TestDLR006:
         assert rules_of(src) == []
 
 
+# -- DLR007: ad-hoc trace span names -------------------------------------------
+
+
+class TestDLR007:
+    def test_flags_literal_span_name_on_tracing_module(self):
+        src = (
+            "from dlrover_tpu.observability import tracing\n"
+            "def f():\n"
+            "    with tracing.span('rdzv.join', source='master'):\n"
+            "        pass\n"
+        )
+        assert rules_of(src) == ["DLR007"]
+
+    def test_flags_literal_span_name_on_tracer_object(self):
+        src = (
+            "def f(self):\n"
+            "    with self._tracer.span('ckpt.save'):\n"
+            "        pass\n"
+        )
+        assert rules_of(src) == ["DLR007"]
+
+    def test_flags_literal_name_keyword(self):
+        src = (
+            "def f(tracer):\n"
+            "    tracer.start_span(name='scale.apply')\n"
+        )
+        assert rules_of(src) == ["DLR007"]
+
+    def test_constant_span_name_is_clean(self):
+        src = (
+            "from dlrover_tpu.common.constants import SpanName\n"
+            "from dlrover_tpu.observability import tracing\n"
+            "def f():\n"
+            "    with tracing.span(SpanName.RDZV_JOIN, source='master'):\n"
+            "        pass\n"
+        )
+        assert rules_of(src) == []
+
+    def test_non_tracer_span_receivers_are_clean(self):
+        # the event-emitter plane (self._events.span) and unrelated .span()
+        # receivers are DLR006's domain / out of scope — not DLR007's
+        src = (
+            "def f(self, em, timer):\n"
+            "    with self._events.span('rendezvous'):\n"
+            "        pass\n"
+            "    with em.span('phase'):\n"
+            "        pass\n"
+            "    timer.span('tick')\n"
+        )
+        assert rules_of(src) == []
+
+
 # -- suppression machinery ----------------------------------------------------
 
 
