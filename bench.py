@@ -996,6 +996,143 @@ def bench_goodput(timeout_s: float = 300.0) -> dict:
         return {"error": repr(e)}
 
 
+def _reshard_point(master, job: str, target_mb: int) -> dict:
+    """Time one live reshard at ``target_mb`` of state: two survivor
+    'hosts' each hold half of every leaf's rows in a sealed shm frame
+    served over localhost RPC, and a restorer with no local frame pulls
+    and assembles everything remotely — the pure wire+assembly cost of
+    the checkpoint-free recovery path (ckpt/reshard.py), no storage, no
+    device link in the loop."""
+    import numpy as np
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.ckpt.engine import _assemble
+    from dlrover_tpu.ckpt.reshard import (
+        ReshardCoordinator,
+        ReshardRestorer,
+        ReshardService,
+    )
+    from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler, shm_name
+    from dlrover_tpu.common.multi_process import unlink_shared_memory
+
+    n_leaves = 4
+    cols = 1024
+    rows = max(2, int(target_mb * 1e6 / 4 / cols / n_leaves)) // 2 * 2
+    half = rows // 2
+    leaves = {
+        f"layer{i}": np.arange(
+            rows * cols, dtype=np.float32
+        ).reshape(rows, cols) + i
+        for i in range(n_leaves)
+    }
+    nbytes = sum(a.nbytes for a in leaves.values())
+
+    def write_half(node_rank, r0, r1):
+        shm = SharedMemoryHandler(shm_name(job, node_rank, 0))
+        metas, bufs, off = [], [], 0
+        for name, arr in leaves.items():
+            part = np.ascontiguousarray(arr[r0:r1])
+            metas.append({
+                "path": f"['{name}']", "kind": "array",
+                "dtype": "float32", "gshape": [rows, cols],
+                "shards": [{
+                    "offset": off, "nbytes": part.nbytes,
+                    "lshape": [r1 - r0, cols], "start": [r0, 0],
+                }],
+            })
+            bufs.append(part)
+            off += part.nbytes
+        shm.write_frame({
+            "step": 1, "ts": 0.0, "job": job, "node_rank": node_rank,
+            "local_rank": 0, "rank": node_rank, "world_size": 2,
+            "leaves": metas,
+        }, bufs)
+
+    services = []
+    try:
+        write_half(0, 0, half)
+        write_half(1, half, rows)
+        for nr in range(2):
+            svc = ReshardService(
+                shm_provider=(
+                    lambda nr=nr: [
+                        SharedMemoryHandler(shm_name(job, nr, 0))
+                    ]
+                )
+            )
+            svc.start()
+            svc.register(MasterClient(master.addr, nr), job, nr)
+            services.append(svc)
+        cut = ReshardCoordinator(job, master.kv_store).on_world_cut(
+            [0, 1], [0], 1
+        )
+        restorer = ReshardRestorer(
+            job, MasterClient(master.addr, 0), node_rank=0, own_shm=None
+        )
+        target = {
+            name: np.zeros((rows, cols), np.float32) for name in leaves
+        }
+        t0 = time.perf_counter()
+        restored, step, stats = restorer.restore(target, _assemble, cut)
+        t_reshard = time.perf_counter() - t0
+        if step != 1 or not np.array_equal(
+            restored["layer3"][-1], leaves["layer3"][-1]
+        ):
+            raise RuntimeError("reshard point restored wrong bytes")
+        return {
+            "state_mb": round(nbytes / 1e6, 1),
+            "t_reshard_s": round(t_reshard, 3),
+            "reshard_rate_mbps": round(
+                nbytes / 1e6 / max(t_reshard, 1e-9), 1
+            ),
+            "transfers": stats["transfers"],
+            "bytes_remote": stats["bytes_remote"],
+        }
+    finally:
+        for svc in services:
+            svc.stop()
+        for nr in range(2):
+            unlink_shared_memory(shm_name(job, nr, 0))
+        gc.collect()
+
+
+def bench_reshard(budget_s: float = 120.0) -> dict:
+    """Live-reshard restore time vs state size (the recovery path the
+    chaos drill exercises end-to-end; here isolated and scaled). The
+    claim under test: recovery cost is host-link bandwidth, so
+    t_reshard grows linearly with state size and never pays a storage
+    round-trip."""
+    from dlrover_tpu.master.master import LocalJobMaster
+
+    job = f"benchresh{os.getpid()}"
+    master = LocalJobMaster(job_name=job, node_num=2)
+    master.prepare()
+    t0 = time.monotonic()
+    points = []
+    try:
+        for target_mb in (32, 128, 512):
+            if points and time.monotonic() - t0 > budget_s - 30.0:
+                points.append(
+                    {"state_mb": target_mb, "skipped": "budget"}
+                )
+                continue
+            points.append(_reshard_point(master, job, target_mb))
+        ran = [p for p in points if "t_reshard_s" in p]
+        return {
+            "points": points,
+            # the headline pair the driver tracks release-over-release
+            "t_reshard_s": ran[-1]["t_reshard_s"] if ran else None,
+            "state_mb": ran[-1]["state_mb"] if ran else None,
+            "reshard_rate_mbps": (
+                ran[-1]["reshard_rate_mbps"] if ran else None
+            ),
+        }
+    except Exception as e:  # noqa: BLE001 — bench must still emit a line
+        return {"error": repr(e), "points": points}
+    finally:
+        master.stop()
+
+
 # Wall-clock discipline (round-4 fix for the r3 rc=124 record hole): the
 # driver runs bench.py under a ~30-min budget; this process budgets
 # BENCH_TIME_BUDGET_S (default 20 min) across sections, RE-PRINTS the
@@ -1015,6 +1152,7 @@ _SECTIONS = (
     ("decode", lambda left: bench_decode(), 150.0),
     ("attn", lambda left: bench_attention(), 90.0),
     ("goodput", lambda left: bench_goodput(timeout_s=left - 10.0), 60.0),
+    ("reshard", lambda left: bench_reshard(budget_s=min(left, 150.0)), 45.0),
     ("ckpt", lambda left: bench_ckpt(budget_s=left), 120.0),
 )
 

@@ -35,8 +35,10 @@ from dlrover_tpu.common.constants import (
     EnvKey,
     NodeStatus,
     RendezvousName,
+    SharedResourceName,
     SpanName,
     TrainingExceptionLevel,
+    env_flag,
     env_float,
     env_str,
 )
@@ -89,14 +91,14 @@ class MasterRendezvousHandler:
             free_port=free_port,
             node_unit=self._node_unit,
         )
-        start = time.time()
+        start = time.monotonic()
         while True:
             rdzv_round, _, world, coordinator = self._client.get_comm_world(
                 self._name, self._node_rank
             )
             if world and self._node_rank in world:
                 return rdzv_round, world, coordinator
-            if time.time() - start > self._timeout_s:
+            if time.monotonic() - start > self._timeout_s:
                 raise TimeoutError(
                     f"rendezvous {self._name} timed out after "
                     f"{self._timeout_s}s (node_rank={self._node_rank})"
@@ -223,6 +225,7 @@ class ElasticTrainingAgent:
         self._events = get_emitter(f"agent_{config.node_rank}")
         self._training_monitor = None
         self._replica_service = None
+        self._reshard_service = None
         # observability spine: local metrics (scraped via the optional
         # per-agent /metrics server) + journal events reported to master
         from dlrover_tpu.observability.registry import get_registry
@@ -431,9 +434,9 @@ class ElasticTrainingAgent:
                     w.proc.send_signal(sig)
                 except ProcessLookupError:
                     pass
-        deadline = time.time() + grace_s
+        deadline = time.monotonic() + grace_s
         for w in self._workers:
-            remaining = max(0.1, deadline - time.time())
+            remaining = max(0.1, deadline - time.monotonic())
             try:
                 w.proc.wait(timeout=remaining)
             except subprocess.TimeoutExpired:
@@ -598,6 +601,18 @@ class ElasticTrainingAgent:
         self._last_global_step = step
         self._last_step_ts = ts
 
+    def _local_shm_handlers(self):
+        """Live handlers for the shm frames this host's workers registered
+        in the IPC meta dict (same attach idiom as the saver) — the
+        ReshardService reads shard byte-ranges through these."""
+        from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler
+
+        handlers = []
+        meta = self._ipc_server.local_dict(SharedResourceName.SHM_META_DICT)
+        for info in dict(meta).values():
+            handlers.append(SharedMemoryHandler(info["shm"]))
+        return handlers
+
     # -- main loop ---------------------------------------------------------
 
     def run(self) -> int:
@@ -650,6 +665,27 @@ class ElasticTrainingAgent:
             self._replica_service.register(
                 self._client, self._config.job_name, self._config.node_rank
             )
+        if env_flag(ConfigKey.RESHARD, default=True):
+            # live-reshard plane (ckpt/reshard.py): serve this host's
+            # sealed shm frames by shard byte-range so survivors of a
+            # world cut can feed relaunched peers without a storage read;
+            # runs in the agent so the frames outlive the workers
+            from dlrover_tpu.ckpt.reshard import ReshardService
+
+            self._reshard_service = ReshardService(
+                shm_provider=self._local_shm_handlers,
+            )
+            self._reshard_service.start()
+            try:
+                self._reshard_service.register(
+                    self._client, self._config.job_name,
+                    self._config.node_rank,
+                )
+            except ConnectionError as e:
+                logger.warning(
+                    "reshard service address publish failed: %r — peers "
+                    "will fall back to replica/shm/storage restore", e,
+                )
         if self._ckpt_saver is not None:
             self._ckpt_saver.start(self._ipc_server)
             try:
@@ -733,6 +769,8 @@ class ElasticTrainingAgent:
                 self._ckpt_saver.stop()
             if self._replica_service is not None:
                 self._replica_service.stop()
+            if self._reshard_service is not None:
+                self._reshard_service.stop()
             if timer_daemon is not None:
                 timer_daemon.kill()
             if self._warm_pool is not None:
@@ -845,7 +883,7 @@ class ElasticTrainingAgent:
                 except ConnectionError:
                     pass
                 return 1
-            now = time.time()
+            now = time.monotonic()
             if now - membership_poll >= 1.0:
                 membership_poll = now
                 if self._membership_changed():

@@ -50,7 +50,6 @@ decision log used by determinism tests.
 """
 
 import json
-import os
 import re
 import threading
 import time
@@ -310,9 +309,15 @@ def get_injector() -> Optional[FaultInjector]:
         return _instance
     with _lock:
         if not _configured:
-            schedule = os.getenv(SCHEDULE_ENV, "")
+            from dlrover_tpu.common.constants import (
+                ConfigKey,
+                env_int,
+                env_str,
+            )
+
+            schedule = env_str(ConfigKey.FAULT_SCHEDULE, "")
             if schedule:
-                seed = int(os.getenv(SEED_ENV, "0") or 0)
+                seed = env_int(ConfigKey.FAULT_SEED, 0)
                 try:
                     _instance = FaultInjector(
                         parse_schedule(schedule), seed=seed,

@@ -199,6 +199,22 @@ class CheckpointEngine:
             "dlrover_ckpt_drain_bytes_per_second",
             "Throughput of the most recent shm drain",
         )
+        # live-reshard plane (ckpt/reshard.py): the checkpoint-free first
+        # rung of the restore ladder
+        self._reshard_hist = _reg.histogram(
+            "dlrover_reshard_seconds",
+            "End-to-end live-reshard restore latency",
+        )
+        self._reshard_bytes = _reg.counter(
+            "dlrover_reshard_bytes_total",
+            "Bytes moved by live reshard, by locality",
+            labelnames=("locality",),
+        )
+        self._reshard_aborts = _reg.counter(
+            "dlrover_reshard_aborts_total",
+            "Live-reshard attempts that fell to the next rung, by reason",
+            labelnames=("reason",),
+        )
         # donation safety (see _plan_state): snapshot shards on-device
         # before the async drain unless explicitly disabled
         self._device_snapshot = env_flag(
@@ -694,6 +710,12 @@ class CheckpointEngine:
             self.wait_drained()
             restore_t0 = time.monotonic()
             self._report_event(JournalEvent.RESTORE_START)
+            # degradation ladder, each rung journaled with its reason:
+            # live reshard → peer-frame restore → shm flash → storage
+            state, step = self._load_via_reshard(target, restore_t0)
+            if state is not None:
+                sp.add_event("restored", medium="reshard", step=step)
+                return state, step
             if self._replicas is not None:
                 # a relaunched node's shm is empty — pull own frame from a
                 # backup-group peer first (replica.py restore semantics)
@@ -714,6 +736,13 @@ class CheckpointEngine:
                     sp.add_event("restored", medium="shm", step=step)
                     self._finish_restore(restore_t0, "shm", step)
                     return state, step
+            state, step = self._load_from_peer_frames(target)
+            if state is not None:
+                logger.info("restored step %s from replica peer frames",
+                            step)
+                sp.add_event("restored", medium="replica", step=step)
+                self._finish_restore(restore_t0, "replica", step)
+                return state, step
             state, step = self._load_from_storage(
                 target, path or self.ckpt_dir
             )
@@ -811,6 +840,139 @@ class CheckpointEngine:
         except (KeyError, ValueError) as e:
             logger.warning("shm restore incomplete (%s) — trying storage", e)
             return None
+
+    def _load_via_reshard(self, target,
+                          restore_t0: float) -> Tuple[Any, int]:
+        """First ladder rung: checkpoint-free live reshard. Only runs when
+        the master published a cut record for this worker's rendezvous
+        round (the world actually changed); any failure journals
+        ``reshard_aborted`` with its reason and returns (None, -1) so the
+        ladder falls to the next rung — a reshard must never wedge the
+        restore."""
+        if self._master is None or not env_flag(
+            ConfigKey.RESHARD, default=True
+        ):
+            return None, -1
+        from dlrover_tpu.ckpt import reshard as reshard_mod
+
+        restorer = reshard_mod.ReshardRestorer(
+            self.job_name, self._master, self.node_rank,
+            local_rank=self.local_rank, rank=self.rank,
+            own_shm=self._shm,
+        )
+        try:
+            cut = restorer.read_cut()
+        except (ConnectionError, RuntimeError, ValueError) as e:
+            logger.info("reshard cut lookup failed: %r", e)
+            return None, -1
+        if cut is None:
+            return None, -1
+        self._report_event(
+            JournalEvent.RESHARD_START,
+            {"round": cut.get("round"), "old_world": cut.get("old"),
+             "new_world": cut.get("new")},
+        )
+        try:
+            state, step, stats = restorer.restore(target, _assemble, cut)
+        except reshard_mod.ReshardAbort as e:
+            logger.warning(
+                "live reshard aborted (%s: %s) — falling to the next "
+                "restore rung", e.reason, e,
+            )
+            self._reshard_aborts.labels(reason=e.reason).inc()
+            self._report_event(
+                JournalEvent.RESHARD_ABORTED,
+                {"reason": e.reason, "detail": str(e),
+                 "round": cut.get("round")},
+            )
+            return None, -1
+        self._reshard_hist.observe(stats["duration_s"])
+        self._reshard_bytes.labels(locality="local").inc(
+            stats.get("bytes_local", 0)
+        )
+        self._reshard_bytes.labels(locality="remote").inc(
+            stats.get("bytes_remote", 0)
+        )
+        self._report_event(JournalEvent.RESHARD_COMPLETE, dict(stats))
+        logger.info(
+            "live reshard complete: step %s, %s transfers, %s bytes "
+            "(%s remote) in %.3fs",
+            step, stats.get("transfers"), stats.get("bytes"),
+            stats.get("bytes_remote"), stats.get("duration_s", 0.0),
+        )
+        self._finish_restore(restore_t0, "reshard", step)
+        return state, step
+
+    def _load_from_peer_frames(self, target) -> Tuple[Any, int]:
+        """Second ladder rung (ROADMAP item 2 slice): before touching
+        storage, assemble from checkpoint frames that live peers' replica
+        stores still hold — any owner's frame, not just our own (the
+        own-frame shm repair already ran and failed by this point)."""
+        if self._replicas is None:
+            return None, -1
+        lister = getattr(self._replicas, "list_entries", None)
+        fetcher = getattr(self._replicas, "fetch_frame", None)
+        if lister is None or fetcher is None:
+            return None, -1
+        try:
+            entries = lister()
+        except (ConnectionError, OSError, RuntimeError) as e:
+            logger.info("replica peer-frame listing failed: %r", e)
+            return None, -1
+        if not entries:
+            return None, -1
+        from dlrover_tpu.ckpt.ckpt_saver import merge_frame_leaves
+        from dlrover_tpu.ckpt.shm_handler import (
+            frame_shard_bytes,
+            parse_frame,
+            verify_parsed_frame,
+        )
+
+        def reader(leaf_meta, shard_meta):
+            return frame_shard_bytes(shard_meta["_frame"], shard_meta)
+
+        # newest step first; an incomplete step (missing/corrupt frames
+        # the surviving shards can't cover) falls to the next one
+        for step in sorted({int(e[2]) for e in entries}, reverse=True):
+            frames = []
+            owners = sorted({
+                (int(o), int(l)) for o, l, s in entries if int(s) == step
+            })
+            for owner, local in owners:
+                try:
+                    held = fetcher(owner, local)
+                except (ConnectionError, OSError, RuntimeError) as e:
+                    logger.info(
+                        "peer frame fetch (owner=%s local=%s) failed: %r",
+                        owner, local, e,
+                    )
+                    continue
+                if held is None or held[0] != step:
+                    continue
+                meta = parse_frame(held[1])
+                if meta is None:
+                    continue
+                bad = verify_parsed_frame(meta)
+                if bad:
+                    self._report_event(
+                        JournalEvent.CKPT_CORRUPT,
+                        {"medium": "replica", "step": step, "shards": bad},
+                    )
+                    continue
+                frames.append(meta)
+            if not frames:
+                continue
+            merged = merge_frame_leaves(frames)
+            try:
+                state = _assemble(target, merged, reader)
+            except (KeyError, ValueError) as e:
+                logger.info(
+                    "peer frames at step %s don't cover the state (%s)",
+                    step, e,
+                )
+                continue
+            return state, step
+        return None, -1
 
     def _load_from_storage(self, target, path: str) -> Tuple[Any, int]:
         from dlrover_tpu.ckpt.ckpt_saver import (

@@ -333,15 +333,18 @@ class ReplicaManager:
                 best = held
         return best
 
-    def _fetch_from(self, client: RPCClient,
-                    local_rank: int) -> Optional[Tuple[int, bytes]]:
-        """Chunked download of this node's frame from one peer. Restarts
-        once if the peer's stored frame advances mid-download."""
+    def _fetch_from(self, client: RPCClient, local_rank: int,
+                    owner_rank: Optional[int] = None
+                    ) -> Optional[Tuple[int, bytes]]:
+        """Chunked download of one owner's frame from one peer (default:
+        this node's own frame). Restarts once if the peer's stored frame
+        advances mid-download."""
+        owner = self.node_rank if owner_rank is None else owner_rank
         for _ in range(2):
             resp = client.call(
                 "replica_get",
                 comm.ReplicaGetRequest(
-                    owner_rank=self.node_rank, local_rank=local_rank,
+                    owner_rank=owner, local_rank=local_rank,
                     chunk_index=0, chunk_bytes=self.CHUNK_BYTES,
                 ),
             )
@@ -354,7 +357,7 @@ class ReplicaManager:
                 nxt = client.call(
                     "replica_get",
                     comm.ReplicaGetRequest(
-                        owner_rank=self.node_rank, local_rank=local_rank,
+                        owner_rank=owner, local_rank=local_rank,
                         chunk_index=i, chunk_bytes=self.CHUNK_BYTES,
                     ),
                 )
@@ -367,6 +370,62 @@ class ReplicaManager:
             if consistent:
                 return step, b"".join(parts)
         return None
+
+    # -- peer-frame restore (engine ladder rung before storage) ------------
+
+    def list_entries(self) -> List[Tuple[int, int, int]]:
+        """Every ``(owner_rank, local_rank, step)`` the local agent store
+        and the group peers currently hold — the engine's peer-frame rung
+        uses this to find a step the replica tier can fully cover."""
+        entries: List[Tuple[int, int, int]] = []
+        if self._service is not None:
+            entries.extend(tuple(e) for e in self._service.entries())
+        remote_ranks = (
+            self.peers if self._service is not None
+            else [self.node_rank, *self.peers]
+        )
+        for rank in remote_ranks:
+            client = self._peer_client(rank)
+            if client is None:
+                continue
+            try:
+                resp = client.call("replica_list", comm.BaseRequest())
+            except _PEER_ERRORS:
+                self._clients.pop(rank, None)
+                continue
+            entries.extend(
+                (int(o), int(l), int(s)) for o, l, s in resp.entries
+            )
+        return sorted(set(entries))
+
+    def fetch_frame(self, owner_rank: int,
+                    local_rank: int = 0) -> Optional[Tuple[int, bytes]]:
+        """Fetch ANY owner's frame from whichever store holds the newest
+        copy (local agent first, then group peers) — unlike :meth:`fetch`,
+        which only retrieves this node's own frame."""
+        best: Optional[Tuple[int, bytes]] = None
+        if self._service is not None:
+            held = self._service.get(owner_rank, local_rank)
+            if held is not None:
+                best = held
+        remote_ranks = (
+            self.peers if self._service is not None
+            else [self.node_rank, *self.peers]
+        )
+        for rank in remote_ranks:
+            client = self._peer_client(rank)
+            if client is None:
+                continue
+            try:
+                held = self._fetch_from(
+                    client, local_rank, owner_rank=owner_rank
+                )
+            except _PEER_ERRORS:
+                self._clients.pop(rank, None)
+                continue
+            if held is not None and (best is None or held[0] > best[0]):
+                best = held
+        return best
 
     def try_restore_shm(self, shm: SharedMemoryHandler,
                         local_rank: int = 0, force: bool = False) -> int:

@@ -1,0 +1,876 @@
+"""Checkpoint-free elastic resharding: the live-reshard plane.
+
+On a rendezvous world cut the master already knows the old and the new
+rank sets (``rdzv_manager._check_rdzv_completed``). Instead of forcing the
+new world through the storage round-trip, the master publishes a **cut
+record** in the KV store and every agent keeps serving its last sealed
+flash-checkpoint frame over a host-TCP ``ReshardService``. The relaunched
+workers then compute a ``ReshardPlan`` — which byte ranges of which
+survivor shards cover each region the *new* sharding needs — and pull
+exactly those shards over RPC, assembling the restored pytree without a
+single storage read. Recovery time becomes a function of host-link
+bandwidth, not storage bandwidth (ROADMAP item 1; ElasWave's live
+redistribution shaped the design, FastPersist the fallback tier).
+
+Shape of the spec layer (SNIPPETS.md [2][3] ``SpecLayout``/partitioner
+patterns): frozen-slots dataclasses describing where every saved shard of
+every leaf lives (``ReshardSpec``) and which global regions the new mesh
+needs (``NeedSpec``); ``plan_reshard`` intersects the two and *proves
+coverage up front* (``CoverageError``) so the restore ladder can fall to
+the next rung before moving a byte.
+
+Degradation ladder (executed in engine.load): live reshard → peer-frame
+restore from ``ckpt/replica.py`` ranks → shm flash-restore → storage.
+Every abort is journaled ``reshard_aborted`` with its reason; success is
+``reshard_complete`` and drives the dedicated ``reshard`` goodput phase.
+
+Consistency: the wire protocol carries the step on every fetch. A
+survivor whose workers already resumed and sealed a *newer* frame answers
+``found=False`` on a stale-step fetch, aborting the rung cleanly instead
+of mixing steps. Like every recovery path in this repo the transfers ride
+the host TCP plane, never the ICI/DCN data fabric.
+
+Chaos sites: ``reshard.plan`` fires before planning, ``reshard.xfer``
+before every shard fetch — the schedule grammar can kill a transfer
+mid-flight and the ladder must fall through (tests/test_resharding.py).
+"""
+
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import msgpack
+import numpy as np
+
+from dlrover_tpu.chaos import get_injector
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import (
+    ConfigKey,
+    EnvKey,
+    SpanName,
+    env_float,
+    env_int,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RPCClient, RPCError, RPCServer, local_host_ip
+from dlrover_tpu.observability import tracing
+from dlrover_tpu.observability.journal import JournalEvent
+
+# one bad peer must never abort the loop over the remaining peers
+_PEER_ERRORS = (ConnectionError, OSError, RPCError)
+
+
+def cut_key(job_name: str, round_: int) -> str:
+    """KV key of the world-cut record for one rendezvous round."""
+    return f"reshard/{job_name}/cut/r{int(round_)}"
+
+
+def addr_key(job_name: str, node_rank: int) -> str:
+    """KV key under which an agent's ReshardService address is published."""
+    return f"reshard/{job_name}/addr/{int(node_rank)}"
+
+
+def _np_dtype(name: str) -> np.dtype:
+    # lazy engine import: the agent hosts ReshardService and must not pull
+    # the (jax-importing) engine module in just for dtype parsing
+    from dlrover_tpu.ckpt.engine import _np_dtype as parse
+
+    return parse(name)
+
+
+class CoverageError(Exception):
+    """The surviving frames cannot cover a region the new mesh needs."""
+
+
+class ReshardAbort(RuntimeError):
+    """Live reshard failed; restore must fall to the next ladder rung.
+    ``reason`` is a short machine-readable token for the journal."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
+
+
+# --------------------------------------------------------------------------
+# Spec layer (SNIPPETS.md [2][3] SpecLayout/partitioner shape)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class ShardSource:
+    """One saved shard of one leaf, addressable on a survivor host."""
+
+    path: str
+    node_rank: int
+    local_rank: int
+    shard_index: int
+    start: Tuple[int, ...]
+    shape: Tuple[int, ...]
+    nbytes: int
+
+
+@dataclass(frozen=True, slots=True)
+class ReshardSpec:
+    """Where every saved shard of one leaf lives across the old world."""
+
+    path: str
+    dtype: str
+    gshape: Tuple[int, ...]
+    shards: Tuple[ShardSource, ...]
+
+
+@dataclass(frozen=True, slots=True)
+class NeedSpec:
+    """The global regions of one leaf this process must materialize under
+    the NEW sharding (one region per distinct addressable device index)."""
+
+    path: str
+    dtype: str
+    gshape: Tuple[int, ...]
+    regions: Tuple[Tuple[Tuple[int, ...], Tuple[int, ...]], ...]
+
+
+@dataclass(frozen=True, slots=True)
+class Transfer:
+    """Copy ``src[lo-src.start : hi-src.start]`` into region
+    ``region_index`` of ``path`` at ``lo-region_start``. ``nbytes`` is the
+    moved volume (overlap elements × itemsize), for accounting."""
+
+    path: str
+    src: ShardSource
+    region_index: int
+    lo: Tuple[int, ...]
+    hi: Tuple[int, ...]
+    nbytes: int
+
+
+@dataclass(slots=True)
+class ReshardPlan:
+    step: int
+    transfers: List[Transfer]
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(t.nbytes for t in self.transfers)
+
+    def sources(self) -> List[ShardSource]:
+        """Unique source shards, in first-use order — the fetch set."""
+        seen, out = set(), []
+        for t in self.transfers:
+            if t.src not in seen:
+                seen.add(t.src)
+                out.append(t.src)
+        return out
+
+
+def layout_from_frames(
+    frames: Sequence[Dict],
+) -> Tuple[Dict[str, ReshardSpec], Dict[str, Dict]]:
+    """Build the old-world layout from survivor frame metas (the msgpack
+    meta dicts of ``shm_handler`` frames, each carrying node_rank/
+    local_rank). Returns ``(specs, values)``: array leaves keyed by path,
+    and plain value leaves (restored verbatim, first frame wins).
+
+    Exact-duplicate extents (same start+shape, e.g. partially-replicated
+    saves) are dropped so the planner's coverage volume sum — which
+    assumes disjoint sources, the save planner's replica_id==0 invariant —
+    stays exact."""
+    specs: Dict[str, ReshardSpec] = {}
+    values: Dict[str, Dict] = {}
+    acc: Dict[str, Dict[str, Any]] = {}
+    for frame in frames:
+        node = int(frame.get("node_rank", 0))
+        local = int(frame.get("local_rank", 0))
+        for leaf in frame.get("leaves", []):
+            path = leaf.get("path", "")
+            if leaf.get("kind") == "value":
+                values.setdefault(path, leaf)
+                continue
+            entry = acc.setdefault(
+                path,
+                {
+                    "dtype": leaf.get("dtype", "float32"),
+                    "gshape": tuple(leaf.get("gshape", ())),
+                    "shards": [],
+                    "extents": set(),
+                },
+            )
+            for i, sh in enumerate(leaf.get("shards", [])):
+                extent = (tuple(sh["start"]), tuple(sh["lshape"]))
+                if extent in entry["extents"]:
+                    continue
+                entry["extents"].add(extent)
+                entry["shards"].append(
+                    ShardSource(
+                        path=path,
+                        node_rank=node,
+                        local_rank=local,
+                        shard_index=i,
+                        start=extent[0],
+                        shape=extent[1],
+                        nbytes=int(sh["nbytes"]),
+                    )
+                )
+    for path, entry in acc.items():
+        specs[path] = ReshardSpec(
+            path=path,
+            dtype=entry["dtype"],
+            gshape=entry["gshape"],
+            shards=tuple(entry["shards"]),
+        )
+    return specs, values
+
+
+def needs_from_state(state) -> Dict[str, NeedSpec]:
+    """The regions THIS process must materialize for ``state`` under its
+    new shardings (deduped: replicas of one index are one region). Plain
+    non-array values carry no region — they restore from the value leaves."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    needs: Dict[str, NeedSpec] = {}
+    for pathkey, leaf in flat:
+        path = jax.tree_util.keystr(pathkey)
+        if isinstance(leaf, jax.Array) or hasattr(leaf, "sharding"):
+            gshape = tuple(leaf.shape)
+            regions = set()
+            if not gshape:
+                regions.add(((), ()))
+            else:
+                index_map = leaf.sharding.addressable_devices_indices_map(
+                    gshape
+                )
+                for index in index_map.values():
+                    if not index:
+                        regions.add(((0,) * len(gshape), gshape))
+                        continue
+                    start = tuple(int(sl.start or 0) for sl in index)
+                    shape = tuple(
+                        int((sl.stop if sl.stop is not None else g)
+                            - (sl.start or 0))
+                        for sl, g in zip(index, gshape)
+                    )
+                    regions.add((start, shape))
+            needs[path] = NeedSpec(
+                path=path,
+                dtype=str(leaf.dtype),
+                gshape=gshape,
+                regions=tuple(sorted(regions)),
+            )
+        elif isinstance(leaf, np.ndarray):
+            gshape = tuple(leaf.shape)
+            region = ((0,) * len(gshape), gshape) if gshape else ((), ())
+            needs[path] = NeedSpec(
+                path=path,
+                dtype=str(leaf.dtype),
+                gshape=gshape,
+                regions=(region,),
+            )
+    return needs
+
+
+def plan_reshard(
+    layout: Dict[str, ReshardSpec],
+    needs: Dict[str, NeedSpec],
+    step: int = -1,
+) -> ReshardPlan:
+    """Intersect every needed region with the surviving shard extents.
+    Raises :class:`CoverageError` naming the first under-covered region —
+    the coverage *proof* runs before any byte moves, so an impossible
+    reshard aborts in microseconds. Volume sums are exact because sources
+    are disjoint (layout_from_frames dedups; the save planner's
+    replica_id==0 rule never double-saves an extent)."""
+    transfers: List[Transfer] = []
+    for path, need in needs.items():
+        spec = layout.get(path)
+        if spec is None:
+            raise CoverageError(f"no surviving frame holds leaf {path}")
+        if tuple(spec.gshape) != tuple(need.gshape):
+            raise CoverageError(
+                f"{path}: saved gshape {list(spec.gshape)} != "
+                f"target {list(need.gshape)}"
+            )
+        itemsize = _np_dtype(need.dtype).itemsize
+        for ridx, (rstart, rshape) in enumerate(need.regions):
+            want = int(np.prod(rshape)) if rshape else 1
+            filled = 0
+            for src in spec.shards:
+                lo = tuple(
+                    max(a, b) for a, b in zip(rstart, src.start)
+                )
+                hi = tuple(
+                    min(a + da, b + db)
+                    for a, da, b, db in zip(
+                        rstart, rshape, src.start, src.shape
+                    )
+                )
+                if any(l >= h for l, h in zip(lo, hi)):
+                    continue
+                vol = (
+                    int(np.prod([h - l for l, h in zip(lo, hi)]))
+                    if lo else 1
+                )
+                transfers.append(
+                    Transfer(
+                        path=path,
+                        src=src,
+                        region_index=ridx,
+                        lo=lo,
+                        hi=hi,
+                        nbytes=vol * itemsize,
+                    )
+                )
+                filled += vol
+            if filled < want:
+                raise CoverageError(
+                    f"{path}: region start={list(rstart)} "
+                    f"shape={list(rshape)} covered {filled}/{want} "
+                    f"elements by surviving shards"
+                )
+    return ReshardPlan(step=step, transfers=transfers)
+
+
+def execute_plan(
+    plan: ReshardPlan,
+    needs: Dict[str, NeedSpec],
+    fetch: Callable[[ShardSource], bytes],
+) -> Dict[str, List[np.ndarray]]:
+    """Materialize every needed region on the host from a plan —
+    ``fetch(src)`` returns the full bytes of one source shard. This is the
+    reference executor the tests compare against a brute-force global
+    gather/scatter; the engine path instead feeds the plan's merged layout
+    through its own ``_assemble`` (device-placed, packed H2D)."""
+    out = {
+        p: [
+            np.zeros(rshape, dtype=_np_dtype(n.dtype))
+            for (_, rshape) in n.regions
+        ]
+        for p, n in needs.items()
+    }
+    for t in plan.transfers:
+        need = needs[t.path]
+        rstart, _ = need.regions[t.region_index]
+        arr = np.frombuffer(
+            fetch(t.src), dtype=_np_dtype(need.dtype)
+        ).reshape(t.src.shape)
+        src_sl = tuple(
+            slice(l - b, h - b) for l, h, b in zip(t.lo, t.hi, t.src.start)
+        )
+        dst_sl = tuple(
+            slice(l - w, h - w) for l, h, w in zip(t.lo, t.hi, rstart)
+        )
+        out[t.path][t.region_index][dst_sl] = arr[src_sl]
+    return out
+
+
+# --------------------------------------------------------------------------
+# Agent-side service: serve the sealed shm frames by shard byte-range
+# --------------------------------------------------------------------------
+
+
+class ReshardService:
+    """Runs inside the agent so the last sealed frame survives worker
+    death. Serves frame *metas* and per-shard *byte ranges* — survivors of
+    a world cut feed relaunched peers directly from shm, no storage read.
+
+    ``shm_provider`` returns the live ``SharedMemoryHandler`` list for
+    this host's local ranks (the agent attaches by the shm names workers
+    registered in the IPC meta dict, same idiom as the saver)."""
+
+    def __init__(self, shm_provider: Callable[[], List],
+                 host: str = "0.0.0.0", port: int = 0):
+        self._shm_provider = shm_provider
+        self._server = RPCServer(host, port)
+        self._server.register("reshard_meta", self._on_meta)
+        self._server.register("reshard_fetch", self._on_fetch)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop()
+
+    def register(self, master_client, job_name: str, node_rank: int,
+                 host: Optional[str] = None) -> str:
+        """Publish this service's reachable address in the master KV."""
+        addr = f"{host or local_host_ip()}:{self.port}"
+        master_client.kv_set(addr_key(job_name, node_rank), addr.encode())
+        return addr
+
+    def _frames(self):
+        out = []
+        for handler in self._shm_provider():
+            try:
+                meta = handler.read_meta()
+            except (OSError, ValueError):
+                continue
+            if meta is not None:
+                out.append((handler, meta))
+        return out
+
+    def _on_meta(self, req) -> comm.ReshardMetaResponse:
+        frames = []
+        node_rank = -1
+        for _, meta in self._frames():
+            node_rank = int(meta.get("node_rank", node_rank))
+            slim = {
+                k: v for k, v in meta.items() if not k.startswith("_")
+            }
+            frames.append([
+                int(meta.get("local_rank", 0)),
+                int(meta.get("step", -1)),
+                msgpack.packb(slim, use_bin_type=True),
+            ])
+        return comm.ReshardMetaResponse(
+            found=bool(frames), node_rank=node_rank, frames=frames
+        )
+
+    def _on_fetch(
+        self, req: comm.ReshardFetchRequest
+    ) -> comm.ReshardBytesResponse:
+        for handler, meta in self._frames():
+            if int(meta.get("local_rank", 0)) != req.local_rank:
+                continue
+            step = int(meta.get("step", -1))
+            if req.step >= 0 and step != req.step:
+                # this host's workers already sealed a newer frame —
+                # refuse rather than mix steps across the new world
+                return comm.ReshardBytesResponse(found=False, step=step)
+            for leaf in meta.get("leaves", []):
+                if leaf.get("path") != req.path:
+                    continue
+                shards = leaf.get("shards", [])
+                if not 0 <= req.shard_index < len(shards):
+                    return comm.ReshardBytesResponse(
+                        found=False, step=step
+                    )
+                shard = shards[req.shard_index]
+                total = int(shard["nbytes"])
+                offset = max(0, int(req.offset))
+                n = (total - offset if req.nbytes <= 0
+                     else min(int(req.nbytes), total - offset))
+                if n <= 0:
+                    return comm.ReshardBytesResponse(
+                        found=False, step=step
+                    )
+                sub = dict(shard)
+                sub["abs_offset"] = int(shard["abs_offset"]) + offset
+                sub["nbytes"] = n
+                data = handler.read_shard_bytes(sub)
+                if data is None:
+                    return comm.ReshardBytesResponse(
+                        found=False, step=step
+                    )
+                return comm.ReshardBytesResponse(
+                    found=True, step=step, data=bytes(data),
+                    total_nbytes=total,
+                )
+            return comm.ReshardBytesResponse(found=False, step=step)
+        return comm.ReshardBytesResponse(found=False)
+
+
+# --------------------------------------------------------------------------
+# Master-side coordinator: announce the cut
+# --------------------------------------------------------------------------
+
+
+class ReshardCoordinator:
+    """Attached to the TRAINING rendezvous manager by the master (same
+    post-construction hook pattern as journal/straggler_history). On a
+    world cut whose rank set actually changed, publishes the cut record
+    relaunched workers key their reshard on, and journals it."""
+
+    def __init__(self, job_name: str, kv_store, journal=None):
+        self._job = job_name
+        self._kv = kv_store
+        self._journal = journal
+
+    def on_world_cut(self, old_ranks, new_ranks,
+                     round_: int) -> Optional[Dict]:
+        old = sorted(int(r) for r in old_ranks)
+        new = sorted(int(r) for r in new_ranks)
+        if not old or old == new:
+            return None
+        cut = {"round": int(round_), "old": old, "new": new}
+        self._kv.set(
+            cut_key(self._job, round_), json.dumps(cut).encode()
+        )
+        if self._journal is not None:
+            self._journal.record(
+                JournalEvent.RESHARD_PLANNED,
+                round=int(round_), old_world=old, new_world=new,
+            )
+        logger.info(
+            "reshard cut r%s published: old=%s new=%s", round_, old, new
+        )
+        return cut
+
+
+# --------------------------------------------------------------------------
+# Worker-side restorer: read the cut, plan, pull, hand off to assembly
+# --------------------------------------------------------------------------
+
+
+class ReshardRestorer:
+    """One live-reshard attempt, run by the relaunched worker inside
+    engine.load's restore span (the plan/xfer/apply child spans therefore
+    share its trace_id — the single-trace reshard arc). All failures are
+    normalized to :class:`ReshardAbort` so the engine's ladder has exactly
+    one thing to catch."""
+
+    # transport frame headroom, same bound as ReplicaManager
+    CHUNK_BYTES = 256 * 1024 * 1024
+
+    def __init__(self, job_name: str, master_client, node_rank: int,
+                 local_rank: int = 0, rank: int = 0, own_shm=None,
+                 timeout_s: Optional[float] = None):
+        self._job = job_name
+        self._master = master_client
+        self._node = node_rank
+        self._local = local_rank
+        self._rank = rank
+        self._own_shm = own_shm
+        self._timeout_s = (
+            timeout_s if timeout_s is not None
+            else env_float(ConfigKey.RESHARD_TIMEOUT_S, 60.0)
+        )
+        self._clients: Dict[int, RPCClient] = {}
+        self._cache: Dict[ShardSource, bytes] = {}
+        self._source = f"worker_{rank}"
+
+    # -- discovery ---------------------------------------------------------
+
+    def read_cut(self, round_: Optional[int] = None) -> Optional[Dict]:
+        """The cut record for this worker's rendezvous round, or None when
+        the world did not change (no live reshard to run)."""
+        if self._master is None:
+            return None
+        if round_ is None:
+            round_ = env_int(EnvKey.RDZV_ROUND, 0)
+        # stub master clients in tests may not speak kv — no cut, no rung
+        getter = getattr(self._master, "kv_get", None)
+        if getter is None:
+            return None
+        raw = getter(cut_key(self._job, round_))
+        if not raw:
+            return None
+        try:
+            cut = json.loads(bytes(raw).decode())
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not cut.get("old") or sorted(cut["old"]) == sorted(
+            cut.get("new", [])
+        ):
+            return None
+        return cut
+
+    def _client(self, rank: int) -> Optional[RPCClient]:
+        client = self._clients.get(rank)
+        if client is not None:
+            return client
+        getter = getattr(self._master, "kv_get", None)
+        addr = getter(addr_key(self._job, rank)) if getter else None
+        if not addr:
+            return None
+        client = RPCClient(
+            bytes(addr).decode(), timeout_s=self._timeout_s, retries=2
+        )
+        self._clients[rank] = client
+        return client
+
+    def gather_frames(
+        self, source_ranks: Sequence[int]
+    ) -> Dict[int, List[Tuple[int, int, Dict]]]:
+        """Ask every old-world agent for its sealed frame metas. Dead or
+        unreachable sources are skipped — the planner decides whether the
+        reachable remainder still covers the state."""
+        out: Dict[int, List[Tuple[int, int, Dict]]] = {}
+        for rank in sorted({int(r) for r in source_ranks}):
+            client = self._client(rank)
+            if client is None:
+                continue
+            try:
+                resp = client.call(
+                    "reshard_meta",
+                    comm.ReshardMetaRequest(node_rank=self._node),
+                )
+            except _PEER_ERRORS as e:
+                logger.info(
+                    "reshard: source agent %s unreachable (%r)", rank, e
+                )
+                self._clients.pop(rank, None)
+                continue
+            if not resp.found:
+                continue
+            metas = []
+            for local, step, blob in resp.frames:
+                try:
+                    meta = msgpack.unpackb(blob, raw=False)
+                except (ValueError, TypeError):
+                    continue
+                meta.setdefault("node_rank", rank)
+                meta.setdefault("local_rank", local)
+                metas.append((int(local), int(step), meta))
+            if metas:
+                out[rank] = metas
+        return out
+
+    # -- execution ---------------------------------------------------------
+
+    def restore(self, target, assemble,
+                cut: Dict) -> Tuple[Any, int, Dict[str, Any]]:
+        """Run the full reshard: plan → prefetch → assemble. ``assemble``
+        is the engine's ``_assemble(target, lookup, reader)`` callback.
+        Returns ``(state, step, stats)``; raises :class:`ReshardAbort`."""
+        from dlrover_tpu.chaos import InjectedError, InjectedFault
+
+        try:
+            return self._restore(target, assemble, cut)
+        except ReshardAbort:
+            raise
+        except CoverageError as e:
+            raise ReshardAbort("coverage", str(e)) from e
+        except (InjectedError, InjectedFault) as e:
+            # chaos hit a reshard.* site: name the cause so the drill can
+            # assert the ladder fell through BECAUSE of the injection
+            raise ReshardAbort("fault_injected", repr(e)) from e
+        except _PEER_ERRORS as e:
+            raise ReshardAbort("transfer_failed", repr(e)) from e
+        except (RuntimeError, ValueError, KeyError) as e:
+            # InjectedError, "checkpoint incomplete" from assembly, a
+            # malformed meta — anything that means this rung cannot win
+            raise ReshardAbort("apply_failed", repr(e)) from e
+
+    def _restore(self, target, assemble, cut):
+        inj = get_injector()
+        t0 = time.monotonic()
+        with tracing.span(
+            SpanName.RESHARD_PLAN, source=self._source,
+            round=cut.get("round"),
+        ) as sp:
+            if inj is not None:
+                inj.fire(
+                    "reshard.plan",
+                    round=cut.get("round"), node_rank=self._node,
+                )
+            frames_by_rank = self.gather_frames(cut.get("old", ()))
+            if not frames_by_rank:
+                raise ReshardAbort(
+                    "no_sources",
+                    "no surviving reshard source is reachable",
+                )
+            needs = needs_from_state(target)
+            all_frames = [
+                entry for metas in frames_by_rank.values()
+                for entry in metas
+            ]
+            # newest step first; a straggler host one step behind just
+            # shrinks the candidate set for that step, and the coverage
+            # proof walks down until a step the survivors fully hold
+            steps = sorted(
+                {s for _, s, _ in all_frames if s >= 0}, reverse=True
+            )
+            plan = layout = values = None
+            chosen = -1
+            last_err: Optional[CoverageError] = None
+            for step in steps:
+                metas = [m for _, s, m in all_frames if s == step]
+                layout, values = layout_from_frames(metas)
+                try:
+                    plan = plan_reshard(layout, needs, step=step)
+                    chosen = step
+                    break
+                except CoverageError as e:
+                    last_err = e
+            if plan is None:
+                raise ReshardAbort(
+                    "coverage",
+                    str(last_err) if last_err is not None
+                    else "survivors hold no complete step",
+                )
+            sp.add_event(
+                "planned", step=chosen, transfers=len(plan.transfers),
+                bytes=plan.total_bytes,
+            )
+
+        with tracing.span(
+            SpanName.RESHARD_XFER, source=self._source, step=chosen,
+        ) as sp:
+            stats = self._prefetch(plan, chosen, inj)
+            sp.add_event("fetched", **stats)
+
+        with tracing.span(
+            SpanName.RESHARD_APPLY, source=self._source, step=chosen,
+        ):
+            lookup = self._merged_lookup(layout, values)
+
+            def reader(leaf_meta, shard_meta):
+                return self._shard_bytes(shard_meta["_src"], chosen, inj)
+
+            state = assemble(target, lookup, reader)
+
+        stats.update(
+            step=chosen,
+            round=int(cut.get("round", -1)),
+            transfers=len(plan.transfers),
+            bytes=plan.total_bytes,
+            duration_s=time.monotonic() - t0,
+        )
+        return state, chosen, stats
+
+    @staticmethod
+    def _merged_lookup(layout: Dict[str, ReshardSpec],
+                       values: Dict[str, Dict]) -> Dict[str, Dict]:
+        """The survivor layout in the engine's leaf-meta shape, each shard
+        dict carrying its ``_src`` so the reader can resolve it from the
+        prefetch cache / peer RPC."""
+        lookup: Dict[str, Dict] = {}
+        for path, spec in layout.items():
+            lookup[path] = {
+                "path": path,
+                "kind": "array",
+                "dtype": spec.dtype,
+                "gshape": list(spec.gshape),
+                "shards": [
+                    {
+                        "start": list(src.start),
+                        "lshape": list(src.shape),
+                        "nbytes": src.nbytes,
+                        "_src": src,
+                    }
+                    for src in spec.shards
+                ],
+            }
+        for path, leaf in values.items():
+            lookup.setdefault(path, leaf)
+        return lookup
+
+    def _prefetch(self, plan: ReshardPlan, step: int,
+                  inj) -> Dict[str, Any]:
+        """Pull every unique source shard the plan references: own-shm
+        reads inline, remote ranks in parallel (one thread per peer, each
+        draining its shards serially — one RPCClient is never shared
+        across threads)."""
+        own: List[ShardSource] = []
+        by_rank: Dict[int, List[ShardSource]] = {}
+        for src in plan.sources():
+            if self._is_own(src, step):
+                own.append(src)
+            else:
+                by_rank.setdefault(src.node_rank, []).append(src)
+        bytes_local = sum(
+            len(self._shard_bytes(src, step, inj)) for src in own
+        )
+
+        parent = tracing.current_context()
+
+        def fetch_rank(srcs: List[ShardSource]) -> int:
+            with tracing.activate(parent):
+                return sum(
+                    len(self._shard_bytes(src, step, inj))
+                    for src in srcs
+                )
+
+        bytes_remote = 0
+        if by_rank:
+            workers = max(1, min(8, len(by_rank)))
+            with ThreadPoolExecutor(
+                max_workers=workers,
+                thread_name_prefix="reshard-fetch",
+            ) as pool:
+                for n in pool.map(fetch_rank, by_rank.values()):
+                    bytes_remote += n
+        return {
+            "bytes_local": bytes_local,
+            "bytes_remote": bytes_remote,
+            "peers": len(by_rank),
+            "sources": len(own) + sum(len(v) for v in by_rank.values()),
+        }
+
+    def _is_own(self, src: ShardSource, step: int) -> bool:
+        return (
+            self._own_shm is not None
+            and src.node_rank == self._node
+            and src.local_rank == self._local
+            and self._own_shm.step == step
+        )
+
+    def _shard_bytes(self, src: ShardSource, step: int, inj) -> bytes:
+        cached = self._cache.get(src)
+        if cached is not None:
+            return cached
+        if inj is not None:
+            inj.fire(
+                "reshard.xfer",
+                path=src.path, node_rank=src.node_rank,
+                local_rank=src.local_rank, nbytes=src.nbytes,
+            )
+        if self._is_own(src, step):
+            blob = self._read_own(src)
+        else:
+            blob = self._fetch_remote(src, step)
+        self._cache[src] = blob
+        return blob
+
+    def _read_own(self, src: ShardSource) -> bytes:
+        meta = self._own_shm.read_meta()
+        if meta is None:
+            raise ReshardAbort(
+                "shard_gone", "own shm frame vanished mid-reshard"
+            )
+        for leaf in meta.get("leaves", []):
+            if leaf.get("path") != src.path:
+                continue
+            shards = leaf.get("shards", [])
+            if src.shard_index < len(shards):
+                data = self._own_shm.read_shard_bytes(
+                    shards[src.shard_index]
+                )
+                if data is not None:
+                    return bytes(data)
+        raise ReshardAbort(
+            "shard_gone",
+            f"own shm no longer holds {src.path}#{src.shard_index}",
+        )
+
+    def _fetch_remote(self, src: ShardSource, step: int) -> bytes:
+        client = self._client(src.node_rank)
+        if client is None:
+            raise ReshardAbort(
+                "peer_unreachable",
+                f"no reshard service address for node {src.node_rank}",
+            )
+        parts: List[bytes] = []
+        offset = 0
+        while offset < src.nbytes:
+            n = min(self.CHUNK_BYTES, src.nbytes - offset)
+            resp = client.call(
+                "reshard_fetch",
+                comm.ReshardFetchRequest(
+                    local_rank=src.local_rank, step=step, path=src.path,
+                    shard_index=src.shard_index, offset=offset, nbytes=n,
+                ),
+            )
+            if not resp.found or not resp.data:
+                raise ReshardAbort(
+                    "shard_gone",
+                    f"node {src.node_rank} no longer serves "
+                    f"{src.path}#{src.shard_index} at step {step} "
+                    f"(its frame is at step {resp.step})",
+                )
+            parts.append(resp.data)
+            offset += len(resp.data)
+        blob = b"".join(parts)
+        if len(blob) != src.nbytes:
+            raise ReshardAbort(
+                "short_read",
+                f"{src.path}#{src.shard_index}: got {len(blob)} of "
+                f"{src.nbytes} bytes from node {src.node_rank}",
+            )
+        return blob

@@ -452,6 +452,51 @@ class ReplicaListResponse:
 
 
 # --------------------------------------------------------------------------
+# Live reshard plane (ckpt/reshard.py): survivors serve their sealed shm
+# frames by shard byte-range to relaunched workers after a world cut
+# --------------------------------------------------------------------------
+
+
+@message
+class ReshardMetaRequest:
+    node_rank: int = -1  # requesting node, for the survivor's logs
+
+
+@message
+class ReshardMetaResponse:
+    """Frame metas a survivor agent currently serves: one
+    ``[local_rank, step, msgpack(meta)]`` entry per sealed local frame
+    (meta without the tensor bytes — the planner only needs the shard
+    extents)."""
+
+    found: bool = False
+    node_rank: int = -1
+    frames: List[List] = field(default_factory=list)
+
+
+@message
+class ReshardFetchRequest:
+    """One byte-range of one saved shard. ``step`` is the consistency
+    guard: the survivor answers found=False if its frame moved on, so a
+    reshard never mixes steps across the new world."""
+
+    local_rank: int = 0
+    step: int = -1
+    path: str = ""
+    shard_index: int = 0
+    offset: int = 0   # byte offset within the shard
+    nbytes: int = 0   # 0 = rest of the shard
+
+
+@message
+class ReshardBytesResponse:
+    found: bool = False
+    step: int = -1
+    data: bytes = b""
+    total_nbytes: int = 0
+
+
+# --------------------------------------------------------------------------
 # Unified runtime: remote actor transport (unified/remote.py)
 # --------------------------------------------------------------------------
 

@@ -242,6 +242,12 @@ class ConfigKey:
     CKPT_READY_TIMEOUT = "DLROVER_TPU_CKPT_READY_TIMEOUT"
     CKPT_READY_COOLDOWN = "DLROVER_TPU_CKPT_READY_COOLDOWN"
     CKPT_STORAGE_WAIT = "DLROVER_TPU_CKPT_STORAGE_WAIT"
+    # live resharding (ckpt/reshard.py): enable flag (default on), per-peer
+    # RPC timeout for shard-region fetches, and how long a worker waits for
+    # survivor agents to publish their reshard service addresses
+    RESHARD = "DLROVER_TPU_RESHARD"
+    RESHARD_TIMEOUT_S = "DLROVER_TPU_RESHARD_TIMEOUT_S"
+    RESHARD_PORT = "DLROVER_TPU_RESHARD_PORT"
     # agent / worker
     HOST_IP = "DLROVER_TPU_HOST_IP"
     AGENT_METRICS_PORT = "DLROVER_TPU_AGENT_METRICS_PORT"
@@ -296,6 +302,11 @@ class SpanName:
     CKPT_PERSIST = "ckpt.persist"
     CKPT_COMMIT = "ckpt.commit"
     CKPT_RESTORE = "ckpt.restore"
+    # live-reshard arc (ckpt/reshard.py planner/executor, served by the
+    # agent's ReshardService; one trace_id spans plan → transfers → apply)
+    RESHARD_PLAN = "reshard.plan"
+    RESHARD_XFER = "reshard.xfer"
+    RESHARD_APPLY = "reshard.apply"
     # scale-plan arc (master/auto_scaler.py → master/job_manager.py)
     SCALE_APPLY = "scale.apply"
     SCALE_RDZV_PARAMS = "scale.update_rdzv_params"

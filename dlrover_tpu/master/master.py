@@ -126,6 +126,16 @@ class JobMaster:
         self.rdzv_managers[RendezvousName.TRAINING].straggler_history = (
             self.skew_monitor.node_straggler_counts
         )
+        # live-reshard plane (ckpt/reshard.py): a TRAINING world cut whose
+        # rank set changed publishes the cut record relaunched workers key
+        # their checkpoint-free reshard on
+        from dlrover_tpu.ckpt.reshard import ReshardCoordinator
+
+        self.rdzv_managers[RendezvousName.TRAINING].reshard_coordinator = (
+            ReshardCoordinator(
+                job_name, self.kv_store, journal=self.event_journal
+            )
+        )
         if diagnosis_master is None:
             from dlrover_tpu.diagnosis.diagnosis_master import DiagnosisMaster
 

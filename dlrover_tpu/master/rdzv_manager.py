@@ -84,6 +84,10 @@ class RendezvousManager(ABC):
         # cut must drop nodes (node_unit truncation), repeat-offender
         # stragglers go first instead of blindly keeping the lowest ranks
         self.straggler_history = None
+        # master attaches a ckpt.reshard.ReshardCoordinator to the
+        # TRAINING manager: a cut whose rank set changed publishes the
+        # cut record the relaunched workers key their live reshard on
+        self.reshard_coordinator = None
         from dlrover_tpu.observability.registry import get_registry
 
         reg = get_registry()
@@ -231,6 +235,7 @@ class RendezvousManager(ABC):
                           rdzv_name=self._name,
                           round=self._rdzv_round + 1):
             ranks = self._select_world_ranks(world_size)
+            old_world = list(self._latest_rdzv_nodes)
             self._rdzv_nodes = {r: self._waiting_nodes[r] for r in ranks}
             # topology-aware comm order: slice-contiguous, torus order
             # within a slice (net_topology.py; the reference's asw/psw
@@ -260,6 +265,17 @@ class RendezvousManager(ABC):
                     JournalEvent.RDZV_COMPLETE, round=self._rdzv_round,
                     world_size=world_size, duration_s=duration,
                 )
+            if self.reshard_coordinator is not None:
+                try:
+                    self.reshard_coordinator.on_world_cut(
+                        old_world, list(ranks), self._rdzv_round
+                    )
+                except Exception:  # noqa: BLE001 — advisory plane: a cut
+                    # must complete even if the reshard announcement fails
+                    logger.warning(
+                        "%s rdzv: reshard coordinator failed on world cut "
+                        "r%s", self._name, self._rdzv_round, exc_info=True,
+                    )
             logger.info(
                 "%s rdzv round %s completed: world=%s (waiting leftover=%s)",
                 self._name, self._rdzv_round, ranks,

@@ -58,13 +58,22 @@ class JournalEvent:
     # flight recorder (observability/flight_recorder.py) wrote a
     # post-mortem bundle — informational, no phase transition
     TRACE_BUNDLE_CAPTURED = "trace_bundle_captured"
+    # live-reshard plane (ckpt/reshard.py + master/rdzv_manager.py):
+    # reshard_planned is the master's cut-side announcement (informational);
+    # reshard_start/complete/aborted bracket the worker-side execution and
+    # drive the `reshard` goodput phase
+    RESHARD_PLANNED = "reshard_planned"
+    RESHARD_START = "reshard_start"
+    RESHARD_COMPLETE = "reshard_complete"
+    RESHARD_ABORTED = "reshard_aborted"
 
     ALL = (
         FAULT_DETECTED, RDZV_START, RDZV_COMPLETE, RESTORE_START,
         RESTORE_COMPLETE, RECOMPILE_START, RECOMPILE_COMPLETE, STEP_RESUMED,
         FAULT_INJECTED, CKPT_CORRUPT, CKPT_REPAIRED, PARTITION_RESYNC,
         SHM_ORPHANS_CLEANED, STRAGGLER_DETECTED, HANG_ATTRIBUTED,
-        STACK_DUMP_CAPTURED, TRACE_BUNDLE_CAPTURED,
+        STACK_DUMP_CAPTURED, TRACE_BUNDLE_CAPTURED, RESHARD_PLANNED,
+        RESHARD_START, RESHARD_COMPLETE, RESHARD_ABORTED,
     )
 
 
@@ -74,8 +83,9 @@ class Phase:
     RENDEZVOUS = "rendezvous"
     RESTORE = "restore"
     RECOMPILE = "recompile"
+    RESHARD = "reshard"
 
-    ALL = (PRODUCTIVE, DETECT, RENDEZVOUS, RESTORE, RECOMPILE)
+    ALL = (PRODUCTIVE, DETECT, RENDEZVOUS, RESTORE, RECOMPILE, RESHARD)
 
 
 # event kind → the phase the job enters when the event lands. rdzv_complete
@@ -92,6 +102,12 @@ _TRANSITIONS: Dict[str, str] = {
     JournalEvent.RECOMPILE_START: Phase.RECOMPILE,
     JournalEvent.RECOMPILE_COMPLETE: Phase.PRODUCTIVE,
     JournalEvent.STEP_RESUMED: Phase.PRODUCTIVE,
+    # live reshard replaces the restore leg: reshard_start enters the
+    # dedicated RESHARD phase; completion enters RECOMPILE (same as
+    # restore_complete); an abort falls back onto the restore ladder.
+    JournalEvent.RESHARD_START: Phase.RESHARD,
+    JournalEvent.RESHARD_COMPLETE: Phase.RECOMPILE,
+    JournalEvent.RESHARD_ABORTED: Phase.RESTORE,
 }
 
 

@@ -13,8 +13,12 @@ script:
    nodes are still alive;
 2. one agent is SIGKILLed mid-training — the master's heartbeat monitor
    declares the node dead, shrinks the job elastically, and tells the
-   survivor to re-rendezvous; the survivor resumes from checkpoint at
-   world=1 with grad-accumulation doubled (fixed global batch);
+   survivor to re-rendezvous; the survivor resumes at world=1 with
+   grad-accumulation doubled (fixed global batch) via **checkpoint-free
+   live reshard** — the state is pulled from the survivors' sealed shm
+   frames (ckpt/reshard.py), and the drill asserts ZERO storage reads
+   across every post-fault restore plus a recorded ``reshard`` goodput
+   phase;
 3. the killed agent comes back, joins the rendezvous, and the world
    scales back to 2;
 4. training goodput (productive-span fraction of wall time, the
@@ -421,6 +425,16 @@ def main(argv=None) -> int:
             ),
             60, "survivor re-rendezvous at world=1",
         )
+        # checkpoint-free recovery: the master published the cut record
+        # ([0,1] -> [0]) and the survivor must restore by live reshard
+        # from the agents' sealed shm frames, never touching storage
+        _wait(
+            lambda: any(
+                e["kind"] == JournalEvent.RESHARD_COMPLETE
+                for e in master.event_journal.events()
+            ),
+            30, "survivor restores via live reshard",
+        )
         shrink_s = time.time() - kill_ts
         step_before_rejoin = master.perf_monitor.completed_global_step
         # mid-drill scrape: /metrics must answer while the world is still
@@ -491,6 +505,35 @@ def main(argv=None) -> int:
         segments = [r for r in records if r["event"] == "segment_start"]
         dones = [r for r in records if r["event"] == "done"]
         goodput = _merged_goodput(event_dir)
+        # checkpoint-free recovery proof: every post-fault restore in the
+        # drill (scale-down AND scale-back-up) went through live reshard;
+        # storage was never read back (a cold start legitimately probes
+        # storage and finds nothing — step stays -1)
+        journal_events = master.event_journal.events()
+        reshard_completes = [
+            e for e in journal_events
+            if e["kind"] == JournalEvent.RESHARD_COMPLETE
+        ]
+        reshard_aborts = [
+            e for e in journal_events
+            if e["kind"] == JournalEvent.RESHARD_ABORTED
+        ]
+        storage_restores = [
+            e for e in journal_events
+            if e["kind"] == JournalEvent.RESTORE_COMPLETE
+            and e["data"].get("medium") == "storage"
+            and e["data"].get("step", -1) >= 0
+        ]
+        assert reshard_completes and not storage_restores, (
+            f"expected checkpoint-free recovery: "
+            f"{len(reshard_completes)} reshard_complete, "
+            f"{len(storage_restores)} storage restores"
+        )
+        reshard_phase_s = (end_phases or {}).get("reshard", 0.0)
+        if end_scrape_ok:
+            assert reshard_phase_s > 0, (
+                "reshard goodput phase missing from /metrics"
+            )
         # flight-recorder bundle: traces.json must be a valid chrome
         # trace whose span track includes the rendezvous arc (the kill
         # froze the ring with the world-formation spans still in it)
@@ -543,6 +586,17 @@ def main(argv=None) -> int:
             ),
             "journal_goodput_pct": journal_goodput_pct,
             "journal_events": len(master.event_journal),
+            # checkpoint-free elastic resharding (ckpt/reshard.py): both
+            # world cuts recovered by pulling state over the host links —
+            # storage_restores counts step>=0 storage reads (must be 0)
+            "reshard_completes": len(reshard_completes),
+            "reshard_aborts": len(reshard_aborts),
+            "storage_restores": len(storage_restores),
+            "reshard_bytes_remote": sum(
+                e["data"].get("bytes_remote", 0)
+                for e in reshard_completes
+            ),
+            "reshard_phase_s": round(reshard_phase_s, 3),
             # skew attribution (op-telemetry uplink -> SkewMonitor): the
             # injected slow rank was named, with cause and ratio, while
             # it was still alive — and the gauge was live on the same

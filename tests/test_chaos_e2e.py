@@ -82,7 +82,15 @@ def test_chaos_kill_shrink_resume_rejoin():
     assert phases is not None
     assert set(phases) == {
         "productive", "detect", "rendezvous", "restore", "recompile",
+        "reshard",
     }
+    # checkpoint-free elastic resharding: both world cuts (shrink and
+    # rejoin) recovered by live reshard from the survivors' shm frames —
+    # no post-fault restore read storage, and the time is attributed to
+    # the dedicated reshard goodput phase
+    assert result["reshard_completes"] >= 1, result
+    assert result["storage_restores"] == 0, result
+    assert phases["reshard"] > 0.0, phases
     # the journal recorded the fault cycle: with one kill + one rejoin the
     # job spent real time off the productive phase...
     unproductive = sum(v for k, v in phases.items() if k != "productive")

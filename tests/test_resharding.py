@@ -1,0 +1,452 @@
+"""Checkpoint-free elastic resharding tests (ckpt/reshard.py): the plan
+layer against a brute-force gather/scatter reference, and the full engine
+ladder — live reshard over real ReshardServices on localhost, fall-through
+to peer replica frames on a coverage hole, and chaos-injected transfer
+faults provably dropping to the next rung."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.chaos import configure, reset_injector
+from dlrover_tpu.ckpt.engine import CheckpointEngine
+from dlrover_tpu.ckpt.replica import ReplicaManager, ReplicaService
+from dlrover_tpu.ckpt.reshard import (
+    CoverageError,
+    NeedSpec,
+    ReshardCoordinator,
+    ReshardRestorer,
+    ReshardService,
+    cut_key,
+    execute_plan,
+    layout_from_frames,
+    needs_from_state,
+    plan_reshard,
+)
+from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler, shm_name
+from dlrover_tpu.common.constants import ConfigKey, EnvKey
+from dlrover_tpu.common.multi_process import unlink_shared_memory
+from dlrover_tpu.master.master import LocalJobMaster
+
+JOB = f"reshtest{os.getpid()}"
+
+W_PATH = "['w']"
+LR_PATH = "['lr']"
+
+
+@pytest.fixture()
+def master():
+    m = LocalJobMaster(job_name=JOB, node_num=2)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clean_shm():
+    yield
+    reset_injector()
+    for nr in range(2):
+        unlink_shared_memory(shm_name(JOB, nr, 0))
+
+
+def _frame_meta(node_rank, step, shards, lr=0.25):
+    """Meta for a frame holding row-slices of the global (8, 4) float32
+    ``w``: ``shards`` is a list of (row_start, row_stop)."""
+    leaf_shards, offset = [], 0
+    for r0, r1 in shards:
+        nbytes = (r1 - r0) * 4 * 4
+        leaf_shards.append({
+            "offset": offset, "nbytes": nbytes,
+            "lshape": [r1 - r0, 4], "start": [r0, 0],
+        })
+        offset += nbytes
+    return {
+        "step": step, "ts": 0.0, "job": JOB, "node_rank": node_rank,
+        "local_rank": 0, "rank": node_rank, "world_size": 2,
+        "leaves": [
+            {"path": W_PATH, "kind": "array", "dtype": "float32",
+             "gshape": [8, 4], "shards": leaf_shards},
+            {"path": LR_PATH, "kind": "value", "value": lr},
+        ],
+    }
+
+
+def _global_w():
+    return np.arange(32, dtype=np.float32).reshape(8, 4)
+
+
+def _write_frame(node_rank, step, shards, lr=0.25):
+    """Write a sealed shm frame for ``node_rank`` holding the given row
+    slices of the canonical global ``w``."""
+    shm = SharedMemoryHandler(shm_name(JOB, node_rank, 0))
+    w = _global_w()
+    meta = _frame_meta(node_rank, step, shards, lr=lr)
+    shm.write_frame(meta, [w[r0:r1] for r0, r1 in shards])
+    return shm
+
+
+def _sharded_state():
+    """The NEW world's target: w sharded over 4 devices (2 rows each)."""
+    devices = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("data",))
+    w = jax.device_put(
+        jnp.asarray(_global_w()), NamedSharding(mesh, P("data"))
+    )
+    return {"w": w, "lr": 0.25}
+
+
+def _kinds(journal):
+    return [e["kind"] for e in journal.events()]
+
+
+def _events_of(journal, kind):
+    return [e for e in journal.events() if e["kind"] == kind]
+
+
+# --------------------------------------------------------------------------
+# Plan layer: correctness against a brute-force gather/scatter reference
+# --------------------------------------------------------------------------
+
+
+def test_plan_matches_bruteforce_reference():
+    w = _global_w()
+    # old world: node 0 holds rows [0:2) and [2:4), node 1 holds [4:8)
+    frames = [
+        _frame_meta(0, 7, [(0, 2), (2, 4)]),
+        _frame_meta(1, 7, [(4, 8)]),
+    ]
+    layout, values = layout_from_frames(frames)
+    assert values[LR_PATH]["value"] == 0.25
+    # new world needs an uneven split that crosses every old boundary
+    needs = {
+        W_PATH: NeedSpec(
+            path=W_PATH, dtype="float32", gshape=(8, 4),
+            regions=(((0, 0), (3, 4)), ((3, 0), (5, 4))),
+        )
+    }
+    plan = plan_reshard(layout, needs, step=7)
+    # region [0:3) pulls from two shards, region [3:8) from two more
+    assert len(plan.transfers) == 4
+    assert plan.total_bytes == w.nbytes
+
+    store = {(0, 0): w[0:2], (0, 1): w[2:4], (1, 0): w[4:8]}
+    fetched = []
+
+    def fetch(src):
+        fetched.append(src)
+        return np.ascontiguousarray(
+            store[(src.node_rank, src.shard_index)]
+        ).tobytes()
+
+    out = execute_plan(plan, needs, fetch)
+    np.testing.assert_array_equal(out[W_PATH][0], w[0:3])
+    np.testing.assert_array_equal(out[W_PATH][1], w[3:8])
+    # every survivor shard was needed exactly as planned
+    assert {(s.node_rank, s.shard_index) for s in fetched} == set(store)
+
+
+def test_plan_coverage_and_shape_errors():
+    layout, _ = layout_from_frames([_frame_meta(0, 3, [(0, 4)])])
+    need_full = {
+        W_PATH: NeedSpec(
+            path=W_PATH, dtype="float32", gshape=(8, 4),
+            regions=(((0, 0), (8, 4)),),
+        )
+    }
+    with pytest.raises(CoverageError, match="covered 16/32"):
+        plan_reshard(layout, need_full)
+    with pytest.raises(CoverageError, match="no surviving frame"):
+        plan_reshard(layout, {
+            "['b']": NeedSpec("['b']", "float32", (2,), (((0,), (2,)),))
+        })
+    with pytest.raises(CoverageError, match="gshape"):
+        plan_reshard(layout, {
+            W_PATH: NeedSpec(W_PATH, "float32", (4, 4), (((0, 0), (4, 4)),))
+        })
+
+
+def test_duplicate_extents_deduped():
+    """Partially-replicated saves present the same extent twice; the
+    planner's volume-sum coverage proof needs it exactly once."""
+    frames = [
+        _frame_meta(0, 2, [(0, 8)]),
+        _frame_meta(1, 2, [(0, 8)]),  # replica of the same extent
+    ]
+    layout, _ = layout_from_frames(frames)
+    assert len(layout[W_PATH].shards) == 1
+    needs = {
+        W_PATH: NeedSpec(W_PATH, "float32", (8, 4), (((0, 0), (8, 4)),))
+    }
+    plan = plan_reshard(layout, needs)
+    assert len(plan.transfers) == 1
+
+
+def test_needs_from_state_regions():
+    state = _sharded_state()
+    needs = needs_from_state(state)
+    assert LR_PATH not in needs  # plain value: restored from value leaves
+    w_need = needs[W_PATH]
+    assert w_need.gshape == (8, 4)
+    assert w_need.regions == (
+        ((0, 0), (2, 4)), ((2, 0), (2, 4)),
+        ((4, 0), (2, 4)), ((6, 0), (2, 4)),
+    )
+
+
+# --------------------------------------------------------------------------
+# Cut records: master-side coordinator ↔ worker-side read_cut
+# --------------------------------------------------------------------------
+
+
+def test_coordinator_publishes_and_worker_reads_cut(master):
+    coord = ReshardCoordinator(
+        JOB, master.kv_store, journal=master.event_journal
+    )
+    # unchanged world: no cut record, no journal noise
+    assert coord.on_world_cut([0, 1], [1, 0], 4) is None
+    assert not master.kv_store.get(cut_key(JOB, 4))
+
+    cut = coord.on_world_cut([0, 1], [0], 5)
+    assert cut == {"round": 5, "old": [0, 1], "new": [0]}
+    planned = _events_of(master.event_journal, "reshard_planned")
+    assert planned and planned[-1]["data"]["old_world"] == [0, 1]
+
+    restorer = ReshardRestorer(JOB, MasterClient(master.addr, 0), 0)
+    assert restorer.read_cut(round_=5) == cut
+    assert restorer.read_cut(round_=99) is None
+    os.environ[EnvKey.RDZV_ROUND] = "5"
+    try:
+        assert restorer.read_cut() == cut  # round from the worker env
+    finally:
+        os.environ.pop(EnvKey.RDZV_ROUND, None)
+
+
+# --------------------------------------------------------------------------
+# Full engine ladder on real services
+# --------------------------------------------------------------------------
+
+
+def _serve(node_rank):
+    svc = ReshardService(
+        shm_provider=lambda: [
+            SharedMemoryHandler(shm_name(JOB, node_rank, 0))
+        ]
+    )
+    svc.start()
+    return svc
+
+
+def _engine(tmp_path, node_rank, client, **kw):
+    return CheckpointEngine(
+        str(tmp_path), job_name=JOB, node_rank=node_rank, local_rank=0,
+        ipc_socket="/nonexistent", world_size=1, rank=node_rank,
+        master_client=client, **kw,
+    )
+
+
+def test_scale_down_live_reshard_zero_storage(master, tmp_path, monkeypatch):
+    """Two hosts each hold half the state; host 1 leaves the world. The
+    survivor restores via live reshard — half from its own shm, half over
+    RPC from the departed host's still-serving agent — with an empty
+    checkpoint dir proving zero storage reads."""
+    _write_frame(0, 11, [(0, 4)])
+    _write_frame(1, 11, [(4, 8)])
+    svc0, svc1 = _serve(0), _serve(1)
+    try:
+        c0 = MasterClient(master.addr, 0)
+        svc0.register(c0, JOB, 0)
+        svc1.register(MasterClient(master.addr, 1), JOB, 1)
+        ReshardCoordinator(
+            JOB, master.kv_store, journal=master.event_journal
+        ).on_world_cut([0, 1], [0], 3)
+        monkeypatch.setenv(EnvKey.RDZV_ROUND, "3")
+
+        state = _sharded_state()
+        restored, step = _engine(tmp_path, 0, c0).load(state)
+        assert step == 11
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), _global_w()
+        )
+        assert restored["lr"] == 0.25
+
+        kinds = _kinds(master.event_journal)
+        assert "reshard_start" in kinds
+        assert "reshard_aborted" not in kinds
+        done = _events_of(master.event_journal, "reshard_complete")[-1]
+        assert done["data"]["step"] == 11
+        assert done["data"]["bytes_remote"] > 0  # host 1's half moved
+        assert done["data"]["bytes_local"] > 0   # own half stayed local
+        fin = _events_of(master.event_journal, "restore_complete")[-1]
+        assert fin["data"]["medium"] == "reshard"
+        assert not any(p.name.startswith("step_") for p in tmp_path.iterdir())
+    finally:
+        svc0.stop()
+        svc1.stop()
+
+
+def test_scale_up_new_node_pulls_everything_remote(master, tmp_path,
+                                                   monkeypatch):
+    """A node joining an expanded world has an empty shm; its whole state
+    arrives from the old world's agents."""
+    _write_frame(0, 6, [(0, 8)])
+    svc0 = _serve(0)
+    try:
+        svc0.register(MasterClient(master.addr, 0), JOB, 0)
+        ReshardCoordinator(JOB, master.kv_store).on_world_cut(
+            [0], [0, 1], 8
+        )
+        monkeypatch.setenv(EnvKey.RDZV_ROUND, "8")
+
+        c1 = MasterClient(master.addr, 1)
+        restored, step = _engine(tmp_path, 1, c1).load(_sharded_state())
+        assert step == 6
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), _global_w()
+        )
+        done = _events_of(master.event_journal, "reshard_complete")[-1]
+        assert done["data"]["bytes_local"] == 0
+        assert done["data"]["bytes_remote"] == _global_w().nbytes
+        fin = _events_of(master.event_journal, "restore_complete")[-1]
+        assert fin["data"]["medium"] == "reshard"
+    finally:
+        svc0.stop()
+
+
+def test_coverage_hole_falls_through_to_replica_rung(master, tmp_path,
+                                                     monkeypatch):
+    """The only reachable survivor holds half the state (the dead host
+    held the rest uniquely): reshard aborts on its coverage proof before
+    moving a byte, and the ladder lands on peer replica frames."""
+    _write_frame(0, 9, [(0, 4)])  # rows [4:8) died with host 1
+    svc0 = _serve(0)
+    replica_store = ReplicaService()
+    replica_store.start()
+    try:
+        c0 = MasterClient(master.addr, 0)
+        svc0.register(c0, JOB, 0)
+        ReshardCoordinator(JOB, master.kv_store).on_world_cut(
+            [0, 1], [0], 2
+        )
+        monkeypatch.setenv(EnvKey.RDZV_ROUND, "2")
+
+        # the replica store still holds both owners' pushed frames
+        replica_store.put(
+            0, 0, 9,
+            SharedMemoryHandler(shm_name(JOB, 0, 0)).read_frame_bytes(),
+        )
+        shm1 = _write_frame(1, 9, [(4, 8)])
+        replica_store.put(1, 0, 9, shm1.read_frame_bytes())
+        shm1.unlink()  # host 1 is gone; only the replica copy survives
+
+        mgr = ReplicaManager(JOB, 0, 2, c0, service=replica_store)
+        restored, step = _engine(
+            tmp_path, 0, c0, replica_manager=mgr
+        ).load(_sharded_state())
+        assert step == 9
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), _global_w()
+        )
+
+        aborted = _events_of(master.event_journal, "reshard_aborted")[-1]
+        assert aborted["data"]["reason"] == "coverage"
+        fin = _events_of(master.event_journal, "restore_complete")[-1]
+        assert fin["data"]["medium"] == "replica"
+    finally:
+        svc0.stop()
+        replica_store.stop()
+
+
+def test_injected_transfer_fault_falls_through_ladder(master, tmp_path,
+                                                      monkeypatch):
+    """Chaos kills the transfer mid-reshard: the rung aborts with the
+    injection named as the reason and the shm rung restores instead."""
+    _write_frame(0, 4, [(0, 8)])
+    svc0 = _serve(0)
+    try:
+        c0 = MasterClient(master.addr, 0)
+        svc0.register(c0, JOB, 0)
+        ReshardCoordinator(JOB, master.kv_store).on_world_cut(
+            [0, 1], [0], 6
+        )
+        monkeypatch.setenv(EnvKey.RDZV_ROUND, "6")
+        configure("reshard.xfer:error")
+
+        restored, step = _engine(tmp_path, 0, c0).load(_sharded_state())
+        assert step == 4
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), _global_w()
+        )
+        aborted = _events_of(master.event_journal, "reshard_aborted")[-1]
+        assert aborted["data"]["reason"] == "fault_injected"
+        fin = _events_of(master.event_journal, "restore_complete")[-1]
+        assert fin["data"]["medium"] == "shm"
+    finally:
+        svc0.stop()
+
+
+def test_peer_frame_rung_without_master(master, tmp_path):
+    """The replica peer-frame rung stands alone: no master on the engine
+    (reshard rung skipped entirely), empty own shm, and the state is
+    reassembled from another owner's frame held in the replica store."""
+    shm1 = _write_frame(1, 5, [(0, 8)])
+    store = ReplicaService()
+    store.start()
+    try:
+        store.put(1, 0, 5, shm1.read_frame_bytes())
+        shm1.unlink()
+        mgr = ReplicaManager(
+            JOB, 0, 2, MasterClient(master.addr, 0), service=store
+        )
+        engine = _engine(tmp_path, 0, None, replica_manager=mgr)
+        restored, step = engine.load(_sharded_state())
+        assert step == 5
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), _global_w()
+        )
+        assert restored["lr"] == 0.25
+    finally:
+        store.stop()
+
+
+def test_reshard_env_gate(master, tmp_path, monkeypatch):
+    """DLROVER_TPU_RESHARD=0 disables the rung even with a cut pending."""
+    ReshardCoordinator(JOB, master.kv_store).on_world_cut([0, 1], [0], 7)
+    monkeypatch.setenv(EnvKey.RDZV_ROUND, "7")
+    monkeypatch.setenv(ConfigKey.RESHARD, "0")
+    engine = _engine(tmp_path, 0, MasterClient(master.addr, 0))
+    state, step = engine._load_via_reshard(
+        _sharded_state(), time.monotonic()
+    )
+    assert state is None and step == -1
+    assert "reshard_start" not in _kinds(master.event_journal)
+
+
+def test_stale_step_fetch_refused(master):
+    """A survivor that already sealed a newer frame refuses stale-step
+    fetches — the wire protocol's consistency guard."""
+    _write_frame(0, 21, [(0, 8)])
+    svc0 = _serve(0)
+    try:
+        c0 = MasterClient(master.addr, 0)
+        addr = svc0.register(c0, JOB, 0)
+        from dlrover_tpu.common import comm
+        from dlrover_tpu.common.rpc import RPCClient
+
+        client = RPCClient(addr, timeout_s=5.0)
+        ok = client.call("reshard_fetch", comm.ReshardFetchRequest(
+            local_rank=0, step=21, path=W_PATH, shard_index=0,
+        ))
+        assert ok.found and len(ok.data) == _global_w().nbytes
+        stale = client.call("reshard_fetch", comm.ReshardFetchRequest(
+            local_rank=0, step=20, path=W_PATH, shard_index=0,
+        ))
+        assert not stale.found and stale.step == 21
+    finally:
+        svc0.stop()
