@@ -1133,6 +1133,107 @@ def bench_reshard(budget_s: float = 120.0) -> dict:
         master.stop()
 
 
+def bench_control_plane(budget_s: float = 240.0) -> dict:
+    """Hierarchical fan-in vs flat heartbeat plane at swarm scale
+    (master/fanin.py + agent/fanin.py, driven by tests/swarm_harness.py).
+    The claim under test: at 1000+ agents an aggregation tree keeps the
+    per-agent heartbeat p99 flat (children are answered by their group
+    aggregator from a local mailbox) while the master ingests compound
+    envelopes — vs the flat plane where every agent's kitchen-sink beat
+    queues on one process."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from swarm_harness import Swarm, make_op_telemetry
+
+    from dlrover_tpu.common.constants import ConfigKey, NodeStatus
+    from dlrover_tpu.master.master import LocalJobMaster
+
+    saved_env = {k: os.environ.get(k) for k in
+                 (ConfigKey.FANIN_DEGREE, ConfigKey.FANIN_FLUSH_S)}
+    t0 = time.monotonic()
+    points = []
+    try:
+        for world in (64, 256, 1024):
+            if points and time.monotonic() - t0 > budget_s - 60.0:
+                points.append({"world": world, "skipped": "budget"})
+                continue
+            entry = {"world": world}
+            for mode, degree in (("flat", 0), ("tree", 32)):
+                os.environ[ConfigKey.FANIN_DEGREE] = str(degree)
+                # forward cadence: the product default is interval/2
+                # (≥0.5s at the default 15s heartbeat); 0.25s keeps the
+                # bench snappy while staying realistic. Child-visible
+                # latency does not depend on this — children are answered
+                # from the aggregator mailbox regardless of flush timing
+                os.environ[ConfigKey.FANIN_FLUSH_S] = "0.25"
+                master = LocalJobMaster(
+                    job_name=f"benchcp{os.getpid()}w{world}{mode}",
+                    node_num=world,
+                )
+                master.prepare()
+                swarm = Swarm(master.addr, world, drivers=32)
+                try:
+                    swarm.settle(rounds=4)
+                    cpu0 = time.process_time()
+                    stats = swarm.beat(
+                        rounds=3,
+                        telemetry_fn=lambda nid, rnd: make_op_telemetry(nid),
+                    )
+                    # process CPU includes the simulated agents too, but
+                    # the sim side is identical across modes at a given
+                    # world — the flat-vs-tree delta is the control plane
+                    cpu_s = time.process_time() - cpu0
+                    time.sleep(0.4)  # let the last flush ticks land
+                    snap = master.fanin_plane.snapshot()
+                    entry[mode] = {
+                        "p50_ms": round(stats["p50_ms"], 3),
+                        "p99_ms": round(stats["p99_ms"], 3),
+                        "max_ms": round(stats["max_ms"], 3),
+                        "wall_s": round(stats["wall_s"], 3),
+                        "errors": stats["errors"],
+                        "proc_cpu_s": round(cpu_s, 3),
+                        "aggregators": len(snap["assignment"]),
+                        "compound_envelopes": snap["compound_total"],
+                        "child_beats": snap["child_beats_total"],
+                        "false_deaths": len([
+                            n for n in master.job_manager.list_nodes()
+                            if n.status == NodeStatus.FAILED
+                        ]),
+                    }
+                finally:
+                    swarm.close()
+                    master.stop()
+            flat, tree = entry.get("flat"), entry.get("tree")
+            if flat and tree and tree["p99_ms"] > 0:
+                entry["p99_speedup_tree_vs_flat"] = round(
+                    flat["p99_ms"] / tree["p99_ms"], 2)
+            points.append(entry)
+        ran = [p for p in points if "p99_speedup_tree_vs_flat" in p]
+        last = ran[-1] if ran else {}
+        return {
+            "points": points,
+            # headline: the tree's p99 win at the largest world that ran
+            "world": last.get("world"),
+            "p99_speedup_tree_vs_flat": last.get("p99_speedup_tree_vs_flat"),
+            "hb_p99_ms_tree": (last.get("tree") or {}).get("p99_ms"),
+            "hb_p99_ms_flat": (last.get("flat") or {}).get("p99_ms"),
+            "false_deaths": sum(
+                (p.get(m) or {}).get("false_deaths", 0)
+                for p in points for m in ("flat", "tree")
+            ),
+        }
+    except Exception as e:  # noqa: BLE001 — bench must still emit a line
+        return {"error": repr(e), "points": points}
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 # Wall-clock discipline (round-4 fix for the r3 rc=124 record hole): the
 # driver runs bench.py under a ~30-min budget; this process budgets
 # BENCH_TIME_BUDGET_S (default 20 min) across sections, RE-PRINTS the
@@ -1153,6 +1254,8 @@ _SECTIONS = (
     ("attn", lambda left: bench_attention(), 90.0),
     ("goodput", lambda left: bench_goodput(timeout_s=left - 10.0), 60.0),
     ("reshard", lambda left: bench_reshard(budget_s=min(left, 150.0)), 45.0),
+    ("control_plane",
+     lambda left: bench_control_plane(budget_s=min(left, 240.0)), 60.0),
     ("ckpt", lambda left: bench_ckpt(budget_s=left), 120.0),
 )
 
@@ -1181,6 +1284,7 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
     attn = detail.get("attn") or {}
     goodput = detail.get("goodput") or {}
     ckpt = detail.get("ckpt") or {}
+    cplane = detail.get("control_plane") or {}
     long_d = decode.get("long_context") or {}
     alt = train.get("alt_shape_s1024_b8") or {}
     feas = ckpt.get("floor_feasible_point") or {}
@@ -1193,7 +1297,8 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
     sections = {
         name: ("error" if "error" in (detail.get(name) or {})
                else (detail.get(name) or {}).get("skipped") or "ok")
-        for name in ("train", "decode", "attn", "goodput", "ckpt")
+        for name in ("train", "decode", "attn", "goodput", "reshard",
+                     "control_plane", "ckpt")
         if name in detail
     }
     summary = {
@@ -1227,6 +1332,9 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
         "ckpt_host_scale": pick(scale, (
             "state_gb", "t_block_s", "drain_rate_mbps",
             "restore_rate_mbps")),
+        "control_plane": pick(cplane, (
+            "world", "p99_speedup_tree_vs_flat", "hb_p99_ms_tree",
+            "hb_p99_ms_flat", "false_deaths")),
         "sections": sections,
     }
     return {

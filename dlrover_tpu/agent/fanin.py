@@ -1,0 +1,365 @@
+"""Agent side of the hierarchical control-plane fan-in (master/fanin.py).
+
+Two pieces:
+
+:class:`FaninAggregator` — the aggregator role. An agent the master
+assigns ``fanin_role="aggregator"`` runs a small RPC server for its group
+siblings. Children's heartbeats are answered *instantly* from a per-child
+action mailbox (no blocking on the master hop — that is where the child
+p99 win comes from), while a flush thread batches the latest beat per
+child, pre-merges their op-telemetry histograms, and forwards ONE
+compound envelope to the master per flush tick. The aggregator's own
+beat joins its batch too — only the flush thread ever talks to the
+master, so one aggregator costs the master one connection, not two.
+
+:class:`HeartbeatRouter` — the dial plane every agent heartbeats
+through. It follows the master's tree assignment from heartbeat replies:
+beat the assigned parent aggregator when one is known, fall straight
+back to the master on any parent failure (a dead aggregator must cost
+its children one failed call, not their liveness), and lazily start/stop
+the local :class:`FaninAggregator` when the master flips this node's
+role. A child keeps its parent for as long as the parent serves: with
+id-space groups the child's assignment can only change when its
+aggregator dies or is demoted, and both surface as a connection failure
+(a demoted aggregator stands down and closes its subtree server).
+
+Chaos sites: ``agg.forward`` fires before each batch is assembled (an
+``error`` kind kills the aggregator mid-batch — the re-parenting drill);
+``hb.fanin`` fires on the forward hop itself (``drop``/``delay`` model a
+lost or slow compound envelope). Both are journaled by the injector's
+reporter like every other site.
+"""
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.chaos import get_injector
+from dlrover_tpu.common import comm, retry
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import (
+    ConfigKey,
+    DiagnosisActionType,
+    SpanName,
+    env_float,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RPCClient, RPCServer, local_host_ip
+from dlrover_tpu.observability import tracing
+
+_MAX_PENDING_EVENTS = 256
+
+
+class FaninAggregator:
+    """Subtree heartbeat collector + batched forwarder; one per
+    aggregator-role agent. Thread-safe; owns one RPC server and one
+    flush thread."""
+
+    def __init__(self, master_client, node_id: int,
+                 flush_s: Optional[float] = None,
+                 advertise_host: Optional[str] = None):
+        self._mc = master_client
+        self._node_id = node_id
+        interval = get_context().heartbeat_interval_s
+        if flush_s is None:
+            flush_s = env_float(ConfigKey.FANIN_FLUSH_S, 0.0) \
+                or min(0.5, interval / 2.0)
+        self._flush_s = max(0.05, flush_s)
+        self._lock = threading.Lock()
+        # node_id → latest HeartbeatRequest (newer beats overwrite older:
+        # liveness only needs the freshest stamp per child)
+        self._beats: Dict[int, comm.HeartbeatRequest] = {}
+        self._events: List[comm.EventReport] = []
+        # node_id → [action_type, action_data] awaiting that child's next
+        # beat — children get replies instantly from here, never blocking
+        # on the master hop
+        self._mailbox: Dict[int, List[Any]] = {}
+        self._backpressure = 0
+        self._backoff_hint_s = 0.0
+        self._epoch = -1
+        self._forwarded = 0  # successful compound forwards so far
+        self._stopped = threading.Event()
+        self._server = RPCServer(port=0)
+        self._server.register("heartbeat", self._rpc_heartbeat)
+        self._server.register("report_event", self._rpc_report_event)
+        self._server.start()
+        host = advertise_host or local_host_ip()
+        self.addr = f"{host}:{self._server.port}"
+        self._thread = threading.Thread(
+            target=self._flush_loop, name=f"fanin-agg-{node_id}",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("fan-in aggregator %s serving subtree on %s "
+                    "(flush %.2fs)", node_id, self.addr, self._flush_s)
+
+    # -- child-facing RPC handlers -----------------------------------------
+
+    def _rpc_heartbeat(
+        self, req: comm.HeartbeatRequest
+    ) -> comm.HeartbeatResponse:
+        with self._lock:
+            self._beats[req.node_id] = req
+            pending = self._mailbox.pop(req.node_id, None)
+            backpressure = self._backpressure
+            hint = self._backoff_hint_s
+            epoch = self._epoch
+        if pending is not None:
+            action_type, action_data = pending[0], dict(pending[1] or {})
+        else:
+            action_type, action_data = DiagnosisActionType.NONE, {}
+        # fanin_role/parent stay at their defaults: tree assignment is
+        # the MASTER's to hand out — the relayed epoch is observability
+        # only (children act on connection failures, not epoch drift)
+        return comm.HeartbeatResponse(
+            action_type=action_type,
+            action_data=action_data,
+            backpressure=backpressure,
+            backoff_hint_s=hint,
+            fanin_epoch=epoch,
+        )
+
+    def _rpc_report_event(self, req: comm.EventReport) -> comm.BaseResponse:
+        with self._lock:
+            self._events.append(req)
+            if len(self._events) > _MAX_PENDING_EVENTS:
+                del self._events[:len(self._events) - _MAX_PENDING_EVENTS]
+        return comm.BaseResponse()
+
+    # -- forward path ------------------------------------------------------
+
+    def _flush_loop(self) -> None:
+        try:
+            # jittered tick: sibling aggregators are all created in the
+            # same heartbeat generation, so un-jittered flushes would land
+            # on the master as one synchronized burst per period — the
+            # exact fan-in spike the tree exists to remove
+            while not self._stopped.wait(retry.jittered(self._flush_s,
+                                                        jitter=0.3)):
+                try:
+                    self._flush_once()
+                except ConnectionError as e:
+                    # forward failed (master restart, injected drop): the
+                    # beats were re-staged by _flush_once — just wait
+                    logger.debug("fan-in forward failed: %r", e)
+                except RuntimeError as e:
+                    # an injected agg.forward error: this aggregator dies
+                    # mid-batch (the re-parenting chaos drill)
+                    logger.warning("fan-in aggregator %s dying: %r",
+                                   self._node_id, e)
+                    self._stopped.set()
+        finally:
+            # teardown IN the flush thread: RPCClient sockets are
+            # thread-local, so only this thread can close the conn whose
+            # death tells the master's on_disconnect hook about us
+            try:
+                self._server.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                logger.debug("fan-in subtree server stop failed",
+                             exc_info=True)
+            try:
+                self._mc._client._close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                logger.debug("fan-in master socket close failed",
+                             exc_info=True)
+
+    def _flush_once(self) -> None:
+        inj = get_injector()
+        with self._lock:
+            have_work = bool(self._beats or self._events)
+            has_children = bool(self._events) or any(
+                nid != self._node_id for nid in self._beats)
+        if not have_work:
+            return
+        if inj is not None and self._forwarded > 0 and has_children:
+            # "kill the aggregator MID-batch": fires only on an
+            # ESTABLISHED aggregator (≥1 forward ⇒ a live master socket,
+            # so its death produces a deterministic disconnect) with
+            # children's beats staged. An error kind ⇒ RuntimeError ⇒
+            # the flush loop tears this aggregator down, the staged
+            # beats still in place for whoever inherits the subtree
+            inj.fire("agg.forward", agg=self._node_id)
+        with self._lock:
+            if not self._beats and not self._events:
+                return
+            beats = dict(self._beats)
+            self._beats = {}
+            events = self._events
+            self._events = []
+        # strip per-beat histograms into one merged field keyed by child
+        # node id — halves the envelope and lets the master ingest the
+        # whole subtree's skew signal in one lock pass
+        merged: Dict[str, Any] = {}
+        wire_beats = []
+        for nid, beat in beats.items():
+            if beat.op_telemetry:
+                merged[str(nid)] = beat.op_telemetry
+                beat = dataclasses.replace(beat, op_telemetry={})
+            wire_beats.append(beat)
+        req = comm.CompoundHeartbeatRequest(
+            agg_node_id=self._node_id,
+            beats=wire_beats,
+            merged_telemetry=merged,
+            events=events,
+        )
+        try:
+            with tracing.span(SpanName.FANIN_FORWARD,
+                              source=f"agent_{self._node_id}",
+                              beats=len(wire_beats)):
+                if inj is not None:
+                    inj.fire("hb.fanin", agg=self._node_id,
+                             beats=len(wire_beats))
+                resp = self._mc.fanin_heartbeat(req)
+            self._forwarded += 1
+        except (ConnectionError, OSError):
+            # re-stage for the next flush — a child that beat again in
+            # the meantime keeps its NEWER beat
+            with self._lock:
+                for nid, beat in beats.items():
+                    self._beats.setdefault(nid, beat)
+                self._events = events + self._events
+                del self._events[:len(self._events) - _MAX_PENDING_EVENTS]
+            raise ConnectionError("fan-in forward failed")
+        with self._lock:
+            for nid, action in (resp.actions or {}).items():
+                self._mailbox[int(nid)] = action
+            self._backpressure = resp.backpressure
+            self._backoff_hint_s = resp.backoff_hint_s
+            self._epoch = resp.fanin_epoch
+        if resp.fanin_role != "aggregator":
+            # demoted (a lower-id sibling returned): stand down — the
+            # flush loop exits, the subtree server closes, and this
+            # node's router resumes plain master beats on its next tick
+            logger.info("fan-in aggregator %s demoted by master — "
+                        "standing down", self._node_id)
+            self._stopped.set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return not self._stopped.is_set()
+
+    def kill(self, join: bool = True) -> None:
+        """Stop serving and close the master connection — from the
+        master's perspective indistinguishable from a SIGKILLed
+        aggregator process (its sockets die, on_disconnect fires, the
+        subtree re-parents)."""
+        self._stopped.set()
+        if join and self._thread is not threading.current_thread():
+            self._thread.join(timeout=5.0)
+
+
+class HeartbeatRouter:
+    """Routes one agent's heartbeats to its assigned parent (aggregator
+    or master), following the master's tree assignment from replies."""
+
+    def __init__(self, master_client):
+        self._mc = master_client
+        self._lock = threading.Lock()
+        self._parent_addr = ""
+        self._parent_client: Optional[RPCClient] = None
+        self._epoch = -1
+        self.aggregator: Optional[FaninAggregator] = None
+
+    def heartbeat(self, global_step: int = 0, step_timestamp: float = 0.0,
+                  gauges=None, rdzv_round: int = -1,
+                  op_telemetry=None) -> comm.HeartbeatResponse:
+        """Same signature/semantics as MasterClient.heartbeat — raises
+        ConnectionError only when BOTH the parent and the master are
+        unreachable (parent failure alone falls back transparently)."""
+        with self._lock:
+            parent = self._parent_client
+        agg = self.aggregator
+        if agg is not None and agg.alive:
+            # aggregator role: this node's own beat joins its batch and
+            # its liveness rides the compound envelope — only the flush
+            # thread ever talks to the master. The compound reply's epoch
+            # is the demotion channel: a bump means assignments moved, so
+            # fall through to a plain master beat to refresh the role.
+            resp = agg._rpc_heartbeat(comm.HeartbeatRequest(
+                node_id=self._mc.node_id,
+                timestamp=time.time(),
+                global_step=global_step,
+                step_timestamp=step_timestamp,
+                gauges=gauges or {},
+                rdzv_round=rdzv_round,
+                op_telemetry=op_telemetry or {},
+            ))
+            if resp.fanin_epoch < 0 or resp.fanin_epoch == self._epoch:
+                return resp
+        if parent is not None:
+            req = comm.HeartbeatRequest(
+                node_id=self._mc.node_id,
+                timestamp=time.time(),
+                global_step=global_step,
+                step_timestamp=step_timestamp,
+                gauges=gauges or {},
+                rdzv_round=rdzv_round,
+                op_telemetry=op_telemetry or {},
+            )
+            try:
+                resp = parent.call("heartbeat", req,
+                                   policy=retry.HEARTBEAT)
+                self._apply(resp, from_master=False)
+                return resp
+            except (ConnectionError, OSError):
+                # dead aggregator: one failed call, then straight back to
+                # the master — never a liveness gap
+                logger.info("node %s: parent aggregator %s unreachable — "
+                            "falling back to master", self._mc.node_id,
+                            self._parent_addr)
+                self._set_parent("")
+        resp = self._mc.heartbeat(
+            global_step=global_step, step_timestamp=step_timestamp,
+            gauges=gauges, rdzv_round=rdzv_round,
+            op_telemetry=op_telemetry,
+        )
+        self._apply(resp, from_master=True)
+        return resp
+
+    def _set_parent(self, addr: str) -> None:
+        with self._lock:
+            if addr == self._parent_addr:
+                return
+            self._parent_addr = addr
+            self._parent_client = RPCClient(addr) if addr else None
+
+    def _apply(self, resp: comm.HeartbeatResponse,
+               from_master: bool) -> None:
+        if not from_master:
+            # a relayed reply carries no routing news a child can act on:
+            # with id-space groups its assignment only changes when its
+            # aggregator dies or is demoted, and both surface as a
+            # connection failure (a demoted aggregator stands down and
+            # closes its subtree server) → transparent master fallback
+            return
+        epoch_changed = resp.fanin_epoch != self._epoch
+        self._epoch = resp.fanin_epoch
+        if resp.fanin_role == "aggregator":
+            if self.aggregator is None or not self.aggregator.alive:
+                self.aggregator = FaninAggregator(self._mc,
+                                                  self._mc.node_id)
+                epoch_changed = True
+            if epoch_changed:
+                # (re-)announce the subtree address — a master restart or
+                # re-parent loses/invalidates the old registration
+                try:
+                    self._mc.fanin_register(self.aggregator.addr)
+                except (ConnectionError, OSError):
+                    logger.debug("fanin_register failed; retrying on a "
+                                 "later beat", exc_info=True)
+            self._set_parent("")
+            return
+        if self.aggregator is not None and self.aggregator.alive:
+            # demoted (a lower-id sibling returned): hand the role back
+            self.aggregator.kill()
+            self.aggregator = None
+        self._set_parent(resp.fanin_parent)
+
+    def close(self) -> None:
+        if self.aggregator is not None:
+            self.aggregator.kill()
+            self.aggregator = None
+        self._set_parent("")

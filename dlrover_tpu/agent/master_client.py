@@ -227,6 +227,25 @@ class MasterClient:
             policy=retry.HEARTBEAT,
         )
 
+    def fanin_heartbeat(
+        self, req: comm.CompoundHeartbeatRequest
+    ) -> comm.CompoundHeartbeatResponse:
+        """Forward one aggregated subtree envelope (agent/fanin.py).
+        Same bounded budget as a plain heartbeat: a forward that can't
+        get through is a signal, and the children's beats are re-staged
+        for the next flush rather than hidden behind a long ladder."""
+        return self._client.call("fanin_heartbeat", req,
+                                 policy=retry.HEARTBEAT)
+
+    def fanin_register(self, addr: str) -> int:
+        """Announce this agent's aggregator RPC address; returns the tree
+        epoch the registration landed in (-1 = no fan-in plane)."""
+        resp = self._client.call(
+            "fanin_register",
+            comm.FaninRegisterRequest(node_id=self._node_id, addr=addr),
+        )
+        return int((resp.data or {}).get("epoch", -1))
+
     def report_failure(self, error_data: str, level: str,
                        restart_count: int = 0) -> None:
         self._client.call(

@@ -484,31 +484,52 @@ class ElasticTrainingAgent:
     # -- heartbeat / diagnosis actions -------------------------------------
 
     def _heartbeat_loop(self) -> None:
+        from dlrover_tpu.agent.fanin import HeartbeatRouter
+        from dlrover_tpu.common import retry
         from dlrover_tpu.common.config import get_context
 
         interval = get_context().heartbeat_interval_s
-        while not self._stop_flag.wait(interval):
-            try:
-                resp = self._client.heartbeat(
-                    global_step=self._last_global_step,
-                    step_timestamp=self._last_step_ts,
-                    gauges=self._diagnosis.collect_gauges(),
-                    rdzv_round=self._current_round,
-                    op_telemetry=self._op_telemetry.collect(),
-                )
-            except ConnectionError:
-                self._note_heartbeat_failure()
-                continue
-            self._note_heartbeat_success()
-            if resp.action_type != DiagnosisActionType.NONE:
-                with self._action_lock:
-                    self._pending_action = (
-                        resp.action_type, dict(resp.action_data or {})
+        # fan-in routing: beats go to this node's assigned aggregator
+        # when the master hands one out, straight to the master otherwise
+        # (and on any aggregator failure) — see agent/fanin.py
+        router = HeartbeatRouter(self._client)
+        self._hb_router = router
+        wait_s = interval
+        try:
+            while not self._stop_flag.wait(wait_s):
+                wait_s = interval
+                try:
+                    resp = router.heartbeat(
+                        global_step=self._last_global_step,
+                        step_timestamp=self._last_step_ts,
+                        gauges=self._diagnosis.collect_gauges(),
+                        rdzv_round=self._current_round,
+                        op_telemetry=self._op_telemetry.collect(),
                     )
-                logger.info(
-                    "received diagnosis action %s (%s)",
-                    resp.action_type, resp.action_data,
-                )
+                except ConnectionError:
+                    self._note_heartbeat_failure()
+                    continue
+                self._note_heartbeat_success()
+                if resp.backoff_hint_s > 0:
+                    # explicit master backpressure: stretch the next beat,
+                    # jittered so the fleet doesn't re-synchronize into
+                    # the very burst the master is shedding
+                    wait_s = interval + retry.jittered(resp.backoff_hint_s)
+                self._handle_heartbeat_action(resp)
+        finally:
+            router.close()
+
+    def _handle_heartbeat_action(self, resp) -> None:
+        if resp.action_type == DiagnosisActionType.NONE:
+            return
+        with self._action_lock:
+            self._pending_action = (
+                resp.action_type, dict(resp.action_data or {})
+            )
+        logger.info(
+            "received diagnosis action %s (%s)",
+            resp.action_type, resp.action_data,
+        )
 
     def _note_heartbeat_failure(self) -> None:
         """Consecutive heartbeat failures are THE partition signal: after

@@ -160,7 +160,10 @@ class OomGuard(BrainPlugin):
     WINDOW_S = 1800.0
 
     def optimize(self, ctx: OptimizeContext) -> ResourcePlan:
-        cutoff = time.time() - self.WINDOW_S
+        # noqa'd: sample.ts values are wall stamps persisted by the
+        # datastore (possibly by another process) — the cutoff must be
+        # computed on the same clock they were written with
+        cutoff = time.time() - self.WINDOW_S  # noqa: DLR001
         ooms = [s for s in ctx.store.query(ctx.job_uuid, kind="oom", limit=5)
                 if s.ts >= cutoff]
         if not ooms:

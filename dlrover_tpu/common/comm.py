@@ -243,6 +243,60 @@ class HeartbeatResponse:
     # DiagnosisAction for the agent to execute, if any
     action_type: str = "no_action"
     action_data: Dict[str, Any] = field(default_factory=dict)
+    # fan-in plane (master/fanin.py): overload ladder level (0 = healthy,
+    # 1 = telemetry shed, 2 = hard shed) plus the client-side backoff the
+    # master is asking for — the explicit backpressure signal that lets a
+    # drowning master slow senders down instead of missing liveness
+    backpressure: int = 0
+    backoff_hint_s: float = 0.0
+    # aggregation-tree assignment for the replying node: role is "" (leaf
+    # or flat mode) or "aggregator"; parent is the aggregator addr this
+    # node should send heartbeats to ("" = straight to the master); epoch
+    # bumps whenever any assignment changes so stale parents are detected
+    fanin_role: str = ""
+    fanin_parent: str = ""
+    fanin_epoch: int = -1
+
+
+@message
+class CompoundHeartbeatRequest:
+    """Aggregator → master: one batched envelope for a whole subtree
+    (agent/fanin.py FaninAggregator). ``beats`` are the children's latest
+    HeartbeatRequests with per-beat ``op_telemetry`` stripped; the
+    aggregator pre-merges those histograms into ``merged_telemetry`` so
+    the master ingests the subtree's skew signal in one pass."""
+
+    agg_node_id: int = -1
+    beats: List[Any] = field(default_factory=list)  # [HeartbeatRequest]
+    # pre-merged op telemetry: {str(node_id): {str(global_rank): snap}} —
+    # grouped per child node so the master's skew monitor keeps rank→node
+    # attribution while still ingesting the subtree in one lock pass
+    merged_telemetry: Dict[str, Any] = field(default_factory=dict)
+    # journal events the children asked the aggregator to forward
+    events: List[Any] = field(default_factory=list)  # [EventReport]
+
+
+@message
+class CompoundHeartbeatResponse:
+    # per-child diagnosis actions: {node_id: [action_type, action_data]}
+    actions: Dict[int, Any] = field(default_factory=dict)
+    backpressure: int = 0
+    backoff_hint_s: float = 0.0
+    # current tree epoch — the aggregator relays it to children so they
+    # notice re-parenting without an extra master round-trip
+    fanin_epoch: int = -1
+    # the CALLER's current role: an aggregator's own liveness rides its
+    # envelope (it stops plain-beating the master), so demotion must be
+    # delivered on this reply — "" tells it to stand down
+    fanin_role: str = "aggregator"
+
+
+@message
+class FaninRegisterRequest:
+    """Aggregator → master: "my subtree RPC server listens at addr"."""
+
+    node_id: int = -1
+    addr: str = ""
 
 
 @message

@@ -268,6 +268,17 @@ class ConfigKey:
     # skew / hang attribution (master/skew_monitor.py)
     SKEW_THRESHOLD = "DLROVER_TPU_SKEW_THRESHOLD"
     SKEW_WINDOW = "DLROVER_TPU_SKEW_WINDOW"
+    # hierarchical control-plane fan-in (master/fanin.py, agent/fanin.py):
+    # aggregation-tree branching factor (0/1 = flat, every agent talks to
+    # the master directly), aggregator flush cadence, the per-beat handler
+    # latency (ms) above which the master starts shedding telemetry, the
+    # KV store's internal shard count, and a test-only override forcing a
+    # backpressure level regardless of measured load
+    FANIN_DEGREE = "DLROVER_TPU_FANIN_DEGREE"
+    FANIN_FLUSH_S = "DLROVER_TPU_FANIN_FLUSH_S"
+    FANIN_SHED_MS = "DLROVER_TPU_FANIN_SHED_MS"
+    FANIN_KV_SHARDS = "DLROVER_TPU_FANIN_KV_SHARDS"
+    FANIN_FORCE_LEVEL = "DLROVER_TPU_FANIN_FORCE_LEVEL"
     # chaos / observability
     FAULT_SCHEDULE = "DLROVER_FAULT_SCHEDULE"
     FAULT_SEED = "DLROVER_FAULT_SEED"
@@ -310,6 +321,10 @@ class SpanName:
     # scale-plan arc (master/auto_scaler.py → master/job_manager.py)
     SCALE_APPLY = "scale.apply"
     SCALE_RDZV_PARAMS = "scale.update_rdzv_params"
+    # fan-in plane (agent/fanin.py aggregator forward hop,
+    # master/fanin.py re-parenting of a dead aggregator's subtree)
+    FANIN_FORWARD = "fanin.forward"
+    FANIN_REPARENT = "fanin.reparent"
     # failure-detect → relaunch arc (master/master.py → agent/training.py)
     FAULT_RELAUNCH = "fault.relaunch"
     AGENT_RESTART_WORKERS = "agent.restart_workers"

@@ -138,6 +138,15 @@ RENDEZVOUS = RetryPolicy(max_attempts=600, base_backoff_s=0.1,
                          max_backoff_s=2.0, respect_breaker=False)
 BULK = RetryPolicy(max_attempts=3, base_backoff_s=0.5, max_backoff_s=2.0)
 
+def jittered(seconds: float, jitter: float = 0.2) -> float:
+    """``seconds`` spread by ±``jitter`` — used wherever many clients act
+    on the same trigger (master backoff hints, reconnect stampedes) so
+    their next attempts don't land in one synchronized burst."""
+    if seconds <= 0.0:
+        return 0.0
+    return max(0.0, seconds * (1.0 + random.uniform(-jitter, jitter)))
+
+
 RetryPolicy.DEFAULT = DEFAULT  # type: ignore[attr-defined]
 RetryPolicy.PROBE = PROBE  # type: ignore[attr-defined]
 RetryPolicy.HEARTBEAT = HEARTBEAT  # type: ignore[attr-defined]

@@ -147,6 +147,13 @@ class _Handler(socketserver.BaseRequestHandler):
 class _ThreadedTCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # socketserver's default listen backlog is 5 — a reconnect stampede
+    # (master restart: the whole fleet dials back at once) or a fan-in
+    # subtree discovering its aggregator's address in the same heartbeat
+    # generation overflows that instantly, and every dropped SYN costs the
+    # client a kernel retransmit (~1s floor) that reads as a control-plane
+    # latency spike
+    request_queue_size = 512
 
 
 class RPCServer:

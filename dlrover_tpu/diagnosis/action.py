@@ -31,8 +31,11 @@ class DiagnosisAction:
         self.instance = instance
         self.reason = reason
         self.data = data or {}
-        self.timestamp = time.time()
+        self.timestamp = time.time()  # noqa: DLR001 — reported creation stamp
         self.expired_time_s = expired_time_s
+        # expiry runs on the monotonic clock: a wall step under NTP must
+        # neither expire a fresh action nor immortalize a stale one
+        self._created_mono = time.monotonic()
         # node ids a broadcast (ANY_INSTANCE) action was delivered to
         self.delivered: set = set()
 
@@ -40,7 +43,10 @@ class DiagnosisAction:
         return self.action_type == DiagnosisActionType.NONE
 
     def is_expired(self, now: Optional[float] = None) -> bool:
-        return ((now or time.time()) - self.timestamp) > self.expired_time_s
+        """``now``, when given, is a time.monotonic() reading."""
+        return (
+            (now or time.monotonic()) - self._created_mono
+        ) > self.expired_time_s
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (
@@ -107,7 +113,7 @@ class DiagnosisActionQueue:
             self._actions.append(action)
 
     def next_action(self, instance: int) -> DiagnosisAction:
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             self._actions = [
                 a for a in self._actions if not a.is_expired(now)

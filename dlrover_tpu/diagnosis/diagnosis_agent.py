@@ -116,7 +116,9 @@ class DiagnosisAgent:
         self._failures: List[WorkerFailure] = []
         self._timer_port = timer_port
         self._stack_dir = stack_dir
-        self._last_stack_capture = 0.0
+        # monotonic stamps; -inf = "never", so the first trigger always
+        # clears the cooldown even right after boot (monotonic starts ~0)
+        self._last_stack_capture = float("-inf")
         self._capture_thread = None
         # xprof-on-hang: with the agent IPC server in hand, a hang also
         # requests an XLA trace from every worker (observability/
@@ -124,7 +126,7 @@ class DiagnosisAgent:
         # what the device was doing
         self._ipc_server = ipc_server
         self._local_world_size = local_world_size
-        self._last_profile_request = 0.0
+        self._last_profile_request = float("-inf")
 
     # minimum seconds between hang-triggered stack captures (a wedged job
     # raises the gauge on every heartbeat; one dump per window is enough)
@@ -154,7 +156,7 @@ class DiagnosisAgent:
         heartbeat loop, which must keep beating."""
         if gauges.get("XPU_TIMER_COMMON_HANG", 0) <= 0:
             return
-        now = time.time()
+        now = time.monotonic()
         if now - self._last_stack_capture < self.STACK_CAPTURE_COOLDOWN_S:
             return
         if self._capture_thread is not None and (
@@ -166,23 +168,23 @@ class DiagnosisAgent:
         def _capture():
             # own cooldown, independent of stack-RPC success: the 15s
             # stack-retry path must not re-trace a wedged job every beat
-            if time.time() - self._last_profile_request > (
+            if time.monotonic() - self._last_profile_request > (
                 self.STACK_CAPTURE_COOLDOWN_S
             ):
-                self._last_profile_request = time.time()
+                self._last_profile_request = time.monotonic()
                 self._request_worker_profiles()
             path = self.capture_worker_stacks()
             if path:
                 # stamp the cooldown only on success: a transient RPC
                 # failure must not suppress the diagnostic for 120s of a
                 # live hang
-                self._last_stack_capture = time.time()
+                self._last_stack_capture = time.monotonic()
                 logger.warning(
                     "hang detected — worker stacks saved to %s", path,
                 )
             else:
                 self._last_stack_capture = (
-                    time.time()
+                    time.monotonic()
                     - self.STACK_CAPTURE_COOLDOWN_S
                     + self.STACK_CAPTURE_RETRY_S
                 )

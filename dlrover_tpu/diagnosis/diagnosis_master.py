@@ -56,7 +56,7 @@ class TrainingHangDiagnostician(Diagnostician):
     name = "training_hang"
 
     def __init__(self, perf_monitor, node_gauges: Dict[int, tuple]):
-        # node_gauges: node_id → (gauge dict, receive timestamp), shared
+        # node_gauges: node_id → (gauge dict, monotonic receive stamp), shared
         # with (and mutated by) DiagnosisMaster.observe_heartbeat
         self._perf_monitor = perf_monitor
         self._node_gauges = node_gauges
@@ -68,7 +68,7 @@ class TrainingHangDiagnostician(Diagnostician):
         # only nodes whose agent recently forwarded the profiler hang gauge
         # get a vote — a node without tpu_timer (or whose daemon died and
         # left a stale snapshot) must not count as "not hung"
-        now = time.time()
+        now = time.monotonic()
         fresh_s = 3 * get_context().heartbeat_interval_s
         votes = {
             nid: g[HANG_GAUGE] > 0
@@ -319,7 +319,7 @@ class DiagnosisMaster:
         Every heartbeat replaces the snapshot — an empty dict means the
         node's collectors went silent and its old votes are void."""
         self._node_gauges[req.node_id] = (
-            dict(getattr(req, "gauges", None) or {}), time.time()
+            dict(getattr(req, "gauges", None) or {}), time.monotonic()
         )
 
     def diagnose_once(self) -> None:

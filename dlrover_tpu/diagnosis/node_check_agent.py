@@ -35,7 +35,7 @@ def _one_check_round(
     )
     _, group, _ = handler.next_rendezvous()
     partners = [r for r in group if r != config.node_rank]
-    poll_state = {"ts": 0.0, "failed": False}
+    poll_state = {"ts": float("-inf"), "failed": False}
 
     def partner_failed() -> bool:
         # a partner whose failure THIS ROUND is already on the books is
@@ -43,7 +43,7 @@ def _one_check_round(
         # the timeout, seconds earlier). The benchmark's wait loops call
         # this every 0.2-1s; cap the master RPC at ~1/s so a large job's
         # check phase doesn't multiply master load
-        now = time.time()
+        now = time.monotonic()
         if now - poll_state["ts"] < 1.0:
             return poll_state["failed"]
         poll_state["ts"] = now
@@ -72,8 +72,8 @@ def _one_check_round(
 def _wait_verdict(
     client: MasterClient, timeout_s: float = 120.0
 ) -> Tuple[list, str]:
-    deadline = time.time() + timeout_s
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
         faults, reason = client.check_fault_node()
         if reason != NetworkFailureReason.WAITING_NODE:
             return faults, reason

@@ -1,0 +1,353 @@
+"""Hierarchical control-plane fan-in: aggregation tree + overload ladder.
+
+At large world sizes the master — a single process — receives one
+kitchen-sink heartbeat envelope per agent, and fan-in overload turns slow
+RPC handling into *false node-death verdicts* and spurious world cuts.
+This module is the master half of the fix (agent/fanin.py is the other):
+
+**Aggregation tree.** When ``DLROVER_TPU_FANIN_DEGREE`` is > 1 and the
+world outgrows one group, agents are partitioned into fixed id-space
+groups of ``degree`` (group g = node ids in [g·degree, (g+1)·degree));
+the lowest live id in each group is that group's *aggregator* and its
+siblings heartbeat the aggregator instead of the master. Keying groups
+by the id space — not by position in a sorted member list — means a node
+loss never re-shuffles unrelated groups: the only assignment that can
+change is the lost node's own group, so re-parenting churn is minimal
+and deterministic. When an aggregator dies, the next-lowest sibling in
+the same group is promoted and its children fall back to the master
+until the new aggregator registers its subtree address — journaled as
+``fanin_reparented``, deliberately NOT a fault/world-cut event.
+
+**Overload ladder.** The plane keeps an EWMA of per-beat handler latency
+on the master. Level 1 (> ``DLROVER_TPU_FANIN_SHED_MS``) sheds telemetry
+processing — skew histograms are dropped, liveness crediting is not —
+and asks clients to stretch their heartbeat period (an explicit
+``backoff_hint_s`` in the RPC reply, applied with jitter client-side).
+Level 2 (> 8× the threshold) stretches harder. Each level also widens
+the job manager's liveness timeout by a slack factor, so a drowning
+master sheds telemetry *before* liveness and never misclassifies a slow
+heartbeat as a dead node. ``DLROVER_TPU_FANIN_FORCE_LEVEL`` pins the
+level for tests.
+
+Lock discipline: journal/metric/trace emission happens OUTSIDE the
+plane's lock (the journal takes its own lock and fans out to listeners —
+same pattern as skew_monitor.py; the runtime lock-order detector
+enforces it).
+"""
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from dlrover_tpu.common.constants import (
+    ConfigKey,
+    SpanName,
+    env_float,
+    env_int,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability import tracing
+from dlrover_tpu.observability.journal import JournalEvent
+
+DEFAULT_SHED_MS = 25.0
+_EWMA_ALPHA = 0.2
+# backpressure level → liveness-slack factor for job_manager timeouts
+_SLACK = {0: 1.0, 1: 2.0, 2: 4.0}
+# backpressure level → client backoff hint, in heartbeat-interval units
+_BACKOFF_HINT = {0: 0.0, 1: 0.5, 2: 1.5}
+
+
+class FaninPlane:
+    """Tree membership + backpressure state; one instance per master.
+
+    Called from the heartbeat RPC path (``note_member``/``note_beats``/
+    ``reply_fields``), the RPC server's disconnect hook
+    (``on_connection_lost``) and ``rpc_fanin_register``. All entry
+    points are thread-safe and cheap: set/dict lookups, with a group
+    recompute only when membership actually changes.
+    """
+
+    def __init__(
+        self,
+        event_journal=None,
+        registry=None,
+        degree: Optional[int] = None,
+        shed_ms: Optional[float] = None,
+        heartbeat_interval_s: float = 15.0,
+        liveness_slack_cb: Optional[Callable[[float], None]] = None,
+    ):
+        self._journal = event_journal
+        self._degree = degree if degree is not None \
+            else env_int(ConfigKey.FANIN_DEGREE, 0)
+        self._shed_ms = shed_ms if shed_ms is not None \
+            else env_float(ConfigKey.FANIN_SHED_MS, DEFAULT_SHED_MS)
+        self._hb_interval_s = heartbeat_interval_s
+        self._slack_cb = liveness_slack_cb
+        self._lock = threading.Lock()
+        self._members: Set[int] = set()
+        self._lost: Set[int] = set()
+        # aggregator node id → its subtree RPC server addr (rpc_fanin_register)
+        self._agg_addrs: Dict[int, str] = {}
+        # group id → aggregator node id, recomputed on membership change
+        self._assignment: Dict[int, int] = {}
+        self._epoch = 0
+        self._ewma_ms = 0.0
+        self._level = 0
+        # per-plane tallies for snapshot(): the registry counters below
+        # are process-global (a second master in the same process — tests,
+        # LocalJobMaster — shares them), so introspection needs its own
+        self._n_compound = 0
+        self._n_child_beats = 0
+        self._n_shed = 0
+        self._n_reparented = 0
+        if registry is None:
+            from dlrover_tpu.observability.registry import get_registry
+
+            registry = get_registry()
+        self._g_degree = registry.gauge(
+            "dlrover_fanin_degree",
+            "Configured fan-in tree degree (0/1 = flat)",
+        )
+        self._g_aggregators = registry.gauge(
+            "dlrover_fanin_aggregators",
+            "Aggregator agents in the current tree assignment",
+        )
+        self._c_compound = registry.counter(
+            "dlrover_fanin_compound_total",
+            "Compound (aggregated) heartbeat envelopes received",
+        )
+        self._c_child_beats = registry.counter(
+            "dlrover_fanin_child_beats_total",
+            "Child heartbeats credited, plain or via compound envelopes",
+        )
+        self._g_level = registry.gauge(
+            "dlrover_fanin_backpressure_level",
+            "Current overload ladder level (0 ok, 1 shed, 2 hard shed)",
+        )
+        self._c_shed = registry.counter(
+            "dlrover_fanin_shed_total",
+            "Heartbeats whose telemetry was shed under backpressure",
+        )
+        self._c_reparented = registry.counter(
+            "dlrover_fanin_reparented_total",
+            "Subtrees re-parented after their aggregator was lost",
+        )
+        self._g_degree.set(self._degree)
+
+    # -- tree membership ----------------------------------------------------
+
+    def _active_locked(self) -> bool:
+        return (self._degree > 1
+                and len(self._members - self._lost) > self._degree)
+
+    def _recompute_locked(self) -> bool:
+        """Rebuild group → aggregator from live members; bump the epoch if
+        anything changed. Caller holds the lock."""
+        assignment: Dict[int, int] = {}
+        if self._degree > 1:
+            live = self._members - self._lost
+            if len(live) > self._degree:
+                for node_id in live:
+                    group = node_id // self._degree
+                    cur = assignment.get(group)
+                    if cur is None or node_id < cur:
+                        assignment[group] = node_id
+        if assignment == self._assignment:
+            return False
+        self._assignment = assignment
+        self._epoch += 1
+        return True
+
+    def note_member(self, node_id: int) -> None:
+        """Any heartbeat sighting of a node (plain or inside a compound
+        envelope) keeps it in the member set; a re-sighting of a node we
+        thought lost revives it."""
+        with self._lock:
+            if node_id in self._members and node_id not in self._lost:
+                return
+            self._members.add(node_id)
+            self._lost.discard(node_id)
+            self._recompute_locked()
+            aggs = len(self._assignment)
+        self._g_aggregators.set(aggs)
+
+    def on_connection_lost(self, node_id: int) -> None:
+        """RPC-server disconnect / node-failure hook. If the lost node was
+        an aggregator, its group is handed to the next-lowest sibling
+        (children fall back to the master until the successor registers)
+        and the re-parent is journaled — never a world cut."""
+        reparents: List[Dict[str, Any]] = []
+        with self._lock:
+            if node_id not in self._members or node_id in self._lost:
+                return
+            was_agg_groups = [g for g, a in self._assignment.items()
+                              if a == node_id]
+            self._lost.add(node_id)
+            self._agg_addrs.pop(node_id, None)
+            self._recompute_locked()
+            for group in was_agg_groups:
+                reparents.append({
+                    "lost": node_id,
+                    "group": group,
+                    "new_parent": self._assignment.get(group, -1),
+                })
+            aggs = len(self._assignment)
+        self._g_aggregators.set(aggs)
+        for data in reparents:
+            self._n_reparented += 1
+            self._c_reparented.inc()
+            with tracing.span(SpanName.FANIN_REPARENT, source="master",
+                              **data):
+                if self._journal is not None:
+                    self._journal.record(JournalEvent.FANIN_REPARENTED,
+                                         source="fanin", **data)
+            logger.warning(
+                "fan-in aggregator %s lost: group %s re-parented to %s",
+                data["lost"], data["group"], data["new_parent"],
+            )
+
+    def register_aggregator(self, node_id: int, addr: str) -> int:
+        """An aggregator announced its subtree RPC address; returns the
+        (possibly bumped) tree epoch."""
+        with self._lock:
+            if self._agg_addrs.get(node_id) != addr:
+                self._agg_addrs[node_id] = addr
+                self._epoch += 1
+            return self._epoch
+
+    def still_aggregator(self, node_id: int) -> bool:
+        """Demotion check for the compound-reply channel. True while the
+        node should keep serving its subtree: either it holds the
+        assignment, or the plane is still forming (a freshly restarted
+        master has not seen enough members yet — tearing the tree down
+        then would turn a master restart into a world-wide fallback
+        stampede; the id-space assignment will converge to the same
+        aggregators anyway)."""
+        with self._lock:
+            if self._degree <= 1:
+                return False  # explicitly flat: stand down
+            if not self._active_locked():
+                return True
+            return self._assignment.get(node_id // self._degree) == node_id
+
+    def reply_fields(self, node_id: int) -> Dict[str, Any]:
+        """The fan-in fields of this node's HeartbeatResponse: its role,
+        the parent addr it should beat ("" = the master), and the tree
+        epoch (children detect re-parenting by epoch change)."""
+        with self._lock:
+            if not self._active_locked():
+                return {"fanin_role": "", "fanin_parent": "",
+                        "fanin_epoch": self._epoch}
+            agg = self._assignment.get(node_id // self._degree, -1)
+            if agg == node_id:
+                return {"fanin_role": "aggregator", "fanin_parent": "",
+                        "fanin_epoch": self._epoch}
+            return {"fanin_role": "",
+                    "fanin_parent": self._agg_addrs.get(agg, ""),
+                    "fanin_epoch": self._epoch}
+
+    # -- overload ladder ----------------------------------------------------
+
+    def _level_for_locked(self, ewma_ms: float) -> int:
+        forced = env_int(ConfigKey.FANIN_FORCE_LEVEL, -1)
+        if forced >= 0:
+            return max(0, min(2, forced))
+        up1, up2 = self._shed_ms, 8.0 * self._shed_ms
+        if ewma_ms > up2:
+            return 2
+        if self._level == 2 and ewma_ms > 0.7 * up2:
+            return 2  # hysteresis: don't flap around the hard threshold
+        if ewma_ms > up1:
+            return 1
+        if self._level >= 1 and ewma_ms > 0.7 * up1:
+            return 1
+        return 0
+
+    def note_beats(self, n: int, handler_s: float,
+                   compound: bool = False) -> None:
+        """Feed one handled heartbeat envelope (``n`` child beats inside
+        it) into the overload EWMA; emits journal/slack/gauge updates on
+        level *changes* only."""
+        if n <= 0:
+            return
+        per_beat_ms = (handler_s / n) * 1000.0
+        change = None
+        with self._lock:
+            self._ewma_ms = (_EWMA_ALPHA * per_beat_ms
+                             + (1.0 - _EWMA_ALPHA) * self._ewma_ms)
+            new_level = self._level_for_locked(self._ewma_ms)
+            if new_level != self._level:
+                change = (self._level, new_level, self._ewma_ms)
+                self._level = new_level
+            self._n_child_beats += n
+            if compound:
+                self._n_compound += 1
+        self._c_child_beats.inc(n)
+        if compound:
+            self._c_compound.inc()
+        if change is None:
+            return
+        old, new, ewma = change
+        self._g_level.set(new)
+        if self._slack_cb is not None:
+            try:
+                self._slack_cb(_SLACK.get(new, _SLACK[2]))
+            except Exception:  # noqa: BLE001 — backpressure must not kill RPC
+                logger.exception("liveness-slack callback failed")
+        if self._journal is not None:
+            self._journal.record(
+                JournalEvent.FANIN_BACKPRESSURE, source="fanin",
+                level=new, prev_level=old, ewma_ms=round(ewma, 2),
+            )
+        logger.warning("fan-in backpressure level %d → %d (ewma %.2fms)",
+                       old, new, ewma)
+
+    def shed_telemetry(self) -> bool:
+        """True while the ladder says to drop telemetry processing
+        (liveness crediting is never shed)."""
+        with self._lock:
+            return self._level >= 1
+
+    def note_shed(self) -> None:
+        with self._lock:
+            self._n_shed += 1
+        self._c_shed.inc()
+
+    def backpressure_level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def backoff_hint_s(self) -> float:
+        """Extra client-side heartbeat delay the master is asking for at
+        the current level (clients apply jitter via retry.jittered)."""
+        with self._lock:
+            return _BACKOFF_HINT.get(self._level, 0.0) * self._hb_interval_s
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    @property
+    def degree(self) -> int:
+        return self._degree
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Debug/testing view of the plane's state (per-plane tallies —
+        the registry counters are process-global and no good for it)."""
+        with self._lock:
+            return {
+                "compound_total": self._n_compound,
+                "child_beats_total": self._n_child_beats,
+                "shed_total": self._n_shed,
+                "reparented_total": self._n_reparented,
+                "degree": self._degree,
+                "active": self._active_locked(),
+                "members": sorted(self._members),
+                "lost": sorted(self._lost),
+                "assignment": dict(self._assignment),
+                "agg_addrs": dict(self._agg_addrs),
+                "epoch": self._epoch,
+                "level": self._level,
+                "ewma_ms": round(self._ewma_ms, 3),
+            }

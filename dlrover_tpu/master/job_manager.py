@@ -133,6 +133,10 @@ class JobManager:
         self._recheck_heap: List[tuple] = []
         self._recheck_cond = threading.Condition()
         self._recheck_thread: Optional[threading.Thread] = None
+        # fan-in backpressure widens liveness deadlines by this factor:
+        # when the master itself is the bottleneck, a slow heartbeat is
+        # evidence of master overload, not node death (master/fanin.py)
+        self._liveness_slack = 1.0
         for node_id in range(node_num):
             self._nodes[node_id] = Node(
                 type=NodeType.WORKER,
@@ -282,6 +286,7 @@ class JobManager:
         # configure a sub-second interval), vs heartbeat_timeout_s (the
         # 300s-scale backstop) without drop detection.
         grace = max(ctx.conn_drop_grace_s, 1.5 * ctx.heartbeat_interval_s)
+        grace *= self._liveness_slack
         logger.info(
             "node %s heartbeat connection dropped — %.1fs grace recheck",
             node_id, grace,
@@ -555,15 +560,26 @@ class JobManager:
             self.check_heartbeats()
             self.check_pending_nodes()
 
+    def set_liveness_slack(self, factor: float) -> None:
+        """Widen (or restore) liveness deadlines under fan-in
+        backpressure — shedding telemetry must come BEFORE shedding
+        liveness, so while the master is drowning the death verdicts get
+        slower, never trigger-happier."""
+        factor = max(1.0, float(factor))
+        if factor != self._liveness_slack:
+            logger.info("liveness slack factor → %.1fx", factor)
+        self._liveness_slack = factor
+
     def check_heartbeats(self, now: Optional[float] = None) -> None:
         ctx = get_context()
         now = now or time.monotonic()
+        timeout_s = ctx.heartbeat_timeout_s * self._liveness_slack
         for node in self.list_nodes():
             if node.status != NodeStatus.RUNNING:
                 continue
             if (
                 node.heartbeat_time > 0
-                and now - node.heartbeat_time > ctx.heartbeat_timeout_s
+                and now - node.heartbeat_time > timeout_s
             ):
                 if (
                     node.start_time

@@ -6,6 +6,14 @@ sync_service.py:25. The reference's KV store backs the torch rendezvous
 cross-host coordination that must work even when the device fabric is down
 (e.g. checkpoint replica bookkeeping).
 
+Sharding: the store is split into ``DLROVER_TPU_FANIN_KV_SHARDS``
+(default 8) hash(key)-addressed shards, each with its own lock/condition.
+At swarm scale every agent's rendezvous traffic funnels through this
+service; one global lock made every ``wait`` wakeup a stampede over one
+condition variable, and any slow ``set`` serialized unrelated keys. The
+public API is unchanged — only cross-shard ops (``clear``, ``dump``,
+``delete_prefix``) touch more than one shard.
+
 Blocking semantics: ``wait``/``join`` deadlines are computed against
 ``time.monotonic()`` and re-derived on every wakeup, so spurious
 ``Condition`` wakeups (and notify storms for other keys) can neither
@@ -17,34 +25,56 @@ no longer holds their key.
 
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional
 
 from dlrover_tpu.chaos import get_injector
+from dlrover_tpu.common.constants import ConfigKey, env_int
+
+DEFAULT_KV_SHARDS = 8
+
+
+class _KVShard:
+    """One hash slice of the store: own lock, condition, and epoch."""
+
+    def __init__(self) -> None:
+        self.store: Dict[str, bytes] = {}
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.epoch = 0  # bumped by clear(); waiters from an old epoch bail
 
 
 class KVStoreService:
-    def __init__(self) -> None:
-        self._store: Dict[str, bytes] = {}
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
-        self._epoch = 0  # bumped by clear(); waiters from an old epoch bail
+    def __init__(self, num_shards: Optional[int] = None) -> None:
+        if num_shards is None:
+            num_shards = env_int(ConfigKey.FANIN_KV_SHARDS,
+                                 DEFAULT_KV_SHARDS)
+        self._shards = [_KVShard() for _ in range(max(1, num_shards))]
+
+    def _shard(self, key: str) -> _KVShard:
+        # crc32, not hash(): stable across processes/runs (PYTHONHASHSEED)
+        # so dumps/diagnostics shard identically everywhere
+        return self._shards[zlib.crc32(key.encode()) % len(self._shards)]
 
     def set(self, key: str, value: bytes) -> None:
-        with self._cond:
-            self._store[key] = value
-            self._cond.notify_all()
+        sh = self._shard(key)
+        with sh.cond:
+            sh.store[key] = value
+            sh.cond.notify_all()
 
     def get(self, key: str) -> Optional[bytes]:
-        with self._lock:
-            return self._store.get(key)
+        sh = self._shard(key)
+        with sh.lock:
+            return sh.store.get(key)
 
     def add(self, key: str, delta: int) -> int:
         """Atomic counter add (torch Store ``add`` semantics)."""
-        with self._cond:
-            cur = int(self._store.get(key, b"0"))
+        sh = self._shard(key)
+        with sh.cond:
+            cur = int(sh.store.get(key, b"0"))
             cur += delta
-            self._store[key] = str(cur).encode()
-            self._cond.notify_all()
+            sh.store[key] = str(cur).encode()
+            sh.cond.notify_all()
             return cur
 
     def wait(self, key: str, timeout_s: float) -> Optional[bytes]:
@@ -52,10 +82,11 @@ class KVStoreService:
         if inj is not None:
             inj.fire("kv.wait", key=key)
         deadline = time.monotonic() + timeout_s
-        with self._cond:
-            epoch = self._epoch
-            while key not in self._store:
-                if self._epoch != epoch:
+        sh = self._shard(key)
+        with sh.cond:
+            epoch = sh.epoch
+            while key not in sh.store:
+                if sh.epoch != epoch:
                     # store cleared mid-wait (failover): the key this
                     # waiter was promised can no longer arrive in the
                     # world it joined — fail fast, let the caller resync
@@ -63,12 +94,13 @@ class KVStoreService:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
-                self._cond.wait(remaining)
-            return self._store[key]
+                sh.cond.wait(remaining)
+            return sh.store[key]
 
     def delete(self, key: str) -> None:
-        with self._lock:
-            self._store.pop(key, None)
+        sh = self._shard(key)
+        with sh.lock:
+            sh.store.pop(key, None)
 
     def delete_prefix(self, prefix: str) -> int:
         """Drop every key under ``prefix``; returns how many were dropped.
@@ -76,37 +108,40 @@ class KVStoreService:
         the writers restart their sequence counters, so the old keys are
         unreachable garbage that would otherwise persist in failover
         snapshots forever.)"""
-        with self._lock:
-            doomed = [k for k in self._store if k.startswith(prefix)]
-            for k in doomed:
-                del self._store[k]
-            return len(doomed)
+        dropped = 0
+        for sh in self._shards:
+            with sh.lock:
+                doomed = [k for k in sh.store if k.startswith(prefix)]
+                for k in doomed:
+                    del sh.store[k]
+                dropped += len(doomed)
+        return dropped
 
     def multi_get(self, keys: List[str]) -> List[bytes]:
-        with self._lock:
-            return [self._store.get(k, b"") for k in keys]
+        return [self.get(k) or b"" for k in keys]
 
     def multi_set(self, keys: List[str], values: List[bytes]) -> None:
-        with self._cond:
-            for k, v in zip(keys, values):
-                self._store[k] = v
-            self._cond.notify_all()
+        for k, v in zip(keys, values):
+            self.set(k, v)
 
     def clear(self) -> None:
-        with self._cond:
-            self._store.clear()
-            self._epoch += 1
-            self._cond.notify_all()
+        for sh in self._shards:
+            with sh.cond:
+                sh.store.clear()
+                sh.epoch += 1
+                sh.cond.notify_all()
 
     def dump(self) -> Dict[str, bytes]:
         """Copy of the whole store (master state snapshots)."""
-        with self._lock:
-            return dict(self._store)
+        out: Dict[str, bytes] = {}
+        for sh in self._shards:
+            with sh.lock:
+                out.update(sh.store)
+        return out
 
     def restore(self, data: Dict[str, bytes]) -> None:
-        with self._cond:
-            self._store.update(data)
-            self._cond.notify_all()
+        for k, v in data.items():
+            self.set(k, v)
 
 
 class SyncService:

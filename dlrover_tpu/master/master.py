@@ -126,6 +126,19 @@ class JobMaster:
         self.rdzv_managers[RendezvousName.TRAINING].straggler_history = (
             self.skew_monitor.node_straggler_counts
         )
+        # hierarchical control-plane fan-in (master/fanin.py): aggregation
+        # tree assignment + overload ladder. Backpressure level changes
+        # widen the job manager's liveness deadlines — telemetry is shed
+        # before liveness, never the other way around.
+        from dlrover_tpu.common.config import get_context as _get_ctx
+        from dlrover_tpu.master.fanin import FaninPlane
+
+        self.fanin_plane = FaninPlane(
+            event_journal=self.event_journal,
+            registry=self.metrics_registry,
+            heartbeat_interval_s=_get_ctx().heartbeat_interval_s,
+            liveness_slack_cb=self.job_manager.set_liveness_slack,
+        )
         # live-reshard plane (ckpt/reshard.py): a TRAINING world cut whose
         # rank set changed publishes the cut record relaunched workers key
         # their checkpoint-free reshard on
@@ -158,6 +171,7 @@ class JobMaster:
             strategy_generator=self.strategy_generator,
             event_journal=self.event_journal,
             skew_monitor=self.skew_monitor,
+            fanin_plane=self.fanin_plane,
         )
         # bridge journal kinds into PerfMonitor's lost-time bookkeeping —
         # fault_happened/fault_recovered get their (only) callers here
@@ -191,11 +205,16 @@ class JobMaster:
         # connection; the grace recheck in report_connection_lost turns
         # that into a node-failed event in ~conn_drop_grace_s instead of
         # the heartbeat timeout
-        self._server.set_on_disconnect(
-            lambda ctx: self.job_manager.report_connection_lost(
-                ctx["node_id"]
-            ) if "node_id" in ctx else None
-        )
+        def _on_disconnect(ctx):
+            if "node_id" not in ctx:
+                return
+            self.job_manager.report_connection_lost(ctx["node_id"])
+            # a dead aggregator's subtree re-parents immediately — its
+            # children must not wait out the liveness grace to learn
+            # their parent is gone (master/fanin.py journals the move)
+            self.fanin_plane.on_connection_lost(ctx["node_id"])
+
+        self._server.set_on_disconnect(_on_disconnect)
         self._server.set_on_contact(
             lambda ctx: self.job_manager.record_raw_contact(
                 ctx["node_id"]
@@ -284,6 +303,7 @@ class JobMaster:
                 node_id=event.node.id, status=event.node.status,
             ):
                 self.task_manager.recover_tasks(event.node.id)
+                self.fanin_plane.on_connection_lost(event.node.id)
                 self.event_journal.record(
                     JournalEvent.FAULT_DETECTED,
                     node_id=event.node.id,

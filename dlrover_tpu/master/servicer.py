@@ -10,7 +10,7 @@ import time
 from typing import Optional
 
 from dlrover_tpu.common import comm
-from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.constants import DiagnosisActionType, RendezvousName
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.job_manager import JobManager
 from dlrover_tpu.master.kv_store import KVStoreService, SyncService
@@ -34,6 +34,7 @@ class MasterServicer:
         strategy_generator=None,
         event_journal=None,
         skew_monitor=None,
+        fanin_plane=None,
     ):
         self._job_manager = job_manager
         self._rdzv_managers = rdzv_managers
@@ -46,6 +47,7 @@ class MasterServicer:
         self._strategy_generator = strategy_generator
         self._event_journal = event_journal
         self._skew_monitor = skew_monitor
+        self._fanin_plane = fanin_plane
         self._start_time = time.monotonic()  # uptime base
 
     # -- rendezvous --------------------------------------------------------
@@ -201,7 +203,12 @@ class MasterServicer:
         from dlrover_tpu.common.rpc import connection_ctx
 
         connection_ctx()["node_id"] = req.node_id
+        t0 = time.monotonic()
+        plane = self._fanin_plane
+        # liveness FIRST, telemetry after: whatever backpressure does
+        # below, the beat has already been credited
         action = self._job_manager.report_heartbeat(req.node_id, req.timestamp)
+        shed = plane is not None and plane.shed_telemetry()
         if req.global_step and self._perf_monitor is not None:
             self._perf_monitor.collect_global_step(
                 req.global_step, req.step_timestamp or time.time(),
@@ -210,11 +217,88 @@ class MasterServicer:
         if self._diagnosis_master is not None:
             self._diagnosis_master.observe_heartbeat(req)
         if self._skew_monitor is not None and req.op_telemetry:
-            self._skew_monitor.observe(req.node_id, req.op_telemetry)
-        return comm.HeartbeatResponse(
+            if shed:
+                plane.note_shed()
+            else:
+                self._skew_monitor.observe(req.node_id, req.op_telemetry)
+        resp = comm.HeartbeatResponse(
             action_type=action.action_type,
             action_data={"reason": action.reason, **action.data},
         )
+        if plane is not None:
+            plane.note_member(req.node_id)
+            plane.note_beats(1, time.monotonic() - t0)
+            fields = plane.reply_fields(req.node_id)
+            resp.fanin_role = fields["fanin_role"]
+            resp.fanin_parent = fields["fanin_parent"]
+            resp.fanin_epoch = fields["fanin_epoch"]
+            resp.backpressure = plane.backpressure_level()
+            resp.backoff_hint_s = plane.backoff_hint_s()
+        return resp
+
+    def rpc_fanin_heartbeat(
+        self, req: comm.CompoundHeartbeatRequest
+    ) -> comm.CompoundHeartbeatResponse:
+        """One aggregator's batched subtree envelope (agent/fanin.py).
+        Liveness is credited per child BEFORE any telemetry work; under
+        backpressure the merged histograms are shed, never the beats."""
+        from dlrover_tpu.common.rpc import connection_ctx
+
+        connection_ctx()["node_id"] = req.agg_node_id
+        t0 = time.monotonic()
+        plane = self._fanin_plane
+        shed = plane is not None and plane.shed_telemetry()
+        actions = {}
+        for beat in req.beats:
+            action = self._job_manager.report_heartbeat(
+                beat.node_id, beat.timestamp
+            )
+            if plane is not None:
+                plane.note_member(beat.node_id)
+            if beat.global_step and self._perf_monitor is not None:
+                self._perf_monitor.collect_global_step(
+                    beat.global_step, beat.step_timestamp or time.time(),
+                    rdzv_round=beat.rdzv_round,
+                )
+            if not shed and self._diagnosis_master is not None:
+                self._diagnosis_master.observe_heartbeat(beat)
+            if action.action_type != DiagnosisActionType.NONE:
+                actions[beat.node_id] = [
+                    action.action_type,
+                    {"reason": action.reason, **action.data},
+                ]
+        if self._skew_monitor is not None and req.merged_telemetry:
+            if shed:
+                if plane is not None:
+                    plane.note_shed()
+            else:
+                self._skew_monitor.observe_many([
+                    (int(node_key), telem)
+                    for node_key, telem in req.merged_telemetry.items()
+                ])
+        for ev in req.events or []:
+            self.rpc_report_event(ev)
+        resp = comm.CompoundHeartbeatResponse(actions=actions)
+        if plane is not None:
+            plane.note_beats(max(1, len(req.beats)),
+                             time.monotonic() - t0, compound=True)
+            resp.backpressure = plane.backpressure_level()
+            resp.backoff_hint_s = plane.backoff_hint_s()
+            resp.fanin_epoch = plane.epoch
+            # the caller's own role rides its envelope reply: it stopped
+            # plain-beating the master, so this is its demotion channel
+            if not plane.still_aggregator(req.agg_node_id):
+                resp.fanin_role = ""
+        return resp
+
+    def rpc_fanin_register(
+        self, req: comm.FaninRegisterRequest
+    ) -> comm.BaseResponse:
+        """An aggregator announces its subtree RPC server address."""
+        if self._fanin_plane is None:
+            return comm.BaseResponse(success=False, message="no fanin plane")
+        epoch = self._fanin_plane.register_aggregator(req.node_id, req.addr)
+        return comm.BaseResponse(data={"epoch": epoch})
 
     def rpc_report_failure(self, req: comm.NodeFailureReport) -> comm.BaseResponse:
         self._job_manager.report_failure(

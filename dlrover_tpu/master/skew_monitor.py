@@ -132,28 +132,42 @@ class SkewMonitor:
     def observe(self, node_id: int, op_telemetry: Dict[str, Any]) -> None:
         """Ingest one heartbeat's worth of per-rank snapshots (keyed by
         str(global_rank)) and re-evaluate verdicts."""
+        self.observe_many([(node_id, op_telemetry)])
+
+    def observe_many(self, items) -> None:
+        """Ingest several nodes' telemetry — the fan-in path: an
+        aggregator's compound envelope carries a whole subtree's
+        snapshots, absorbed under one lock pass and ONE re-evaluation
+        instead of one per child heartbeat. ``items`` is an iterable of
+        ``(node_id, op_telemetry)`` pairs."""
         arrival = self._monotonic()
         with self._lock:
-            for rank_key, snap in (op_telemetry or {}).items():
-                try:
-                    rank = int(rank_key)
-                    snap = dict(snap)
-                    seq = int(snap.get("seq", 0))
-                except (TypeError, ValueError):
-                    logger.warning("malformed op-telemetry for key %r from "
-                                   "node %s", rank_key, node_id)
-                    continue
-                self._rank_node[rank] = node_id
-                dq = self._snaps.get(rank)
-                if dq is None:
-                    dq = deque(maxlen=self._window)
-                    self._snaps[rank] = dq
-                if dq and seq < int(dq[-1][1].get("seq", 0)):
-                    # observation counter went backwards: the worker
-                    # restarted — never diff across incarnations
-                    dq.clear()
-                dq.append((arrival, snap))
+            for node_id, op_telemetry in items:
+                self._ingest_one_locked(node_id, op_telemetry, arrival)
         self.evaluate()
+
+    def _ingest_one_locked(self, node_id: int,
+                           op_telemetry: Dict[str, Any],
+                           arrival: float) -> None:
+        for rank_key, snap in (op_telemetry or {}).items():
+            try:
+                rank = int(rank_key)
+                snap = dict(snap)
+                seq = int(snap.get("seq", 0))
+            except (TypeError, ValueError):
+                logger.warning("malformed op-telemetry for key %r from "
+                               "node %s", rank_key, node_id)
+                continue
+            self._rank_node[rank] = node_id
+            dq = self._snaps.get(rank)
+            if dq is None:
+                dq = deque(maxlen=self._window)
+                self._snaps[rank] = dq
+            if dq and seq < int(dq[-1][1].get("seq", 0)):
+                # observation counter went backwards: the worker
+                # restarted — never diff across incarnations
+                dq.clear()
+            dq.append((arrival, snap))
 
     # -- evaluation ---------------------------------------------------------
 
@@ -326,3 +340,37 @@ class SkewMonitor:
         """Drop a rank's window (e.g. its node left the world)."""
         with self._lock:
             self._snaps.pop(rank, None)
+
+    # -- failover persistence ----------------------------------------------
+
+    def export_straggler_state(self) -> Dict[str, Any]:
+        """Straggler-episode history for MasterStateStore snapshots. Keys
+        are stringified (state_store.load unpacks with string map keys
+        only); the rank→node map rides along so restored counts still
+        aggregate per node for the rdzv world-cut bias."""
+        with self._lock:
+            return {
+                "counts": {str(r): c
+                           for r, c in self._straggler_counts.items()},
+                "rank_node": {str(r): n
+                              for r, n in self._rank_node.items()},
+            }
+
+    def restore_straggler_state(self, state: Dict[str, Any]) -> None:
+        """Re-seed straggler history after a master restart — without
+        this, repeat-straggler world-cut biasing silently resets on
+        failover. Telemetry windows are NOT restored (they are stale by
+        definition); only the episode counts and rank→node attribution."""
+        if not state:
+            return
+        with self._lock:
+            for rank_key, count in (state.get("counts") or {}).items():
+                try:
+                    self._straggler_counts[int(rank_key)] = int(count)
+                except (TypeError, ValueError):
+                    continue
+            for rank_key, node in (state.get("rank_node") or {}).items():
+                try:
+                    self._rank_node.setdefault(int(rank_key), int(node))
+                except (TypeError, ValueError):
+                    continue

@@ -67,6 +67,11 @@ class MasterStateStore:
             "kv": master.kv_store.dump(),
             "datasets": datasets,
             "global_step": master.perf_monitor.completed_global_step,
+            # straggler-episode history: the rdzv world-cut bias against
+            # repeat stragglers must survive a master restart (the hook is
+            # a bound method on the skew monitor, so restoring the
+            # monitor's counts re-seeds the bias)
+            "straggler": master.skew_monitor.export_straggler_state(),
         }
 
     def save(self, master) -> None:
@@ -107,6 +112,9 @@ class MasterStateStore:
         step = int(snap.get("global_step", 0))
         if step > 0:
             master.perf_monitor.collect_global_step(step, time.time())
+        master.skew_monitor.restore_straggler_state(
+            snap.get("straggler") or {}
+        )
         logger.info(
             "master state restored from %s: %d kv keys, %d datasets, "
             "step %s (snapshot age %.1fs)",

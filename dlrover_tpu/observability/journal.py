@@ -66,6 +66,12 @@ class JournalEvent:
     RESHARD_START = "reshard_start"
     RESHARD_COMPLETE = "reshard_complete"
     RESHARD_ABORTED = "reshard_aborted"
+    # hierarchical fan-in plane (master/fanin.py): a dead aggregator's
+    # children were re-parented to a sibling/the master (informational —
+    # deliberately NOT a world cut, so no phase transition), and the
+    # master's backpressure level changed (telemetry shed before liveness)
+    FANIN_REPARENTED = "fanin_reparented"
+    FANIN_BACKPRESSURE = "fanin_backpressure"
 
     ALL = (
         FAULT_DETECTED, RDZV_START, RDZV_COMPLETE, RESTORE_START,
@@ -74,6 +80,7 @@ class JournalEvent:
         SHM_ORPHANS_CLEANED, STRAGGLER_DETECTED, HANG_ATTRIBUTED,
         STACK_DUMP_CAPTURED, TRACE_BUNDLE_CAPTURED, RESHARD_PLANNED,
         RESHARD_START, RESHARD_COMPLETE, RESHARD_ABORTED,
+        FANIN_REPARENTED, FANIN_BACKPRESSURE,
     )
 
 

@@ -63,7 +63,7 @@ class TestActionQueue:
         q.add_action(a)
         q.add_action(NodeAction(1, DiagnosisActionType.RESTART_WORKER))
         assert len(q) == 1
-        a.timestamp -= DiagnosisConstant.ACTION_EXPIRY_S + 1
+        a._created_mono -= DiagnosisConstant.ACTION_EXPIRY_S + 1
         assert q.next_action(1).is_noop()
 
     def test_noop_not_queued(self):
@@ -333,8 +333,8 @@ class TestDiagnosisAgent:
             # node 1's snapshot is ancient (daemon died holding HANG=0):
             # it must not veto the live nodes' unanimous hang vote
             gauges = {
-                0: ({HANG_GAUGE: 1.0}, time.time()),
-                1: ({HANG_GAUGE: 0.0}, time.time() - 10_000),
+                0: ({HANG_GAUGE: 1.0}, time.monotonic()),
+                1: ({HANG_GAUGE: 0.0}, time.monotonic() - 10_000),
             }
             d = TrainingHangDiagnostician(pm, gauges)
             action = d.diagnose()

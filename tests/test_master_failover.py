@@ -111,6 +111,98 @@ def test_snapshot_loop_writes_periodically(tmp_path):
     assert snap is not None and snap["job_name"] == "failover2"
 
 
+def test_straggler_history_survives_restart(tmp_path):
+    """The skew monitor's straggler-episode counts feed the rendezvous
+    world-cut bias (rdzv_manager picks repeat stragglers to drop first);
+    a master restart must re-seed that history, not forget offenders."""
+    from dlrover_tpu.common.constants import RendezvousName
+
+    m1 = _master(tmp_path)
+    m1.skew_monitor.restore_straggler_state({
+        "counts": {"3": 2, "5": 1},
+        "rank_node": {"3": 3, "5": 5},
+    })
+    assert m1.skew_monitor.node_straggler_counts() == {3: 2, 5: 1}
+    m1._state_store.save(m1)
+    m1.stop()
+
+    m2 = _master(tmp_path, port=m1.port)
+    try:
+        assert m2.skew_monitor.node_straggler_counts() == {3: 2, 5: 1}
+        # the rdzv bias hook (a bound method on the restored monitor)
+        # serves the re-seeded history
+        hook = m2.rdzv_managers[RendezvousName.TRAINING].straggler_history
+        assert dict(hook()) == {3: 2, 5: 1}
+    finally:
+        m2.stop()
+
+
+def test_reconnect_stampede_is_bounded_and_kills_nobody(tmp_path):
+    """A master restart makes EVERY agent reconnect at once. The
+    heartbeat retry budget must fail fast during the outage (bounded,
+    jittered ladder — not minutes of pile-up), and the restarted master
+    must re-admit the whole fleet without ever declaring a node dead."""
+    world = 16
+    m1 = LocalJobMaster(
+        job_name="stampede", node_num=world,
+        state_dir=str(tmp_path / "state"),
+    )
+    m1.prepare()
+    port = m1.port
+    clients = [MasterClient(m1.addr, node_id=i, node_rank=i)
+               for i in range(world)]
+
+    def beat_all(note):
+        """One concurrent heartbeat per client; returns outcome map."""
+        out = {}
+
+        def one(i):
+            t0 = time.monotonic()
+            try:
+                clients[i].heartbeat(global_step=1)
+                out[i] = ("ok", time.monotonic() - t0)
+            except ConnectionError:
+                out[i] = ("err", time.monotonic() - t0)
+
+        threads = [threading.Thread(target=one, args=(i,), name=f"{note}-{i}")
+                   for i in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        return out
+
+    assert all(v[0] == "ok" for v in beat_all("pre").values())
+    m1._state_store.save(m1)
+    m1.stop()
+
+    # the whole fleet beats into the dead master at once: every client
+    # must fail within its bounded retry deadline (~3s + jitter), not
+    # hang on an unbounded ladder
+    outage = beat_all("outage")
+    assert all(v[0] == "err" for v in outage.values())
+    assert max(v[1] for v in outage.values()) < 10.0
+
+    m2 = LocalJobMaster(
+        job_name="stampede", node_num=world,
+        state_dir=str(tmp_path / "state"), port=port,
+    )
+    m2.prepare()
+    try:
+        # reconnect stampede: everyone at once, everyone re-admitted
+        recovered = beat_all("reconnect")
+        assert all(v[0] == "ok" for v in recovered.values())
+        from dlrover_tpu.common.constants import NodeStatus
+
+        statuses = {n.id: n.status for n in m2.job_manager.list_nodes()}
+        assert all(s == NodeStatus.RUNNING for s in statuses.values())
+        m2.job_manager.check_heartbeats()
+        assert not [n for n in m2.job_manager.list_nodes()
+                    if n.status == NodeStatus.FAILED]
+    finally:
+        m2.stop()
+
+
 def test_restore_preserves_streaming_offset_and_indices(tmp_path):
     m1 = _master(tmp_path)
     client = MasterClient(m1.addr, node_id=0, node_rank=0)

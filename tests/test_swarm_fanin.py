@@ -1,0 +1,280 @@
+"""Hierarchical control-plane fan-in under swarm load (master/fanin.py +
+agent/fanin.py), driven through the in-process swarm harness
+(swarm_harness.py — real MasterClient + HeartbeatRouter per simulated
+agent).
+
+Tier-1 smoke: small worlds (≤64 agents) prove tree formation, liveness
+crediting through compound envelopes, aggregator-death re-parenting
+without a world cut, and the overload ladder (telemetry shed before
+liveness). The 1000+-agent storm/SIGKILL drills are marked both
+``swarm`` and ``slow`` so tier-1 stays fast; run them with
+``pytest -m swarm``.
+"""
+
+import time
+
+import pytest
+
+from dlrover_tpu import chaos
+from dlrover_tpu.common.constants import ConfigKey, NodeStatus
+from dlrover_tpu.master.master import LocalJobMaster
+from dlrover_tpu.observability.journal import JournalEvent
+
+from swarm_harness import Swarm, make_op_telemetry
+
+
+@pytest.fixture(autouse=True)
+def _reset_injector():
+    yield
+    chaos.reset_injector()
+
+
+def _fanin_env(monkeypatch, degree, flush_s=0.05):
+    monkeypatch.setenv(ConfigKey.FANIN_DEGREE, str(degree))
+    monkeypatch.setenv(ConfigKey.FANIN_FLUSH_S, str(flush_s))
+
+
+def _master(tmp_path, world):
+    m = LocalJobMaster(
+        job_name="swarm", node_num=world,
+        state_dir=str(tmp_path / "state"),
+    )
+    m.prepare()
+    return m
+
+
+def _journal_kinds(master):
+    return [e["kind"] for e in master.event_journal.events()]
+
+
+def _failed_nodes(master):
+    return [n.id for n in master.job_manager.list_nodes()
+            if n.status == NodeStatus.FAILED]
+
+
+# -- tier-1 smoke (small worlds) --------------------------------------------
+
+
+def test_tree_forms_and_credits_liveness(tmp_path, monkeypatch):
+    world, degree = 48, 8
+    _fanin_env(monkeypatch, degree)
+    master = _master(tmp_path, world)
+    swarm = Swarm(master.addr, world)
+    try:
+        swarm.settle(rounds=4)
+        time.sleep(0.2)  # flush ticks land; mid-settle aggregators that
+        # lost their role to a lower-id sibling are demoted via their
+        # compound reply and stand down
+        stats = swarm.beat(rounds=1)  # demoted ex-aggregators re-parent
+        assert stats["errors"] == 0
+        time.sleep(0.2)  # let the aggregators' flush ticks reach the master
+
+        snap = master.fanin_plane.snapshot()
+        assert snap["active"]
+        # one aggregator per id-space group, always the lowest id
+        assert snap["assignment"] == {g: g * degree
+                                      for g in range(world // degree)}
+        assert swarm.aggregator_ids() == [g * degree
+                                          for g in range(world // degree)]
+        # every non-aggregator beats its aggregator, not the master
+        assert len(swarm.parented_ids()) == world - world // degree
+        assert snap["compound_total"] > 0
+        assert snap["child_beats_total"] >= world
+
+        # liveness is credited for EVERY node — children's beats arrive
+        # inside compound envelopes yet still stamp contact/heartbeat
+        for node in master.job_manager.list_nodes():
+            assert node.status == NodeStatus.RUNNING, node.id
+            assert node.heartbeat_time > 0, node.id
+        assert _failed_nodes(master) == []
+    finally:
+        swarm.close()
+        master.stop()
+
+
+def test_aggregator_kill_reparents_without_world_cut(tmp_path, monkeypatch):
+    world, degree = 24, 4
+    _fanin_env(monkeypatch, degree)
+    master = _master(tmp_path, world)
+    swarm = Swarm(master.addr, world)
+    try:
+        swarm.settle(rounds=4)
+        # one beat + a flush tick so every aggregator has forwarded at
+        # least one batch — the kill must close a LIVE master connection
+        # for the disconnect hook to attribute
+        swarm.beat(rounds=1)
+        time.sleep(0.3)
+        victim = swarm.aggregator_ids()[1]  # not node 0, an interior agg
+        phase_before = master.event_journal.current_phase()
+
+        swarm.kill_aggregator(victim)  # SIGKILL-equivalent: sockets just die
+        deadline = time.monotonic() + 5.0
+        while (JournalEvent.FANIN_REPARENTED not in _journal_kinds(master)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+
+        events = [e for e in master.event_journal.events()
+                  if e["kind"] == JournalEvent.FANIN_REPARENTED]
+        assert events, "aggregator death was never journaled as a re-parent"
+        ev = events[0]
+        assert ev["data"]["lost"] == victim
+        # the group was handed to the next-lowest LIVE sibling
+        assert ev["data"]["new_parent"] in range(victim + 1,
+                                                victim + degree)
+        # deliberately NOT a world cut: no fault/rdzv events, same phase,
+        # nobody marked dead
+        kinds = _journal_kinds(master)
+        assert JournalEvent.FAULT_DETECTED not in kinds
+        assert JournalEvent.RDZV_START not in kinds
+        assert master.event_journal.current_phase() == phase_before
+        assert _failed_nodes(master) == []
+
+        # the subtree keeps beating: children transparently fall back to
+        # the master / the promoted sibling on their next beat
+        stats = swarm.beat(rounds=2)
+        assert stats["errors"] == 0
+        assert _failed_nodes(master) == []
+    finally:
+        swarm.close()
+        master.stop()
+
+
+def test_backpressure_sheds_telemetry_before_liveness(tmp_path, monkeypatch):
+    world = 8
+    _fanin_env(monkeypatch, 0)  # flat — the ladder is orthogonal to the tree
+    master = _master(tmp_path, world)
+    swarm = Swarm(master.addr, world)
+    try:
+        swarm.beat(rounds=1)
+        assert not master.fanin_plane.shed_telemetry()
+
+        # force level 1: telemetry is shed, liveness is not, and replies
+        # carry an explicit jittered-backoff ask
+        monkeypatch.setenv(ConfigKey.FANIN_FORCE_LEVEL, "1")
+        swarm.beat(rounds=1)
+        assert master.fanin_plane.backpressure_level() == 1
+        assert JournalEvent.FANIN_BACKPRESSURE in _journal_kinds(master)
+        before = master.fanin_plane.snapshot()["shed_total"]
+        stats = swarm.beat(
+            rounds=1, telemetry_fn=lambda nid, rnd: make_op_telemetry(nid)
+        )
+        assert master.fanin_plane.snapshot()["shed_total"] > before
+        assert stats["backoff_hints"] == stats["beats"]  # every reply asks
+        for node in master.job_manager.list_nodes():
+            assert node.heartbeat_time > 0  # liveness still credited
+
+        # level 2 widens liveness deadlines: a heartbeat 600s late is NOT
+        # a death verdict while the master is drowning...
+        monkeypatch.setenv(ConfigKey.FANIN_FORCE_LEVEL, "2")
+        swarm.beat(rounds=1)
+        assert master.job_manager._liveness_slack == 4.0
+        master.job_manager.check_heartbeats(now=time.monotonic() + 600.0)
+        assert _failed_nodes(master) == []
+
+        # ...and recovery restores the strict deadlines (same 600s gap
+        # IS a death verdict at slack 1.0 — proving the slack, not the
+        # clock, carried the verdict above)
+        monkeypatch.setenv(ConfigKey.FANIN_FORCE_LEVEL, "0")
+        swarm.beat(rounds=1)
+        assert master.job_manager._liveness_slack == 1.0
+        master.job_manager.check_heartbeats(now=time.monotonic() + 600.0)
+        assert len(_failed_nodes(master)) == world
+    finally:
+        swarm.close()
+        master.stop()
+
+
+def test_flat_mode_is_the_default_and_inert(tmp_path):
+    """Without DLROVER_TPU_FANIN_DEGREE the plane stays flat: no roles,
+    no parents, plain replies — the pre-fan-in wire behavior."""
+    world = 6
+    master = _master(tmp_path, world)
+    swarm = Swarm(master.addr, world)
+    try:
+        swarm.settle(rounds=2)
+        snap = master.fanin_plane.snapshot()
+        assert not snap["active"]
+        assert snap["assignment"] == {}
+        assert swarm.aggregator_ids() == []
+        assert swarm.parented_ids() == []
+        assert snap["compound_total"] == 0
+        assert _failed_nodes(master) == []
+    finally:
+        swarm.close()
+        master.stop()
+
+
+# -- swarm drills (1000+ agents; not tier-1) --------------------------------
+
+
+@pytest.mark.swarm
+@pytest.mark.slow
+def test_swarm_1024_no_false_deaths_under_fanin_delay_storm(
+    tmp_path, monkeypatch
+):
+    world, degree = 1024, 32
+    _fanin_env(monkeypatch, degree)
+    master = _master(tmp_path, world)
+    swarm = Swarm(master.addr, world, drivers=32)
+    try:
+        swarm.settle(rounds=4)
+        assert master.fanin_plane.snapshot()["active"]
+
+        # delay storm on the compound forward hop: half of all envelopes
+        # arrive 100ms late, for several full beat generations
+        chaos.configure("hb.fanin:delay=100ms@p=0.5", seed=11)
+        stats = swarm.beat(
+            rounds=3, telemetry_fn=lambda nid, rnd: make_op_telemetry(nid)
+        )
+        assert stats["errors"] == 0
+        time.sleep(0.5)  # drain the delayed flush ticks
+
+        # acceptance: ZERO false node-death verdicts under the storm
+        master.job_manager.check_heartbeats()
+        assert _failed_nodes(master) == []
+        assert JournalEvent.FAULT_DETECTED not in _journal_kinds(master)
+        snap = master.fanin_plane.snapshot()
+        assert snap["child_beats_total"] >= 4 * world
+    finally:
+        chaos.reset_injector()
+        swarm.close()
+        master.stop()
+
+
+@pytest.mark.swarm
+@pytest.mark.slow
+def test_swarm_1024_aggregator_sigkill_reparents_subtrees(
+    tmp_path, monkeypatch
+):
+    world, degree = 1024, 32
+    _fanin_env(monkeypatch, degree)
+    master = _master(tmp_path, world)
+    swarm = Swarm(master.addr, world, drivers=32)
+    try:
+        swarm.settle(rounds=4)
+        swarm.beat(rounds=1)
+        time.sleep(0.3)  # every aggregator forwards ≥1 batch (live socket)
+        victims = swarm.aggregator_ids()[1:4]
+        for v in victims:
+            swarm.kill_aggregator(v)
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            lost = {e["data"]["lost"] for e in master.event_journal.events()
+                    if e["kind"] == JournalEvent.FANIN_REPARENTED}
+            if lost >= set(victims):
+                break
+            time.sleep(0.1)
+        assert lost >= set(victims), f"re-parent missing: {set(victims) - lost}"
+
+        kinds = _journal_kinds(master)
+        assert JournalEvent.FAULT_DETECTED not in kinds
+        assert JournalEvent.RDZV_START not in kinds
+        assert _failed_nodes(master) == []
+
+        stats = swarm.beat(rounds=2)
+        assert stats["errors"] == 0
+        assert _failed_nodes(master) == []
+    finally:
+        swarm.close()
+        master.stop()
