@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from dlrover_tpu.analysis.race_detector import shared
 from dlrover_tpu.chaos import get_injector
 from dlrover_tpu.common import comm, retry
 from dlrover_tpu.common.config import get_context
@@ -68,13 +69,18 @@ class FaninAggregator:
         self._flush_s = max(0.05, flush_s)
         self._lock = threading.Lock()
         # node_id → latest HeartbeatRequest (newer beats overwrite older:
-        # liveness only needs the freshest stamp per child)
-        self._beats: Dict[int, comm.HeartbeatRequest] = {}
-        self._events: List[comm.EventReport] = []
+        # liveness only needs the freshest stamp per child). Registered
+        # with the race detector: the RPC handler threads and the flush
+        # thread meet on these three, only ever under _lock.
+        self._beats: Dict[int, comm.HeartbeatRequest] = shared(
+            {}, f"FaninAggregator[{node_id}]._beats")
+        self._events: List[comm.EventReport] = shared(
+            [], f"FaninAggregator[{node_id}]._events")
         # node_id → [action_type, action_data] awaiting that child's next
         # beat — children get replies instantly from here, never blocking
         # on the master hop
-        self._mailbox: Dict[int, List[Any]] = {}
+        self._mailbox: Dict[int, List[Any]] = shared(
+            {}, f"FaninAggregator[{node_id}]._mailbox")
         self._backpressure = 0
         self._backoff_hint_s = 0.0
         self._epoch = -1
@@ -183,10 +189,14 @@ class FaninAggregator:
         with self._lock:
             if not self._beats and not self._events:
                 return
+            # drain by copy+clear, NOT by rebinding to fresh containers: a
+            # child's _rpc_heartbeat thread may hold a reference to the
+            # old object (and rebinding would also shed the race-detector
+            # registration)
             beats = dict(self._beats)
-            self._beats = {}
-            events = self._events
-            self._events = []
+            self._beats.clear()
+            events = list(self._events)
+            self._events.clear()
         # strip per-beat histograms into one merged field keyed by child
         # node id — halves the envelope and lets the master ingest the
         # whole subtree's skew signal in one lock pass
@@ -218,7 +228,7 @@ class FaninAggregator:
             with self._lock:
                 for nid, beat in beats.items():
                     self._beats.setdefault(nid, beat)
-                self._events = events + self._events
+                self._events[:0] = events
                 del self._events[:len(self._events) - _MAX_PENDING_EVENTS]
             raise ConnectionError("fan-in forward failed")
         with self._lock:
@@ -258,10 +268,12 @@ class HeartbeatRouter:
     def __init__(self, master_client):
         self._mc = master_client
         self._lock = threading.Lock()
-        self._parent_addr = ""
-        self._parent_client: Optional[RPCClient] = None
-        self._epoch = -1
-        self.aggregator: Optional[FaninAggregator] = None
+        # the heartbeat loop and close() (agent teardown thread) race on
+        # all four of these — reads and writes go under _lock
+        self._parent_addr = ""  # thread-shared
+        self._parent_client: Optional[RPCClient] = None  # thread-shared
+        self._epoch = -1  # thread-shared
+        self.aggregator: Optional[FaninAggregator] = None  # thread-shared
 
     def heartbeat(self, global_step: int = 0, step_timestamp: float = 0.0,
                   gauges=None, rdzv_round: int = -1,
@@ -271,7 +283,9 @@ class HeartbeatRouter:
         unreachable (parent failure alone falls back transparently)."""
         with self._lock:
             parent = self._parent_client
-        agg = self.aggregator
+            parent_addr = self._parent_addr
+            epoch = self._epoch
+            agg = self.aggregator
         if agg is not None and agg.alive:
             # aggregator role: this node's own beat joins its batch and
             # its liveness rides the compound envelope — only the flush
@@ -287,7 +301,7 @@ class HeartbeatRouter:
                 rdzv_round=rdzv_round,
                 op_telemetry=op_telemetry or {},
             ))
-            if resp.fanin_epoch < 0 or resp.fanin_epoch == self._epoch:
+            if resp.fanin_epoch < 0 or resp.fanin_epoch == epoch:
                 return resp
         if parent is not None:
             req = comm.HeartbeatRequest(
@@ -309,7 +323,7 @@ class HeartbeatRouter:
                 # the master — never a liveness gap
                 logger.info("node %s: parent aggregator %s unreachable — "
                             "falling back to master", self._mc.node_id,
-                            self._parent_addr)
+                            parent_addr)
                 self._set_parent("")
         resp = self._mc.heartbeat(
             global_step=global_step, step_timestamp=step_timestamp,
@@ -335,31 +349,39 @@ class HeartbeatRouter:
             # connection failure (a demoted aggregator stands down and
             # closes its subtree server) → transparent master fallback
             return
-        epoch_changed = resp.fanin_epoch != self._epoch
-        self._epoch = resp.fanin_epoch
+        with self._lock:
+            epoch_changed = resp.fanin_epoch != self._epoch
+            self._epoch = resp.fanin_epoch
+            agg = self.aggregator
         if resp.fanin_role == "aggregator":
-            if self.aggregator is None or not self.aggregator.alive:
-                self.aggregator = FaninAggregator(self._mc,
-                                                  self._mc.node_id)
+            if agg is None or not agg.alive:
+                # build OUTSIDE the lock (spins up an RPC server), then
+                # publish under it
+                agg = FaninAggregator(self._mc, self._mc.node_id)
+                with self._lock:
+                    self.aggregator = agg
                 epoch_changed = True
             if epoch_changed:
                 # (re-)announce the subtree address — a master restart or
                 # re-parent loses/invalidates the old registration
                 try:
-                    self._mc.fanin_register(self.aggregator.addr)
+                    self._mc.fanin_register(agg.addr)
                 except (ConnectionError, OSError):
                     logger.debug("fanin_register failed; retrying on a "
                                  "later beat", exc_info=True)
             self._set_parent("")
             return
-        if self.aggregator is not None and self.aggregator.alive:
+        if agg is not None and agg.alive:
             # demoted (a lower-id sibling returned): hand the role back
-            self.aggregator.kill()
-            self.aggregator = None
+            agg.kill()
+            with self._lock:
+                self.aggregator = None
         self._set_parent(resp.fanin_parent)
 
     def close(self) -> None:
-        if self.aggregator is not None:
-            self.aggregator.kill()
+        with self._lock:
+            agg = self.aggregator
             self.aggregator = None
+        if agg is not None:
+            agg.kill()
         self._set_parent("")

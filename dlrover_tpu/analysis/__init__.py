@@ -22,33 +22,62 @@ DLR005     raw urlopen/socket retry loops bypassing
            ``common/retry.py`` RetryPolicy
 DLR006     journaled event kinds / metric names as ad-hoc string
            literals instead of declared constants
+DLR007     trace span names as ad-hoc string literals instead of
+           declared constants
+DLR008     ``threading.Thread`` created without a ``name=``
+DLR009     non-daemon thread with no ``join()`` on any stop path
+DLR010     ``time.sleep`` polling loop on a flag that should block on a
+           stop ``threading.Event`` instead
+DLR011     mutation of a thread-shared attribute (marked via
+           ``race_detector.shared(...)`` or ``# thread-shared``) outside
+           a ``with <lock>:`` body
 =========  ==============================================================
 
 Suppression is explicit: an inline ``# noqa: DLR00X`` (with a reason) on
 the flagged line, or an entry in the checked-in baseline
 (``dlrover_tpu/analysis/baseline.txt``) for violations deliberately
 deferred. ``python -m dlrover_tpu.analysis --check`` exits non-zero on any
-violation not covered by either.
+violation not covered by either. Both suppression layers are themselves
+checked for rot: stale baseline entries and stale noqa codes (the line no
+longer trips that rule) are reported, and ``--fix-noqa`` strips the
+latter.
 
-The runtime half (:mod:`dlrover_tpu.analysis.lock_order`) instruments
-``threading.Lock``/``RLock`` under pytest (opt-in ``lock_order_guard``
-fixture) to build a lock-acquisition-order graph and fails tests whose
-threads acquire locks in inverted orders — the deadlocks DLR004 cannot see
-because the two acquisitions live in different functions.
+The runtime half is two detectors that instrument ``threading`` under
+pytest:
+
+- :mod:`dlrover_tpu.analysis.lock_order` (opt-in ``lock_order_guard``
+  fixture) builds a lock-acquisition-order graph and fails tests whose
+  threads acquire locks in inverted orders — the deadlocks DLR004 cannot
+  see because the two acquisitions live in different functions.
+- :mod:`dlrover_tpu.analysis.race_detector` (opt-in ``race_guard``
+  fixture) runs FastTrack-style happens-before data-race detection over
+  vector clocks advanced at every sync edge (thread start/join,
+  lock release→acquire, Event set→wait, queue and SharedQueue/SharedDict
+  handoffs) and reports unsynchronized accesses to containers registered
+  via :func:`~dlrover_tpu.analysis.race_detector.shared` — the races
+  DLR011 cannot see because they span call chains, not single statements.
+  See docs/design/concurrency_analysis.md.
 """
 
 from dlrover_tpu.analysis.engine import (  # noqa: F401
     AnalysisReport,
+    StaleNoqa,
     Violation,
     analyze_package,
     analyze_paths,
     analyze_source,
     default_baseline_path,
+    fix_stale_noqa,
     load_baseline,
     write_baseline,
 )
 from dlrover_tpu.analysis.lock_order import (  # noqa: F401
     LockOrderDetector,
     LockOrderViolation,
+)
+from dlrover_tpu.analysis.race_detector import (  # noqa: F401
+    RaceDetector,
+    RaceViolation,
+    shared,
 )
 from dlrover_tpu.analysis.rules import ALL_RULES  # noqa: F401

@@ -7,6 +7,7 @@ and local pre-commit runs. Typical flows::
     python -m dlrover_tpu.analysis --check          # CI gate
     python -m dlrover_tpu.analysis                  # full listing
     python -m dlrover_tpu.analysis --update-baseline  # accept current state
+    python -m dlrover_tpu.analysis --fix-noqa       # strip stale noqa codes
     python -m dlrover_tpu.analysis --list-rules
 """
 
@@ -16,9 +17,11 @@ import sys
 from typing import List, Optional
 
 from dlrover_tpu.analysis.engine import (
+    StaleNoqa,
     analyze_paths,
     check,
     default_baseline_path,
+    fix_stale_noqa,
     load_baseline,
     package_root,
     write_baseline,
@@ -30,8 +33,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m dlrover_tpu.analysis",
         description="dlrover_tpu control-plane invariant analyzer "
-                    "(rules DLR001-DLR007; see docs/design/"
-                    "static_analysis.md)",
+                    "(rules DLR001-DLR011; see docs/design/"
+                    "static_analysis.md and docs/design/"
+                    "concurrency_analysis.md)",
     )
     parser.add_argument(
         "paths", nargs="*",
@@ -56,6 +60,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="rewrite the baseline to exactly the current violations",
     )
     parser.add_argument(
+        "--fix-noqa", action="store_true",
+        help="strip stale DLR codes from noqa comments (a noqa whose "
+             "line no longer trips that rule); foreign codes are kept",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalogue and exit",
     )
@@ -69,7 +78,17 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     root = package_root()
     paths = args.paths or [os.path.join(root, "dlrover_tpu")]
-    violations = analyze_paths(paths, root=root)
+    stale_noqa: List[StaleNoqa] = []
+    violations = analyze_paths(paths, root=root,
+                               stale_noqa_out=stale_noqa)
+
+    if args.fix_noqa:
+        changed = fix_stale_noqa(stale_noqa, root=root)
+        for s in stale_noqa:
+            print(s.render())
+        print(f"--fix-noqa: stripped {len(stale_noqa)} stale code(s) "
+              f"from {len(changed)} file(s)")
+        return 0
 
     if args.update_baseline:
         path = write_baseline(violations, args.baseline)
@@ -79,6 +98,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     baseline = (None if args.no_baseline
                 else load_baseline(args.baseline))
     report = check(violations, baseline)
+    report.stale_noqa = stale_noqa
 
     shown = report.new if args.check else report.violations
     baselined_fps = {id(v) for v in report.baselined}
@@ -88,6 +108,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     for fp in report.stale_baseline:
         print(f"stale baseline entry (violation fixed — prune it): "
               f"{fp[0]} {fp[1]} | {fp[2]}")
+    for s in report.stale_noqa:
+        print(s.render())
     print(report.summary())
     if report.new:
         print(

@@ -13,6 +13,10 @@ Suppression layers, in order:
 
 ``check()`` reports *new* violations (not in the baseline) and *stale*
 baseline entries (baselined lines that no longer trip — prune them).
+Suppressions rot the same way baselines do, so the analyzer also reports
+*stale noqa* comments: a ``# noqa: DLR00X`` whose line no longer trips
+that rule (only codes of rules in the active run set are judged — foreign
+codes like ``BLE001`` are never touched). ``--fix-noqa`` strips them.
 """
 
 import ast
@@ -41,15 +45,32 @@ def noqa_codes(line: str) -> frozenset:
     )
 
 
+@dataclass(frozen=True)
+class StaleNoqa:
+    """A ``# noqa: DLR00X`` whose line no longer trips that rule."""
+
+    path: str
+    line: int
+    code: str
+    line_text: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: stale noqa: {self.code} no "
+                f"longer triggers here (strip it: --fix-noqa)")
+
+
 def analyze_source(
     source: str,
     path: str = "<string>",
     rules: Optional[Sequence[RuleFn]] = None,
+    stale_noqa_out: Optional[List[StaleNoqa]] = None,
 ) -> List[Violation]:
     """Run the rules over one source blob; returns noqa-filtered
     violations sorted by (path, line, rule). A syntax error surfaces as a
     single DLR000 violation so a broken file fails --check loudly instead
-    of being skipped silently."""
+    of being skipped silently. When ``stale_noqa_out`` is given, noqa
+    codes that suppressed nothing (for rules in this run set) are
+    appended to it."""
     lines = source.splitlines()
     try:
         tree = attach_parents(ast.parse(source))
@@ -61,14 +82,26 @@ def analyze_source(
             line_text=(lines[e.lineno - 1].strip()
                        if e.lineno and e.lineno <= len(lines) else ""),
         )]
+    active = list(rules if rules is not None else ALL_RULES)
     out: List[Violation] = []
-    for rule in (rules if rules is not None else ALL_RULES):
+    suppressed: Dict[int, set] = {}  # line -> codes that earned their keep
+    for rule in active:
         for v in rule(tree, path, lines):
             if 0 < v.line <= len(lines) and v.rule in noqa_codes(
                 lines[v.line - 1]
             ):
+                suppressed.setdefault(v.line, set()).add(v.rule)
                 continue
             out.append(v)
+    if stale_noqa_out is not None:
+        known = {getattr(r, "rule_id", "") for r in active}
+        for lineno, line in enumerate(lines, 1):
+            for code in sorted(noqa_codes(line)):
+                if code in known and code not in suppressed.get(lineno, ()):
+                    stale_noqa_out.append(StaleNoqa(
+                        path=path, line=lineno, code=code,
+                        line_text=line.strip(),
+                    ))
     out.sort(key=lambda v: (v.path, v.line, v.rule))
     return out
 
@@ -95,6 +128,7 @@ def analyze_paths(
     paths: Iterable[str],
     root: Optional[str] = None,
     rules: Optional[Sequence[RuleFn]] = None,
+    stale_noqa_out: Optional[List[StaleNoqa]] = None,
 ) -> List[Violation]:
     """Analyze every .py file under ``paths``; violation paths are
     reported relative to ``root`` (default: cwd) in posix form so the
@@ -106,7 +140,8 @@ def analyze_paths(
         rel = rel.replace(os.sep, "/")
         with open(fpath, "r", encoding="utf-8") as f:
             source = f.read()
-        out.extend(analyze_source(source, path=rel, rules=rules))
+        out.extend(analyze_source(source, path=rel, rules=rules,
+                                  stale_noqa_out=stale_noqa_out))
     out.sort(key=lambda v: (v.path, v.line, v.rule))
     return out
 
@@ -126,9 +161,58 @@ def analyze_package(
     """Analyze the whole ``dlrover_tpu`` package against the checked-in
     baseline — the programmatic equivalent of ``--check``."""
     root = package_root()
+    stale_noqa: List[StaleNoqa] = []
     violations = analyze_paths([os.path.join(root, "dlrover_tpu")],
-                               root=root, rules=rules)
-    return check(violations, load_baseline(baseline_path))
+                               root=root, rules=rules,
+                               stale_noqa_out=stale_noqa)
+    report = check(violations, load_baseline(baseline_path))
+    report.stale_noqa = stale_noqa
+    return report
+
+
+def _strip_noqa_codes(line: str, codes: set) -> str:
+    """Remove ``codes`` from the line's noqa comment. Keeps other codes
+    (including foreign ones like BLE001); drops the whole comment —
+    justification text and all — when no codes remain."""
+    m = _NOQA_RE.search(line)
+    if not m:
+        return line
+    existing = [c.strip() for c in m.group(1).split(",") if c.strip()]
+    remaining = [c for c in existing if c.upper() not in codes]
+    if remaining:
+        tail_ws = m.group(1)[len(m.group(1).rstrip()):]
+        return (line[:m.start(1)] + ", ".join(remaining) + tail_ws
+                + line[m.end(1):])
+    return line[:m.start()].rstrip()
+
+
+def fix_stale_noqa(
+    stale: Sequence[StaleNoqa],
+    root: Optional[str] = None,
+) -> List[str]:
+    """Rewrite files to strip the stale codes reported in ``stale``
+    (paths are resolved relative to ``root``). Returns the files
+    changed."""
+    root = os.path.abspath(root or os.getcwd())
+    by_file: Dict[str, Dict[int, set]] = {}
+    for s in stale:
+        by_file.setdefault(s.path, {}).setdefault(s.line, set()).add(s.code)
+    changed: List[str] = []
+    for rel, by_line in sorted(by_file.items()):
+        fpath = os.path.join(root, rel)
+        with open(fpath, "r", encoding="utf-8") as f:
+            src = f.read()
+        lines = src.splitlines()
+        for lineno, codes in by_line.items():
+            if 0 < lineno <= len(lines):
+                lines[lineno - 1] = _strip_noqa_codes(lines[lineno - 1],
+                                                      codes)
+        new_src = "\n".join(lines) + ("\n" if src.endswith("\n") else "")
+        if new_src != src:
+            with open(fpath, "w", encoding="utf-8") as f:
+                f.write(new_src)
+            changed.append(fpath)
+    return changed
 
 
 # -- baseline ----------------------------------------------------------------
@@ -184,6 +268,7 @@ class AnalysisReport:
     new: List[Violation] = field(default_factory=list)
     baselined: List[Violation] = field(default_factory=list)
     stale_baseline: List[Tuple[str, str, str]] = field(default_factory=list)
+    stale_noqa: List[StaleNoqa] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -193,7 +278,8 @@ class AnalysisReport:
         return (
             f"{len(self.violations)} violation(s): {len(self.new)} new, "
             f"{len(self.baselined)} baselined, "
-            f"{len(self.stale_baseline)} stale baseline entr(y/ies)"
+            f"{len(self.stale_baseline)} stale baseline entr(y/ies), "
+            f"{len(self.stale_noqa)} stale noqa"
         )
 
 

@@ -1,0 +1,959 @@
+"""Runtime happens-before data-race detector (the second dynamic half of
+the analyzer, sibling of :mod:`dlrover_tpu.analysis.lock_order`).
+
+The lock-order detector proves we never take locks in inverted orders;
+this module proves the *data* we guard is actually guarded. It is a
+FastTrack-style vector-clock detector specialised to the repo's own
+threading idioms — the synchronization edges it understands are exactly
+the ones the control plane uses:
+
+=====================  =====================================================
+sync primitive         happens-before edge
+=====================  =====================================================
+``Thread.start``       parent's clock is inherited by the child
+``Thread.join``        the child's final clock joins into the joiner
+``Lock``/``RLock``     release transfers the holder's clock to the lock;
+(and ``Condition``      the next acquirer joins it (reentrant acquires are
+ built over them)       no-ops; ``Condition.wait`` is covered through the
+                        ``_release_save``/``_acquire_restore`` protocol)
+``Event.set``          the setter's clock is published on the event; a
+                        ``wait()``/``is_set()`` that observes the set joins it
+``queue.Queue``        ``put`` publishes the sender's clock on the queue's
+                        channel; a successful ``get`` joins it
+``SharedQueue``/       same, keyed by the IPC object's name — the socket
+``SharedDict``          hop to LocalIPCServer is one cumulative channel
+=====================  =====================================================
+
+Channel clocks (queues, events, IPC objects) are *cumulative*: a receive
+joins every publish so far, not just the matching one. That trades a
+little detection power (an extra edge can mask a true race) for zero
+false positives from producer/consumer timing — the right bias for a
+detector whose job is to *certify* the fan-in/saver planes race-free
+under the swarm smokes.
+
+Shared state is registered with :func:`shared`::
+
+    self._beats = shared({}, "agent.fanin.FaninAggregator._beats")
+
+When no detector is installed (production), ``shared`` returns its
+argument untouched — zero overhead. Under the ``race_guard`` pytest
+fixture it returns a tracking proxy; every read/write through the proxy
+is checked against the last conflicting access's vector clock, and a
+pair of accesses with no happens-before path between them is reported
+as a race: the field name, both access stacks, both thread names, and
+the lock sets each thread held. The ``shared(...)`` call is also the
+static marker DLR011 keys on: mutations of a shared-registered
+attribute outside a ``with <lock>:`` block are flagged at lint time.
+
+Like the lock-order detector this is opt-in and test-scoped; it is NOT
+async-signal-safe and must not be installed in production processes.
+It patches the same factories (``threading.Lock``/``RLock``), so the
+two guards cannot be installed simultaneously.
+"""
+
+import os
+import queue as _queue_module
+import threading
+import traceback
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+# real primitives, captured at import time: the detector's own internals
+# must never run through instrumented locks/queues
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_EVENT = threading.Event
+_REAL_THREAD_START = threading.Thread.start
+_REAL_THREAD_JOIN = threading.Thread.join
+_REAL_QUEUE_PUT = _queue_module.Queue.put
+_REAL_QUEUE_GET = _queue_module.Queue.get
+
+_MAX_RACES = 64
+_STACK_LIMIT = 8
+
+# the currently installed detector (None in production). Module-level so
+# `shared()` stays a cheap global read on the hot path.
+_ACTIVE: Optional["RaceDetector"] = None
+
+
+def shared(obj: Any, name: str) -> Any:
+    """Register ``obj`` (a dict, list or set) as thread-shared state.
+
+    Production: returns ``obj`` unchanged. Under an installed
+    :class:`RaceDetector`: returns a tracking proxy that reports every
+    access to the detector. Also serves as the DLR011 static marker —
+    mutations of a shared-registered attribute outside a lock block are
+    a lint violation.
+    """
+    det = _ACTIVE
+    if det is None:
+        return obj
+    return det.track(obj, name)
+
+
+class RaceViolation(AssertionError):
+    """Raised by :meth:`RaceDetector.check` when any access pair without
+    a happens-before path was observed."""
+
+
+# exact-path match, NOT endswith("race_detector.py"): that suffix also
+# matches callers like tests/test_race_detector.py and would eat their
+# frames from the reported stacks
+_OWN_FILE = os.path.abspath(__file__)
+
+
+def _is_own_frame(filename: str) -> bool:
+    return os.path.abspath(filename) == _OWN_FILE
+
+
+def _stack(limit: int = _STACK_LIMIT) -> str:
+    frames = [
+        f for f in traceback.extract_stack()[:-2]
+        if not _is_own_frame(f.filename)
+    ]
+    return "".join(traceback.format_list(frames[-limit:]))
+
+
+def _site(skip_internal: bool = True) -> str:
+    """'file:line in func' of the innermost non-detector caller frame."""
+    for frame in reversed(traceback.extract_stack()[:-1]):
+        if skip_internal and _is_own_frame(frame.filename):
+            continue
+        return f"{frame.filename}:{frame.lineno} in {frame.name}"
+    return "<unknown>"
+
+
+class _Access:
+    """One recorded read/write: who, where, and what locks they held."""
+
+    __slots__ = ("thread_name", "stack", "locks", "op")
+
+    def __init__(self, thread_name: str, stack: str,
+                 locks: Tuple[str, ...], op: str):
+        self.thread_name = thread_name
+        self.stack = stack
+        self.locks = locks
+        self.op = op  # "read" | "write"
+
+    def describe(self) -> str:
+        held = ", ".join(self.locks) if self.locks else "<no locks held>"
+        return (f"thread {self.thread_name!r} {self.op} "
+                f"(locks held: {held}):\n" + _indent(self.stack))
+
+
+class Race:
+    __slots__ = ("field", "kind", "first", "second")
+
+    def __init__(self, field: str, kind: str,
+                 first: _Access, second: _Access):
+        self.field = field
+        self.kind = kind  # "write/write" | "read/write" | "write/read"
+        self.first = first
+        self.second = second
+
+
+class _ThreadState:
+    __slots__ = ("token", "vc", "thread", "locks")
+
+    def __init__(self, token: int, vc: Dict[int, int],
+                 thread: threading.Thread):
+        self.token = token
+        self.vc = vc  # token -> clock
+        self.thread = thread
+        self.locks: List[list] = []  # [ _RaceLock, reentry count ]
+
+    @property
+    def name(self) -> str:
+        name = self.thread.name
+        # a thread first sighted inside Thread._bootstrap (before it
+        # registers in threading._active) resolves as a _DummyThread;
+        # prefer the real name once the registration lands
+        if name.startswith("Dummy-"):
+            cur = threading.current_thread()
+            if cur.ident == self.thread.ident:
+                return cur.name
+        return name
+
+    def lockset(self) -> Tuple[str, ...]:
+        return tuple(entry[0].name for entry in self.locks)
+
+
+class _VarState:
+    """Per-registered-object access history: the last write epoch plus
+    every read epoch not yet subsumed by a write."""
+
+    __slots__ = ("name", "write", "reads")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.write: Optional[Tuple[int, int, _Access]] = None
+        self.reads: Dict[int, Tuple[int, _Access]] = {}
+
+
+def _join(into: Dict[int, int], other: Dict[int, int]) -> None:
+    for token, clock in other.items():
+        if into.get(token, 0) < clock:
+            into[token] = clock
+
+
+class _RaceLock:
+    """Instrumented ``threading.Lock``/``RLock``: carries the vector
+    clock transferred release→acquire, and feeds the per-thread lockset
+    the race reports name."""
+
+    def __init__(self, detector: "RaceDetector", inner, kind: str,
+                 name: Optional[str] = None):
+        self._detector = detector
+        self._inner = inner
+        self._kind = kind
+        self.name = name or f"{kind}@{_site()}"
+        self.vc: Dict[int, int] = {}
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._detector._on_lock_acquired(self)
+        return got
+
+    def release(self) -> None:
+        self._detector._on_lock_released(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        locked = getattr(self._inner, "locked", None)
+        return locked() if locked is not None else False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition-protocol delegation — same shape as lock_order.py: only
+    # RLock has the protocol; a plain Lock must raise AttributeError so
+    # Condition binds its acquire/release fallbacks.
+    def __getattr__(self, name: str):
+        if name == "_at_fork_reinit":
+            return getattr(self._inner, name)
+        if name in ("_release_save", "_acquire_restore", "_is_owned"):
+            inner_fn = getattr(self._inner, name)  # AttributeError for Lock
+            if name == "_release_save":
+                def _release_save():
+                    self._detector._on_lock_released(self, full=True)
+                    return inner_fn()
+                return _release_save
+            if name == "_acquire_restore":
+                def _acquire_restore(state):
+                    inner_fn(state)
+                    self._detector._on_lock_acquired(self)
+                return _acquire_restore
+            return inner_fn
+        raise AttributeError(name)
+
+    def __repr__(self) -> str:
+        return f"<Race{self._kind} {self.name}>"
+
+
+class _RaceEvent:
+    """Instrumented ``threading.Event``: ``set`` publishes the setter's
+    clock; a ``wait``/``is_set`` that observes the set joins it."""
+
+    def __init__(self, detector: "RaceDetector"):
+        self._detector = detector
+        self._inner = _REAL_EVENT()
+        self.vc: Dict[int, int] = {}
+
+    def set(self) -> None:
+        self._detector._on_publish(self.vc)
+        self._inner.set()
+
+    def clear(self) -> None:
+        self._inner.clear()
+
+    def is_set(self) -> bool:
+        r = self._inner.is_set()
+        if r:
+            self._detector._on_observe(self.vc)
+        return r
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        r = self._inner.wait(timeout)
+        if r:
+            self._detector._on_observe(self.vc)
+        return r
+
+    def _at_fork_reinit(self) -> None:
+        self._inner._at_fork_reinit()
+
+
+class RaceDetector:
+    """Vector-clock bookkeeping + the patch set. Thread-safe via one
+    REAL leaf lock (never held across a blocking call)."""
+
+    def __init__(self, stack_limit: int = _STACK_LIMIT):
+        self._glock = _REAL_LOCK()
+        # reentrancy guard: Thread._bootstrap's started-event set() runs
+        # before the thread registers in threading._active, so resolving
+        # current_thread() inside a hook can allocate a _DummyThread
+        # whose __init__ fires ANOTHER instrumented set() — without the
+        # guard that nested hook self-deadlocks on _glock
+        self._tls = threading.local()
+        self._stack_limit = stack_limit
+        self._next_token = 0
+        # thread ident -> state (ident, not object id: the same OS
+        # thread can surface as a _DummyThread first and its real
+        # Thread object later)
+        self._threads: Dict[int, _ThreadState] = {}
+        # id(thread) -> (thread, inherited vc) for started-not-yet-seen
+        # threads; matched by ident scan at first sighting
+        self._pending: Dict[int, Tuple[threading.Thread, Dict[int, int]]] = {}
+        # id(thread) -> (thread, final vc) for dead threads whose ident
+        # was recycled before they were joined
+        self._final_vcs: Dict[int, Tuple[threading.Thread,
+                                         Dict[int, int]]] = {}
+        # channel key -> (keepalive ref, cumulative vc): queue.Queue by
+        # identity, SharedQueue/SharedDict by IPC name
+        self._chans: Dict[Any, Tuple[Any, Dict[int, int]]] = {}
+        self._races: List[Race] = []
+        self._race_keys: set = set()
+        self._installed = False
+        self.tracked_created = 0
+
+    # -- instrumentation lifecycle ----------------------------------------
+
+    def install(self) -> "RaceDetector":
+        global _ACTIVE
+        if self._installed:
+            return self
+        if _ACTIVE is not None:
+            raise RuntimeError("another RaceDetector is already installed")
+        threading.Lock = self.make_lock  # type: ignore[assignment]
+        threading.RLock = self.make_rlock  # type: ignore[assignment]
+        threading.Event = self.make_event  # type: ignore[assignment]
+        det = self
+
+        def _start(thread_self, *a, **kw):
+            det._on_thread_start(thread_self)
+            return _REAL_THREAD_START(thread_self, *a, **kw)
+
+        def _join(thread_self, timeout=None):
+            _REAL_THREAD_JOIN(thread_self, timeout)
+            if not thread_self.is_alive():
+                det._on_thread_joined(thread_self)
+
+        def _put(q_self, item, block=True, timeout=None):
+            det._on_channel_send(id(q_self), q_self)
+            return _REAL_QUEUE_PUT(q_self, item, block, timeout)
+
+        def _get(q_self, block=True, timeout=None):
+            item = _REAL_QUEUE_GET(q_self, block, timeout)
+            det._on_channel_recv(id(q_self))
+            return item
+
+        threading.Thread.start = _start  # type: ignore[assignment]
+        threading.Thread.join = _join  # type: ignore[assignment]
+        _queue_module.Queue.put = _put  # type: ignore[assignment]
+        _queue_module.Queue.get = _get  # type: ignore[assignment]
+        self._patch_ipc()
+        self._installed = True
+        _ACTIVE = self
+        return self
+
+    def uninstall(self) -> None:
+        global _ACTIVE
+        if not self._installed:
+            return
+        threading.Lock = _REAL_LOCK  # type: ignore[assignment]
+        threading.RLock = _REAL_RLOCK  # type: ignore[assignment]
+        threading.Event = _REAL_EVENT  # type: ignore[assignment]
+        threading.Thread.start = _REAL_THREAD_START  # type: ignore
+        threading.Thread.join = _REAL_THREAD_JOIN  # type: ignore
+        _queue_module.Queue.put = _REAL_QUEUE_PUT  # type: ignore
+        _queue_module.Queue.get = _REAL_QUEUE_GET  # type: ignore
+        self._unpatch_ipc()
+        self._installed = False
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+    def __enter__(self) -> "RaceDetector":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def _patch_ipc(self) -> None:
+        # lazy import: race_detector must stay stdlib-only at import time
+        # (production modules import `shared` from here)
+        from dlrover_tpu.common import multi_process as mp
+
+        det = self
+        self._ipc_saved = {
+            "sq_put": mp.SharedQueue.put, "sq_get": mp.SharedQueue.get,
+            "sd_set": mp.SharedDict.set, "sd_get": mp.SharedDict.get,
+            "sd_update": mp.SharedDict.update,
+            "sd_snapshot": mp.SharedDict.snapshot,
+            "sd_delete": mp.SharedDict.delete,
+        }
+        saved = self._ipc_saved
+
+        def sq_put(q_self, item):
+            det._on_channel_send(("sq", q_self._name), None)
+            return saved["sq_put"](q_self, item)
+
+        def sq_get(q_self, timeout=None):
+            item = saved["sq_get"](q_self, timeout)
+            det._on_channel_recv(("sq", q_self._name))
+            return item
+
+        def sd_set(d_self, key, value):
+            det._on_channel_send(("sd", d_self._name), None)
+            return saved["sd_set"](d_self, key, value)
+
+        def sd_update(d_self, items):
+            det._on_channel_send(("sd", d_self._name), None)
+            return saved["sd_update"](d_self, items)
+
+        def sd_delete(d_self, key):
+            det._on_channel_send(("sd", d_self._name), None)
+            return saved["sd_delete"](d_self, key)
+
+        def sd_get(d_self, key, default=None):
+            r = saved["sd_get"](d_self, key, default)
+            det._on_channel_recv(("sd", d_self._name))
+            return r
+
+        def sd_snapshot(d_self):
+            r = saved["sd_snapshot"](d_self)
+            det._on_channel_recv(("sd", d_self._name))
+            return r
+
+        mp.SharedQueue.put = sq_put
+        mp.SharedQueue.get = sq_get
+        mp.SharedDict.set = sd_set
+        mp.SharedDict.get = sd_get
+        mp.SharedDict.update = sd_update
+        mp.SharedDict.snapshot = sd_snapshot
+        mp.SharedDict.delete = sd_delete
+
+    def _unpatch_ipc(self) -> None:
+        from dlrover_tpu.common import multi_process as mp
+
+        saved = self._ipc_saved
+        mp.SharedQueue.put = saved["sq_put"]
+        mp.SharedQueue.get = saved["sq_get"]
+        mp.SharedDict.set = saved["sd_set"]
+        mp.SharedDict.get = saved["sd_get"]
+        mp.SharedDict.update = saved["sd_update"]
+        mp.SharedDict.snapshot = saved["sd_snapshot"]
+        mp.SharedDict.delete = saved["sd_delete"]
+
+    def make_lock(self, name: Optional[str] = None) -> _RaceLock:
+        return _RaceLock(self, _REAL_LOCK(), "Lock", name)
+
+    def make_rlock(self, name: Optional[str] = None) -> _RaceLock:
+        return _RaceLock(self, _REAL_RLOCK(), "RLock", name)
+
+    def make_event(self) -> _RaceEvent:
+        return _RaceEvent(self)
+
+    # -- per-thread vector clocks ------------------------------------------
+
+    def _enter_hook(self) -> bool:
+        """Reentrancy guard (see ``_tls`` above). True = proceed."""
+        if getattr(self._tls, "busy", False):
+            return False
+        self._tls.busy = True
+        return True
+
+    def _exit_hook(self) -> None:
+        self._tls.busy = False
+
+    def _state_locked(self) -> _ThreadState:
+        cur = threading.current_thread()
+        ident = cur.ident if cur.ident is not None else id(cur)
+        st = self._threads.get(ident)
+        if st is not None:
+            if st.thread is cur or st.thread.is_alive():
+                # same OS thread (possibly _DummyThread → real object
+                # aliasing); keep the state, prefer the real object
+                if st.thread is not cur \
+                        and st.thread.__class__.__name__ == "_DummyThread":
+                    st.thread = cur
+                return st
+            # ident recycled from a dead, never-joined thread: keep its
+            # final clock for a late join, then start fresh
+            self._final_vcs[id(st.thread)] = (st.thread, st.vc)
+            del self._threads[ident]
+        self._next_token += 1
+        token = self._next_token
+        vc: Dict[int, int] = {}
+        for key, (thread, inherited) in list(self._pending.items()):
+            if thread.ident == ident:
+                vc = dict(inherited)
+                del self._pending[key]
+                break
+        vc[token] = 1
+        st = self._threads[ident] = _ThreadState(token, vc, cur)
+        return st
+
+    def _bump_locked(self, st: _ThreadState) -> None:
+        st.vc[st.token] = st.vc.get(st.token, 0) + 1
+
+    # -- sync-edge hooks ----------------------------------------------------
+
+    def _on_lock_acquired(self, lock: _RaceLock) -> None:
+        if not self._enter_hook():
+            return
+        try:
+            with self._glock:
+                st = self._state_locked()
+                for entry in st.locks:
+                    if entry[0] is lock:
+                        entry[1] += 1
+                        return  # reentrant: no new edge
+                _join(st.vc, lock.vc)
+                st.locks.append([lock, 1])
+        finally:
+            self._exit_hook()
+
+    def _on_lock_released(self, lock: _RaceLock, full: bool = False) -> None:
+        if not self._enter_hook():
+            return
+        try:
+            with self._glock:
+                st = self._state_locked()
+                for i, entry in enumerate(st.locks):
+                    if entry[0] is lock:
+                        entry[1] = 0 if full else entry[1] - 1
+                        if entry[1] > 0:
+                            return  # still held reentrantly
+                        st.locks.pop(i)
+                        break
+                # transfer the clock even on a handoff-release (a plain
+                # Lock released by a thread that never acquired it): the
+                # release still publishes this thread's history to the
+                # next acquirer
+                _join(lock.vc, st.vc)
+                self._bump_locked(st)
+        finally:
+            self._exit_hook()
+
+    def _on_publish(self, chan_vc: Dict[int, int]) -> None:
+        """Event.set / any publish-side edge onto a channel clock."""
+        if not self._enter_hook():
+            return
+        try:
+            with self._glock:
+                st = self._state_locked()
+                _join(chan_vc, st.vc)
+                self._bump_locked(st)
+        finally:
+            self._exit_hook()
+
+    def _on_observe(self, chan_vc: Dict[int, int]) -> None:
+        if not self._enter_hook():
+            return
+        try:
+            with self._glock:
+                st = self._state_locked()
+                _join(st.vc, chan_vc)
+        finally:
+            self._exit_hook()
+
+    def _chan_locked(self, key: Any, ref: Any) -> Dict[int, int]:
+        ent = self._chans.get(key)
+        if ent is None:
+            ent = self._chans[key] = (ref, {})
+        return ent[1]
+
+    def _on_channel_send(self, key: Any, ref: Any) -> None:
+        if not self._enter_hook():
+            return
+        try:
+            with self._glock:
+                st = self._state_locked()
+                vc = self._chan_locked(key, ref)
+                _join(vc, st.vc)
+                self._bump_locked(st)
+        finally:
+            self._exit_hook()
+
+    def _on_channel_recv(self, key: Any) -> None:
+        if not self._enter_hook():
+            return
+        try:
+            with self._glock:
+                st = self._state_locked()
+                ent = self._chans.get(key)
+                if ent is not None:
+                    _join(st.vc, ent[1])
+        finally:
+            self._exit_hook()
+
+    def _on_thread_start(self, thread: threading.Thread) -> None:
+        if not self._enter_hook():
+            return
+        try:
+            with self._glock:
+                st = self._state_locked()
+                self._pending[id(thread)] = (thread, dict(st.vc))
+                self._bump_locked(st)
+        finally:
+            self._exit_hook()
+
+    def _on_thread_joined(self, thread: threading.Thread) -> None:
+        if not self._enter_hook():
+            return
+        try:
+            with self._glock:
+                st = self._state_locked()
+                ident = thread.ident
+                child = self._threads.get(ident) if ident is not None \
+                    else None
+                if child is not None and child.thread is thread:
+                    _join(st.vc, child.vc)
+                    return
+                final = self._final_vcs.get(id(thread))
+                if final is not None and final[0] is thread:
+                    _join(st.vc, final[1])
+                    return
+                # started under the guard but never touched tracked
+                # state: its inherited clock is all it could publish
+                pending = self._pending.get(id(thread))
+                if pending is not None and pending[0] is thread:
+                    _join(st.vc, pending[1])
+        finally:
+            self._exit_hook()
+
+    # -- tracked variables ---------------------------------------------------
+
+    def track(self, obj: Any, name: str) -> Any:
+        if isinstance(obj, dict):
+            proxy: Any = _TrackedDict(self, obj, name)
+        elif isinstance(obj, list):
+            proxy = _TrackedList(self, obj, name)
+        elif isinstance(obj, (set, frozenset)):
+            proxy = _TrackedSet(self, set(obj), name)
+        else:
+            raise TypeError(
+                f"shared() supports dict/list/set, not {type(obj).__name__}"
+                f" (field {name!r})"
+            )
+        self.tracked_created += 1
+        return proxy
+
+    def _access(self, var: _VarState, is_write: bool) -> None:
+        if not self._enter_hook():
+            return
+        try:
+            self._access_inner(var, is_write)
+        finally:
+            self._exit_hook()
+
+    def _access_inner(self, var: _VarState, is_write: bool) -> None:
+        stack = _stack(self._stack_limit)
+        with self._glock:
+            st = self._state_locked()
+            clock = st.vc[st.token]
+            info = _Access(st.name, stack, st.lockset(),
+                           "write" if is_write else "read")
+            w = var.write
+            if w is not None and w[0] != st.token \
+                    and st.vc.get(w[0], 0) < w[1]:
+                self._record_locked(
+                    var, "write/write" if is_write else "write/read",
+                    w[2], info)
+            if is_write:
+                for token, (rclock, raccess) in var.reads.items():
+                    if token != st.token and st.vc.get(token, 0) < rclock:
+                        self._record_locked(var, "read/write",
+                                            raccess, info)
+                var.write = (st.token, clock, info)
+                var.reads = {}
+            else:
+                var.reads[st.token] = (clock, info)
+
+    def _record_locked(self, var: _VarState, kind: str,
+                       first: _Access, second: _Access) -> None:
+        if len(self._races) >= _MAX_RACES:
+            return
+        f_site = first.stack.strip().splitlines()[-2:-1] or [first.stack]
+        s_site = second.stack.strip().splitlines()[-2:-1] or [second.stack]
+        key = (var.name, kind, first.thread_name, second.thread_name,
+               f_site[0], s_site[0])
+        if key in self._race_keys:
+            return
+        self._race_keys.add(key)
+        self._races.append(Race(var.name, kind, first, second))
+
+    # -- reporting -----------------------------------------------------------
+
+    @property
+    def races(self) -> List[Race]:
+        with self._glock:
+            return list(self._races)
+
+    def report(self) -> str:
+        out: List[str] = []
+        for i, race in enumerate(self.races, 1):
+            out.append(f"data race #{i} on {race.field!r} ({race.kind}):")
+            out.append("  first access: " + race.first.describe())
+            out.append("  second access: " + race.second.describe())
+        return "\n".join(out)
+
+    def check(self) -> None:
+        """Raise :class:`RaceViolation` if any race was observed. Call
+        after the exercised code ran (the conftest fixture does this at
+        teardown)."""
+        if self.races:
+            raise RaceViolation(
+                "data race(s) detected — two threads access the same "
+                "shared field with no happens-before path (no common "
+                "lock, queue, event or join orders them):\n"
+                + self.report()
+            )
+
+
+# -- tracking proxies --------------------------------------------------------
+#
+# Deliberately NOT dict/list/set subclasses: CPython fast-paths
+# (e.g. dict(subclass), list concat) would bypass the overridden methods
+# and silently drop accesses. Each proxy implements the protocol surface
+# the control plane actually uses and records exactly one access per
+# call.
+
+
+class _TrackedBase:
+    __slots__ = ("_det", "_inner", "_var")
+
+    def __init__(self, detector: RaceDetector, inner: Any, name: str):
+        self._det = detector
+        self._inner = inner
+        self._var = _VarState(name)
+
+    def _r(self) -> None:
+        self._det._access(self._var, is_write=False)
+
+    def _w(self) -> None:
+        self._det._access(self._var, is_write=True)
+
+    def __len__(self) -> int:
+        self._r()
+        return len(self._inner)
+
+    def __iter__(self) -> Iterator:
+        self._r()
+        return iter(list(self._inner))
+
+    def __contains__(self, item: Any) -> bool:
+        self._r()
+        return item in self._inner
+
+    def __eq__(self, other: Any) -> bool:
+        self._r()
+        if isinstance(other, _TrackedBase):
+            return self._inner == other._inner
+        return self._inner == other
+
+    def __ne__(self, other: Any) -> bool:
+        return not self.__eq__(other)
+
+    def __hash__(self) -> None:  # type: ignore[override]
+        raise TypeError("tracked shared containers are unhashable")
+
+    def __bool__(self) -> bool:
+        self._r()
+        return bool(self._inner)
+
+    def __repr__(self) -> str:
+        return f"<shared {self._var.name}: {self._inner!r}>"
+
+
+class _TrackedDict(_TrackedBase):
+    __slots__ = ()
+
+    def __getitem__(self, key: Any) -> Any:
+        self._r()
+        return self._inner[key]
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        self._w()
+        self._inner[key] = value
+
+    def __delitem__(self, key: Any) -> None:
+        self._w()
+        del self._inner[key]
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        self._r()
+        return self._inner.get(key, default)
+
+    def keys(self):
+        self._r()
+        return list(self._inner.keys())
+
+    def values(self):
+        self._r()
+        return list(self._inner.values())
+
+    def items(self):
+        self._r()
+        return list(self._inner.items())
+
+    def copy(self) -> dict:
+        self._r()
+        return dict(self._inner)
+
+    def pop(self, key: Any, *default: Any) -> Any:
+        self._w()
+        return self._inner.pop(key, *default)
+
+    def popitem(self) -> Tuple[Any, Any]:
+        self._w()
+        return self._inner.popitem()
+
+    def setdefault(self, key: Any, default: Any = None) -> Any:
+        self._w()
+        return self._inner.setdefault(key, default)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._w()
+        self._inner.update(*args, **kwargs)
+
+    def clear(self) -> None:
+        self._w()
+        self._inner.clear()
+
+
+class _TrackedList(_TrackedBase):
+    __slots__ = ()
+
+    def __getitem__(self, idx: Any) -> Any:
+        self._r()
+        return self._inner[idx]
+
+    def __setitem__(self, idx: Any, value: Any) -> None:
+        self._w()
+        self._inner[idx] = value
+
+    def __delitem__(self, idx: Any) -> None:
+        self._w()
+        del self._inner[idx]
+
+    def __add__(self, other: Any) -> list:
+        self._r()
+        return list(self._inner) + list(other)
+
+    def __radd__(self, other: Any) -> list:
+        self._r()
+        return list(other) + list(self._inner)
+
+    def append(self, item: Any) -> None:
+        self._w()
+        self._inner.append(item)
+
+    def extend(self, items: Any) -> None:
+        self._w()
+        self._inner.extend(items)
+
+    def insert(self, idx: int, item: Any) -> None:
+        self._w()
+        self._inner.insert(idx, item)
+
+    def pop(self, idx: int = -1) -> Any:
+        self._w()
+        return self._inner.pop(idx)
+
+    def remove(self, item: Any) -> None:
+        self._w()
+        self._inner.remove(item)
+
+    def clear(self) -> None:
+        self._w()
+        self._inner.clear()
+
+    def index(self, *args: Any) -> int:
+        self._r()
+        return self._inner.index(*args)
+
+    def count(self, item: Any) -> int:
+        self._r()
+        return self._inner.count(item)
+
+    def copy(self) -> list:
+        self._r()
+        return list(self._inner)
+
+    def sort(self, **kwargs: Any) -> None:
+        self._w()
+        self._inner.sort(**kwargs)
+
+    def reverse(self) -> None:
+        self._w()
+        self._inner.reverse()
+
+
+class _TrackedSet(_TrackedBase):
+    __slots__ = ()
+
+    def add(self, item: Any) -> None:
+        self._w()
+        self._inner.add(item)
+
+    def discard(self, item: Any) -> None:
+        self._w()
+        self._inner.discard(item)
+
+    def remove(self, item: Any) -> None:
+        self._w()
+        self._inner.remove(item)
+
+    def pop(self) -> Any:
+        self._w()
+        return self._inner.pop()
+
+    def clear(self) -> None:
+        self._w()
+        self._inner.clear()
+
+    def update(self, *others: Any) -> None:
+        self._w()
+        self._inner.update(*(set(o) for o in others))
+
+    def copy(self) -> set:
+        self._r()
+        return set(self._inner)
+
+    def __sub__(self, other: Any) -> set:
+        self._r()
+        return set(self._inner) - set(other)
+
+    def __rsub__(self, other: Any) -> set:
+        self._r()
+        return set(other) - set(self._inner)
+
+    def __or__(self, other: Any) -> set:
+        self._r()
+        return set(self._inner) | set(other)
+
+    def __ror__(self, other: Any) -> set:
+        return self.__or__(other)
+
+    def __and__(self, other: Any) -> set:
+        self._r()
+        return set(self._inner) & set(other)
+
+    def __rand__(self, other: Any) -> set:
+        return self.__and__(other)
+
+    def issubset(self, other: Any) -> bool:
+        self._r()
+        return self._inner.issubset(set(other))
+
+    def issuperset(self, other: Any) -> bool:
+        self._r()
+        return self._inner.issuperset(set(other))
+
+
+def _indent(text: str, prefix: str = "    ") -> str:
+    return "".join(prefix + ln + "\n" for ln in text.rstrip().splitlines())

@@ -30,6 +30,19 @@ Rule catalogue (motivating incidents in docs/design/static_analysis.md):
   are correlated BY NAME (agent join ↔ master join ↔ world cut); a typo'd
   span name silently drops the arc from every flight-recorder bundle —
   declare names on ``constants.SpanName``.
+- DLR008: ``threading.Thread`` created without a ``name=``. Stack dumps,
+  the crash flight recorder, and the race detector's reports all key on
+  thread names; ``Thread-37`` attributes nothing.
+- DLR009: non-daemon thread with no join path. A non-daemon thread
+  nobody joins keeps the process alive past shutdown — either mark it
+  daemon (with a stop Event) or join it on the stop path.
+- DLR010: ``time.sleep`` polling loop on a flag. A loop that sleeps and
+  re-checks a stop flag is unjoinable for up to a full sleep period;
+  ``Event.wait(timeout)`` wakes instantly on stop.
+- DLR011: mutation of a thread-shared attribute outside ``with lock:``.
+  Attributes registered via ``race_detector.shared(...)`` (or marked
+  ``# thread-shared``) are cross-thread state; an unlocked mutation is
+  the static face of the data races the race_guard catches at runtime.
 """
 
 import ast
@@ -493,5 +506,251 @@ def rule_dlr007_adhoc_span_names(
                 "it on constants.SpanName (cross-process arcs correlate by "
                 "name; a typo silently drops the arc from every trace "
                 "bundle)",
+                lines,
+            )
+
+
+# -- DLR008/DLR009: thread lifecycle -------------------------------------------
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    name = _dotted(node.func)
+    return bool(name) and name.rsplit(".", 1)[-1] == "Thread"
+
+
+def _kw(node: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+@_rule
+def rule_dlr008_unnamed_thread(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """threading.Thread created without a name= (unreadable stack dumps)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_thread_ctor(node):
+            continue
+        if _kw(node, "name") is None:
+            yield _violation(
+                "DLR008", path, node,
+                "Thread created without a name= — stack dumps, the crash "
+                "flight recorder, and race reports all attribute by thread "
+                "name; `Thread-37` attributes nothing",
+                lines,
+            )
+
+
+@_rule
+def rule_dlr009_unjoined_thread(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """non-daemon thread with no join path (process can't shut down)."""
+    # collect every `<target>.join(...)` call and `<target>.daemon = True`
+    # assignment in the file, then require each non-daemon Thread(...)
+    # creation to be assigned to a target with one of them
+    joined: set = set()
+    daemoned: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name.endswith(".join"):
+                joined.add(name[: -len(".join")])
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                d = _dotted(tgt)
+                if d.endswith(".daemon") and isinstance(
+                    node.value, ast.Constant
+                ) and node.value.value is True:
+                    daemoned.add(d[: -len(".daemon")])
+    msg = (
+        "non-daemon thread with no join path — nobody joins it, so it "
+        "keeps the process alive past shutdown; pass daemon=True (with a "
+        "stop Event) or join it on the stop path"
+    )
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_thread_ctor(node):
+            continue
+        daemon = _kw(node, "daemon")
+        if isinstance(daemon, ast.Constant) and daemon.value is True:
+            continue
+        par = _parent(node)
+        targets: List[str] = []
+        if isinstance(par, ast.Assign):
+            targets = [_dotted(t) for t in par.targets]
+        elif isinstance(par, ast.AnnAssign) and par.target is not None:
+            targets = [_dotted(par.target)]
+        elif isinstance(par, (ast.List, ast.Tuple)):
+            gp = _parent(par)
+            if isinstance(gp, ast.Assign):
+                targets = [_dotted(t) for t in gp.targets]
+        elif isinstance(par, ast.Call):
+            # Thread(...) passed straight into a call — e.g.
+            # ``self._threads.append(Thread(...))``: credit the receiver
+            # container (joined later as ``for t in self._threads: ...``)
+            recv = _dotted(par.func)
+            if "." in recv:
+                targets = [recv.rsplit(".", 1)[0]]
+        targets = [t for t in targets if t]
+        if any(t in joined or t in daemoned for t in targets):
+            continue
+        # a creation whose target is kept somewhere counts as joined if
+        # the file joins ANY thread handle — collected-then-joined lists
+        # ("for t in threads: t.join()") bind the join to the loop var,
+        # not the container, so exact matching would false-positive
+        if targets and joined:
+            continue
+        yield _violation("DLR009", path, node, msg, lines)
+
+
+# -- DLR010: sleep-polling loops ----------------------------------------------
+
+
+def _is_flagish(test: ast.expr) -> bool:
+    """Loop conditions that are a stop-flag shape: True, a bare flag,
+    ``not flag``, ``x.is_set()`` / ``not x.is_set()``. Deadline compares
+    (``time.monotonic() < deadline``) are deliberately excluded — those
+    loops are bounded and DLR001 already polices their clock."""
+    if isinstance(test, ast.Constant):
+        return test.value is True
+    if isinstance(test, (ast.Name, ast.Attribute)):
+        return True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_flagish(test.operand)
+    if isinstance(test, ast.Call):
+        return _dotted(test.func).rsplit(".", 1)[-1] == "is_set"
+    return False
+
+
+@_rule
+def rule_dlr010_sleep_polling_loop(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """time.sleep polling loop on a flag — wait on a stop Event instead."""
+    prune = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+             ast.While, ast.For)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.While) or not _is_flagish(node.test):
+            continue
+        stack: List[ast.AST] = list(node.body)
+        while stack:
+            inner = stack.pop()
+            if isinstance(inner, prune):
+                continue  # nested loops/functions pace their own bodies
+            if isinstance(inner, ast.Call) and _dotted(inner.func) in (
+                "time.sleep", "sleep"
+            ):
+                yield _violation(
+                    "DLR010", path, inner,
+                    "time.sleep polling loop on a flag — the thread is "
+                    "unjoinable for up to a full sleep period; wait on "
+                    "the stop Event instead (`stop_event.wait(period)`) "
+                    "so shutdown wakes it instantly",
+                    lines,
+                )
+            stack.extend(ast.iter_child_nodes(inner))
+
+
+# -- DLR011: unlocked mutation of thread-shared attributes --------------------
+
+_MUTATOR_TAILS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "sort", "reverse",
+}
+_THREAD_SHARED_COMMENT = "# thread-shared"
+
+
+def _under_lock(node: ast.AST) -> bool:
+    cur = _parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                ctx = item.context_expr
+                name = _dotted(ctx.func if isinstance(ctx, ast.Call)
+                               else ctx)
+                if name and _LOCKISH_RE.search(name):
+                    return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        cur = _parent(cur)
+    return False
+
+
+def _self_attr(node: ast.expr) -> str:
+    """'X' if node is exactly ``self.X``, else ''."""
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ) and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+@_rule
+def rule_dlr011_unlocked_shared_mutation(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """mutation of a thread-shared attribute outside any `with lock:`."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        # pass 1: attributes marked thread-shared — assigned from a
+        # shared(...) call, or carrying a `# thread-shared` comment
+        marked: dict = {}  # attr name -> marking node
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if not attr:
+                    continue
+                is_shared_call = (
+                    isinstance(node.value, ast.Call)
+                    and _dotted(node.value.func).rsplit(".", 1)[-1]
+                    == "shared"
+                )
+                line = node.lineno
+                has_comment = (
+                    0 < line <= len(lines)
+                    and _THREAD_SHARED_COMMENT in lines[line - 1]
+                )
+                if is_shared_call or has_comment:
+                    marked.setdefault(attr, node)
+        if not marked:
+            continue
+        # pass 2: every mutation of a marked attr needs a lock ancestor
+        for node in ast.walk(cls):
+            attr = None
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in tgts:
+                    a = _self_attr(tgt)
+                    if not a and isinstance(tgt, ast.Subscript):
+                        a = _self_attr(tgt.value)
+                    if a in marked and node is not marked[a]:
+                        attr = a
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    a = _self_attr(tgt)
+                    if not a and isinstance(tgt, ast.Subscript):
+                        a = _self_attr(tgt.value)
+                    if a in marked:
+                        attr = a
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ) and node.func.attr in _MUTATOR_TAILS:
+                a = _self_attr(node.func.value)
+                if a in marked:
+                    attr = a
+            if attr is None or _under_lock(node):
+                continue
+            yield _violation(
+                "DLR011", path, node,
+                f"thread-shared attribute self.{attr} mutated outside "
+                "any `with <lock>:` block — this is exactly the unlocked "
+                "access the race_guard reports at runtime; take the "
+                "owning lock (or # noqa with the reason it is safe)",
                 lines,
             )

@@ -27,6 +27,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
+from dlrover_tpu.analysis.race_detector import shared
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.constants import (
     CheckpointConstant,
@@ -167,7 +168,11 @@ class AsyncCheckpointSaver:
             max_workers=get_context().ckpt_save_workers,
             thread_name_prefix="ckpt-persist",
         )
-        self._persisted_steps: Dict[str, int] = {}
+        # shm frame name → last persisted step; the "ckpt-saver" consumer
+        # thread and bp-commit threads meet here — registered with the
+        # race detector, accessed only under _lock
+        self._persisted_steps: Dict[str, int] = shared(
+            {}, "AsyncCheckpointSaver._persisted_steps")
         self._lock = threading.Lock()
         # serializes tracker check+write across the event thread and any
         # async breakpoint-commit threads (the monotonic check is useless

@@ -461,7 +461,7 @@ class CheckpointEngine:
                     )
                     ok = False
                     break
-                time.sleep(0.02)
+                time.sleep(0.02)  # noqa: DLR010 — cross-process kv-store barrier poll (deadline-bounded); no Event spans processes
             # GC old attempts with a generous lag (a straggler may still
             # be polling the previous attempt's keys — never delete those)
             gc_seq = self._save_seq - 8

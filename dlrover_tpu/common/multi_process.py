@@ -127,13 +127,16 @@ class LocalIPCServer:
     # -- server internals --------------------------------------------------
 
     def _accept_loop(self) -> None:
+        conn_seq = 0
         while not self._stopped:
             try:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            conn_seq += 1
             t = threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name=f"ipc-conn-{conn_seq}",
             )
             t.start()
 

@@ -182,6 +182,8 @@ class GcsStorage(CheckpointStorage):
                 return fn()
             except Exception as e:  # noqa: BLE001 — raised after retries
                 last = e
+                logger.debug("gcs attempt %d/%d failed: %r",
+                             attempt + 1, self.RETRIES, e)
                 _time.sleep(self.BACKOFF_S * (2 ** attempt))
         logger.warning("gcs operation failed after retries: %r", last)
         raise last
@@ -223,14 +225,14 @@ class GcsStorage(CheckpointStorage):
         try:
             self._retry(_rm)
         except Exception:  # noqa: BLE001 — best-effort like shutil.rmtree
-            pass
+            logger.debug("gcs rmtree %s failed", dir_path, exc_info=True)
 
     def safe_remove(self, path: str) -> None:
         bucket, key = self._split(path)
         try:
             self._retry(lambda: self._c().bucket(bucket).blob(key).delete())
         except Exception:  # noqa: BLE001 — parity with os.remove swallow
-            pass
+            logger.debug("gcs remove %s failed", path, exc_info=True)
 
     def safe_makedirs(self, dir_path: str) -> None:
         pass  # prefixes need no creation
@@ -287,6 +289,7 @@ class GcsStorage(CheckpointStorage):
         try:
             return self._retry(_ls)
         except Exception:  # noqa: BLE001 — parity with os.listdir swallow
+            logger.debug("gcs listdir %s failed", path, exc_info=True)
             return []
 
 

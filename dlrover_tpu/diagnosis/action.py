@@ -31,7 +31,7 @@ class DiagnosisAction:
         self.instance = instance
         self.reason = reason
         self.data = data or {}
-        self.timestamp = time.time()  # noqa: DLR001 — reported creation stamp
+        self.timestamp = time.time()
         self.expired_time_s = expired_time_s
         # expiry runs on the monotonic clock: a wall step under NTP must
         # neither expire a fresh action nor immortalize a stale one

@@ -54,7 +54,7 @@ class PreCheckOperator:
             result = self.check(job_manager)
             if result.passed or time.monotonic() >= deadline:
                 return result
-            time.sleep(self.retry_interval_s)
+            time.sleep(self.retry_interval_s)  # noqa: DLR010 — deadline-bounded pre-check poll (returns at the deadline above); not a thread loop
 
 
 class NoPreCheckOperator(PreCheckOperator):

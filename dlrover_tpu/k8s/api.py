@@ -334,7 +334,9 @@ class RealK8sApi(K8sApi):
     def get_pod(self, namespace, name):
         try:
             return self._core.read_namespaced_pod(name, namespace).to_dict()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — absent-or-unreachable reads as absent
+            logger.debug("get pod %s/%s failed", namespace, name,
+                         exc_info=True)
             return None
 
     def list_pods(self, namespace, label_selector=""):
@@ -358,7 +360,9 @@ class RealK8sApi(K8sApi):
             return self._core.read_namespaced_service(
                 name, namespace
             ).to_dict()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — absent-or-unreachable reads as absent
+            logger.debug("get service %s/%s failed", namespace, name,
+                         exc_info=True)
             return None
 
     def create_custom_object(self, namespace, plural, obj):
@@ -371,7 +375,9 @@ class RealK8sApi(K8sApi):
             return self._custom.get_namespaced_custom_object(
                 self.GROUP, self.VERSION, namespace, plural, name
             )
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — absent-or-unreachable reads as absent
+            logger.debug("get %s %s/%s failed", plural, namespace, name,
+                         exc_info=True)
             return None
 
     def list_custom_objects(self, namespace, plural):
@@ -396,7 +402,9 @@ class RealK8sApi(K8sApi):
                 self.GROUP, self.VERSION, namespace, plural, name
             )
             return True
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — caller acts on the False
+            logger.warning("delete %s %s/%s failed", plural, namespace,
+                           name, exc_info=True)
             return False
 
     def watch_pods(self, namespace, label_selector="", timeout_s=None):

@@ -298,7 +298,7 @@ def main(argv=None) -> int:
     logger.info("elasticjob operator watching namespace %s", args.namespace)
     try:
         while True:
-            time.sleep(max(1, args.resync_seconds))
+            time.sleep(max(1, args.resync_seconds))  # noqa: DLR010 — foreground controller resync loop; process lifetime, SIGTERM ends it
             try:
                 reconciler.resync()
                 with open(args.liveness_file, "w") as f:

@@ -159,7 +159,7 @@ class PodScaler(Scaler):
                     "pod creation for node %s failed (%r) — re-queueing",
                     node.id, e,
                 )
-                time.sleep(self.RETRY_DELAY_S)
+                self._stopped.wait(self.RETRY_DELAY_S)
                 self._queue.put(node)
 
     def _create_worker_pod(self, node: Node) -> None:

@@ -39,6 +39,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Set
 
+from dlrover_tpu.analysis.race_detector import shared
 from dlrover_tpu.common.constants import (
     ConfigKey,
     SpanName,
@@ -84,12 +85,17 @@ class FaninPlane:
         self._hb_interval_s = heartbeat_interval_s
         self._slack_cb = liveness_slack_cb
         self._lock = threading.Lock()
-        self._members: Set[int] = set()
-        self._lost: Set[int] = set()
+        # registered with the race detector: heartbeat handler threads,
+        # the disconnect hook and rpc_fanin_register all meet on these
+        # four, only ever under _lock
+        self._members: Set[int] = shared(set(), "FaninPlane._members")
+        self._lost: Set[int] = shared(set(), "FaninPlane._lost")
         # aggregator node id → its subtree RPC server addr (rpc_fanin_register)
-        self._agg_addrs: Dict[int, str] = {}
+        self._agg_addrs: Dict[int, str] = shared(
+            {}, "FaninPlane._agg_addrs")
         # group id → aggregator node id, recomputed on membership change
-        self._assignment: Dict[int, int] = {}
+        self._assignment: Dict[int, int] = shared(
+            {}, "FaninPlane._assignment")
         self._epoch = 0
         self._ewma_ms = 0.0
         self._level = 0
@@ -154,7 +160,10 @@ class FaninPlane:
                         assignment[group] = node_id
         if assignment == self._assignment:
             return False
-        self._assignment = assignment
+        # clear+update, not rebind: rebinding would shed the race-detector
+        # registration (and orphan any reader holding the old dict)
+        self._assignment.clear()
+        self._assignment.update(assignment)
         self._epoch += 1
         return True
 
@@ -192,9 +201,12 @@ class FaninPlane:
                     "new_parent": self._assignment.get(group, -1),
                 })
             aggs = len(self._assignment)
+            # tally under the lock — snapshot() reads it there; only the
+            # journal/metric/trace emission below stays outside (module
+            # docstring: the journal takes its own lock)
+            self._n_reparented += len(reparents)
         self._g_aggregators.set(aggs)
         for data in reparents:
-            self._n_reparented += 1
             self._c_reparented.inc()
             with tracing.span(SpanName.FANIN_REPARENT, source="master",
                               **data):

@@ -28,6 +28,7 @@ import time
 import zlib
 from typing import Dict, List, Optional
 
+from dlrover_tpu.analysis.race_detector import shared
 from dlrover_tpu.chaos import get_injector
 from dlrover_tpu.common.constants import ConfigKey, env_int
 
@@ -37,8 +38,12 @@ DEFAULT_KV_SHARDS = 8
 class _KVShard:
     """One hash slice of the store: own lock, condition, and epoch."""
 
-    def __init__(self) -> None:
-        self.store: Dict[str, bytes] = {}
+    def __init__(self, index: int = 0) -> None:
+        # every RPC handler thread + the rendezvous barrier waiters meet
+        # on this dict; registered so race_guard certifies the lock/cond
+        # discipline
+        self.store: Dict[str, bytes] = shared(
+            {}, f"_KVShard[{index}].store")
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.epoch = 0  # bumped by clear(); waiters from an old epoch bail
@@ -49,7 +54,7 @@ class KVStoreService:
         if num_shards is None:
             num_shards = env_int(ConfigKey.FANIN_KV_SHARDS,
                                  DEFAULT_KV_SHARDS)
-        self._shards = [_KVShard() for _ in range(max(1, num_shards))]
+        self._shards = [_KVShard(i) for i in range(max(1, num_shards))]
 
     def _shard(self, key: str) -> _KVShard:
         # crc32, not hash(): stable across processes/runs (PYTHONHASHSEED)

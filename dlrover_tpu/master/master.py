@@ -394,7 +394,7 @@ class JobMaster:
                 if stage == JobStage.FAILED:
                     logger.error("job %s failed", self.job_name)
                     return 1
-                time.sleep(poll_s)
+                time.sleep(poll_s)  # noqa: DLR010 — foreground job-stage wait in run(); returns on terminal stages, not a stop event
         finally:
             final_stage = self.job_manager.job_stage
             get_emitter("master").instant(

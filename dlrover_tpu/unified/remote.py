@@ -284,7 +284,7 @@ def hosts_from_master(master_addr: str, job_name: str, node_num: int,
                 f"daemons were started with THIS job name (daemons "
                 f"register under the elastic job's --job_name)"
             )
-        time.sleep(0.5)
+        time.sleep(0.5)  # noqa: DLR010 — deadline-bounded cross-process registration poll (raises TimeoutError above)
 
 
 def main(argv=None) -> int:
@@ -337,7 +337,7 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _term)
     try:
         while True:
-            time.sleep(3600)
+            time.sleep(3600)  # noqa: DLR010 — main-thread signal wait; KeyboardInterrupt/SIGTERM is the only exit
     except KeyboardInterrupt:
         servicer.shutdown()
         server.stop()
@@ -423,7 +423,8 @@ class CallHomeListener:
             except OSError:
                 return
             threading.Thread(
-                target=self._handshake, args=(sock,), daemon=True
+                target=self._handshake, args=(sock,), daemon=True,
+                name=f"actor-handshake-{sock.fileno()}",
             ).start()
 
     def _handshake(self, sock: socket.socket) -> None:

@@ -42,6 +42,8 @@ def _actor_main(ctx: WorkloadContext, module_name: str, class_name: str,
         workload.setup()
         conn.send(("ready", os.getpid()))
     except Exception as e:  # noqa: BLE001 — report then die
+        logger.error("workload %s.%s init failed: %r",
+                     module_name, class_name, e)
         conn.send(("err", f"init failed: {e!r}"))
         return
     while True:
@@ -57,6 +59,7 @@ def _actor_main(ctx: WorkloadContext, module_name: str, class_name: str,
             fn = getattr(workload, method)
             conn.send(("ok", fn(*args, **kwargs)))
         except Exception as e:  # noqa: BLE001 — call error ≠ actor death
+            logger.debug("workload call %s failed: %r", method, e)
             conn.send(("err", repr(e)))
 
 
@@ -243,7 +246,8 @@ class RoleGroup:
                     try:
                         f.result()
                     except Exception:  # noqa: BLE001 — already failing over
-                        pass
+                        logger.debug("drained call failed during "
+                                     "fail-over", exc_info=True)
                 raise died
         return [f.result() for f in futs]
 

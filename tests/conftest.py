@@ -28,7 +28,43 @@ import jax  # noqa: E402 — must follow the env setup above
 
 jax.config.update("jax_platforms", "cpu")
 
+import threading  # noqa: E402
+
 import pytest  # noqa: E402
+
+# non-daemon threads a test may legitimately leave behind briefly; matched
+# by name prefix after the grace wait below
+_THREAD_LEAK_ALLOWLIST = (
+    "pytest-",            # pytest-timeout and friends
+    "ThreadPoolExecutor",  # pools shut down lazily by gc
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_thread_leaks():
+    """Every tier-1 test must join the non-daemon threads it starts: a
+    leaked non-daemon thread blocks interpreter exit (the DLR009 class,
+    caught at runtime). Daemon threads are exempt — the repo's long-lived
+    loops are daemons by convention and die with the process."""
+    before = {t for t in threading.enumerate() if not t.daemon}
+    yield
+    deadline = 2.0
+    leaked = []
+    for t in threading.enumerate():
+        if t.daemon or t in before or not t.is_alive():
+            continue
+        t.join(deadline)  # grace: the test may still be tearing down
+        deadline = 0.1
+        if t.is_alive() and not any(
+            t.name.startswith(p) for p in _THREAD_LEAK_ALLOWLIST
+        ):
+            leaked.append(t)
+    assert not leaked, (
+        "non-daemon thread(s) leaked by this test (they would block "
+        "interpreter exit — join them on the stop path, or make the loop "
+        "a named daemon): "
+        + ", ".join(f"{t.name!r} (ident={t.ident})" for t in leaked)
+    )
 
 
 @pytest.hookimpl(hookwrapper=True)
@@ -74,6 +110,26 @@ def lock_order_guard():
     from dlrover_tpu.analysis.lock_order import LockOrderDetector
 
     detector = LockOrderDetector()
+    detector.install()
+    try:
+        yield detector
+    finally:
+        detector.uninstall()
+    detector.check()
+
+
+@pytest.fixture
+def race_guard():
+    """Opt-in happens-before data-race detector: instruments threading
+    primitives + queue handoffs for the duration of the test and fails it
+    if any container registered via ``race_detector.shared(...)`` saw two
+    accesses unordered by the happens-before relation. The fixture yields
+    the detector so tests can register extra state via ``guard.track()``
+    and inspect ``guard.races``. Uninstall always runs, even when the
+    test body fails, so instrumentation never bleeds across tests."""
+    from dlrover_tpu.analysis.race_detector import RaceDetector
+
+    detector = RaceDetector()
     detector.install()
     try:
         yield detector

@@ -254,6 +254,46 @@ def test_async_save_via_agent(tmp_path, mesh, agent_ipc):
         saver.stop()
 
 
+@pytest.mark.race
+def test_flash_ckpt_cycle_is_race_free_under_race_guard(
+    tmp_path, mesh, agent_ipc, race_guard
+):
+    """One full flash-checkpoint save/restore cycle under the
+    happens-before race detector: the worker engine hands frames to the
+    agent saver over SharedQueue/SharedDict (channel clocks), the
+    "ckpt-saver" consumer thread persists and stamps the registered
+    ``_persisted_steps`` map — all certified free of unsynchronized
+    access at fixture teardown."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    saver = AsyncCheckpointSaver(
+        ckpt_dir=ckpt_dir, node_rank=0, local_world_size=1, expected_frames=1
+    )
+    saver.start(agent_ipc)
+    try:
+        engine = CheckpointEngine(
+            ckpt_dir, job_name=JOB, node_rank=0, local_rank=0,
+            ipc_socket=agent_ipc.path, world_size=1, rank=0,
+        )
+        state = make_state(mesh)
+        assert engine.save_to_storage(21, state)
+        deadline = time.time() + 10
+        while latest_step(ckpt_dir) != 21 and time.time() < deadline:
+            time.sleep(0.05)
+        assert latest_step(ckpt_dir) == 21
+        assert race_guard.tracked_created > 0, (
+            "the saver's shared() registration never engaged"
+        )
+        restored, step = engine.load(make_state(mesh))
+        assert step == 21
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]),
+            np.asarray(state["params"]["w"]),
+        )
+        assert race_guard.races == [], race_guard.report()
+    finally:
+        saver.stop()
+
+
 def test_breakpoint_save_after_worker_death(tmp_path, mesh, agent_ipc):
     """THE flash-checkpoint property: worker saves to memory only and dies;
     the agent persists the shm bytes (reference save_shm_to_storage:758)."""

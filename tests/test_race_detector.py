@@ -1,0 +1,233 @@
+"""Runtime happens-before race detection (analysis/race_detector.py).
+
+Covers the detection side (a seeded unlocked-writer race is caught with
+both stacks, thread names and held locks), the certification side (the
+repo's blessed synchronization idioms — common lock, queue handoff,
+Event publish, thread join — produce ZERO races), the tracking-proxy
+overhead bound, and clean uninstall even when the guarded test body
+fails.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.analysis.race_detector import (
+    RaceDetector,
+    RaceViolation,
+    shared,
+)
+
+
+def _run(*targets):
+    """Start all targets as named threads, then join them — start-before-
+    join order matters: joining one before starting the next would create
+    a happens-before edge and hide seeded races."""
+    threads = [
+        threading.Thread(target=fn, name=f"drill-{i}")
+        for i, fn in enumerate(targets)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+@pytest.fixture
+def detector():
+    det = RaceDetector()
+    det.install()
+    try:
+        yield det
+    finally:
+        det.uninstall()
+
+
+class TestSeededRace:
+    def test_unlocked_writers_caught_with_stacks_and_locks(self, detector):
+        """The acceptance drill: two writers under DIFFERENT locks race;
+        the report must carry both stacks, both thread names and the
+        locks each held."""
+        state = detector.track({}, "seeded.state")
+        lock_a = detector.make_lock("lock-a")
+        lock_b = detector.make_lock("lock-b")
+
+        def writer_a():
+            with lock_a:
+                state["x"] = 1
+
+        def writer_b():
+            with lock_b:
+                state["x"] = 2
+
+        _run(writer_a, writer_b)
+        races = detector.races
+        assert races, "disjoint-lock writers must be reported as a race"
+        race = races[0]
+        assert race.field == "seeded.state"
+        assert race.kind == "write/write"
+        names = {race.first.thread_name, race.second.thread_name}
+        assert names == {"drill-0", "drill-1"}
+        report = detector.report()
+        # both access stacks point at the offending lines
+        assert report.count("state[\"x\"]") >= 2
+        assert "writer_a" in report and "writer_b" in report
+        # ... and name the locks held at each access
+        assert "locks held: lock-a" in report
+        assert "locks held: lock-b" in report
+        with pytest.raises(RaceViolation):
+            detector.check()
+
+    def test_no_lock_at_all_is_caught(self, detector):
+        items = detector.track([], "seeded.items")
+        _run(lambda: items.append(1), lambda: items.append(2))
+        assert detector.races
+        assert "<no locks held>" in detector.report()
+
+    def test_unsynced_read_vs_write_is_caught(self, detector):
+        state = detector.track({"x": 0}, "seeded.rw")
+        _run(lambda: state.get("x"), lambda: state.update(x=1))
+        kinds = {r.kind for r in detector.races}
+        assert kinds & {"read/write", "write/read"}
+
+
+class TestCertifiedClean:
+    def test_lock_guarded_counter(self, detector):
+        state = detector.track({"n": 0}, "clean.counter")
+        lock = detector.make_lock("counter-lock")
+
+        def bump():
+            for _ in range(50):
+                with lock:
+                    state["n"] = state["n"] + 1
+
+        _run(bump, bump, bump)
+        assert detector.races == []
+        assert state["n"] == 150
+        detector.check()  # must not raise
+
+    def test_queue_handoff(self, detector):
+        state = detector.track({}, "clean.handoff")
+        q = queue.Queue()
+
+        def producer():
+            state["payload"] = 42  # before put: ordered by the handoff
+            q.put("ready")
+
+        def consumer():
+            q.get()
+            assert state["payload"] == 42
+
+        _run(producer, consumer)
+        assert detector.races == []
+
+    def test_event_published_value(self, detector):
+        state = detector.track({}, "clean.event")
+        ready = threading.Event()  # patched: carries the publisher's clock
+
+        def publisher():
+            state["cfg"] = {"flush_s": 0.5}
+            ready.set()
+
+        def subscriber():
+            assert ready.wait(timeout=5.0)
+            assert state["cfg"]["flush_s"] == 0.5
+
+        _run(publisher, subscriber)
+        assert detector.races == []
+
+    def test_start_join_ordering(self, detector):
+        """Parent writes before start and after join; child writes in
+        between — fully ordered, zero races."""
+        state = detector.track({}, "clean.lifecycle")
+        state["phase"] = "init"
+        t = threading.Thread(target=lambda: state.update(phase="child"),
+                             name="joined-child")
+        t.start()
+        t.join()
+        state["phase"] = "done"
+        assert detector.races == []
+
+
+class TestSharedRegistration:
+    def test_shared_is_identity_when_inactive(self):
+        d = {}
+        assert shared(d, "inactive") is d
+
+    def test_shared_tracks_when_active(self, detector):
+        d = shared({}, "active.dict")
+        _run(lambda: d.update(a=1), lambda: d.update(b=2))
+        assert [r.field for r in detector.races] == ["active.dict"]
+
+
+class TestProxyOverhead:
+    def test_tracked_dict_ops_are_bounded(self, detector):
+        """The proxy must stay usable on hot-ish paths: single-threaded
+        tracked ops should cost well under a millisecond each (they are
+        dict ops + one vector-clock compare)."""
+        d = detector.track({}, "perf.dict")
+        n = 5000
+        start = time.monotonic()
+        for i in range(n):
+            d[i % 64] = i
+            d.get(i % 64)
+        elapsed = time.monotonic() - start
+        assert elapsed / (2 * n) < 1e-3, (
+            f"tracked ops too slow: {elapsed:.3f}s for {2 * n} ops"
+        )
+        assert detector.races == []
+
+
+class TestInstallLifecycle:
+    def test_uninstall_restores_primitives_after_body_failure(self):
+        """The race_guard fixture uninstalls in a finally: even when the
+        test body dies mid-flight, threading must come back pristine and
+        a fresh detector must be installable."""
+        orig_lock, orig_event = threading.Lock, threading.Event
+        orig_start, orig_join = (threading.Thread.start,
+                                 threading.Thread.join)
+        det = RaceDetector()
+        det.install()
+        try:
+            det.track({}, "failing.state")["x"] = 1
+            raise RuntimeError("simulated test-body failure")
+        except RuntimeError:
+            pass
+        finally:
+            det.uninstall()
+        assert threading.Lock is orig_lock
+        assert threading.Event is orig_event
+        assert threading.Thread.start is orig_start
+        assert threading.Thread.join is orig_join
+        # queue must be unpatched too: a put after uninstall goes through
+        # the real implementation
+        q = queue.Queue()
+        q.put(1)
+        assert q.get() == 1
+        det2 = RaceDetector()
+        det2.install()
+        det2.uninstall()
+
+    def test_second_install_while_active_raises(self, detector):
+        with pytest.raises(RuntimeError):
+            RaceDetector().install()
+
+    def test_track_rejects_unsupported_types(self, detector):
+        with pytest.raises(TypeError):
+            detector.track(object(), "nope")
+
+
+class TestRaceGuardFixture:
+    def test_fixture_yields_working_detector(self, race_guard):
+        state = race_guard.track({}, "fixture.state")
+        lock = threading.Lock()
+
+        def bump():
+            with lock:
+                state["n"] = state.get("n", 0) + 1
+
+        _run(bump, bump)
+        assert state["n"] == 2
+        assert race_guard.races == []

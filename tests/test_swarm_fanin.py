@@ -204,6 +204,46 @@ def test_flat_mode_is_the_default_and_inert(tmp_path):
         master.stop()
 
 
+@pytest.mark.race
+def test_fanin_smoke_is_race_free_under_race_guard(
+    tmp_path, monkeypatch, race_guard
+):
+    """The fan-in control plane under the happens-before race detector:
+    tree formation, compound forwarding, an aggregator kill and the
+    re-parent — with every registered shared container (FaninPlane
+    membership/assignment maps, aggregator staged-beat maps and
+    mailboxes, kv shards) certified free of unsynchronized access. The
+    race_guard fixture fails the test on any race at teardown."""
+    world, degree = 24, 4
+    _fanin_env(monkeypatch, degree)
+    master = _master(tmp_path, world)
+    swarm = Swarm(master.addr, world)
+    try:
+        swarm.settle(rounds=4)
+        swarm.beat(rounds=1)
+        time.sleep(0.3)  # aggregators forward ≥1 batch each
+        assert master.fanin_plane.snapshot()["active"]
+        assert race_guard.tracked_created > 0, (
+            "shared() registration never engaged — the drill certified "
+            "nothing"
+        )
+
+        victim = swarm.aggregator_ids()[1]
+        swarm.kill_aggregator(victim)
+        deadline = time.monotonic() + 5.0
+        while (JournalEvent.FANIN_REPARENTED not in _journal_kinds(master)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+
+        stats = swarm.beat(rounds=2)
+        assert stats["errors"] == 0
+        assert _failed_nodes(master) == []
+        assert race_guard.races == [], race_guard.report()
+    finally:
+        swarm.close()
+        master.stop()
+
+
 # -- swarm drills (1000+ agents; not tier-1) --------------------------------
 
 
