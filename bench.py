@@ -889,20 +889,78 @@ def _ckpt_host_scale_point(target_gb: float) -> dict:
         t_inplace = time.perf_counter() - t0
         if step2 != 1 or restored2["sentinel"][-1] != 4095:
             raise RuntimeError("scale-point in-place restore bad")
+        del restored, restored2, target
+
+        # -- storage plane: striped persist + chain restore ---------------
+        # cold persist: step 2 goes to disk through the striped writer
+        # (agent-less save_to_storage persists in-process, synchronously)
+        t0 = time.perf_counter()
+        if not engine.save_to_storage(2, state):
+            raise RuntimeError("scale-point storage persist failed")
+        t_persist = time.perf_counter() - t0
+        # incremental follow-up: one mutated leaf → a delta link whose
+        # on-disk footprint over the base's is the delta_ratio
+        state["sentinel"] = state["sentinel"] + 1.0
+        if not engine.save_to_storage(3, state):
+            raise RuntimeError("scale-point delta persist failed")
+
+        def _dir_bytes(step: int) -> int:
+            d = os.path.join(ckpt_dir, f"step_{step:08d}")
+            return sum(
+                os.path.getsize(os.path.join(dp, f))
+                for dp, _, fs in os.walk(d) for f in fs
+            )
+
+        base_bytes, delta_bytes = _dir_bytes(2), _dir_bytes(3)
+
+        # chain-cold restore: shm gone (crashed host), a fresh engine
+        # walks the manifest chain — striped reads + CRC on every shard
+        unlink_shared_memory(shm_name(job, 0, 0))
+        engine2 = CheckpointEngine(
+            ckpt_dir, job_name=job + "r", node_rank=0, local_rank=0,
+            ipc_socket="/nonexistent", world_size=1, rank=0,
+        )
+        try:
+            t0 = time.perf_counter()
+            restored3, step3 = engine2.load(state)
+            touched3 = sum(
+                int(x.view(np.uint8).max()) for x in restored3.values()
+            )
+            t_chain_cold = time.perf_counter() - t0
+            if step3 != 3 or touched3 == 0:
+                raise RuntimeError(
+                    f"scale-point chain restore bad: step={step3}")
+            if not np.array_equal(restored3["sentinel"],
+                                  state["sentinel"]):
+                raise RuntimeError("scale-point chain sentinel mismatch")
+            del restored3
+        finally:
+            unlink_shared_memory(shm_name(job + "r", 0, 0))
+
         return {
             "state_gb": round(nbytes / 1e9, 2),
             "backend": "host-shm",
             "t_block_s": round(t_block, 4),
             "t_drain_s": round(t_drain, 3),
             "drain_rate_mbps": round(nbytes / 1e6 / max(t_drain, 1e-9), 0),
-            "t_restore_cold_s": round(t_cold, 3),
-            "restore_cold_rate_mbps": round(
+            "t_restore_shm_cold_s": round(t_cold, 3),
+            "restore_shm_cold_rate_mbps": round(
                 nbytes / 1e6 / max(t_cold, 1e-9), 0
             ),
             "t_restore_s": round(t_inplace, 3),
             "restore_rate_mbps": round(
                 nbytes / 1e6 / max(t_inplace, 1e-9), 0
             ),
+            # storage plane (r05 baseline: serial 86 MB/s cold restore)
+            "t_persist_cold_s": round(t_persist, 3),
+            "persist_cold_rate_mbps": round(
+                nbytes / 1e6 / max(t_persist, 1e-9), 0
+            ),
+            "t_restore_cold_s": round(t_chain_cold, 3),
+            "restore_cold_rate_mbps": round(
+                nbytes / 1e6 / max(t_chain_cold, 1e-9), 0
+            ),
+            "delta_ratio": round(delta_bytes / max(base_bytes, 1), 6),
             "blocking_stays_ms_order": t_block < 0.1,
         }
     finally:
@@ -1331,7 +1389,8 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
             "restore_link_efficiency")),
         "ckpt_host_scale": pick(scale, (
             "state_gb", "t_block_s", "drain_rate_mbps",
-            "restore_rate_mbps")),
+            "restore_rate_mbps", "persist_cold_rate_mbps",
+            "restore_cold_rate_mbps", "delta_ratio")),
         "control_plane": pick(cplane, (
             "world", "p99_speedup_tree_vs_flat", "hb_p99_ms_tree",
             "hb_p99_ms_flat", "false_deaths")),

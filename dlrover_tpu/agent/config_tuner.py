@@ -69,7 +69,7 @@ class ParalConfigTuner:
         tmp = self.config_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(payload, f)
-        os.replace(tmp, self.config_path)
+        os.replace(tmp, self.config_path)  # noqa: DLR012 — advisory tuning hint, torn loss is harmless (rewritten next tick)
         logger.info(
             "paral config v%s written to %s", config.version, self.config_path
         )

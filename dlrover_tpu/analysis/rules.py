@@ -43,6 +43,11 @@ Rule catalogue (motivating incidents in docs/design/static_analysis.md):
   Attributes registered via ``race_detector.shared(...)`` (or marked
   ``# thread-shared``) are cross-thread state; an unlocked mutation is
   the static face of the data races the race_guard catches at runtime.
+- DLR012: atomic-commit discipline. ``os.replace``/``os.rename`` in a
+  function with no flush+fsync publishes a possibly-torn file under the
+  final name (the crash window the chain chaos drills SIGKILL into), and
+  a bare ``open(manifest, "w")`` outside ``ckpt/manifest.py`` bypasses
+  the write-temp → fsync → atomic-replace commit helper entirely.
 """
 
 import ast
@@ -752,5 +757,86 @@ def rule_dlr011_unlocked_shared_mutation(
                 "any `with <lock>:` block — this is exactly the unlocked "
                 "access the race_guard reports at runtime; take the "
                 "owning lock (or # noqa with the reason it is safe)",
+                lines,
+            )
+
+
+# -- DLR012: atomic-commit discipline ------------------------------------------
+
+# the two modules that IMPLEMENT the commit protocol (safe_move,
+# commit_file) are the only places a bare rename-commit is legitimate
+DLR012_ALLOWED_SUFFIXES = ("common/storage.py", "ckpt/manifest.py")
+_MANIFEST_HINT_RE = re.compile(r"(manifest|\.mf\b)", re.IGNORECASE)
+# calls that make the pending bytes durable before the rename publishes
+# them: a raw fsync, or the blessed commit helper (which fsyncs inside)
+_DURABLE_TAILS = {"fsync", "commit_file"}
+_WRITE_MODE_RE = re.compile(r"[wax+]")
+
+
+def _expr_hints(node: ast.expr) -> str:
+    """Concatenated name-ish text of an expression — dotted names,
+    attribute tails, embedded string constants — enough to spot a
+    manifest path flowing through ``os.path.join(d, name + ".mf")`` or
+    ``self.manifest_path``."""
+    parts: List[str] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            parts.append(sub.value)
+        elif isinstance(sub, (ast.Name, ast.Attribute)):
+            d = _dotted(sub)
+            if d:
+                parts.append(d)
+    return " ".join(parts)
+
+
+@_rule
+def rule_dlr012_atomic_commit_discipline(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """rename-commit with no flush+fsync in the same function, or a bare
+    write of a manifest path outside the commit helper."""
+    if path.replace("\\", "/").endswith(DLR012_ALLOWED_SUFFIXES):
+        return
+    for scope, body in _scopes(tree):
+        renames: List[Tuple[ast.Call, str]] = []
+        durable = False
+        for node in _walk_scope(body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name in ("os.replace", "os.rename"):
+                renames.append((node, name))
+            elif name.rsplit(".", 1)[-1] in _DURABLE_TAILS:
+                durable = True
+        if durable:
+            continue
+        for node, name in renames:
+            yield _violation(
+                "DLR012", path, node,
+                f"{name}() commits an artifact with no flush+fsync in "
+                "the same function — a crash can publish a torn file "
+                "under the final name; fsync the temp file first, or "
+                "route the commit through ckpt.manifest.commit_file",
+                lines,
+            )
+    # bare writes of manifest paths bypass the commit protocol entirely
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or _dotted(node.func) != "open":
+            continue
+        mode = node.args[1] if len(node.args) > 1 else _kw(node, "mode")
+        if not (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and _WRITE_MODE_RE.search(mode.value)):
+            continue
+        target = node.args[0] if node.args else None
+        if target is not None and _MANIFEST_HINT_RE.search(
+            _expr_hints(target)
+        ):
+            yield _violation(
+                "DLR012", path, node,
+                "manifest artifact opened for writing outside the commit "
+                "helper — manifest links are crash-consistent only when "
+                "written via ckpt.manifest.commit_file (write-temp → "
+                "fsync → atomic replace)",
                 lines,
             )

@@ -36,23 +36,19 @@ from dlrover_tpu.common.constants import (
 )
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability import tracing
+from dlrover_tpu.observability.registry import get_registry
 from dlrover_tpu.common.storage import (
     CheckpointDeletionStrategy,
     CheckpointStorage,
     PosixDiskStorage,  # noqa: F401 — re-exported for callers
     get_checkpoint_storage,
 )
+from dlrover_tpu.ckpt import manifest
+from dlrover_tpu.ckpt.manifest import (  # noqa: F401 — canonical layout
+    frame_file,
+    step_dir,
+)
 from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler, parse_frame
-
-
-def step_dir(ckpt_dir: str, step: int) -> str:
-    return os.path.join(ckpt_dir, f"step_{step:08d}")
-
-
-def frame_file(ckpt_dir: str, step: int, node_rank: int, local_rank: int) -> str:
-    return os.path.join(
-        step_dir(ckpt_dir, step), f"frame_{node_rank}_{local_rank}.dlrover"
-    )
 
 
 def latest_step(ckpt_dir: str, storage: Optional[CheckpointStorage] = None) -> int:
@@ -72,6 +68,18 @@ def load_frames_for_step(
 ) -> List[Dict]:
     storage = storage or get_checkpoint_storage(ckpt_dir)
     d = step_dir(ckpt_dir, step)
+    if manifest.manifest_links(ckpt_dir, step, storage):
+        # chain layout: reconstruct through the manifest links (delta
+        # shards resolve into ancestor steps' payload files). A chain that
+        # fails verification yields NOTHING — the loose .dlrover files in
+        # a chain-format dir are unverified payloads, not fallbacks.
+        try:
+            return manifest.load_step_frames(ckpt_dir, step, storage)
+        except manifest.ChainError as e:
+            logger.error(
+                "manifest chain for step %s unusable (%s)", step, e.reason
+            )
+            return []
     frames = []
     for name in storage.listdir(d):
         if not name.endswith(".dlrover"):
@@ -109,8 +117,8 @@ def persist_shm_frame(
     step: int,
     storage: Optional[CheckpointStorage] = None,
 ) -> bool:
-    """Persist one shm frame as an atomic file write (used directly by
-    agent-less workers)."""
+    """Persist one shm frame as a manifest chain link (used directly by
+    agent-less workers — same on-disk format as the agent saver)."""
     storage = storage or get_checkpoint_storage(ckpt_dir)
     meta = shm.read_meta()
     if meta is None or meta["step"] != step:
@@ -118,15 +126,21 @@ def persist_shm_frame(
     blob = shm.read_frame_bytes()
     if blob is None:
         return False
-    d = step_dir(ckpt_dir, step)
-    storage.safe_makedirs(d)
-    target = frame_file(ckpt_dir, step, meta["node_rank"], meta["local_rank"])
-    tmp = target + ".tmp"
-    storage.write(blob, tmp)
-    storage.safe_move(tmp, target)
+    # prev_state=None: the chain tip is re-seeded from the on-disk
+    # manifests, so restarted single-process jobs still write deltas
+    pool = ThreadPoolExecutor(
+        max_workers=get_context().ckpt_save_workers,
+        thread_name_prefix="ckpt-persist",
+    )
+    try:
+        manifest.persist_frame(
+            storage, ckpt_dir, step, meta, blob, executor=pool
+        )
+    finally:
+        pool.shutdown(wait=False)
     # agent-less path commits immediately (single process owns the dir)
     tracker = os.path.join(ckpt_dir, CheckpointConstant.TRACKER_FILE)
-    storage.write(str(step), tracker)
+    manifest.commit_file(storage, str(step), tracker, step=step)
     return True
 
 
@@ -173,6 +187,22 @@ class AsyncCheckpointSaver:
         # race detector, accessed only under _lock
         self._persisted_steps: Dict[str, int] = shared(
             {}, "AsyncCheckpointSaver._persisted_steps")
+        # (path, frame) → chain tip state from the last committed link:
+        # per-shard digests (the delta decision) + resolved shard map.
+        # Same thread-crossing as _persisted_steps — registered with the
+        # race detector, accessed only under _lock
+        self._chain_state: Dict[str, Dict] = shared(
+            {}, "AsyncCheckpointSaver._chain_state")
+        reg = get_registry()
+        self._persist_bytes = reg.counter(
+            "dlrover_ckpt_persist_bytes_total",
+            "Checkpoint payload bytes persisted to storage",
+            labelnames=("kind",),
+        )
+        self._persist_frames = reg.counter(
+            "dlrover_ckpt_persist_frames_total",
+            "Checkpoint frame links committed", labelnames=("kind",),
+        )
         self._lock = threading.Lock()
         # serializes tracker check+write across the event thread and any
         # async breakpoint-commit threads (the monotonic check is useless
@@ -268,12 +298,14 @@ class AsyncCheckpointSaver:
             step=step,
         ) as sp:
             handlers = self._local_shm_handlers()
-            futures = [
-                (shm,
-                 self._executor.submit(self._persist_one, shm, path, step))
-                for shm in handlers
+            # frames persist sequentially; the parallelism lives INSIDE
+            # each persist (stripe fan-out over self._executor in
+            # manifest.persist_frame). Submitting frames to the same pool
+            # their stripes need would deadlock it.
+            persisted = [
+                shm for shm in handlers
+                if self._persist_one(shm, path, step)
             ]
-            persisted = [shm for shm, f in futures if f.result()]
             sp.add_event("persisted", frames=len(persisted),
                          handlers=len(handlers))
             if not persisted:
@@ -339,16 +371,29 @@ class AsyncCheckpointSaver:
         finally:
             if lock is not None:
                 lock.release()
-        d = step_dir(path, step)
-        self._storage.safe_makedirs(d)
-        target = frame_file(path, step, meta["node_rank"], meta["local_rank"])
-        tmp = target + ".tmp"
-        self._storage.write(blob, tmp)
-        self._storage.safe_move(tmp, target)
+        chain_key = f"{path}|{shm.name}"
         with self._lock:
+            prev = self._chain_state.get(chain_key)
+        try:
+            state = manifest.persist_frame(
+                self._storage, path, step, meta, blob,
+                prev_state=prev, executor=self._executor,
+            )
+        except Exception:  # noqa: BLE001 — a failed persist holds the quorum open
+            logger.exception(
+                "persist of %s for step %s failed — no done marker, the "
+                "commit quorum stays open", shm.name, step,
+            )
+            return False
+        with self._lock:
+            self._chain_state[chain_key] = state
+            # a frame counts as "persisted at step N" only once its
+            # manifest link committed — payload files alone are invisible
+            # to restore, so the breakpoint-save skip must not trust them
             self._persisted_steps[shm.name] = step
-        logger.info("persisted %s (%.1f MB) for step %s",
-                    os.path.basename(target), len(blob) / 1e6, step)
+        self._persist_bytes.labels(kind=state["kind"]).inc(
+            state["bytes_written"])
+        self._persist_frames.labels(kind=state["kind"]).inc()
         return True
 
     def _write_done_files(
@@ -407,9 +452,12 @@ class AsyncCheckpointSaver:
                 logger.info("checkpoint step %s committed (%s frames)",
                             step, count)
                 if self._deletion_strategy is not None:
+                    # chain-aware GC: never collects a link on a live
+                    # tip's digest walk or a payload a live link resolves
+                    # into (a delta step keeps its base reachable)
                     self._deletion_strategy.clean_up(
                         step,
-                        lambda s: self._storage.safe_rmtree(step_dir(path, s)),
+                        lambda s: manifest.gc_step(self._storage, path, s),
                     )
                 return True
             if self._stopped.is_set():

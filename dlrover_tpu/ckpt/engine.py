@@ -711,7 +711,8 @@ class CheckpointEngine:
             restore_t0 = time.monotonic()
             self._report_event(JournalEvent.RESTORE_START)
             # degradation ladder, each rung journaled with its reason:
-            # live reshard → peer-frame restore → shm flash → storage
+            # live reshard → shm flash → manifest chain → peer-frame
+            # restore → legacy storage
             state, step = self._load_via_reshard(target, restore_t0)
             if state is not None:
                 sp.add_event("restored", medium="reshard", step=step)
@@ -736,6 +737,14 @@ class CheckpointEngine:
                     sp.add_event("restored", medium="shm", step=step)
                     self._finish_restore(restore_t0, "shm", step)
                     return state, step
+            state, step = self._load_from_chain(
+                target, path or self.ckpt_dir
+            )
+            if state is not None:
+                logger.info("restored step %s from manifest chain", step)
+                sp.add_event("restored", medium="chain", step=step)
+                self._finish_restore(restore_t0, "chain", step)
+                return state, step
             state, step = self._load_from_peer_frames(target)
             if state is not None:
                 logger.info("restored step %s from replica peer frames",
@@ -973,6 +982,79 @@ class CheckpointEngine:
                 continue
             return state, step
         return None, -1
+
+    def _load_from_chain(self, target, path: str) -> Tuple[Any, int]:
+        """Manifest-chain rung: walk storage's newest manifest chain,
+        digest-verify every link tip→base and CRC-verify every payload
+        range, falling back link-by-link to the last provably complete
+        step; each rejected candidate is journaled ``ckpt_chain_truncated``
+        with its reason. Yields to the peer-replica rung when live peers
+        hold a NEWER step than the newest committed chain — a relaunched
+        node must not elect stale disk state over fresher replica copies.
+        Returns (None, -1) on any failure (including a missing base) so
+        the ladder keeps degrading."""
+        from dlrover_tpu.ckpt import manifest
+
+        if not path:
+            return None, -1
+        newest = manifest.newest_candidate_step(path)
+        if newest < 0:
+            return None, -1
+        if self._replicas is not None:
+            peer_newest = getattr(self._replicas, "newest_step", None)
+            if peer_newest is not None:
+                try:
+                    peer = peer_newest()
+                except (ConnectionError, OSError, RuntimeError):
+                    peer = -1
+                if peer > newest:
+                    logger.info(
+                        "replica peers hold step %s, newer than the chain "
+                        "tip %s — deferring to the peer-frame rung",
+                        peer, newest,
+                    )
+                    return None, -1
+
+        def on_truncate(step: int, reason: str) -> None:
+            logger.error(
+                "checkpoint chain at step %s failed verification (%s) — "
+                "falling back to an older link", step, reason,
+            )
+            self._report_event(
+                JournalEvent.CKPT_CHAIN_TRUNCATED,
+                {"step": step, "reason": reason},
+            )
+
+        with tracing.span(
+            SpanName.CKPT_CHAIN_RESTORE, source=f"worker_{self.rank}",
+        ) as sp:
+            try:
+                step, frames = manifest.load_newest_chain(
+                    path, on_truncate=on_truncate
+                )
+            except (OSError, ValueError, KeyError) as e:
+                logger.warning("chain restore failed: %r", e)
+                return None, -1
+            if step < 0 or not frames:
+                return None, -1
+            from dlrover_tpu.ckpt.ckpt_saver import merge_frame_leaves
+            from dlrover_tpu.ckpt.shm_handler import frame_shard_bytes
+
+            merged = merge_frame_leaves(frames)
+
+            def reader(leaf_meta, shard_meta):
+                return frame_shard_bytes(shard_meta["_frame"], shard_meta)
+
+            try:
+                state = _assemble(target, merged, reader)
+            except (KeyError, ValueError) as e:
+                logger.warning(
+                    "chain frames at step %s don't cover the state (%s)",
+                    step, e,
+                )
+                return None, -1
+            sp.add_event("restored", step=step, frames=len(frames))
+            return state, step
 
     def _load_from_storage(self, target, path: str) -> Tuple[Any, int]:
         from dlrover_tpu.ckpt.ckpt_saver import (

@@ -398,6 +398,17 @@ class ReplicaManager:
             )
         return sorted(set(entries))
 
+    def newest_step(self) -> int:
+        """Newest step any replica store holds (-1 when none reachable) —
+        the engine's chain rung compares this against the newest on-disk
+        manifest so a relaunched node never elects stale storage over
+        fresher peer-held frames."""
+        try:
+            entries = self.list_entries()
+        except (ConnectionError, OSError, RuntimeError):
+            return -1
+        return max((int(s) for _, _, s in entries), default=-1)
+
     def fetch_frame(self, owner_rank: int,
                     local_rank: int = 0) -> Optional[Tuple[int, bytes]]:
         """Fetch ANY owner's frame from whichever store holds the newest

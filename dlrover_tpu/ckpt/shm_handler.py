@@ -40,6 +40,21 @@ from dlrover_tpu.common.multi_process import (
 
 _U64 = struct.Struct("<Q")
 _CRC = struct.Struct(">I")
+# 8-byte content digest (crc32 + adler32) stamped per shard next to the
+# CRC — the incremental saver (ckpt/manifest.py) compares these across
+# steps to find dirty shards without hashing the frame again; two
+# independent 32-bit checksums make a silent delta-skip collision
+# vanishingly unlikely at adler/crc cost (no cryptographic hash in the
+# drain path)
+_DIG = struct.Struct(">II")
+
+
+def shard_digest(data) -> bytes:
+    """The 8-byte content digest of one shard's bytes (same function the
+    frame writer stamps into the sealed meta as ``dig``)."""
+    return _DIG.pack(
+        zlib.crc32(data) & 0xFFFFFFFF, zlib.adler32(data) & 0xFFFFFFFF
+    )
 
 # per-shard CRC32 stamping on frame writes; on by default, env-gated for
 # benchmarking the raw write path
@@ -217,6 +232,7 @@ class SharedMemoryHandler:
                 for shard in leaf.get("shards", []):
                     if expected.get(shard["offset"]) == shard["nbytes"]:
                         shard["crc"] = b"\x00\x00\x00\x00"
+                        shard["dig"] = b"\x00" * 8
         header = pack_frame(meta)
         data_start = len(header)
         total = data_start + sum(int(b.nbytes) for b in buffers)
@@ -251,12 +267,17 @@ class SharedMemoryHandler:
         buf[:8] = _U64.pack(0)
         pos = data_start
         crcs: Dict[int, int] = {}
+        digs: Dict[int, bytes] = {}
         for b in buffers:
             flat = np.ascontiguousarray(b).view(np.uint8).reshape(-1)
             n = flat.nbytes
             buf[pos : pos + n] = flat.data
             if compute_crc:
-                crcs[pos - data_start] = zlib.crc32(flat.data) & 0xFFFFFFFF
+                rel = pos - data_start
+                crcs[rel] = zlib.crc32(flat.data) & 0xFFFFFFFF
+                digs[rel] = _DIG.pack(
+                    crcs[rel], zlib.adler32(flat.data) & 0xFFFFFFFF
+                )
             pos += n
         if compute_crc:
             for leaf in meta["leaves"]:
@@ -264,6 +285,9 @@ class SharedMemoryHandler:
                     crc = crcs.get(shard["offset"])
                     if crc is not None and "crc" in shard:
                         shard["crc"] = _CRC.pack(crc)
+                    dig = digs.get(shard["offset"])
+                    if dig is not None and "dig" in shard:
+                        shard["dig"] = dig
             sealed = pack_frame(meta)
             assert len(sealed) == len(header), "CRC stamp changed header size"
             header = sealed

@@ -174,6 +174,13 @@ class CheckpointConstant:
     DONE_DIR = "._done"
     TEMP_DIR_PREFIX = "._tmp_"
     SAVE_TIMEOUT_S = 600
+    # incremental-chain layout (ckpt/manifest.py): one manifest link per
+    # frame per step, committed write-temp → fsync → atomic replace; delta
+    # links reference unchanged shards in ancestor steps' payload files
+    MANIFEST_PREFIX = "manifest_"
+    MANIFEST_SUFFIX = ".mf"
+    DELTA_PREFIX = "delta_"
+    FRAME_SUFFIX = ".dlrover"
 
 
 class SharedResourceName:
@@ -242,6 +249,12 @@ class ConfigKey:
     CKPT_READY_TIMEOUT = "DLROVER_TPU_CKPT_READY_TIMEOUT"
     CKPT_READY_COOLDOWN = "DLROVER_TPU_CKPT_READY_COOLDOWN"
     CKPT_STORAGE_WAIT = "DLROVER_TPU_CKPT_STORAGE_WAIT"
+    # incremental persistence plane (ckpt/manifest.py): dirty-shard delta
+    # checkpoints on/off, max delta links before a full-rebase compaction,
+    # and the stripe size (bytes) for parallel cold persists/restores
+    CKPT_DELTA = "DLROVER_TPU_CKPT_DELTA"
+    CKPT_CHAIN_MAX = "DLROVER_TPU_CKPT_CHAIN_MAX"
+    CKPT_STRIPE_BYTES = "DLROVER_TPU_CKPT_STRIPE_BYTES"
     # live resharding (ckpt/reshard.py): enable flag (default on), per-peer
     # RPC timeout for shard-region fetches, and how long a worker waits for
     # survivor agents to publish their reshard service addresses
@@ -313,6 +326,9 @@ class SpanName:
     CKPT_PERSIST = "ckpt.persist"
     CKPT_COMMIT = "ckpt.commit"
     CKPT_RESTORE = "ckpt.restore"
+    # incremental-chain storage restore (engine._load_from_chain): the
+    # newest-first candidate walk + striped frame reconstruction
+    CKPT_CHAIN_RESTORE = "ckpt.chain_restore"
     # live-reshard arc (ckpt/reshard.py planner/executor, served by the
     # agent's ReshardService; one trace_id spans plan → transfers → apply)
     RESHARD_PLAN = "reshard.plan"

@@ -67,6 +67,39 @@ class CheckpointStorage(ABC):
     @abstractmethod
     def read(self, path: str, mode: str = "rb"): ...
 
+    def read_at(self, path: str, offset: int, nbytes: int):
+        """Bytes ``[offset, offset+nbytes)`` of ``path``; None when the
+        file is missing or shorter than the requested range. Default:
+        whole-file read + slice; POSIX overrides with pread so striped
+        chain restores don't re-read a multi-GB frame per shard."""
+        blob = self.read(path)
+        if blob is None or len(blob) < offset + nbytes:
+            return None
+        return blob[offset : offset + nbytes]
+
+    def write_stripes(self, path: str, total: int, stripes,
+                      executor=None) -> None:
+        """Write ``stripes`` — an iterable of ``(offset, bytes-like,
+        ctx-dict)`` covering ``[0, total)`` — as one file at ``path``.
+        Fires the ``storage.persist`` chaos site once per stripe (the
+        mid-persist kill window the crash drills exercise). Default:
+        assemble in memory and do one durable write; POSIX overrides with
+        parallel pwrite so cold persist scales with shard count.
+
+        Visibility contract: the file at ``path`` is NOT atomic — callers
+        must gate readers on a separately committed manifest (or write to
+        a temp name and ``safe_move`` it themselves)."""
+        from dlrover_tpu.chaos import get_injector
+
+        inj = get_injector()
+        buf = bytearray(total)
+        for offset, data, ctx in stripes:
+            if inj is not None:
+                inj.fire("storage.persist", path=path, offset=offset,
+                         **(ctx or {}))
+            buf[offset : offset + len(data)] = data
+        self.write(buf, path)
+
     @abstractmethod
     def safe_rmtree(self, dir_path: str) -> None: ...
 
@@ -104,6 +137,59 @@ class PosixDiskStorage(CheckpointStorage):
             return None
         with open(path, mode) as f:
             return f.read()
+
+    def read_at(self, path: str, offset: int, nbytes: int):
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return None
+        try:
+            buf = bytearray(nbytes)
+            mv = memoryview(buf)
+            pos = 0
+            while pos < nbytes:
+                got = os.preadv(fd, [mv[pos:]], offset + pos)
+                if got <= 0:
+                    return None
+                pos += got
+            return buf
+        except OSError:
+            return None
+        finally:
+            os.close(fd)
+
+    def write_stripes(self, path: str, total: int, stripes,
+                      executor=None) -> None:
+        from dlrover_tpu.chaos import get_injector
+
+        inj = get_injector()
+        fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+        try:
+            os.ftruncate(fd, total)
+
+            def _one(offset, data, ctx):
+                if inj is not None:
+                    inj.fire("storage.persist", path=path, offset=offset,
+                             **(ctx or {}))
+                mv = memoryview(data)
+                pos = 0
+                while pos < len(mv):
+                    pos += os.pwrite(fd, mv[pos:], offset + pos)
+
+            stripes = list(stripes)
+            if executor is None or len(stripes) <= 1:
+                for offset, data, ctx in stripes:
+                    _one(offset, data, ctx)
+            else:
+                futures = [
+                    executor.submit(_one, offset, data, ctx)
+                    for offset, data, ctx in stripes
+                ]
+                for f in futures:
+                    f.result()
+            os.fsync(fd)
+        finally:
+            os.close(fd)
 
     def safe_rmtree(self, dir_path: str) -> None:
         shutil.rmtree(dir_path, ignore_errors=True)

@@ -225,7 +225,7 @@ class FlightRecorder:
             "files": sorted(os.listdir(bundle_dir)) + ["manifest.json"],
             **extra,
         }
-        with open(os.path.join(bundle_dir, "manifest.json"), "w") as f:
+        with open(os.path.join(bundle_dir, "manifest.json"), "w") as f:  # noqa: DLR012 — crash-bundle index, best-effort debug data, not a ckpt commit
             json.dump(manifest, f, indent=2, sort_keys=True)
 
         if self._bundles_total is not None:

@@ -72,6 +72,11 @@ class JournalEvent:
     # master's backpressure level changed (telemetry shed before liveness)
     FANIN_REPARENTED = "fanin_reparented"
     FANIN_BACKPRESSURE = "fanin_backpressure"
+    # incremental-chain storage restore (ckpt/manifest.py via
+    # engine._load_from_chain): a candidate step's manifest chain failed
+    # verification (torn/incomplete/corrupt) and restore fell back to an
+    # older link — informational, no phase transition
+    CKPT_CHAIN_TRUNCATED = "ckpt_chain_truncated"
 
     ALL = (
         FAULT_DETECTED, RDZV_START, RDZV_COMPLETE, RESTORE_START,
@@ -80,7 +85,7 @@ class JournalEvent:
         SHM_ORPHANS_CLEANED, STRAGGLER_DETECTED, HANG_ATTRIBUTED,
         STACK_DUMP_CAPTURED, TRACE_BUNDLE_CAPTURED, RESHARD_PLANNED,
         RESHARD_START, RESHARD_COMPLETE, RESHARD_ABORTED,
-        FANIN_REPARENTED, FANIN_BACKPRESSURE,
+        FANIN_REPARENTED, FANIN_BACKPRESSURE, CKPT_CHAIN_TRUNCATED,
     )
 
 

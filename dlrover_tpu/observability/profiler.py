@@ -115,7 +115,8 @@ class ProfileListener:
             try:
                 jax.profiler.stop_trace()
             except Exception:  # noqa: BLE001 — may not have started
-                pass
+                logger.debug("stop_trace after failed capture: trace was "
+                             "never started", exc_info=True)
         try:
             self._dict.set(done_key(self._local_rank), {
                 "id": req.get("id"), "dir": out_dir, "ok": ok,

@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from dlrover_tpu.common.log import logger
+
 _DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
     60.0, 120.0, 300.0,
@@ -173,6 +175,8 @@ class Gauge(_Metric):
         try:
             return float(fn())
         except Exception:  # noqa: BLE001 — a broken callback must not 500
+            logger.debug("gauge %s value callback failed; scraping NaN",
+                         self.name, exc_info=True)
             return float("nan")
 
     def _own_samples(self, labels):
@@ -294,7 +298,8 @@ class MetricsRegistry:
             try:
                 fn()
             except Exception:  # noqa: BLE001 — a bad hook must not 500
-                pass
+                logger.warning("metrics collect hook %r failed; rendering "
+                               "without its update", fn, exc_info=True)
         blocks = [m.render() for _, m in metrics]
         body = "\n".join(b for b in blocks if b)
         return body + "\n" if body else ""

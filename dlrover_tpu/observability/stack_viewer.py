@@ -22,6 +22,8 @@ import urllib.request
 from collections import Counter
 from typing import Dict, Iterable, List
 
+from dlrover_tpu.common.log import logger
+
 _THREAD_RE = re.compile(r"^(Current thread|Thread) (0x[0-9a-f]+)")
 _FRAME_RE = re.compile(r'^\s+File "([^"]+)", line (\d+) in (.+)$')
 
@@ -122,6 +124,7 @@ def sample(daemon_port: int = 18889, rounds: int = 20,
                 f"http://127.0.0.1:{daemon_port}/dump_stack", timeout=3
             ).read()
         except Exception:  # noqa: BLE001 — daemon may not be up yet
-            pass
+            logger.debug("dump_stack poll on port %s failed (daemon may "
+                         "not be up yet)", daemon_port, exc_info=True)
         time.sleep(interval_s)
     return collapse_dump_files(out_path=out_path, offsets=offsets)

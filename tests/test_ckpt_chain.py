@@ -1,0 +1,354 @@
+"""Chain algebra for incremental checkpoints (ckpt/manifest.py): a delta
+chain must be byte-equivalent to a full save, compaction must not strand
+mid-chain steps, GC must never collect a link or payload reachable from a
+live manifest, and a chain whose base is gone must fall through to the
+peer-replica rung — plus race-detector certification of the saver's
+save → persist → compact cycle."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.ckpt import manifest
+from dlrover_tpu.ckpt.shm_handler import (
+    SharedMemoryHandler,
+    frame_shard_bytes,
+    shm_name,
+)
+from dlrover_tpu.common.constants import ConfigKey
+from dlrover_tpu.common.multi_process import (
+    LocalIPCServer,
+    unlink_shared_memory,
+)
+from dlrover_tpu.common.storage import PosixDiskStorage
+
+JOB = f"chaintest{os.getpid()}"
+
+
+def _seal(handler, step, arrs, paths=None):
+    """Seal ``arrs`` ({name: np.ndarray}) as one frame at ``step``."""
+    leaves, bufs, off = [], [], 0
+    for k in sorted(arrs):
+        a = arrs[k]
+        leaves.append({
+            "path": (paths or {}).get(k, k), "kind": "array",
+            "dtype": str(a.dtype), "gshape": list(a.shape),
+            "shards": [{"offset": off, "nbytes": a.nbytes,
+                        "lshape": list(a.shape), "start": [0] * a.ndim}],
+        })
+        bufs.append(a)
+        off += a.nbytes
+    meta = {"step": step, "ts": 0.0, "job": JOB, "node_rank": 0,
+            "local_rank": 0, "rank": 0, "world_size": 1,
+            "expected_frames": 1, "leaves": leaves}
+    handler.write_frame(meta, bufs)
+
+
+def _persist(handler, ckpt_dir, step, storage):
+    return manifest.persist_frame(
+        storage, ckpt_dir, step, handler.read_meta(),
+        handler.read_frame_bytes(),
+    )
+
+
+def _leaf_arrays(frame):
+    """{path: concatenated shard bytes} of a reconstructed frame."""
+    out = {}
+    for leaf in frame["leaves"]:
+        out[leaf["path"]] = b"".join(
+            bytes(frame_shard_bytes(frame, sh)) for sh in leaf["shards"]
+        )
+    return out
+
+
+@pytest.fixture()
+def handler():
+    h = SharedMemoryHandler(f"chaintest_{os.getpid()}")
+    yield h
+    h.unlink()
+
+
+def test_delta_over_delta_equals_full_save(tmp_path, handler):
+    """Reconstructing through two stacked deltas must produce the exact
+    bytes a full save of the final state would."""
+    storage = PosixDiskStorage()
+    chain_dir = str(tmp_path / "chain")
+    full_dir = str(tmp_path / "full")
+    arrs = {"w": np.arange(2048, dtype=np.float32),
+            "b": np.zeros(512, dtype=np.float32)}
+    _seal(handler, 1, arrs)
+    assert _persist(handler, chain_dir, 1, storage)["kind"] == "base"
+    arrs["b"] = arrs["b"] + 3
+    _seal(handler, 2, arrs)
+    s2 = _persist(handler, chain_dir, 2, storage)
+    arrs["w"] = arrs["w"] * 2
+    _seal(handler, 3, arrs)
+    s3 = _persist(handler, chain_dir, 3, storage)
+    assert s2["kind"] == "delta" and s3["kind"] == "delta"
+    # each delta persisted only the changed shard's bytes
+    assert s2["bytes_written"] == 512 * 4
+    assert s3["bytes_written"] == 2048 * 4
+    # the same final state as ONE full save into a fresh dir
+    _seal(handler, 3, arrs)
+    _persist(handler, full_dir, 3, storage)
+    step_c, frames_c = manifest.load_newest_chain(chain_dir, storage)
+    step_f, frames_f = manifest.load_newest_chain(full_dir, storage)
+    assert step_c == step_f == 3
+    assert _leaf_arrays(frames_c[0]) == _leaf_arrays(frames_f[0])
+
+
+def test_compaction_rebases_and_preserves_mid_chain_steps(
+    tmp_path, handler, monkeypatch
+):
+    """After ``CKPT_CHAIN_MAX`` delta links the next save full-rebases;
+    steps in the middle of the old chain stay restorable."""
+    monkeypatch.setenv(ConfigKey.CKPT_CHAIN_MAX, "2")
+    storage = PosixDiskStorage()
+    d = str(tmp_path)
+    arrs = {"w": np.arange(1024, dtype=np.float32)}
+    kinds = {}
+    for step in range(1, 5):
+        arrs["w"] = arrs["w"] + 1
+        _seal(handler, step, arrs)
+        kinds[step] = _persist(handler, d, step, storage)["kind"]
+    # 1=base, 2=delta (len 2 == max), 3=rebase, 4=delta on the new base
+    assert kinds == {1: "base", 2: "delta", 3: "base", 4: "delta"}
+    # a step mid-way through the OLD chain is still fully restorable
+    frames = manifest.load_step_frames(d, 2, storage)
+    want = (np.arange(1024, dtype=np.float32) + 2).tobytes()
+    assert _leaf_arrays(frames[0])["w"] == want
+
+
+def test_gc_never_collects_link_reachable_from_newest_manifest(
+    tmp_path, handler
+):
+    """GC of an old step must keep every link on the newest complete
+    manifest's digest walk and every payload file it resolves into."""
+    storage = PosixDiskStorage()
+    d = str(tmp_path)
+    arrs = {"w": np.arange(1024, dtype=np.float32),
+            "b": np.ones(256, dtype=np.float32)}
+    _seal(handler, 1, arrs)
+    _persist(handler, d, 1, storage)
+    arrs["b"] = arrs["b"] * 5
+    _seal(handler, 2, arrs)
+    _persist(handler, d, 2, storage)
+    arrs["b"] = arrs["b"] + 1
+    _seal(handler, 3, arrs)
+    _persist(handler, d, 3, storage)
+    # victim 1 carries the base LINK and the base payload both deltas
+    # resolve "w" into; victim 2's link is on step 3's digest walk
+    manifest.gc_step(storage, d, 1)
+    manifest.gc_step(storage, d, 2)
+    assert os.path.exists(manifest.manifest_file(d, 1, 0, 0))
+    assert os.path.exists(manifest.frame_file(d, 1, 0, 0))
+    assert os.path.exists(manifest.manifest_file(d, 2, 0, 0))
+    step, frames = manifest.load_newest_chain(d, storage)
+    assert step == 3
+    got = _leaf_arrays(frames[0])
+    assert got["w"] == np.arange(1024, dtype=np.float32).tobytes()
+    assert got["b"] == (np.ones(256, dtype=np.float32) * 5 + 1).tobytes()
+
+
+def test_gc_removes_steps_unreachable_after_rebase(tmp_path, handler):
+    """Once a later save full-rebased, the old chain's artifacts are
+    unreferenced and GC removes the victim dirs entirely."""
+    storage = PosixDiskStorage()
+    d = str(tmp_path)
+    arrs = {"w": np.arange(1024, dtype=np.float32)}
+    _seal(handler, 1, arrs)
+    _persist(handler, d, 1, storage)
+    arrs["w"] = arrs["w"] + 1
+    _seal(handler, 2, arrs)
+    _persist(handler, d, 2, storage)
+    # force a rebase by changing the shard layout (different shapes)
+    arrs = {"w": np.arange(2048, dtype=np.float32)}
+    _seal(handler, 3, arrs)
+    assert _persist(handler, d, 3, storage)["kind"] == "base"
+    manifest.gc_step(storage, d, 1)
+    manifest.gc_step(storage, d, 2)
+    assert not os.path.isdir(manifest.step_dir(d, 1))
+    assert not os.path.isdir(manifest.step_dir(d, 2))
+    step, frames = manifest.load_newest_chain(d, storage)
+    assert step == 3
+    assert _leaf_arrays(frames[0])["w"] == arrs["w"].tobytes()
+
+
+def test_agentless_restart_seeds_chain_from_disk(tmp_path, handler):
+    """A restarted single-process saver (no in-memory chain state) must
+    seed the tip from the on-disk manifests and keep writing deltas."""
+    storage = PosixDiskStorage()
+    d = str(tmp_path)
+    arrs = {"w": np.arange(1024, dtype=np.float32),
+            "b": np.ones(256, dtype=np.float32)}
+    _seal(handler, 1, arrs)
+    _persist(handler, d, 1, storage)
+    # "restart": prev_state=None forces the disk-seeding path
+    arrs["b"] = arrs["b"] * 2
+    _seal(handler, 2, arrs)
+    state = manifest.persist_frame(
+        storage, d, 2, handler.read_meta(), handler.read_frame_bytes(),
+        prev_state=None,
+    )
+    assert state["kind"] == "delta"
+    assert state["bytes_written"] == 256 * 4
+    step, frames = manifest.load_newest_chain(d, storage)
+    assert step == 2
+    assert _leaf_arrays(frames[0])["b"] == (
+        np.ones(256, dtype=np.float32) * 2
+    ).tobytes()
+
+
+# -- ladder fall-through (missing base → peer-replica rung) -----------------
+
+
+class _StubMaster:
+    def __init__(self):
+        self.events = []
+
+    def kv_set(self, key, value):
+        pass
+
+    def report_event(self, kind, data=None):
+        self.events.append((kind, data or {}))
+
+
+class _FakeReplicas:
+    """Peer-replica tier holding one clean frame at ``step``."""
+
+    def __init__(self, step, blob):
+        self._step = step
+        self._blob = blob
+
+    def try_restore_shm(self, shm, local_rank, force=False):
+        return -1
+
+    def newest_step(self):
+        return self._step
+
+    def list_entries(self):
+        return [(0, 0, self._step)]
+
+    def fetch_frame(self, owner_rank, local_rank=0):
+        return self._step, self._blob
+
+
+def test_chain_with_missing_base_falls_through_to_peer_rung(tmp_path):
+    """Delete the base link under a two-link chain: the chain rung must
+    journal the truncations and return nothing, and the ladder's next
+    rung (peer-replica frames) must serve the restore."""
+    from dlrover_tpu.ckpt.engine import CheckpointEngine
+
+    storage = PosixDiskStorage()
+    d = str(tmp_path / "ckpt")
+    handler = SharedMemoryHandler(f"chainbase_{os.getpid()}")
+    try:
+        arrs = {"w": np.arange(512, dtype=np.float32)}
+        paths = {"w": "['w']"}
+        _seal(handler, 6, arrs, paths=paths)
+        _persist(handler, d, 6, storage)
+        arrs["w"] = arrs["w"] + 1
+        _seal(handler, 7, arrs, paths=paths)
+        assert _persist(handler, d, 7, storage)["kind"] == "delta"
+        # the peer tier holds an OLDER step 5 — the freshness guard lets
+        # the (newer) chain try first; only after the chain proves torn
+        # does the ladder fall to the peer rung
+        peer_w = np.full(512, 9.0, dtype=np.float32)
+        _seal(handler, 5, {"w": peer_w}, paths=paths)
+        peer_blob = bytes(handler.read_frame_bytes())
+        os.remove(manifest.manifest_file(d, 6, 0, 0))
+        unlink_shared_memory(shm_name(JOB, 0, 0))
+        stub = _StubMaster()
+        engine = CheckpointEngine(
+            d, job_name=JOB, node_rank=0, local_rank=0,
+            ipc_socket="/nonexistent", world_size=1, rank=0,
+            master_client=stub,
+            replica_manager=_FakeReplicas(5, peer_blob),
+        )
+        restored, step = engine.load({"w": np.zeros(512, dtype=np.float32)})
+        assert step == 5
+        np.testing.assert_array_equal(np.asarray(restored["w"]), peer_w)
+        truncs = {d_["step"]: d_["reason"] for k, d_ in stub.events
+                  if k == "ckpt_chain_truncated"}
+        assert truncs.get(7) == "missing_link"
+        # step 6's dir still holds payloads but no committed link
+        assert truncs.get(6) == "no_committed_links"
+    finally:
+        handler.unlink()
+        unlink_shared_memory(shm_name(JOB, 0, 0))
+
+
+# -- race-detector certification of the full saver cycle --------------------
+
+
+@pytest.fixture()
+def agent_ipc(tmp_path):
+    server = LocalIPCServer(str(tmp_path / "ipc.sock"))
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_chain_save_persist_compact_cycle_race_free(
+    tmp_path, agent_ipc, race_guard, monkeypatch
+):
+    """Three saves through the real agent saver — base, delta, rebase
+    (CKPT_CHAIN_MAX=2) — with GC of the oldest step, certified free of
+    unsynchronized access to the saver's shared ``_persisted_steps`` and
+    ``_chain_state`` maps by the happens-before detector."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dlrover_tpu.ckpt.ckpt_saver import (
+        AsyncCheckpointSaver,
+        latest_step,
+    )
+    from dlrover_tpu.ckpt.engine import CheckpointEngine
+    from dlrover_tpu.common.storage import KeepLatestStepStrategy
+
+    monkeypatch.setenv(ConfigKey.CKPT_CHAIN_MAX, "2")
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devices, ("data", "model"))
+    ckpt_dir = str(tmp_path / "ckpt")
+    saver = AsyncCheckpointSaver(
+        ckpt_dir=ckpt_dir, node_rank=0, local_world_size=1,
+        expected_frames=1,
+        deletion_strategy=KeepLatestStepStrategy(2, ckpt_dir),
+    )
+    saver.start(agent_ipc)
+    try:
+        engine = CheckpointEngine(
+            ckpt_dir, job_name=JOB, node_rank=0, local_rank=0,
+            ipc_socket=agent_ipc.path, world_size=1, rank=0,
+        )
+
+        def state_at(v):
+            w = jax.device_put(
+                jnp.full((8, 8), float(v), dtype=jnp.float32),
+                NamedSharding(mesh, P("data", "model")),
+            )
+            return {"w": w}
+
+        for step in (31, 32, 33):
+            state = state_at(step)
+            assert engine.save_to_storage(step, state)
+            deadline = time.time() + 20
+            while latest_step(ckpt_dir) != step and time.time() < deadline:
+                time.sleep(0.05)
+            assert latest_step(ckpt_dir) == step
+        assert race_guard.tracked_created > 0, (
+            "the saver's shared() registrations never engaged"
+        )
+        restored, step = engine.load(state_at(0))
+        assert step == 33
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]),
+            np.full((8, 8), 33.0, dtype=np.float32),
+        )
+        assert race_guard.races == [], race_guard.report()
+    finally:
+        saver.stop()
+        unlink_shared_memory(shm_name(JOB, 0, 0))

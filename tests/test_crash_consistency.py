@@ -235,6 +235,168 @@ def test_bitflip_detected_and_repaired_from_replica(tmp_path):
         unlink_shared_memory(shm_name(job, 1, 0))
 
 
+# -- saver SIGKILL drills (manifest chain torn-window coverage) -------------
+#
+# The two windows where an incremental persist can die with payload bytes
+# on disk but no committed link: (a) after the delta payload landed but
+# before the manifest's atomic replace, (b) between two striped shard
+# writes. Both must leave the previous step as the restore point, journal
+# the truncation, and never produce a corrupt load.
+
+SAVER = '''
+import sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from dlrover_tpu import chaos
+from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler
+from dlrover_tpu.ckpt.ckpt_saver import persist_shm_frame
+
+shm = SharedMemoryHandler({name!r})
+w = np.arange(1 << 12, dtype=np.float32)
+b = np.ones(1 << 10, dtype=np.float32)
+
+def seal(step, w, b):
+    meta = {{"step": step, "ts": time.time(), "job": "chainkill",
+            "node_rank": 0, "local_rank": 0, "expected_frames": 1,
+            "leaves": [
+                {{"path": "['w']", "kind": "array", "dtype": "float32",
+                 "gshape": [1 << 12],
+                 "shards": [{{"offset": 0, "nbytes": w.nbytes,
+                             "lshape": [1 << 12], "start": [0]}}]}},
+                {{"path": "['b']", "kind": "array", "dtype": "float32",
+                 "gshape": [1 << 10],
+                 "shards": [{{"offset": w.nbytes, "nbytes": b.nbytes,
+                             "lshape": [1 << 10], "start": [0]}}]}},
+            ]}}
+    shm.write_frame(meta, [w, b])
+
+seal(1, w, b)
+assert persist_shm_frame(shm, {ckpt!r}, 1)
+open({marker!r}, "w").close()  # step 1 fully committed on disk
+# arm the fault AFTER the good step so nth counts only step-2 activity;
+# the delay stalls the saver inside the torn window until SIGKILL lands
+chaos.configure({schedule!r}, seed=5)
+seal(2, w + 1, b + 1)
+persist_shm_frame(shm, {ckpt!r}, 2)
+'''
+
+
+def _run_saver_kill_drill(tmp_path, schedule, kill_when):
+    """Spawn a saver subprocess, SIGKILL it once ``kill_when(step2_dir)``
+    observes the torn window, then restore and return (engine step,
+    restored state, journal events)."""
+    from dlrover_tpu.ckpt.engine import CheckpointEngine
+    from dlrover_tpu.ckpt.ckpt_saver import latest_step, step_dir
+
+    job = f"chainkill{os.getpid()}"
+    name = shm_name(job, 0, 0)
+    unlink_shared_memory(name)
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt)
+    marker = str(tmp_path / "step1_committed")
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         SAVER.format(repo=REPO, name=name, ckpt=ckpt, marker=marker,
+                      schedule=schedule)],
+    )
+    try:
+        d2 = step_dir(ckpt, 2)
+        deadline = time.time() + 60
+        in_window = False
+        while time.time() < deadline:
+            assert child.poll() is None, (
+                "saver exited before the torn window — the fault schedule "
+                "no longer matches the persist path; fix the drill"
+            )
+            committed = any(
+                n.endswith(".mf") for n in
+                (os.listdir(d2) if os.path.isdir(d2) else [])
+            )
+            assert not committed, (
+                "step-2 link committed — the stall site fired too late"
+            )
+            if os.path.exists(marker) and kill_when(d2):
+                in_window = True
+                break
+            time.sleep(0.02)
+        assert in_window, "never observed the torn window"
+        child.send_signal(signal.SIGKILL)
+        child.wait()
+        # the tracker still names the last provably complete step
+        assert latest_step(ckpt) == 1
+        # relaunch restore: shm is gone (node replaced), only storage left
+        unlink_shared_memory(name)
+        stub = _StubMaster()
+        engine = CheckpointEngine(
+            ckpt, job_name=job, node_rank=0, local_rank=0,
+            ipc_socket="/nonexistent", world_size=1, rank=0,
+            master_client=stub,
+        )
+        target = {"w": np.zeros(1 << 12, dtype=np.float32),
+                  "b": np.zeros(1 << 10, dtype=np.float32)}
+        restored, step = engine.load(target)
+        return step, restored, stub.events
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+        unlink_shared_memory(name)
+
+
+def _assert_landed_on_step1(step, restored, events):
+    assert step == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), np.arange(1 << 12, dtype=np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored["b"]), np.ones(1 << 10, dtype=np.float32)
+    )
+    kinds = [k for k, _ in events]
+    # the torn step-2 chain was journaled, and nothing corrupt was loaded
+    truncs = [d for k, d in events if k == "ckpt_chain_truncated"]
+    assert truncs and truncs[0]["step"] == 2
+    assert truncs[0]["reason"]
+    assert "ckpt_corrupt" not in kinds
+
+
+@pytest.mark.chaos
+def test_sigkill_between_delta_persist_and_manifest_commit(tmp_path):
+    """Drill (a): the delta payload landed and the link's temp file exists,
+    but the saver dies before the atomic replace. Restore must land on
+    step 1 with the truncation journaled."""
+
+    def kill_when(d2):
+        # the temp link proves the payload pass finished and the commit
+        # began; the .mf replace never ran (the chaos delay holds it)
+        return os.path.isdir(d2) and any(
+            n.endswith(".mf.tmp") for n in os.listdir(d2)
+        )
+
+    step, restored, events = _run_saver_kill_drill(
+        tmp_path, "storage.commit:delay=120@nth=1", kill_when
+    )
+    _assert_landed_on_step1(step, restored, events)
+
+
+@pytest.mark.chaos
+def test_sigkill_between_striped_shard_writes(tmp_path):
+    """Drill (b): both shards changed, so step 2 persists two delta
+    payload files; the saver dies while the second stripe write is still
+    in flight. No link ever commits — restore lands on step 1."""
+
+    def kill_when(d2):
+        # the first payload write fired (nth=1 passed); the second is
+        # stalled inside the storage.persist site — mid-stripe window
+        return os.path.isdir(d2) and any(
+            n.startswith("delta_") for n in os.listdir(d2)
+        )
+
+    step, restored, events = _run_saver_kill_drill(
+        tmp_path, "storage.persist:delay=120@nth=2", kill_when
+    )
+    _assert_landed_on_step1(step, restored, events)
+
+
 @pytest.mark.chaos
 def test_torn_write_without_replica_fails_loudly(tmp_path):
     """A torn (half-zeroed) shard with no replica peers to repair from:

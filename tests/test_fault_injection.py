@@ -497,6 +497,92 @@ def test_clean_frame_passes_crc_and_roundtrips_blob():
         handler.unlink()
 
 
+# -- storage chain chaos sites (storage.persist, storage.commit) ------------
+
+
+def _seal_frame(handler, step: int, value: float = 1.0):
+    buf = np.full(256, value, dtype=np.float32)
+    handler.write_frame(_frame_meta(step, buf.nbytes), [buf])
+
+
+@pytest.mark.chaos
+def test_storage_persist_error_leaves_no_committed_link(tmp_path):
+    """An injected error inside a striped payload write must abort the
+    persist BEFORE any link commits: the step is invisible to restore and
+    the previous chain tip survives untouched."""
+    from dlrover_tpu.ckpt import manifest
+    from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler
+    from dlrover_tpu.common.storage import PosixDiskStorage
+
+    storage = PosixDiskStorage()
+    handler = SharedMemoryHandler(f"test_persist_site_{os.getpid()}")
+    try:
+        _seal_frame(handler, 1, 1.0)
+        manifest.persist_frame(
+            storage, str(tmp_path), 1, handler.read_meta(),
+            handler.read_frame_bytes(),
+        )
+        chaos.configure("storage.persist:error@nth=1", seed=7)
+        _seal_frame(handler, 2, 2.0)
+        with pytest.raises(chaos.InjectedError):
+            manifest.persist_frame(
+                storage, str(tmp_path), 2, handler.read_meta(),
+                handler.read_frame_bytes(),
+            )
+        chaos.reset_injector()
+        assert not os.path.exists(manifest.manifest_file(
+            str(tmp_path), 2, 0, 0))
+        truncs = []
+        step, frames = manifest.load_newest_chain(
+            str(tmp_path), storage,
+            on_truncate=lambda s, r: truncs.append((s, r)),
+        )
+        assert step == 1 and len(frames) == 1
+        assert (2, "no_committed_links") in truncs
+    finally:
+        handler.unlink()
+
+
+@pytest.mark.chaos
+def test_storage_commit_error_keeps_previous_tip(tmp_path):
+    """An injected error at the commit site (after the temp link's durable
+    write, before the atomic replace) must leave the previous step as the
+    newest restorable chain — the exact window SIGKILL drill (a) covers
+    end-to-end in test_crash_consistency.py."""
+    from dlrover_tpu.ckpt import manifest
+    from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler
+    from dlrover_tpu.common.storage import PosixDiskStorage
+
+    storage = PosixDiskStorage()
+    handler = SharedMemoryHandler(f"test_commit_site_{os.getpid()}")
+    try:
+        _seal_frame(handler, 1, 1.0)
+        manifest.persist_frame(
+            storage, str(tmp_path), 1, handler.read_meta(),
+            handler.read_frame_bytes(),
+        )
+        chaos.configure("storage.commit:error@nth=1", seed=7)
+        _seal_frame(handler, 2, 2.0)
+        with pytest.raises(chaos.InjectedError):
+            manifest.persist_frame(
+                storage, str(tmp_path), 2, handler.read_meta(),
+                handler.read_frame_bytes(),
+            )
+        chaos.reset_injector()
+        d2 = manifest.step_dir(str(tmp_path), 2)
+        assert any(n.endswith(".mf.tmp") for n in os.listdir(d2))
+        assert not any(n.endswith(".mf") for n in os.listdir(d2))
+        truncs = []
+        step, frames = manifest.load_newest_chain(
+            str(tmp_path), storage,
+            on_truncate=lambda s, r: truncs.append((s, r)),
+        )
+        assert step == 1 and len(frames) == 1
+        assert (2, "no_committed_links") in truncs
+    finally:
+        handler.unlink()
+
+
 # -- fan-in plane chaos sites (hb.fanin, agg.forward) -----------------------
 
 

@@ -537,6 +537,77 @@ class TestDLR011:
         assert rules_of(src) == []
 
 
+# -- DLR012: atomic-commit discipline ------------------------------------------
+
+
+class TestDLR012:
+    def test_flags_rename_without_fsync(self):
+        src = (
+            "import os\n"
+            "def commit(tmp, final):\n"
+            "    with open(tmp, 'w') as f:\n"
+            "        f.write('x')\n"
+            "    os.replace(tmp, final)\n"
+        )
+        assert "DLR012" in rules_of(src)
+
+    def test_rename_after_fsync_is_clean(self):
+        src = (
+            "import os\n"
+            "def commit(tmp, final):\n"
+            "    with open(tmp, 'w') as f:\n"
+            "        f.write('x')\n"
+            "        f.flush()\n"
+            "        os.fsync(f.fileno())\n"
+            "    os.replace(tmp, final)\n"
+        )
+        assert rules_of(src) == []
+
+    def test_commit_helper_counts_as_durable(self):
+        src = (
+            "import os\n"
+            "from dlrover_tpu.ckpt.manifest import commit_file\n"
+            "def commit(storage, blob, final):\n"
+            "    commit_file(storage, blob, final)\n"
+            "    os.rename(final + '.a', final + '.b')\n"
+        )
+        assert rules_of(src) == []
+
+    def test_flags_bare_manifest_write(self):
+        src = (
+            "import os\n"
+            "def publish(d):\n"
+            "    with open(os.path.join(d, 'manifest_0_0.mf'), 'w') as f:\n"
+            "        f.write('{}')\n"
+        )
+        assert "DLR012" in rules_of(src)
+
+    def test_manifest_read_is_clean(self):
+        src = (
+            "def peek(manifest_path):\n"
+            "    with open(manifest_path, 'rb') as f:\n"
+            "        return f.read()\n"
+        )
+        assert rules_of(src) == []
+
+    def test_non_manifest_write_is_clean(self):
+        src = (
+            "def dump(path):\n"
+            "    with open(path, 'w') as f:\n"
+            "        f.write('x')\n"
+        )
+        assert rules_of(src) == []
+
+    def test_allowed_suffixes_exempt_protocol_modules(self):
+        src = (
+            "import os\n"
+            "def safe_move(src, dst):\n"
+            "    os.replace(src, dst)\n"
+        )
+        vs = analyze_source(src, path="dlrover_tpu/common/storage.py")
+        assert vs == []
+
+
 # -- suppression machinery ----------------------------------------------------
 
 
@@ -722,12 +793,13 @@ def test_package_has_no_stale_noqa():
 
 @pytest.mark.analysis
 def test_baseline_burn_down_floor():
-    """The baseline only shrinks: PR 7 burned it from 95 down to ≤85.
-    If this fails with a LOWER count, ratchet the floor down in this
-    test; if with a higher one, a deferral leaked in — fix it instead."""
+    """The baseline only shrinks: PR 7 burned it from 95 down to ≤85,
+    PR 9 from 85 down to ≤80. If this fails with a LOWER count, ratchet
+    the floor down in this test; if with a higher one, a deferral leaked
+    in — fix it instead."""
     baseline_total = sum(load_baseline().values())
-    assert baseline_total <= 85, (
-        f"baseline grew to {baseline_total} entries (must stay ≤85); "
+    assert baseline_total <= 80, (
+        f"baseline grew to {baseline_total} entries (must stay ≤80); "
         "fix the new violations instead of deferring them"
     )
 
