@@ -246,6 +246,35 @@ class MasterClient:
         )
         return int((resp.data or {}).get("epoch", -1))
 
+    # -- serving -----------------------------------------------------------
+
+    def serve_register(self, addr: str, slots: int) -> int:
+        """Register this node as a decode replica; types the node SERVE on
+        the master and returns the membership epoch."""
+        resp = self._client.call(
+            "serve_register",
+            comm.ServeRegisterRequest(node_id=self._node_id, addr=addr,
+                                      slots=slots),
+        )
+        return int((resp.data or {}).get("epoch", -1))
+
+    def serve_deregister(self, reason: str = "drain") -> None:
+        self._client.call(
+            "serve_deregister",
+            comm.ServeDeregisterRequest(node_id=self._node_id, reason=reason),
+        )
+
+    def serve_replicas(self) -> Tuple[int, List[Dict[str, Any]]]:
+        """Live (non-draining) replica membership. Short budget: routers
+        poll this and must fail fast during a master restart (the cached
+        view keeps serving)."""
+        resp = self._client.call("serve_replicas", comm.BaseRequest(),
+                                 policy=retry.HEARTBEAT)
+        return resp.epoch, [
+            {"node_id": r.node_id, "addr": r.addr, "slots": r.slots}
+            for r in resp.replicas
+        ]
+
     def report_failure(self, error_data: str, level: str,
                        restart_count: int = 0) -> None:
         self._client.call(

@@ -56,6 +56,7 @@ def device_stats_from_ipc(ipc_server) -> Dict[int, Dict[str, float]]:
     try:
         metrics = dict(ipc_server.local_dict(TRAINING_METRICS_DICT))
     except Exception:  # noqa: BLE001 — IPC down = no telemetry
+        logger.debug("worker metrics SharedDict unreachable", exc_info=True)
         return stats
     for key, value in metrics.items():
         if not isinstance(key, str) or not key.startswith(HBM_KEY_PREFIX):

@@ -341,6 +341,80 @@ class BoolResponse:
 
 
 # --------------------------------------------------------------------------
+# Elastic decode-serving plane (dlrover_tpu/serving/)
+# --------------------------------------------------------------------------
+
+
+@message
+class ServeRegisterRequest:
+    """Replica → master: "my decode RPC server listens at addr with this
+    many continuous-batching slots"."""
+
+    node_id: int = -1
+    addr: str = ""
+    slots: int = 0
+
+
+@message
+class ServeDeregisterRequest:
+    """Replica/router → master: planned removal (drain completed) vs the
+    crash path, which the conn-drop/heartbeat plane detects instead."""
+
+    node_id: int = -1
+    reason: str = "drain"
+
+
+@message
+class ServeReplicaInfo:
+    node_id: int = -1
+    addr: str = ""
+    slots: int = 0
+
+
+@message
+class ServeReplicasResponse:
+    """Master's live-membership view the router load-balances over.
+    ``epoch`` bumps on every membership change so cached views are
+    cheaply validated."""
+
+    replicas: List[Any] = field(default_factory=list)  # [ServeReplicaInfo]
+    epoch: int = 0
+
+
+@message
+class ServeGenerateRequest:
+    """One decode request. ``request_id`` keys idempotent retry: decode
+    is a pure function of (prompt, max_new_tokens) under greedy
+    sampling, so the router may replay the same request on another
+    replica after a death without double-effect."""
+
+    request_id: str = ""
+    prompt: List[int] = field(default_factory=list)
+    max_new_tokens: int = 16
+
+
+@message
+class ServeGenerateResponse:
+    request_id: str = ""
+    success: bool = True
+    message: str = ""
+    tokens: List[int] = field(default_factory=list)
+    # per-request accounting the router feeds the autoscaler signals
+    ttft_s: float = 0.0
+    tpot_s: float = 0.0
+    queue_depth: int = 0
+    replica_id: int = -1
+
+
+@message
+class ServeDrainRequest:
+    """Router/scaler → replica: stop admitting, finish every in-flight
+    sequence, then deregister and shut down."""
+
+    reason: str = ""
+
+
+# --------------------------------------------------------------------------
 # Data sharding (reference comm.py Task/TaskResult, shard messages)
 # --------------------------------------------------------------------------
 

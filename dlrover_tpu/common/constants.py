@@ -67,7 +67,12 @@ class NodeType:
     MASTER = "master"
     WORKER = "worker"
     # PS/chief/evaluator exist in the reference for the TF stack; the TPU
-    # build is SPMD-only, so WORKER is the only trainable role.
+    # build is SPMD-only, so WORKER is the only trainable role. SERVE is
+    # the decode-serving replica role (dlrover_tpu/serving/): it shares
+    # the worker's liveness plane (heartbeats, conn-drop detection) but a
+    # SERVE death is absorbed by request re-routing + the serving
+    # autoscaler instead of a training world re-formation.
+    SERVE = "serve"
 
 
 class NodeStatus:
@@ -292,6 +297,14 @@ class ConfigKey:
     FANIN_SHED_MS = "DLROVER_TPU_FANIN_SHED_MS"
     FANIN_KV_SHARDS = "DLROVER_TPU_FANIN_KV_SHARDS"
     FANIN_FORCE_LEVEL = "DLROVER_TPU_FANIN_FORCE_LEVEL"
+    # elastic decode-serving plane (dlrover_tpu/serving/): autoscaler
+    # signal thresholds — TTFT p99 SLO (seconds) and the router queue
+    # depth above which the serving optimizer grows the replica set —
+    # plus the grow/shrink cooldowns bounding oscillation
+    SERVE_TTFT_SLO_S = "DLROVER_TPU_SERVE_TTFT_SLO_S"
+    SERVE_QUEUE_HI = "DLROVER_TPU_SERVE_QUEUE_HI"
+    SERVE_GROW_COOLDOWN_S = "DLROVER_TPU_SERVE_GROW_COOLDOWN_S"
+    SERVE_SHRINK_COOLDOWN_S = "DLROVER_TPU_SERVE_SHRINK_COOLDOWN_S"
     # chaos / observability
     FAULT_SCHEDULE = "DLROVER_FAULT_SCHEDULE"
     FAULT_SEED = "DLROVER_FAULT_SEED"
@@ -341,6 +354,14 @@ class SpanName:
     # master/fanin.py re-parenting of a dead aggregator's subtree)
     FANIN_FORWARD = "fanin.forward"
     FANIN_REPARENT = "fanin.reparent"
+    # elastic decode-serving plane (dlrover_tpu/serving/): router-side
+    # routing of one request, replica-side generate handling, the
+    # batcher's prefill leg, a planned drain, and an applied serve plan
+    SERVE_ROUTE = "serve.route"
+    SERVE_GENERATE = "serve.generate"
+    SERVE_PREFILL = "serve.prefill"
+    SERVE_DRAIN = "serve.drain"
+    SERVE_SCALE = "serve.scale"
     # failure-detect → relaunch arc (master/master.py → agent/training.py)
     FAULT_RELAUNCH = "fault.relaunch"
     AGENT_RESTART_WORKERS = "agent.restart_workers"

@@ -39,6 +39,10 @@ class JobAutoScaler:
         metrics_sink=None,
         strategy_generator=None,
         hbm_provider=None,
+        serving_optimizer=None,
+        serving_signals=None,
+        serve_scaler=None,
+        event_journal=None,
     ):
         self._job_manager = job_manager
         self._perf_monitor = perf_monitor
@@ -63,6 +67,17 @@ class JobAutoScaler:
         # without a cooldown execute() would compound 0.5^ticks
         self.paral_cooldown_s = 300.0
         self._last_paral_apply = 0.0
+        # serving plane (serving/autoscaler.py): a traffic-driven optimizer
+        # rides the same tick — signals provider feeds it, plans execute
+        # through the serve scaler (replica processes/pods, NOT the
+        # training world's node count)
+        self._serving_optimizer = serving_optimizer
+        self._serving_signals = serving_signals or (lambda: None)
+        self._serve_scaler = serve_scaler
+        self._event_journal = event_journal
+        # a restore plan re-emits every tick until the replacement
+        # registers; journal it once per distinct plan, not per tick
+        self._last_serve_plan = None
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -78,7 +93,18 @@ class JobAutoScaler:
         self._stopped.set()
 
     def _loop(self) -> None:
-        while not self._stopped.wait(self._interval_s):
+        # deadline pacing: ticks land on the cadence grid regardless of
+        # how long planning/execution took, and stop() wakes immediately
+        # — a tick that overruns a whole period skips forward instead of
+        # bursting to catch up
+        next_tick = time.monotonic() + self._interval_s
+        while not self._stopped.wait(
+            max(0.0, next_tick - time.monotonic())
+        ):
+            next_tick += self._interval_s
+            now = time.monotonic()
+            if next_tick <= now:
+                next_tick = now + self._interval_s
             try:
                 self.tick()
             except Exception:  # noqa: BLE001
@@ -109,7 +135,47 @@ class JobAutoScaler:
             oldest_pending_s=oldest_pending,
         )
 
+    def serve_tick(self) -> None:
+        """Serving side of the tick: traffic signals → ServePlan →
+        serve scaler. Separate from the training plan on purpose — a
+        serving grow must never resize the training world."""
+        if self._serving_optimizer is None:
+            return
+        signals = self._serving_signals()
+        if signals is None:
+            return
+        plan = self._serving_optimizer.plan(signals)
+        if plan.empty():
+            self._last_serve_plan = None
+            return
+        # still EXECUTE a repeated plan (scale_to is idempotent and must
+        # re-spawn if an earlier spawn died), but only journal/trace the
+        # first emission — a restore re-plans every tick for the whole
+        # replacement-startup window
+        repeat = (plan.replica_num, plan.reason) == self._last_serve_plan
+        self._last_serve_plan = (plan.replica_num, plan.reason)
+        if repeat:
+            if self._serve_scaler is not None:
+                self._serve_scaler.scale_to(plan.replica_num,
+                                            reason=plan.reason)
+            return
+        logger.info("serve auto-scale → %s replicas (%s)",
+                    plan.replica_num, plan.reason)
+        with tracing.span(SpanName.SERVE_SCALE, source="master",
+                          target=plan.replica_num, reason=plan.reason):
+            if self._event_journal is not None:
+                from dlrover_tpu.observability.journal import JournalEvent
+
+                self._event_journal.record(
+                    JournalEvent.SERVE_SCALE, target=plan.replica_num,
+                    reason=plan.reason,
+                )
+            if self._serve_scaler is not None:
+                self._serve_scaler.scale_to(plan.replica_num,
+                                            reason=plan.reason)
+
     def tick(self) -> Optional[ResourcePlan]:
+        self.serve_tick()
         stats = self.collect_stats()
         if self._metrics_sink is not None:
             try:

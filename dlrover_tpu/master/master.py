@@ -159,6 +159,16 @@ class JobMaster:
                 skew_monitor=self.skew_monitor,
             )
         self.diagnosis_master = diagnosis_master
+        # serving plane membership (serving/registry.py): SERVE replicas
+        # heartbeat through the same liveness plane as workers; this table
+        # is just the routable view + journal semantics. Cheap, so always
+        # constructed — a training-only job never touches it.
+        from dlrover_tpu.serving.registry import ServeReplicaRegistry
+
+        self.serve_registry = ServeReplicaRegistry(
+            event_journal=self.event_journal,
+            registry=self.metrics_registry,
+        )
         self.servicer = MasterServicer(
             job_manager=self.job_manager,
             rdzv_managers=self.rdzv_managers,
@@ -172,6 +182,7 @@ class JobMaster:
             event_journal=self.event_journal,
             skew_monitor=self.skew_monitor,
             fanin_plane=self.fanin_plane,
+            serve_registry=self.serve_registry,
         )
         # bridge journal kinds into PerfMonitor's lost-time bookkeeping —
         # fault_happened/fault_recovered get their (only) callers here
@@ -287,6 +298,7 @@ class JobMaster:
         from dlrover_tpu.common.constants import (
             DiagnosisActionType as _DA,
             NodeStatus as _NS,
+            NodeType as _NT,
         )
         from dlrover_tpu.diagnosis.action import DiagnosisAction
 
@@ -294,6 +306,21 @@ class JobMaster:
             if event.node.status not in (
                 _NS.FAILED, _NS.DELETED, _NS.BREAKDOWN,
             ):
+                return
+            if event.node.type == _NT.SERVE:
+                # a decode replica's death is a SERVING event: drop it
+                # from the routable set (the router re-routes in-flight
+                # requests, the serving autoscaler restores the count) —
+                # it must NOT open a training fault arc or broadcast
+                # RESTART_WORKER into the training world
+                if self.serve_registry.on_node_lost(event.node.id):
+                    self.task_manager.recover_tasks(event.node.id)
+                    self.flight_recorder.capture(
+                        _FR_REASON_NODE_FAULT,
+                        extra={"node_id": event.node.id,
+                               "status": event.node.status,
+                               "role": "serve"},
+                    )
                 return
             # one trace roots the whole detect→relaunch arc; its context
             # rides down to every survivor inside the restart action, so
@@ -314,7 +341,8 @@ class JobMaster:
                 carry = tracing.inject_wire()
                 for node in self.job_manager.list_nodes():
                     if (node.id != event.node.id
-                            and node.status == _NS.RUNNING):
+                            and node.status == _NS.RUNNING
+                            and node.type != _NT.SERVE):
                         data = (
                             {tracing.WIRE_KEY: carry}
                             if carry is not None else None

@@ -156,7 +156,8 @@ class BrainOptimizer(ResourceOptimizer):
             try:
                 self._ever_ran = self._client.ever_ran()
             except Exception:  # noqa: BLE001 — offline brain ⇒ no history
-                pass
+                logger.debug("brain ever_ran probe failed — assuming "
+                             "no history", exc_info=True)
         phase = "running" if self._ever_ran else "create"
         try:
             return self._client.optimize(stats, phase=phase)

@@ -35,6 +35,7 @@ class MasterServicer:
         event_journal=None,
         skew_monitor=None,
         fanin_plane=None,
+        serve_registry=None,
     ):
         self._job_manager = job_manager
         self._rdzv_managers = rdzv_managers
@@ -48,6 +49,7 @@ class MasterServicer:
         self._event_journal = event_journal
         self._skew_monitor = skew_monitor
         self._fanin_plane = fanin_plane
+        self._serve_registry = serve_registry
         self._start_time = time.monotonic()  # uptime base
 
     # -- rendezvous --------------------------------------------------------
@@ -299,6 +301,58 @@ class MasterServicer:
             return comm.BaseResponse(success=False, message="no fanin plane")
         epoch = self._fanin_plane.register_aggregator(req.node_id, req.addr)
         return comm.BaseResponse(data={"epoch": epoch})
+
+    # -- serving plane -----------------------------------------------------
+
+    def rpc_serve_register(
+        self, req: comm.ServeRegisterRequest
+    ) -> comm.BaseResponse:
+        """A decode replica joins: type its node SERVE on the job manager
+        (so its death routes to the serving branch of the node-event
+        callback, not the training fault arc) and enter it into the
+        routable membership table."""
+        if self._serve_registry is None:
+            return comm.BaseResponse(success=False,
+                                     message="no serving plane")
+        from dlrover_tpu.common.constants import NodeType
+        from dlrover_tpu.common.rpc import connection_ctx
+
+        connection_ctx()["node_id"] = req.node_id
+        node = self._job_manager.get_node(req.node_id)
+        node.type = NodeType.SERVE
+        # liveness plane admission: the replica is a live, heartbeating
+        # member from this moment (also readmits a re-used released id)
+        self._job_manager.record_node_contact(req.node_id, running=True)
+        epoch = self._serve_registry.register(req.node_id, req.addr,
+                                              req.slots)
+        return comm.BaseResponse(data={"epoch": epoch})
+
+    def rpc_serve_deregister(
+        self, req: comm.ServeDeregisterRequest
+    ) -> comm.BaseResponse:
+        if self._serve_registry is None:
+            return comm.BaseResponse(success=False,
+                                     message="no serving plane")
+        self._serve_registry.deregister(req.node_id, reason=req.reason)
+        # a drained replica's process exit must read as a planned leave,
+        # not a death the autoscaler would race to replace
+        self._job_manager.update_node_status(req.node_id, "deleted",
+                                             exit_reason=req.reason)
+        return comm.BaseResponse()
+
+    def rpc_serve_replicas(
+        self, req: comm.BaseRequest
+    ) -> comm.ServeReplicasResponse:
+        if self._serve_registry is None:
+            return comm.ServeReplicasResponse()
+        return comm.ServeReplicasResponse(
+            replicas=[
+                comm.ServeReplicaInfo(node_id=r["node_id"], addr=r["addr"],
+                                      slots=r["slots"])
+                for r in self._serve_registry.live()
+            ],
+            epoch=self._serve_registry.epoch,
+        )
 
     def rpc_report_failure(self, req: comm.NodeFailureReport) -> comm.BaseResponse:
         self._job_manager.report_failure(

@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from dlrover_tpu.common.log import logger
+
 
 class JournalEvent:
     """Typed event kinds. Plain strings on the wire/in JSON."""
@@ -77,6 +79,17 @@ class JournalEvent:
     # verification (torn/incomplete/corrupt) and restore fell back to an
     # older link — informational, no phase transition
     CKPT_CHAIN_TRUNCATED = "ckpt_chain_truncated"
+    # elastic decode-serving plane (dlrover_tpu/serving/): replica
+    # lifecycle (up drives the `serving` phase; an unplanned loss drives
+    # `detect` until the autoscaler restores capacity; a planned drain is
+    # informational), router-side request outcomes (a failed attempt and
+    # the re-route that saves it), and applied serving scale plans
+    SERVE_REPLICA_UP = "serve_replica_up"
+    SERVE_REPLICA_LOST = "serve_replica_lost"
+    SERVE_REPLICA_DRAINED = "serve_replica_drained"
+    SERVE_REQUEST_FAILED = "serve_request_failed"
+    SERVE_REROUTED = "serve_rerouted"
+    SERVE_SCALE = "serve_scale"
 
     ALL = (
         FAULT_DETECTED, RDZV_START, RDZV_COMPLETE, RESTORE_START,
@@ -86,6 +99,8 @@ class JournalEvent:
         STACK_DUMP_CAPTURED, TRACE_BUNDLE_CAPTURED, RESHARD_PLANNED,
         RESHARD_START, RESHARD_COMPLETE, RESHARD_ABORTED,
         FANIN_REPARENTED, FANIN_BACKPRESSURE, CKPT_CHAIN_TRUNCATED,
+        SERVE_REPLICA_UP, SERVE_REPLICA_LOST, SERVE_REPLICA_DRAINED,
+        SERVE_REQUEST_FAILED, SERVE_REROUTED, SERVE_SCALE,
     )
 
 
@@ -96,8 +111,14 @@ class Phase:
     RESTORE = "restore"
     RECOMPILE = "recompile"
     RESHARD = "reshard"
+    # serving jobs (dlrover_tpu/serving/): SERVING means the registered
+    # replica capacity is up and taking traffic; an unplanned replica
+    # loss drops to DETECT until a replacement registers. Serving
+    # goodput over a traffic window = the SERVING share of that window.
+    SERVING = "serving"
 
-    ALL = (PRODUCTIVE, DETECT, RENDEZVOUS, RESTORE, RECOMPILE, RESHARD)
+    ALL = (PRODUCTIVE, DETECT, RENDEZVOUS, RESTORE, RECOMPILE, RESHARD,
+           SERVING)
 
 
 # event kind → the phase the job enters when the event lands. rdzv_complete
@@ -120,6 +141,13 @@ _TRANSITIONS: Dict[str, str] = {
     JournalEvent.RESHARD_START: Phase.RESHARD,
     JournalEvent.RESHARD_COMPLETE: Phase.RECOMPILE,
     JournalEvent.RESHARD_ABORTED: Phase.RESTORE,
+    # serving plane: a replica registering enters/restores SERVING; an
+    # unplanned replica loss enters DETECT until the autoscaler's
+    # replacement registers (the next serve_replica_up). A planned drain
+    # (serve_replica_drained) is capacity the operator asked to give
+    # back, so it does NOT leave SERVING.
+    JournalEvent.SERVE_REPLICA_UP: Phase.SERVING,
+    JournalEvent.SERVE_REPLICA_LOST: Phase.DETECT,
 }
 
 
@@ -178,7 +206,10 @@ class EventJournal:
             try:
                 fn(event)
             except Exception:  # noqa: BLE001 — telemetry must not kill work
-                pass
+                logger.warning(
+                    "journal listener %r failed on %s event",
+                    fn, event["kind"], exc_info=True,
+                )
         return event
 
     def current_phase(self) -> str:

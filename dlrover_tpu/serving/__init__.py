@@ -1,0 +1,25 @@
+"""Elastic decode-serving plane (ROADMAP item 4, ROSE arxiv 2605.06534).
+
+The first user-facing workload built on the PR 1–9 substrate: decode
+replicas are master-managed ``SERVE`` nodes riding the training control
+plane's liveness machinery (heartbeats, conn-drop detection, fan-in),
+while requests flow through a serving-specific data plane:
+
+- :mod:`engine` — multi-slot batched prefill/decode over the
+  ``models/decode.py`` kernels: a preallocated per-slot KV cache, pure
+  per-bucket prefill (overlappable with decode), one compiled step;
+- :mod:`batcher` — the continuous-batching scheduler: prompt-length
+  bucket admission, slot reuse on completion, prefill workers overlapped
+  with the decode loop, per-request TTFT/TPOT accounting;
+- :mod:`replica` — the SERVE node: an RPC server wrapping a batcher,
+  registered with the master and heartbeating like any worker, plus a
+  subprocess replica manager used as the local serve scaler;
+- :mod:`router` — the request frontend: load-balances over the master's
+  live-membership view, retries idempotent requests on replica death,
+  drains in-flight sequences on planned scale-down;
+- :mod:`registry` — the master-side replica table (journal + gauges);
+- :mod:`autoscaler` — the traffic-driven serving optimizer consumed by
+  ``master/auto_scaler.py`` and the ROSE train↔serve coordinator;
+- :mod:`drill` — the shared closed-loop load harness (bench / e2e /
+  example) including the chaos replica-kill scenario.
+"""

@@ -1,0 +1,184 @@
+"""Traffic-driven serving autoscaling + the ROSE train↔serve move.
+
+The training auto-scaler plans from pending-node/straggler/speed stats;
+serving plans from TRAFFIC: router queue depth, TTFT p99 against the
+SLO, live-vs-target replica count. :class:`ServingOptimizer` mirrors the
+``ResourceOptimizer``/``ResourcePlan`` shape (master/resource.py) so
+``JobAutoScaler`` threads it through the same deadline-paced tick.
+
+Planning rules, in priority order:
+
+1. **restore** — live < target means a replica died (the registry
+   already journaled ``serve_replica_lost``): scale back to target
+   immediately, no cooldown (crash recovery is never an oscillation);
+2. **grow** — queue depth above ``DLROVER_TPU_SERVE_QUEUE_HI`` or TTFT
+   p99 above ``DLROVER_TPU_SERVE_TTFT_SLO_S``, bounded by max replicas
+   and the grow cooldown;
+3. **shrink** — zero queue AND zero in-flight, bounded by min replicas
+   and the shrink cooldown; executed as a DRAIN (planned scale-down
+   completes all in-flight — the batcher invariant).
+
+:class:`TrainServeCoordinator` is the ROSE cooperative move: when
+serving is SLO-starved at its configured max and the training side is
+idle (between rendezvous, or preempted down to a rump world), it lends
+the serving plane headroom for extra replicas; a training rendezvous
+start (journal listener — the same event stream goodput attribution
+reads) hands the loan back by draining the borrowed replicas.
+"""
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from dlrover_tpu.common.constants import ConfigKey, env_float, env_int
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.journal import JournalEvent
+
+
+@dataclass
+class ServingSignals:
+    """One tick's traffic snapshot (router + registry + scaler views)."""
+
+    live_replicas: int = 0
+    target_replicas: int = 0
+    queue_depth: int = 0
+    inflight: int = 0
+    ttft_p99_s: float = 0.0
+    tokens_per_s: float = 0.0
+
+
+@dataclass
+class ServePlan:
+    replica_num: Optional[int] = None
+    reason: str = ""
+
+    def empty(self) -> bool:
+        return self.replica_num is None
+
+
+class ServingOptimizer:
+    def __init__(
+        self,
+        min_replicas: int = 1,
+        max_replicas: int = 2,
+        ttft_slo_s: Optional[float] = None,
+        queue_hi: Optional[int] = None,
+        grow_cooldown_s: Optional[float] = None,
+        shrink_cooldown_s: Optional[float] = None,
+    ):
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.ttft_slo_s = (
+            env_float(ConfigKey.SERVE_TTFT_SLO_S, 2.0)
+            if ttft_slo_s is None else ttft_slo_s
+        )
+        self.queue_hi = (
+            env_int(ConfigKey.SERVE_QUEUE_HI, 8)
+            if queue_hi is None else queue_hi
+        )
+        self.grow_cooldown_s = (
+            env_float(ConfigKey.SERVE_GROW_COOLDOWN_S, 5.0)
+            if grow_cooldown_s is None else grow_cooldown_s
+        )
+        self.shrink_cooldown_s = (
+            env_float(ConfigKey.SERVE_SHRINK_COOLDOWN_S, 30.0)
+            if shrink_cooldown_s is None else shrink_cooldown_s
+        )
+        # cooldowns gate from CONSTRUCTION, not -inf: a serving plane that
+        # comes up with no traffic yet must not shrink (or a cold-start
+        # latency blip grow) on the very first tick
+        self._last_grow = self._last_shrink = time.monotonic()
+
+    def plan(self, signals: ServingSignals) -> ServePlan:
+        now = time.monotonic()  # cooldown window arithmetic
+        target = signals.target_replicas
+        if signals.live_replicas < target:
+            # a lost replica: restore immediately (plan the TARGET — the
+            # scaler decides what spawning reaches it)
+            return ServePlan(target, "restore lost replica "
+                             f"({signals.live_replicas}/{target} live)")
+        hot = (signals.queue_depth > self.queue_hi
+               or signals.ttft_p99_s > self.ttft_slo_s)
+        if (hot and target < self.max_replicas
+                and now - self._last_grow >= self.grow_cooldown_s):
+            self._last_grow = now
+            return ServePlan(
+                target + 1,
+                f"traffic grow (queue={signals.queue_depth}, "
+                f"ttft_p99={signals.ttft_p99_s:.3f}s)")
+        idle = signals.queue_depth == 0 and signals.inflight == 0
+        if (idle and target > self.min_replicas
+                and now - self._last_shrink >= self.shrink_cooldown_s):
+            self._last_shrink = now
+            return ServePlan(target - 1, "idle shrink")
+        return ServePlan()
+
+
+class TrainServeCoordinator:
+    """ROSE cooperative elasticity: lend idle training capacity to the
+    serving plane, hand it back the moment training re-forms.
+
+    The loan is expressed as extra headroom on the serving optimizer's
+    ``max_replicas`` (+ a scale-to executed through the serve scaler):
+    on a local/standalone deployment "re-roling a node" IS running a
+    decode replica where a training worker would have run. Handback
+    subscribes to the journal's ``rdzv_start`` — the authoritative
+    "training wants its nodes" signal — so no new hook is invented.
+    """
+
+    def __init__(self, optimizer: ServingOptimizer, serve_scaler=None,
+                 event_journal=None, idle_provider=None, max_borrow: int = 1):
+        self._optimizer = optimizer
+        self._scaler = serve_scaler
+        self._journal = event_journal
+        # () -> int: training nodes currently idle/released and borrowable
+        self._idle_provider = idle_provider or (lambda: 0)
+        self._max_borrow = max_borrow
+        self._lock = threading.Lock()
+        self.borrowed = 0
+        self._base_max = optimizer.max_replicas
+        if event_journal is not None:
+            event_journal.add_listener(self._on_journal_event)
+
+    def _record(self, **data) -> None:
+        if self._journal is not None:
+            self._journal.record(JournalEvent.SERVE_SCALE,
+                                 source="rose", **data)
+
+    def maybe_borrow(self, signals: ServingSignals) -> bool:
+        """Called on the autoscaler tick when serving is hot at its max:
+        borrow one idle training node's worth of capacity."""
+        hot = (signals.queue_depth > self._optimizer.queue_hi
+               or signals.ttft_p99_s > self._optimizer.ttft_slo_s)
+        with self._lock:
+            if (not hot or self.borrowed >= self._max_borrow
+                    or signals.target_replicas < self._optimizer.max_replicas
+                    or self._idle_provider() <= 0):
+                return False
+            self.borrowed += 1
+            self._optimizer.max_replicas = self._base_max + self.borrowed
+            target = self._optimizer.max_replicas
+        logger.info("ROSE: borrowing an idle training node → "
+                    "%s decode replicas", target)
+        self._record(direction="borrow", target=target)
+        if self._scaler is not None:
+            self._scaler.scale_to(target, reason="rose borrow")
+        return True
+
+    def _on_journal_event(self, event) -> None:
+        if event.get("kind") == JournalEvent.RDZV_START:
+            self.handback(reason="training rendezvous")
+
+    def handback(self, reason: str = "training rendezvous") -> None:
+        """Training is re-forming: drain every borrowed replica NOW."""
+        with self._lock:
+            if self.borrowed == 0:
+                return
+            self.borrowed = 0
+            self._optimizer.max_replicas = self._base_max
+            target = self._base_max
+        logger.info("ROSE: handing borrowed capacity back (%s)", reason)
+        self._record(direction="handback", target=target, reason=reason)
+        if self._scaler is not None:
+            self._scaler.scale_to(target, reason=f"rose handback: {reason}")

@@ -1,0 +1,259 @@
+"""The closed-loop serving drill: bench section, e2e test and example
+share this one harness so they measure the same thing.
+
+One process plays master + router + load generator; decode replicas run
+as real subprocesses (so the chaos SIGKILL is a real process death whose
+socket loss the master's conn-drop grace turns into a node-failed event).
+The traffic-driven autoscaler rides the deadline-paced ``JobAutoScaler``
+tick and restores the replica count after the kill.
+
+The zero-loss claim this drill asserts: generation is greedy over
+replica-identical weights (same init seed in every subprocess), so a
+request is idempotent — every request the kill catches in flight
+completes via router re-route, and ``lost == 0`` at the end.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.auto_scaler import JobAutoScaler
+from dlrover_tpu.master.master import LocalJobMaster
+from dlrover_tpu.master.resource import ResourcePlan
+from dlrover_tpu.observability.journal import (
+    JournalEvent,
+    Phase,
+    attribute_phases,
+)
+from dlrover_tpu.serving.autoscaler import ServingOptimizer, ServingSignals
+from dlrover_tpu.serving.replica import (
+    SERVE_REPLICA_SITE,
+    LocalReplicaManager,
+)
+from dlrover_tpu.serving.router import RequestRouter
+
+
+class _NoTrainingPlan:
+    """Serving-only drill: the training side of the tick plans nothing."""
+
+    def plan(self, stats) -> ResourcePlan:
+        del stats
+        return ResourcePlan()
+
+
+def run_serving_drill(
+    replicas: int = 2,
+    backend: str = "toy",
+    num_requests: int = 24,
+    concurrency: int = 4,
+    kill_mid_traffic: bool = True,
+    prompt_lens: Sequence[int] = (3, 5, 7, 10, 12, 14),
+    max_new_tokens: int = 6,
+    buckets: Sequence[int] = (8, 16),
+    slots: int = 4,
+    cache_len: int = 48,
+    autoscale_interval_s: float = 0.3,
+    request_timeout_s: float = 60.0,
+    kill_after_completed: Optional[int] = None,
+    restore_timeout_s: float = 30.0,
+    step_delay_s: Optional[float] = None,
+    seed: int = 0,
+) -> Dict:
+    """Run the drill; returns the metrics/assertion dict the bench
+    section records and the e2e test asserts on."""
+    from dlrover_tpu.chaos import configure, get_injector, reset_injector
+
+    own_injector = False
+    if kill_mid_traffic and get_injector() is None:
+        # the injector DECIDES the kill (and journals it through the
+        # master's fault reporter); SIGKILL is just the mechanism
+        configure(f"{SERVE_REPLICA_SITE}:error@nth=1", seed=seed)
+        own_injector = True
+    ctx = get_context()
+    saved = (ctx.heartbeat_interval_s, ctx.conn_drop_grace_s)
+    ctx.heartbeat_interval_s = 0.2
+    ctx.conn_drop_grace_s = 0.2
+    master = LocalJobMaster(job_name="serve-drill", node_num=replicas,
+                            min_nodes=1)
+    master.prepare()
+    manager = LocalReplicaManager(
+        master.addr,
+        live_fn=master.serve_registry.live,
+        backend=backend,
+        slots=slots,
+        buckets=buckets,
+        max_new_cap=max_new_tokens,
+        cache_len=cache_len,
+        heartbeat_interval_s=0.2,
+        seed=seed,
+        # the toy engine decodes in microseconds — pace its steps so the
+        # traffic window is long enough for a MID-traffic kill; the jax
+        # backend's real compute needs no pacing
+        step_delay_s=(
+            (0.01 if backend == "toy" else 0.0)
+            if step_delay_s is None else step_delay_s
+        ),
+    )
+    router = RequestRouter(
+        replicas_fn=master.serve_registry.live,
+        journal_fn=lambda kind, **d: master.event_journal.record(
+            kind, source="router", **d),
+        request_timeout_s=request_timeout_s,
+    )
+
+    def signals() -> ServingSignals:
+        return ServingSignals(
+            live_replicas=len(master.serve_registry.live()),
+            target_replicas=manager.target,
+            queue_depth=router.inflight(),
+            inflight=router.inflight(),
+            ttft_p99_s=router.ttft_p99(),
+            tokens_per_s=router.tokens_per_s(),
+        )
+
+    autoscaler = JobAutoScaler(
+        master.job_manager, master.perf_monitor, scaler=None,
+        optimizer=_NoTrainingPlan(),
+        interval_s=autoscale_interval_s,
+        serving_optimizer=ServingOptimizer(
+            min_replicas=1, max_replicas=replicas,
+            # the drill's idle moments must not shrink the fleet under it
+            shrink_cooldown_s=3600.0,
+        ),
+        serving_signals=signals,
+        serve_scaler=manager,
+        event_journal=master.event_journal,
+    )
+    result: Dict = {"requests": num_requests, "killed_node": None,
+                    "backend": backend, "replicas": replicas}
+    responses: List = []
+    res_lock = threading.Lock()
+    next_idx = [0]
+    done_evt = threading.Event()
+    try:
+        manager.scale_to(replicas, reason="drill start")
+        if not manager.wait_live(replicas, timeout_s=60.0):
+            raise RuntimeError(
+                f"replicas failed to register: "
+                f"{len(master.serve_registry.live())}/{replicas} live")
+        autoscaler.start()
+
+        def _load_worker() -> None:
+            while True:
+                with res_lock:
+                    i = next_idx[0]
+                    next_idx[0] += 1
+                if i >= num_requests:
+                    return
+                plen = prompt_lens[i % len(prompt_lens)]
+                prompt = [1 + ((i * 7 + j * 3) % 23) for j in range(plen)]
+                resp = router.submit(
+                    prompt, max_new_tokens,
+                    request_id=f"req-{i:04d}",
+                    deadline_s=request_timeout_s,
+                )
+                with res_lock:
+                    responses.append(resp)
+
+        def _kill_controller() -> None:
+            threshold = (max(1, num_requests // 3)
+                         if kill_after_completed is None
+                         else kill_after_completed)
+            while not done_evt.wait(0.02):
+                if router.completed >= threshold:
+                    break
+            if done_evt.is_set():
+                return
+            inj = get_injector()
+            try:
+                if inj is not None:
+                    inj.fire(SERVE_REPLICA_SITE, phase="drill_kill")
+            except (ConnectionError, RuntimeError):
+                # the injected fault IS the kill decision (journaled
+                # through the master's fault reporter as fault_injected)
+                logger.info("chaos fired on %s — SIGKILLing a replica",
+                            SERVE_REPLICA_SITE)
+            result["killed_node"] = manager.kill_one()
+
+        t0 = time.monotonic()
+        workers = [
+            threading.Thread(target=_load_worker, name=f"serve-load-{i}",
+                             daemon=True)
+            for i in range(concurrency)
+        ]
+        killer = None
+        if kill_mid_traffic:
+            killer = threading.Thread(target=_kill_controller,
+                                      name="serve-chaos", daemon=True)
+            killer.start()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(timeout=request_timeout_s * num_requests)
+        done_evt.set()
+        if killer is not None:
+            killer.join(timeout=30.0)
+        elapsed = time.monotonic() - t0
+
+        # recovery sequencing: the master must first DETECT the kill
+        # (conn-drop grace → node failed → serve_replica_lost drops the
+        # victim from the registry) before the autoscaler can see
+        # live < target and restore — waiting for live >= N alone would
+        # accept the stale membership still naming the dead replica
+        pacer = threading.Event()  # pacing only, never set
+        detected = result["killed_node"] is None
+        if result["killed_node"] is not None:
+            victim = result["killed_node"]
+            deadline = time.monotonic() + restore_timeout_s
+            while time.monotonic() < deadline:
+                if all(r["node_id"] != victim
+                       for r in master.serve_registry.live()):
+                    detected = True
+                    break
+                pacer.wait(0.05)
+        result["kill_detected"] = detected
+        restored = detected and manager.wait_live(
+            replicas, timeout_s=restore_timeout_s)
+        ok = [r for r in responses if r.success]
+        ttfts = sorted(r.ttft_s for r in ok)
+        kinds: Dict[str, int] = {}
+        for e in master.event_journal.events():
+            kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+        now_t = master.event_journal.now()
+        serve_t0 = min(
+            (e["t"] for e in master.event_journal.events()
+             if e["kind"] == JournalEvent.SERVE_REPLICA_UP),
+            default=0.0,
+        )
+        phases = attribute_phases(master.event_journal.events(), now_t,
+                                  start_t=serve_t0)
+        window = max(1e-6, now_t - serve_t0)
+        total_tokens = sum(len(r.tokens) for r in ok)
+        result.update({
+            "completed": len(ok),
+            "lost": router.lost,
+            "failed_responses": len(responses) - len(ok),
+            "rerouted": router.rerouted,
+            "replicas_restored": restored,
+            "live_replicas_end": len(master.serve_registry.live()),
+            "elapsed_s": round(elapsed, 3),
+            "tokens_total": total_tokens,
+            "tokens_per_s": round(total_tokens / max(1e-6, elapsed), 2),
+            "ttft_p50_s": round(ttfts[len(ttfts) // 2], 4) if ttfts else 0.0,
+            "ttft_p99_s": round(
+                ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 4
+            ) if ttfts else 0.0,
+            "serving_goodput": round(
+                phases[Phase.SERVING] / window, 4),
+            "journal": kinds,
+        })
+        return result
+    finally:
+        autoscaler.stop()
+        manager.stop_all()
+        master.stop()
+        ctx.heartbeat_interval_s, ctx.conn_drop_grace_s = saved
+        if own_injector:
+            reset_injector()

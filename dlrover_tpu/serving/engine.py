@@ -1,0 +1,361 @@
+"""Multi-slot batched decode engine for serving replicas.
+
+``models/decode.py`` owns the single-sequence path (one scalar ``pos``,
+whole-batch prefill→decode). Serving needs sequences at DIFFERENT
+positions in one batch — continuous batching — so this engine keeps a
+per-SLOT position vector over the same head-major per-layer cache layout
+and splits prefill in two:
+
+- :meth:`BatchDecodeEngine.prefill_rows` is a PURE function of the
+  prompt (no engine state touched): it runs the bucket-padded prompt
+  through a single-sequence forward and returns the per-layer k/v rows
+  plus the first generated token. Pure means the batcher's prefill
+  workers can run it CONCURRENTLY with the decode loop — the real
+  prefill/decode overlap, not a scheduling trick.
+- :meth:`BatchDecodeEngine.insert` is the cheap, decode-thread-only
+  commit: one ``dynamic_update_slice`` of the precomputed rows into the
+  slot's cache rows and a ``pos[slot] = real_len`` write.
+
+Compile discipline (the batcher's "never recompiles mid-bucket"
+invariant): prompts are right-padded to their admission bucket's length,
+so prefill traces once per BUCKET, and the decode step traces exactly
+once (fixed ``(slots,)`` shapes). ``compile_count`` tracks distinct
+traced shapes for the invariant test.
+
+Padding correctness: the pad rows write garbage k/v beyond ``real_len``,
+but the step mask is ``arange(T) <= pos`` and every cell at ``pos`` is
+written before it is attended — garbage is always overwritten before it
+becomes visible (same argument as decode.py's zero-initialized cache).
+
+Greedy sampling only: serving decode must be a pure function of the
+prompt so the router can replay a request on another replica after a
+death (idempotent retry). Temperature sampling would need the request to
+carry its PRNG key to stay replayable — headroom, not needed here.
+
+A :class:`ToyEngine` with the same interface (deterministic integer
+recurrence, no jax) backs the fast batcher/router unit tests.
+"""
+
+import threading
+from dataclasses import dataclass
+from typing import Any, List, Sequence
+
+from dlrover_tpu.common.log import logger
+
+
+@dataclass
+class PrefillResult:
+    """Output of a pure prefill: what :meth:`insert` commits to a slot."""
+
+    first_token: int
+    real_len: int
+    bucket_len: int
+    # backend payload: (L, KV, P, Dh) k/v stacks for the jax engine, the
+    # recurrence seed for the toy engine
+    payload: Any = None
+
+
+class ToyEngine:
+    """Deterministic stand-in engine (no jax): token ``i`` of a sequence
+    is a fixed integer function of (prompt, i), so two replicas given the
+    same request produce identical outputs — the property idempotent
+    retry rests on — while a batcher step costs microseconds."""
+
+    def __init__(self, slots: int = 4, vocab: int = 97,
+                 cache_len: int = 1024, prefill_delay_s: float = 0.0,
+                 step_delay_s: float = 0.0):
+        self.slots = slots
+        self.cache_len = cache_len
+        self._vocab = vocab
+        self._prefill_delay_s = prefill_delay_s
+        self._step_delay_s = step_delay_s
+        self._seeds = [0] * slots
+        self._counts = [0] * slots
+        self._shapes_lock = threading.Lock()
+        self._shapes = set()
+
+    @property
+    def compile_count(self) -> int:
+        with self._shapes_lock:
+            return len(self._shapes)
+
+    @staticmethod
+    def _seed(prompt: Sequence[int]) -> int:
+        return (sum(prompt) * 1000003 + len(prompt)) & 0x7FFFFFFF
+
+    def _token(self, seed: int, i: int) -> int:
+        return (seed * 31 + 7 + i * 17) % self._vocab
+
+    def prefill_rows(self, prompt: Sequence[int],
+                     bucket_len: int) -> PrefillResult:
+        if self._prefill_delay_s:
+            import time
+
+            time.sleep(self._prefill_delay_s)  # simulated prefill work
+        with self._shapes_lock:
+            self._shapes.add(("prefill", bucket_len))
+        seed = self._seed(prompt)
+        return PrefillResult(
+            first_token=self._token(seed, 0),
+            real_len=len(prompt),
+            bucket_len=bucket_len,
+            payload=seed,
+        )
+
+    def insert(self, result: PrefillResult, slot: int) -> int:
+        self._seeds[slot] = result.payload
+        self._counts[slot] = 1
+        return result.first_token
+
+    def step(self, tokens: Sequence[int],
+             active: Sequence[bool]) -> List[int]:
+        del tokens  # the recurrence carries its own state
+        if self._step_delay_s:
+            import time
+
+            time.sleep(self._step_delay_s)  # simulated decode work
+        with self._shapes_lock:
+            self._shapes.add(("step",))
+        out = []
+        for s in range(self.slots):
+            if active[s]:
+                i = self._counts[s]
+                self._counts[s] += 1
+                out.append(self._token(self._seeds[s], i))
+            else:
+                out.append(0)
+        return out
+
+
+class BatchDecodeEngine:
+    """Jax engine: per-layer head-major ``(S, KV, T, Dh)`` cache buffers
+    (the decode.py layout, batch axis = slots) + a ``(S,)`` position
+    vector. Greedy decode; CPU/TPU-portable (no pallas dependency — the
+    einsum attend path, see ``flash_decode_wanted`` for when the fused
+    kernel would take over on TPU)."""
+
+    def __init__(self, params, config, slots: int = 4,
+                 cache_len: int = 64):
+        import jax
+        import jax.numpy as jnp
+
+        self.slots = slots
+        self.cache_len = cache_len
+        self._params = params
+        self._config = config
+        c = config
+        shape = (slots, c.n_kv_heads, cache_len, c.head_dim)
+        self._k = tuple(jnp.zeros(shape, c.dtype) for _ in range(c.n_layers))
+        self._v = tuple(jnp.zeros(shape, c.dtype) for _ in range(c.n_layers))
+        self._pos = jnp.zeros((slots,), jnp.int32)
+        # public for equality tests against the stock decode.py path
+        self.params = params
+        self.config = config
+        self._shapes_lock = threading.Lock()
+        self._shapes = set()
+        self._prefill_jit = jax.jit(self._prefill_fn)
+        self._insert_jit = jax.jit(self._insert_fn)
+        self._step_jit = jax.jit(self._step_fn)
+
+    @property
+    def compile_count(self) -> int:
+        with self._shapes_lock:
+            return len(self._shapes)
+
+    def _note_shape(self, key) -> None:
+        with self._shapes_lock:
+            if key not in self._shapes:
+                self._shapes.add(key)
+                logger.info("serving engine traces %s", key)
+
+    # -- pure prefill (prefill-worker threads) -----------------------------
+
+    def _prefill_fn(self, params, tokens, real_len):
+        """Single-sequence bucket-padded forward → (first greedy token,
+        (L, KV, P, Dh) k stack, v stack). Pure: touches no engine state."""
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.models.decode import _attend, _ffn, _split_heads
+        from dlrover_tpu.models.llama import _rms_norm, _rope
+
+        c = self._config
+        P = tokens.shape[0]
+        x = params["tok_embed"][tokens][None]           # (1, P, D)
+        positions = jnp.arange(P)[None]
+        # causal over the padded length: the logits row at real_len-1
+        # never attends a pad key (pads sit at indices >= real_len)
+        causal = (
+            jnp.arange(P)[None, None, :, None]
+            >= jnp.arange(P)[None, None, None, :]
+        )
+        scale = c.head_dim ** -0.5
+
+        def layer_fn(h, layer):
+            xn = _rms_norm(h, layer["attn_norm"], c.norm_eps)
+            q = _rope(_split_heads(xn @ layer["wq"], c.n_heads, c.head_dim),
+                      positions, c.rope_theta)
+            k = _rope(
+                _split_heads(xn @ layer["wk"], c.n_kv_heads, c.head_dim),
+                positions, c.rope_theta,
+            )
+            v = _split_heads(xn @ layer["wv"], c.n_kv_heads, c.head_dim)
+            k = jnp.swapaxes(k, 1, 2)                   # (1, KV, P, Dh)
+            v = jnp.swapaxes(v, 1, 2)
+            out = _attend(q, k, v, causal, scale)
+            h = h + out @ layer["wo"]
+            h = h + _ffn(_rms_norm(h, layer["ffn_norm"], c.norm_eps),
+                         layer, c)
+            return h, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(layer_fn, x, params["layers"])
+        x = _rms_norm(x, params["final_norm"], c.norm_eps)
+        # the next-token logits live at the LAST REAL position, not the
+        # padded tail
+        h_last = jax.lax.dynamic_slice_in_dim(x[0], real_len - 1, 1)[0]
+        logits = (h_last @ params["lm_head"]).astype(jnp.float32)
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return first, ks[:, 0].astype(c.dtype), vs[:, 0].astype(c.dtype)
+
+    def prefill_rows(self, prompt: Sequence[int],
+                     bucket_len: int) -> PrefillResult:
+        import jax.numpy as jnp
+
+        if len(prompt) > bucket_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds bucket {bucket_len}")
+        if bucket_len > self.cache_len:
+            raise ValueError(
+                f"bucket {bucket_len} exceeds cache length {self.cache_len}")
+        self._note_shape(("prefill", bucket_len))
+        padded = list(prompt) + [0] * (bucket_len - len(prompt))
+        first, ks, vs = self._prefill_jit(
+            self._params,
+            jnp.asarray(padded, jnp.int32),
+            jnp.int32(len(prompt)),
+        )
+        return PrefillResult(
+            first_token=int(first),
+            real_len=len(prompt),
+            bucket_len=bucket_len,
+            payload=(ks, vs),
+        )
+
+    # -- decode-thread-only state commits ----------------------------------
+
+    def _insert_fn(self, k_bufs, v_bufs, pos, ks, vs, slot, real_len):
+        import jax
+        import jax.numpy as jnp
+
+        new_k, new_v = [], []
+        for li in range(self._config.n_layers):
+            # write the (KV, P, Dh) rows at batch row ``slot``; the stale
+            # tail beyond P from a previous occupant stays masked until
+            # overwritten (mask <= pos, and the cell at pos is written
+            # before it is read each step)
+            new_k.append(jax.lax.dynamic_update_slice(
+                k_bufs[li], ks[li][None], (slot, 0, 0, 0)))
+            new_v.append(jax.lax.dynamic_update_slice(
+                v_bufs[li], vs[li][None], (slot, 0, 0, 0)))
+        pos = pos.at[slot].set(real_len.astype(jnp.int32))
+        return tuple(new_k), tuple(new_v), pos
+
+    def insert(self, result: PrefillResult, slot: int) -> int:
+        import jax.numpy as jnp
+
+        ks, vs = result.payload
+        self._note_shape(("insert", result.bucket_len))
+        self._k, self._v, self._pos = self._insert_jit(
+            self._k, self._v, self._pos, ks, vs,
+            jnp.int32(slot), jnp.int32(result.real_len),
+        )
+        return result.first_token
+
+    def _step_fn(self, params, k_bufs, v_bufs, pos, tokens, active):
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.models.decode import _attend, _ffn, _split_heads
+        from dlrover_tpu.models.llama import _rms_norm, _rope
+
+        c = self._config
+        T = self.cache_len
+        x = params["tok_embed"][tokens][:, None, :]     # (S, 1, D)
+        positions = pos[:, None]                        # per-slot position
+        mask = (
+            jnp.arange(T)[None, None, None, :]
+            <= pos[:, None, None, None]
+        )
+        scale = c.head_dim ** -0.5
+
+        def row_write(buf_row, val_row, p):
+            # (KV, T, Dh) ← (KV, 1, Dh) at this row's own position
+            return jax.lax.dynamic_update_slice(buf_row, val_row, (0, p, 0))
+
+        k_bufs, v_bufs = list(k_bufs), list(v_bufs)
+        h = x
+        # unrolled layer loop, per-layer buffers: the decode.py in-place-
+        # DUS shape, now with a vmap over slots for the per-row positions
+        for li in range(c.n_layers):
+            layer = jax.tree.map(lambda w, li=li: w[li], params["layers"])
+            xn = _rms_norm(h, layer["attn_norm"], c.norm_eps)
+            q = _rope(_split_heads(xn @ layer["wq"], c.n_heads, c.head_dim),
+                      positions, c.rope_theta)
+            k_new = _rope(
+                _split_heads(xn @ layer["wk"], c.n_kv_heads, c.head_dim),
+                positions, c.rope_theta,
+            )
+            v_new = _split_heads(xn @ layer["wv"], c.n_kv_heads, c.head_dim)
+            k_new = jnp.swapaxes(k_new, 1, 2)           # (S, KV, 1, Dh)
+            v_new = jnp.swapaxes(v_new, 1, 2)
+            # inactive rows write garbage at their frozen pos — harmless:
+            # that cell is rewritten (insert or this write) before any
+            # mask ever reveals it
+            k_bufs[li] = jax.vmap(row_write)(
+                k_bufs[li], k_new.astype(c.dtype), pos)
+            v_bufs[li] = jax.vmap(row_write)(
+                v_bufs[li], v_new.astype(c.dtype), pos)
+            out = _attend(q, k_bufs[li], v_bufs[li], mask, scale)
+            h = h + out @ layer["wo"]
+            h = h + _ffn(_rms_norm(h, layer["ffn_norm"], c.norm_eps),
+                         layer, c)
+        x = _rms_norm(h, params["final_norm"], c.norm_eps)
+        logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        pos = pos + active.astype(jnp.int32)
+        return nxt, tuple(k_bufs), tuple(v_bufs), pos
+
+    def step(self, tokens: Sequence[int],
+             active: Sequence[bool]) -> List[int]:
+        import jax.numpy as jnp
+
+        self._note_shape(("step",))
+        nxt, self._k, self._v, self._pos = self._step_jit(
+            self._params, self._k, self._v, self._pos,
+            jnp.asarray(list(tokens), jnp.int32),
+            jnp.asarray(list(active), bool),
+        )
+        return [int(t) for t in nxt]
+
+
+def build_tiny_engine(slots: int = 4, cache_len: int = 48,
+                      vocab: int = 32, dim: int = 16, n_layers: int = 2,
+                      n_heads: int = 2, n_kv_heads: int = 1,
+                      seed: int = 0) -> BatchDecodeEngine:
+    """CPU-sized jax engine with DETERMINISTIC params: every replica
+    built from the same seed holds identical weights, so re-routing a
+    request mid-stream reproduces the exact same tokens (the e2e zero-
+    loss assertion depends on this)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models.llama import LlamaConfig, init_params
+
+    config = LlamaConfig(
+        vocab_size=vocab, dim=dim, n_layers=n_layers, n_heads=n_heads,
+        n_kv_heads=n_kv_heads, ffn_dim=4 * dim, max_seq_len=cache_len,
+        dtype=jnp.float32, remat=False,
+    )
+    params = init_params(config, jax.random.PRNGKey(seed))
+    return BatchDecodeEngine(params, config, slots=slots,
+                             cache_len=cache_len)

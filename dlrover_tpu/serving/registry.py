@@ -1,0 +1,102 @@
+"""Master-side serve-replica registry: the membership view the router
+load-balances over and the autoscaler restores.
+
+Liveness is NOT duplicated here — replicas heartbeat through the same
+job-manager plane as workers (conn-drop grace, heartbeat timeout, fan-in
+backpressure); the master's node-event callback translates a SERVE node
+death into :meth:`on_node_lost`. This table only answers "which live
+replicas, at which addresses, with how many slots" — bumping ``epoch``
+on every change so cached router views validate cheaply.
+
+Journal semantics (goodput attribution): ``serve_replica_up`` opens the
+``serving`` phase (registered capacity healthy), ``serve_replica_lost``
+opens ``detect`` until the autoscaler's replacement registers; a planned
+``serve_replica_drained`` is informational — scale-down is not lost
+serving time.
+"""
+
+import threading
+from typing import Dict, List, Optional
+
+from dlrover_tpu.analysis.race_detector import shared
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.journal import JournalEvent
+
+
+class ServeReplicaRegistry:
+    def __init__(self, event_journal=None, registry=None):
+        self._journal = event_journal
+        self._lock = threading.Lock()
+        # node_id -> {"addr", "slots", "draining"}; serving shared state,
+        # race-certified together with the batcher's queue/slot map
+        self._replicas = shared({}, "serve.replica_table")
+        self.epoch = 0
+        if registry is not None:
+            registry.gauge(
+                "dlrover_serving_replicas",
+                "live (non-draining) decode replicas",
+            ).set_function(lambda: float(len(self.live())))
+
+    def _record(self, kind: str, **data) -> None:
+        if self._journal is not None:
+            self._journal.record(kind, **data)
+
+    def register(self, node_id: int, addr: str, slots: int) -> int:
+        with self._lock:
+            self._replicas[node_id] = {
+                "addr": addr, "slots": slots, "draining": False,
+            }
+            self.epoch += 1
+            epoch = self.epoch
+        logger.info("serve replica %s up at %s (%s slots, epoch %s)",
+                    node_id, addr, slots, epoch)
+        self._record(JournalEvent.SERVE_REPLICA_UP,
+                     node_id=node_id, addr=addr, slots=slots, epoch=epoch)
+        return epoch
+
+    def mark_draining(self, node_id: int) -> None:
+        with self._lock:
+            if node_id in self._replicas:
+                self._replicas[node_id]["draining"] = True
+                self.epoch += 1
+
+    def deregister(self, node_id: int, reason: str = "drain") -> None:
+        with self._lock:
+            if self._replicas.pop(node_id, None) is None:
+                return
+            self.epoch += 1
+            epoch = self.epoch
+        self._record(JournalEvent.SERVE_REPLICA_DRAINED,
+                     node_id=node_id, reason=reason, epoch=epoch)
+
+    def on_node_lost(self, node_id: int) -> bool:
+        """A SERVE node died un-drained (conn drop / heartbeat timeout /
+        SIGKILL). True when it was still registered — the caller journals
+        a flight-recorder bundle for exactly these."""
+        with self._lock:
+            if self._replicas.pop(node_id, None) is None:
+                return False
+            self.epoch += 1
+            epoch = self.epoch
+        logger.warning("serve replica %s LOST (epoch %s)", node_id, epoch)
+        self._record(JournalEvent.SERVE_REPLICA_LOST,
+                     node_id=node_id, epoch=epoch)
+        return True
+
+    def live(self) -> List[Dict]:
+        """Routable replicas (registered, not draining), as dicts with
+        ``node_id``/``addr``/``slots``."""
+        with self._lock:
+            return [
+                {"node_id": nid, "addr": r["addr"], "slots": r["slots"]}
+                for nid, r in self._replicas.items() if not r["draining"]
+            ]
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def addr_of(self, node_id: int) -> Optional[str]:
+        with self._lock:
+            entry = self._replicas.get(node_id)
+            return entry["addr"] if entry else None

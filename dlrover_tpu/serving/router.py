@@ -1,0 +1,262 @@
+"""Request router: load-balances generate requests over live replicas.
+
+The membership view comes from a caller-supplied ``replicas_fn`` — the
+master registry's ``live()`` in-process, or a cached
+``MasterClient.serve_replicas()`` poll across hosts — so the router
+itself holds no liveness machinery. What it owns is the RETRY contract:
+generation here is greedy over replica-identical weights, so a request
+is idempotent and a replica death mid-request is absorbed by re-routing
+the same request (same ``request_id``) to a surviving replica. Lost
+requests are therefore a bug, not an operational fact — the chaos drill
+SIGKILLs a replica mid-traffic and asserts ``lost == 0``.
+
+Retry taxonomy per attempt:
+
+- transport error / injected fault (site ``serve.request``) / replica
+  death mid-call → journal ``serve_request_failed``, re-route
+  (``serve_rerouted``) to a replica not yet tried;
+- ``draining``/``timeout`` refusal → re-route (the replica is healthy,
+  just closed for admission);
+- deterministic refusal (prompt too long) → fail fast, no retry;
+- no live replica → wait out the membership gap (the autoscaler is
+  restoring the count) until the deadline, consuming no attempt.
+"""
+
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.analysis.race_detector import shared
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.constants import SpanName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RPCClient
+from dlrover_tpu.observability import tracing
+from dlrover_tpu.observability.journal import JournalEvent
+from dlrover_tpu.observability.registry import get_registry
+
+SERVE_REQUEST_SITE = "serve.request"
+
+# deterministic refusals: retrying on another replica cannot change them
+_PERMANENT = ("exceeds largest bucket",)
+
+
+class RequestRouter:
+    def __init__(
+        self,
+        replicas_fn: Callable[[], List[Dict]],
+        journal_fn: Optional[Callable] = None,
+        max_attempts: int = 4,
+        request_timeout_s: float = 60.0,
+        no_replica_wait_s: float = 0.1,
+        tokens_window_s: float = 30.0,
+        registry=None,
+    ):
+        self._replicas_fn = replicas_fn
+        self._journal_fn = journal_fn
+        self._max_attempts = max_attempts
+        self._request_timeout_s = request_timeout_s
+        self._no_replica_wait_s = no_replica_wait_s
+        self._tokens_window_s = tokens_window_s
+        self._lock = threading.Lock()
+        # node_id -> in-flight attempt count; serving shared state,
+        # race-certified alongside the batcher's queue/slot map
+        self._inflight = shared({}, "serve.router_inflight")
+        self._clients: Dict[str, RPCClient] = {}
+        self._ttft_samples: List[float] = []
+        self._token_marks: List[tuple] = []  # (t_done, n_tokens)
+        self._pacer = threading.Event()  # pacing only, never set
+        self.completed = 0
+        self.lost = 0
+        self.rerouted = 0
+        reg = registry or get_registry()
+        self._m_requests = reg.counter(
+            "dlrover_serving_router_requests_total",
+            "routed requests by outcome", labelnames=("status",))
+        self._m_rerouted = reg.counter(
+            "dlrover_serving_rerouted_total",
+            "requests re-routed after a replica failure")
+        reg.gauge(
+            "dlrover_serving_router_inflight", "requests in flight",
+        ).set_function(lambda: float(sum(self._inflight.values())))
+
+    # -- internals ---------------------------------------------------------
+
+    def _record(self, kind: str, **data) -> None:
+        if self._journal_fn is not None:
+            self._journal_fn(kind, **data)
+
+    def _client_for(self, addr: str) -> RPCClient:
+        with self._lock:
+            client = self._clients.get(addr)
+            if client is None:
+                # retries=0: the ROUTER owns failover — a transport retry
+                # to the same dead replica would just burn the deadline
+                client = RPCClient(addr, timeout_s=self._request_timeout_s,
+                                   retries=0)
+                self._clients[addr] = client
+        return client
+
+    def _pick(self, tried: set) -> Optional[Dict]:
+        """Least-loaded live replica, preferring ones not yet tried for
+        this request (a replica that just failed it is the LAST resort)."""
+        live = self._replicas_fn()
+        if not live:
+            return None
+        with self._lock:
+            def load(r):
+                return (self._inflight.get(r["node_id"], 0)
+                        / max(1, r.get("slots", 1)))
+
+            fresh = [r for r in live if r["node_id"] not in tried]
+            return min(fresh or live, key=load)
+
+    def _mark(self, node_id: int, delta: int) -> None:
+        with self._lock:
+            n = self._inflight.get(node_id, 0) + delta
+            if n <= 0:
+                self._inflight.pop(node_id, None)
+            else:
+                self._inflight[node_id] = n
+
+    # -- public API --------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: List[int],
+        max_new_tokens: int = 16,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> comm.ServeGenerateResponse:
+        request_id = request_id or uuid.uuid4().hex[:12]
+        deadline = time.monotonic() + (deadline_s or self._request_timeout_s)
+        req = comm.ServeGenerateRequest(
+            request_id=request_id, prompt=list(prompt),
+            max_new_tokens=max_new_tokens)
+        tried: set = set()
+        attempts = 0
+        last_err = "no live replica"
+        with tracing.span(SpanName.SERVE_ROUTE, source="router",
+                          request_id=request_id):
+            while attempts < self._max_attempts:
+                if time.monotonic() >= deadline:
+                    last_err = f"deadline exceeded ({last_err})"
+                    break
+                from dlrover_tpu.chaos import get_injector
+
+                inj = get_injector()
+                if inj is not None:
+                    try:
+                        inj.fire(SERVE_REQUEST_SITE, request_id=request_id,
+                                 attempt=attempts)
+                    except (ConnectionError, RuntimeError) as e:
+                        attempts += 1
+                        last_err = f"injected: {e!r}"
+                        self._record(JournalEvent.SERVE_REQUEST_FAILED,
+                                     request_id=request_id, node_id=-1,
+                                     attempt=attempts, error=repr(e))
+                        continue
+                target = self._pick(tried)
+                if target is None:
+                    # membership gap (replica died, replacement still
+                    # registering): wait it out, consuming no attempt
+                    self._pacer.wait(self._no_replica_wait_s)
+                    continue
+                node_id = target["node_id"]
+                attempts += 1
+                self._mark(node_id, +1)
+                try:
+                    resp = self._client_for(target["addr"]).call(
+                        "serve_generate", req)
+                except (ConnectionError, OSError, RuntimeError) as e:
+                    last_err = repr(e)
+                    tried.add(node_id)
+                    self._record(JournalEvent.SERVE_REQUEST_FAILED,
+                                 request_id=request_id, node_id=node_id,
+                                 attempt=attempts, error=last_err)
+                    logger.warning("request %s attempt %s on replica %s "
+                                   "failed: %s", request_id, attempts,
+                                   node_id, last_err)
+                    self.rerouted += 1
+                    self._m_rerouted.inc()
+                    self._record(JournalEvent.SERVE_REROUTED,
+                                 request_id=request_id, from_node=node_id)
+                    continue
+                finally:
+                    self._mark(node_id, -1)
+                if resp.success:
+                    self._done_ok(resp)
+                    return resp
+                last_err = resp.message
+                tried.add(node_id)
+                if any(m in resp.message for m in _PERMANENT):
+                    break  # deterministic: no replica will accept it
+                # draining/timeout refusal: healthy replica, closed door
+                self.rerouted += 1
+                self._m_rerouted.inc()
+                self._record(JournalEvent.SERVE_REROUTED,
+                             request_id=request_id, from_node=node_id,
+                             reason=resp.message)
+        with self._lock:
+            self.lost += 1
+        self._m_requests.labels(status="lost").inc()
+        self._record(JournalEvent.SERVE_REQUEST_FAILED,
+                     request_id=request_id, node_id=-1, attempt=attempts,
+                     error=f"exhausted: {last_err}", terminal=True)
+        return comm.ServeGenerateResponse(
+            request_id=request_id, success=False,
+            message=f"exhausted after {attempts} attempts: {last_err}")
+
+    def _done_ok(self, resp: comm.ServeGenerateResponse) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self.completed += 1
+            self._ttft_samples.append(resp.ttft_s)
+            del self._ttft_samples[:-512]
+            self._token_marks.append((now, len(resp.tokens)))
+            cutoff = now - self._tokens_window_s
+            while self._token_marks and self._token_marks[0][0] < cutoff:
+                self._token_marks.pop(0)
+        self._m_requests.labels(status="ok").inc()
+
+    def rpc_serve_submit(self, req: comm.ServeGenerateRequest
+                         ) -> comm.ServeGenerateResponse:
+        """The router itself as an RPC surface: mount on any RPCServer via
+        ``register_object`` for out-of-process frontends."""
+        return self.submit(req.prompt, req.max_new_tokens,
+                           request_id=req.request_id or None)
+
+    def drain(self, addr: str, reason: str = "scale down") -> bool:
+        """Planned scale-down: tell the replica at ``addr`` to drain
+        (completes all in-flight) through this router's cached client."""
+        try:
+            resp = self._client_for(addr).call(
+                "serve_drain", comm.ServeDrainRequest(reason=reason))
+            return bool(resp.success)
+        except (ConnectionError, OSError, RuntimeError):
+            logger.warning("drain of %s failed", addr, exc_info=True)
+            return False
+
+    # -- autoscaler signal surface -----------------------------------------
+
+    def inflight(self) -> int:
+        with self._lock:
+            return sum(self._inflight.values())
+
+    def ttft_p99(self) -> float:
+        with self._lock:
+            samples = sorted(self._ttft_samples)
+        if not samples:
+            return 0.0
+        return samples[min(len(samples) - 1, int(len(samples) * 0.99))]
+
+    def tokens_per_s(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            marks = [(t, n) for t, n in self._token_marks
+                     if t >= now - self._tokens_window_s]
+        if not marks:
+            return 0.0
+        span = max(1e-3, now - marks[0][0])
+        return sum(n for _, n in marks) / span

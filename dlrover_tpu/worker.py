@@ -124,6 +124,7 @@ class WorkerContext:
                 }
             return out
         except Exception:  # noqa: BLE001 — telemetry is best-effort
+            logger.debug("device memory_stats unavailable", exc_info=True)
             return {}
 
 

@@ -82,8 +82,10 @@ def test_chaos_kill_shrink_resume_rejoin():
     assert phases is not None
     assert set(phases) == {
         "productive", "detect", "rendezvous", "restore", "recompile",
-        "reshard",
+        "reshard", "serving",
     }
+    # a pure-training drill never enters the serving phase
+    assert phases["serving"] == 0.0, phases
     # checkpoint-free elastic resharding: both world cuts (shrink and
     # rejoin) recovered by live reshard from the survivors' shm frames —
     # no post-fault restore read storage, and the time is attributed to

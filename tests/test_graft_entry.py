@@ -42,6 +42,10 @@ def test_bench_smoke_cpu(tmp_path):
         # own e2e (test_chaos_e2e.py) and would dominate the 300 s cap
         "BENCH_SKIP_CHAOS": "1",
         "BENCH_TIME_BUDGET_S": "240",
+        # the multi-GB host-scale point is sized for bench hardware; on a
+        # CI box with slow cold storage the 3 GB persist alone can eat
+        # the whole cap — the smoke only asserts the main device point
+        "BENCH_CKPT_SCALE_GB": "0.25",
     })
     env.pop("PALLAS_AXON_POOL_IPS", None)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

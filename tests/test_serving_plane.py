@@ -1,0 +1,457 @@
+"""Serving-plane drills: continuous batcher invariants, router failover,
+traffic autoscaling, and the CPU-sized closed-loop kill/restore e2e.
+
+The batcher invariants pinned here are the ones the module docstring
+promises (serving/batcher.py): bucket admission never recompiles
+mid-bucket, freed slots are reused within one decode step, and a drain
+completes every in-flight request. The e2e is the acceptance drill: a
+chaos SIGKILL of one decode replica mid-traffic loses zero requests —
+every in-flight request completes via router re-route — and the
+traffic autoscaler restores the replica count.
+"""
+
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu import chaos
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.rpc import RPCServer
+from dlrover_tpu.serving.batcher import BatcherClosed, ContinuousBatcher
+from dlrover_tpu.serving.engine import ToyEngine, build_tiny_engine
+from dlrover_tpu.serving.registry import ServeReplicaRegistry
+from dlrover_tpu.serving.router import RequestRouter
+from dlrover_tpu.serving.autoscaler import (
+    ServePlan,
+    ServingOptimizer,
+    ServingSignals,
+    TrainServeCoordinator,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_injector():
+    yield
+    chaos.reset_injector()
+
+
+def _submit_and_wait(batcher, reqs, timeout_s=30.0):
+    pending = [batcher.submit(rid, prompt, n) for rid, prompt, n in reqs]
+    for p in pending:
+        assert p.done.wait(timeout_s), f"request {p.request_id} never done"
+    return pending
+
+
+# -- batcher invariants -----------------------------------------------------
+
+
+def test_bucket_admission_never_recompiles_mid_bucket():
+    """Prompts land in the smallest configured bucket and are padded to
+    its length, so a second wave of DIFFERENT prompt lengths inside the
+    same buckets adds zero traced shapes."""
+    engine = build_tiny_engine(slots=4, cache_len=48)
+    batcher = ContinuousBatcher(engine, buckets=(8, 16), max_new_cap=4)
+    batcher.start()
+    try:
+        wave1 = [(f"w1-{i}", [1 + i] * plen, 3)
+                 for i, plen in enumerate((3, 10))]  # one per bucket
+        done1 = _submit_and_wait(batcher, wave1)
+        assert all(not p.error for p in done1)
+        traced = engine.compile_count
+        assert traced <= 2 * 2 + 1  # per-bucket prefill path + one step
+
+        wave2 = [(f"w2-{i}", [2 + i] * plen, 3)
+                 for i, plen in enumerate((5, 7, 12, 14, 8, 16))]
+        done2 = _submit_and_wait(batcher, wave2)
+        assert all(not p.error for p in done2)
+        assert engine.compile_count == traced, (
+            "new prompt lengths inside existing buckets recompiled")
+    finally:
+        batcher.stop()
+
+
+def test_oversized_prompt_refused_not_recompiled():
+    engine = ToyEngine(slots=2, cache_len=48)
+    batcher = ContinuousBatcher(engine, buckets=(8, 16), max_new_cap=4)
+    batcher.start()
+    try:
+        with pytest.raises(ValueError, match="exceeds largest bucket"):
+            batcher.submit("too-long", list(range(17)), 2)
+    finally:
+        batcher.stop()
+
+
+def test_freed_slots_reused_within_one_decode_step():
+    """With more backlog than slots, every completion's freed slot is
+    refilled before the NEXT step runs — ``max_reuse_lag_steps`` counts
+    steps a freed slot idled while the ready set was non-empty."""
+    engine = ToyEngine(slots=2, step_delay_s=0.001)
+    batcher = ContinuousBatcher(engine, buckets=(8,), max_new_cap=8)
+    batcher.start()
+    try:
+        reqs = [(f"r{i}", [1 + (i % 5)] * (2 + i % 4), 4 + i % 3)
+                for i in range(10)]
+        done = _submit_and_wait(batcher, reqs)
+        assert all(not p.error for p in done)
+        assert batcher.completed == len(reqs)
+        assert batcher.max_reuse_lag_steps == 0, (
+            f"a freed slot idled {batcher.max_reuse_lag_steps} step(s) "
+            "with backlog waiting")
+    finally:
+        batcher.stop()
+
+
+def test_drain_completes_all_inflight():
+    """Planned scale-down: drain() stops admission and completes every
+    queued/ready/active request before returning."""
+    engine = ToyEngine(slots=2, step_delay_s=0.002)
+    batcher = ContinuousBatcher(engine, buckets=(8,), max_new_cap=6)
+    batcher.start()
+    pending = [batcher.submit(f"d{i}", [1 + i % 7] * 3, 6)
+               for i in range(8)]
+    assert batcher.drain(timeout_s=30.0)
+    for p in pending:
+        assert p.done.is_set(), f"drain returned with {p.request_id} open"
+        assert not p.error and p.tokens
+    with pytest.raises(BatcherClosed):
+        batcher.submit("late", [1, 2, 3], 2)
+    batcher.stop()
+
+
+def test_engine_greedy_matches_stock_decode():
+    """The replica's batched cached-decode path must be numerically the
+    stock models/decode.py greedy path — this equality is what makes a
+    re-routed request idempotent across replicas."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import decode as D
+
+    engine = build_tiny_engine(slots=2, cache_len=48)
+    prompt = [3, 1, 4, 1, 5]
+    batcher = ContinuousBatcher(engine, buckets=(8, 16), max_new_cap=6)
+    batcher.start()
+    try:
+        (served,) = _submit_and_wait(batcher, [("eq", prompt, 6)])
+        assert not served.error
+    finally:
+        batcher.stop()
+    stock = D.generate(
+        engine.params, jnp.array([prompt]), engine.config,
+        jax.random.PRNGKey(0), max_new_tokens=6, temperature=0.0,
+    )
+    assert served.tokens == stock[0, len(prompt):].tolist()
+
+
+# -- satellite: race certification of the serving shared state --------------
+
+
+@pytest.mark.race
+def test_serving_shared_state_race_certified(race_guard):
+    """Admit→decode→complete churn concurrent with replica-table churn
+    (register / lost — the replica-death path) under the happens-before
+    detector: the batcher queue/ready/slot-map and the registry table
+    are ``shared(...)``-tracked, so any unordered access fails here."""
+    engine = ToyEngine(slots=2, step_delay_s=0.0005)
+    batcher = ContinuousBatcher(engine, buckets=(8,), max_new_cap=4)
+    registry = ServeReplicaRegistry()
+    batcher.start()
+    errors = []
+
+    def _traffic(worker):
+        try:
+            for i in range(6):
+                p = batcher.submit(f"t{worker}-{i}",
+                                   [1 + worker, 2 + i], 3)
+                assert p.done.wait(30.0) and not p.error
+        except Exception as e:  # noqa: BLE001 — joined + re-raised below
+            errors.append(e)
+
+    def _membership():
+        try:
+            for i in range(6):
+                registry.register(200 + i, f"127.0.0.1:{9000 + i}", 2)
+                registry.on_node_lost(200 + i)
+        except Exception as e:  # noqa: BLE001 — joined + re-raised below
+            errors.append(e)
+
+    threads = [threading.Thread(target=_traffic, args=(w,), daemon=True)
+               for w in range(3)]
+    threads.append(threading.Thread(target=_membership, daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    batcher.stop()
+    assert not errors, errors
+    assert race_guard.tracked_created > 0
+    assert race_guard.races == [], race_guard.report()
+
+
+# -- router: failover contract ----------------------------------------------
+
+
+class _FakeReplica:
+    """In-process stand-in for a decode replica's RPC surface."""
+
+    def __init__(self, node_id, message=""):
+        self.node_id = node_id
+        self.message = message  # non-empty → refuse with this message
+        self.calls = 0
+
+    def rpc_serve_generate(self, req):
+        self.calls += 1
+        if self.message:
+            return comm.ServeGenerateResponse(
+                request_id=req.request_id, success=False,
+                message=self.message, replica_id=self.node_id)
+        return comm.ServeGenerateResponse(
+            request_id=req.request_id, success=True,
+            tokens=list(req.prompt)[: req.max_new_tokens],
+            ttft_s=0.01, tpot_s=0.001, replica_id=self.node_id)
+
+
+def _serve_fake(replica):
+    server = RPCServer(port=0)
+    server.register_object(replica)
+    server.start()
+    return server, f"127.0.0.1:{server.port}"
+
+
+@pytest.mark.chaos
+def test_chaos_serve_request_retries_to_success():
+    """Site ``serve.request``: an injected router-side error consumes one
+    attempt and is journaled, then the SAME request completes on retry —
+    no caller-visible failure."""
+    chaos.configure("serve.request:error@nth=1", seed=3)
+    replica = _FakeReplica(1)
+    server, addr = _serve_fake(replica)
+    journal = []
+    router = RequestRouter(
+        replicas_fn=lambda: [{"node_id": 1, "addr": addr, "slots": 4}],
+        journal_fn=lambda kind, **d: journal.append((kind, d)),
+        request_timeout_s=10.0,
+    )
+    try:
+        resp = router.submit([5, 6, 7], max_new_tokens=3, request_id="c1")
+        assert resp.success and resp.replica_id == 1
+        assert router.completed == 1 and router.lost == 0
+        failed = [d for kind, d in journal if kind == "serve_request_failed"]
+        assert len(failed) == 1 and failed[0]["node_id"] == -1
+        assert "injected" in failed[0]["error"].lower()
+    finally:
+        server.stop()
+
+
+def test_router_reroutes_off_dead_replica():
+    """A connection-refused replica is journaled + retried on the other
+    live replica with the SAME request id — the idempotent-retry path a
+    SIGKILL exercises end-to-end in the drill."""
+    replica = _FakeReplica(2)
+    server, addr = _serve_fake(replica)
+    dead_addr = "127.0.0.1:1"  # nothing listens: immediate refusal
+    journal = []
+    router = RequestRouter(
+        replicas_fn=lambda: [
+            {"node_id": 1, "addr": dead_addr, "slots": 64},  # least loaded
+            {"node_id": 2, "addr": addr, "slots": 1},
+        ],
+        journal_fn=lambda kind, **d: journal.append((kind, d)),
+        request_timeout_s=10.0,
+    )
+    try:
+        resp = router.submit([1] * 64, max_new_tokens=2, request_id="rr1")
+        # node 1 sorts first (64 idle slots) but is dead — the router
+        # must land the request on node 2
+        assert resp.success and resp.replica_id == 2
+        assert router.rerouted == 1 and router.lost == 0
+        kinds = [kind for kind, _ in journal]
+        assert "serve_request_failed" in kinds
+        assert "serve_rerouted" in kinds
+    finally:
+        server.stop()
+
+
+def test_router_permanent_refusal_fails_fast():
+    """A deterministic refusal (prompt exceeds the largest bucket) must
+    not burn retries — every replica would refuse identically."""
+    replica = _FakeReplica(1, message="prompt 99 exceeds largest bucket 16")
+    server, addr = _serve_fake(replica)
+    router = RequestRouter(
+        replicas_fn=lambda: [{"node_id": 1, "addr": addr, "slots": 4}],
+        request_timeout_s=10.0,
+    )
+    try:
+        resp = router.submit(list(range(32)), max_new_tokens=2)
+        assert not resp.success
+        assert replica.calls == 1  # exactly one attempt, no retry storm
+        assert router.lost == 1
+    finally:
+        server.stop()
+
+
+# -- serving optimizer / ROSE ----------------------------------------------
+
+
+def _signals(**kw):
+    base = dict(live_replicas=2, target_replicas=2, queue_depth=0,
+                inflight=0, ttft_p99_s=0.1, tokens_per_s=100.0)
+    base.update(kw)
+    return ServingSignals(**base)
+
+
+def test_optimizer_restores_lost_replica_immediately():
+    opt = ServingOptimizer(min_replicas=1, max_replicas=2)
+    plan = opt.plan(_signals(live_replicas=1))
+    assert plan.replica_num == 2 and "restore" in plan.reason
+
+
+def test_optimizer_grow_and_shrink_honor_cooldowns():
+    opt = ServingOptimizer(min_replicas=1, max_replicas=4, ttft_slo_s=1.0,
+                           queue_hi=4, grow_cooldown_s=0.0,
+                           shrink_cooldown_s=3600.0)
+    grown = opt.plan(_signals(queue_depth=9))
+    assert grown.replica_num == 3  # hot: queue above the high-water mark
+    grown = opt.plan(_signals(live_replicas=3, target_replicas=3,
+                              ttft_p99_s=2.5))
+    assert grown.replica_num == 4  # hot: TTFT p99 above the SLO
+    assert opt.plan(_signals(live_replicas=4, target_replicas=4,
+                             ttft_p99_s=2.5)).empty()  # at max
+    # idle, but the shrink cooldown gates FROM CONSTRUCTION — a fleet
+    # with no traffic yet must not shrink on its first tick
+    assert opt.plan(_signals(live_replicas=4, target_replicas=4)).empty()
+    opt.shrink_cooldown_s = 0.0
+    shrunk = opt.plan(_signals(live_replicas=4, target_replicas=4))
+    assert shrunk.replica_num == 3 and "shrink" in shrunk.reason
+
+
+def test_rose_borrow_and_handback():
+    """The ROSE move: serving hot at its max borrows an idle training
+    node's capacity; a training rendezvous start hands it back."""
+    from dlrover_tpu.observability.journal import EventJournal
+
+    opt = ServingOptimizer(min_replicas=1, max_replicas=2, ttft_slo_s=1.0)
+    journal = EventJournal()
+    scaled = []
+
+    class _Scaler:
+        def scale_to(self, n, reason=""):
+            scaled.append((n, reason))
+
+    coord = TrainServeCoordinator(opt, serve_scaler=_Scaler(),
+                                  event_journal=journal,
+                                  idle_provider=lambda: 1, max_borrow=1)
+    hot = _signals(ttft_p99_s=3.0, target_replicas=2)
+    assert coord.maybe_borrow(hot)
+    assert opt.max_replicas == 3 and scaled[-1][0] == 3
+    assert not coord.maybe_borrow(hot)  # loan exhausted
+    # training re-forms: the rendezvous-start journal event triggers
+    # the handback without any serving-side hook
+    journal.record("rdzv_start", round=1)
+    assert opt.max_replicas == 2 and coord.borrowed == 0
+    assert scaled[-1][0] == 2 and "handback" in scaled[-1][1]
+
+
+def test_serve_tick_journals_repeated_restore_plan_once():
+    """A restore plan re-emits every tick until the replacement replica
+    registers; the autoscaler must execute each tick (spawn retry) but
+    journal only the first emission."""
+    from dlrover_tpu.master.auto_scaler import JobAutoScaler
+    from dlrover_tpu.observability.journal import EventJournal
+
+    class _FixedPlan:
+        def plan(self, signals):
+            return ServePlan(2, "restore lost replica (1/2 live)")
+
+    journal = EventJournal()
+    scaled = []
+
+    class _Scaler:
+        def scale_to(self, n, reason=""):
+            scaled.append(n)
+
+    class _Perf:
+        def running_speed(self):
+            return 0.0
+
+    class _JM:
+        nodes = {}
+
+    autoscaler = JobAutoScaler(
+        _JM(), _Perf(), scaler=None,
+        serving_optimizer=_FixedPlan(),
+        serving_signals=lambda: _signals(live_replicas=1),
+        serve_scaler=_Scaler(), event_journal=journal,
+    )
+    for _ in range(5):
+        autoscaler.serve_tick()
+    assert scaled == [2] * 5  # executed every tick (idempotent respawn)
+    events = [e for e in journal.events() if e["kind"] == "serve_scale"]
+    assert len(events) == 1  # journaled once
+
+
+# -- satellite: chaos site serve.replica ------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_serve_replica_site_crashes_replica():
+    """Site ``serve.replica`` fires in the heartbeat loop: the injected
+    error crashes the replica abruptly (no drain, no deregister) and the
+    master journals the injected fault."""
+    from dlrover_tpu.master.master import LocalJobMaster
+    from dlrover_tpu.serving.replica import DecodeReplica
+
+    chaos.configure("serve.replica:error@nth=1", seed=7)
+    master = LocalJobMaster(job_name="serve-chaos", node_num=1, min_nodes=1)
+    master.prepare()
+    crashed = threading.Event()
+    replica = DecodeReplica(
+        master.addr, node_id=300, engine=ToyEngine(slots=2),
+        buckets=(8,), heartbeat_interval_s=0.05,
+        on_crash=crashed.set,
+    )
+    try:
+        replica.start()
+        assert master.serve_registry.count() == 1
+        assert crashed.wait(10.0), "injected heartbeat fault never fired"
+        assert replica.crashed
+        kinds = {e["kind"] for e in master.event_journal.events()}
+        assert "fault_injected" in kinds
+        # crash-like death: no drain happened, no deregister was sent
+        assert "serve_replica_drained" not in kinds
+    finally:
+        replica.stop()
+        master.stop()
+
+
+# -- the acceptance e2e -----------------------------------------------------
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+def test_serving_e2e_replica_kill_loses_zero_requests():
+    """The acceptance drill, CPU-sized: closed-loop traffic over two toy
+    decode replicas, chaos SIGKILLs one mid-traffic, and the contract is
+    zero lost requests (idempotent re-route), the kill journaled, and
+    the autoscaler restoring the replica count."""
+    from dlrover_tpu.serving.drill import run_serving_drill
+
+    result = run_serving_drill(replicas=2, backend="toy", num_requests=24)
+    assert result["completed"] == result["requests"] == 24
+    assert result["lost"] == 0
+    assert result["failed_responses"] == 0
+    assert result["killed_node"] is not None
+    assert result["kill_detected"]
+    assert result["replicas_restored"]
+    assert result["live_replicas_end"] == 2
+    assert result["rerouted"] >= 1  # the kill landed mid-traffic
+    journal = result["journal"]
+    assert journal.get("fault_injected", 0) >= 1
+    assert journal.get("serve_replica_lost", 0) >= 1
+    assert journal.get("serve_rerouted", 0) >= 1
+    assert journal.get("serve_scale", 0) >= 1  # the restore plan
+    # 2 initial + ≥1 replacement registration
+    assert journal.get("serve_replica_up", 0) >= 3
+    assert result["tokens_total"] > 0
+    assert 0.0 < result["serving_goodput"] <= 1.0

@@ -794,12 +794,12 @@ def test_package_has_no_stale_noqa():
 @pytest.mark.analysis
 def test_baseline_burn_down_floor():
     """The baseline only shrinks: PR 7 burned it from 95 down to ≤85,
-    PR 9 from 85 down to ≤80. If this fails with a LOWER count, ratchet
-    the floor down in this test; if with a higher one, a deferral leaked
-    in — fix it instead."""
+    PR 9 from 85 down to ≤80, PR 10 from 80 down to ≤76. If this fails
+    with a LOWER count, ratchet the floor down in this test; if with a
+    higher one, a deferral leaked in — fix it instead."""
     baseline_total = sum(load_baseline().values())
-    assert baseline_total <= 80, (
-        f"baseline grew to {baseline_total} entries (must stay ≤80); "
+    assert baseline_total <= 76, (
+        f"baseline grew to {baseline_total} entries (must stay ≤76); "
         "fix the new violations instead of deferring them"
     )
 
