@@ -81,6 +81,11 @@ class FaninAggregator:
         # on the master hop
         self._mailbox: Dict[int, List[Any]] = shared(
             {}, f"FaninAggregator[{node_id}]._mailbox")
+        # shard completion acks staged by children ([TaskResult]); the
+        # master's ledger is idempotent, so re-staging after a failed
+        # flush (at-least-once delivery) is safe — duplicates are no-ops
+        self._acks: List[Any] = shared(
+            [], f"FaninAggregator[{node_id}]._acks")
         self._backpressure = 0
         self._backoff_hint_s = 0.0
         self._epoch = -1
@@ -89,6 +94,7 @@ class FaninAggregator:
         self._server = RPCServer(port=0)
         self._server.register("heartbeat", self._rpc_heartbeat)
         self._server.register("report_event", self._rpc_report_event)
+        self._server.register("report_shard_acks", self._rpc_report_shard_acks)
         self._server.start()
         host = advertise_host or local_host_ip()
         self.addr = f"{host}:{self._server.port}"
@@ -133,6 +139,18 @@ class FaninAggregator:
                 del self._events[:len(self._events) - _MAX_PENDING_EVENTS]
         return comm.BaseResponse()
 
+    def _rpc_report_shard_acks(
+        self, req: comm.ShardAckBatch
+    ) -> comm.ShardAckResponse:
+        """Stage a child's shard acks for the next compound flush. The
+        reply carries no verdicts or revokes (those need the master);
+        children wanting the steal signal flush straight to the master.
+        Acks are NEVER dropped under the events cap — they are the
+        exactly-once ledger's progress, not telemetry."""
+        with self._lock:
+            self._acks.extend(req.acks or [])
+        return comm.ShardAckResponse(accepted=len(req.acks or []))
+
     # -- forward path ------------------------------------------------------
 
     def _flush_loop(self) -> None:
@@ -173,7 +191,7 @@ class FaninAggregator:
     def _flush_once(self) -> None:
         inj = get_injector()
         with self._lock:
-            have_work = bool(self._beats or self._events)
+            have_work = bool(self._beats or self._events or self._acks)
             has_children = bool(self._events) or any(
                 nid != self._node_id for nid in self._beats)
         if not have_work:
@@ -187,7 +205,7 @@ class FaninAggregator:
             # beats still in place for whoever inherits the subtree
             inj.fire("agg.forward", agg=self._node_id)
         with self._lock:
-            if not self._beats and not self._events:
+            if not self._beats and not self._events and not self._acks:
                 return
             # drain by copy+clear, NOT by rebinding to fresh containers: a
             # child's _rpc_heartbeat thread may hold a reference to the
@@ -197,6 +215,8 @@ class FaninAggregator:
             self._beats.clear()
             events = list(self._events)
             self._events.clear()
+            acks = list(self._acks)
+            self._acks.clear()
         # strip per-beat histograms into one merged field keyed by child
         # node id — halves the envelope and lets the master ingest the
         # whole subtree's skew signal in one lock pass
@@ -212,6 +232,7 @@ class FaninAggregator:
             beats=wire_beats,
             merged_telemetry=merged,
             events=events,
+            shard_acks=acks,
         )
         try:
             with tracing.span(SpanName.FANIN_FORWARD,
@@ -230,6 +251,10 @@ class FaninAggregator:
                     self._beats.setdefault(nid, beat)
                 self._events[:0] = events
                 del self._events[:len(self._events) - _MAX_PENDING_EVENTS]
+                # acks re-stage UNCAPPED: losing one breaks exactly-once
+                # accounting until the lease expires; the master ledger
+                # dedupes, so replays are free
+                self._acks[:0] = acks
             raise ConnectionError("fan-in forward failed")
         with self._lock:
             for nid, action in (resp.actions or {}).items():

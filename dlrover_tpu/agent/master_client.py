@@ -209,7 +209,7 @@ class MasterClient:
 
     def heartbeat(self, global_step: int = 0, step_timestamp: float = 0.0,
                   gauges=None, rdzv_round: int = -1,
-                  op_telemetry=None) -> comm.HeartbeatResponse:
+                  op_telemetry=None, shard_acks=None) -> comm.HeartbeatResponse:
         # bounded budget (2 attempts, ~3s deadline): a heartbeat that can't
         # get through IS the partition signal the agent's degraded-mode
         # detector consumes — the old 30-attempt default hid it for minutes
@@ -223,6 +223,10 @@ class MasterClient:
                 gauges=gauges or {},
                 rdzv_round=rdzv_round,
                 op_telemetry=op_telemetry or {},
+                # shard completion acks ride the beat one-way (fire and
+                # forget — the ledger dedupes; callers wanting the revoke
+                # feedback use report_shard_acks)
+                shard_acks=list(shard_acks or []),
             ),
             policy=retry.HEARTBEAT,
         )
@@ -366,6 +370,32 @@ class MasterClient:
         self._client.call(
             "restore_shard_checkpoint",
             comm.ShardCheckpointResponse(content=content),
+        )
+
+    def recover_shard_tasks(self) -> None:
+        """Requeue this node's in-flight shard leases (worker restart:
+        the relaunched workers must not wait out the lease timeout)."""
+        self._client.call(
+            "recover_shard_tasks", comm.TaskRequest(node_id=self._node_id)
+        )
+
+    def report_shard_acks(self, acks) -> comm.ShardAckResponse:
+        """Batched exactly-once completion acks ([TaskResult]); the reply
+        carries verdict counts + this node's pending revokes (stealing)."""
+        return self._client.call(
+            "report_shard_acks",
+            comm.ShardAckBatch(node_id=self._node_id, acks=list(acks)),
+        )
+
+    def export_data_state(self) -> str:
+        """Whole shard-ledger export (delta-chain sidecar content)."""
+        resp = self._client.call("export_data_state", comm.BaseRequest())
+        return resp.content
+
+    def import_data_state(self, content: str) -> None:
+        """Mid-epoch ledger restore on the (possibly fresh) master."""
+        self._client.call(
+            "import_data_state", comm.ShardCheckpointResponse(content=content)
         )
 
     def get_parallel_config(self) -> comm.ParallelConfig:

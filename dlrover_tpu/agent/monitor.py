@@ -163,7 +163,7 @@ class ResourceMonitor:
         try:
             collect_host_usage()
         except Exception:  # noqa: BLE001
-            pass
+            logger.debug("cpu_percent priming failed", exc_info=True)
         while not self._stopped.wait(self._interval_s):
             try:
                 self.report_once()

@@ -277,6 +277,7 @@ def run(config: ElasticLaunchConfig) -> int:
             try:
                 actor_host_proc.wait(timeout=10)
             except Exception:  # noqa: BLE001 — escalate, never hang exit
+                logger.warning("actor host ignored terminate — killing")
                 actor_host_proc.kill()
         if master is not None:
             master.stop()

@@ -455,6 +455,15 @@ class ElasticTrainingAgent:
         # die removes any chance of reading a frame mid-write
         self._stop_workers(grace_s=grace_s)
         self._save_breakpoint_checkpoint(reason)
+        # the dead workers' unacked shard leases go back to TODO now —
+        # relaunched workers (or any survivor) re-pull them immediately
+        # instead of waiting out shard_lease_timeout_s; acked shards stay
+        # retired in the master ledger, so nothing double-trains
+        try:
+            self._client.recover_shard_tasks()
+        except (ConnectionError, OSError) as e:
+            # best-effort fast path: lease expiry remains the backstop
+            logger.warning("shard-lease recovery skipped: %r", e)
         self._restart_count += 1
         # drop the stale step observation: heartbeats must not re-populate
         # the master's PerfMonitor with pre-restart timestamps (that would

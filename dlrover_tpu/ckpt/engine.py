@@ -522,10 +522,69 @@ class CheckpointEngine:
             # wait out a busy drain (bounded) instead of skipping, so
             # fast-stepping jobs can't starve the disk cadence.
             wait_s = env_float(ConfigKey.CKPT_STORAGE_WAIT, 60.0)
-            return self.save_to_memory(
+            ok = self.save_to_memory(
                 step, state, blocking=not self._has_agent,
                 _on_drained=_request_persist, _wait_busy_s=wait_s,
             )
+        if ok:
+            # fold the shard-ledger position into the step dir so a
+            # restore resumes the data stream from the same lineage as
+            # the model (elastic data plane, docs/design/
+            # elastic_data_plane.md)
+            self._persist_data_state(step, path)
+        return ok
+
+    def _persist_data_state(self, step: int, path: str) -> None:
+        """Fold the master's shard-ledger export into the step dir as a
+        sidecar (rank 0 only; best-effort — a data-plane-less job or an
+        old master simply has no sidecar and restore skips it)."""
+        if self.rank != 0 or self._master is None or not path:
+            return
+        export = getattr(self._master, "export_data_state", None)
+        if export is None:
+            return
+        try:
+            content = export()
+        except (ConnectionError, OSError, AttributeError) as e:
+            logger.warning("data-state export skipped: %r", e)
+            return
+        if not content or content == "{}":
+            return
+        try:
+            from dlrover_tpu.ckpt import manifest
+
+            manifest.write_data_state(path, step, content)
+        except OSError as e:
+            logger.warning("data-state sidecar write failed: %r", e)
+
+    def _restore_data_state(self, path: str, step: int) -> None:
+        """Mid-epoch resume: push the step's ledger sidecar back into the
+        (possibly brand-new) master so unfinished leases requeue and
+        acked shards stay retired. Rank 0, best-effort — a chain written
+        before the data plane existed restores model-only."""
+        if self.rank != 0 or self._master is None:
+            return
+        import_state = getattr(self._master, "import_data_state", None)
+        if import_state is None:
+            return
+        try:
+            from dlrover_tpu.ckpt import manifest
+
+            content = manifest.read_data_state(path, step)
+        except OSError as e:
+            logger.warning("data-state sidecar read failed: %r", e)
+            return
+        if not content:
+            return
+        try:
+            import_state(content)
+        except (ConnectionError, OSError) as e:
+            logger.warning("data-state import failed: %r", e)
+            return
+        self._report_event(
+            JournalEvent.DATA_STATE_RESTORED, {"step": step},
+        )
+        logger.info("restored shard-ledger data state from step %s", step)
 
     def _plan_state(self, step: int, state) -> Tuple[Dict, List]:
         """Planning pass: build frame metadata and dispatch async work for
@@ -1054,6 +1113,7 @@ class CheckpointEngine:
                 )
                 return None, -1
             sp.add_event("restored", step=step, frames=len(frames))
+            self._restore_data_state(path, step)
             return state, step
 
     def _load_from_storage(self, target, path: str) -> Tuple[Any, int]:

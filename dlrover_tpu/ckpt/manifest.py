@@ -115,6 +115,38 @@ def delta_file(ckpt_dir: str, step: int, node_rank: int, local_rank: int,
     )
 
 
+def data_state_file(ckpt_dir: str, step: int) -> str:
+    """The elastic data plane's shard-ledger sidecar: one JSON blob per
+    step dir (rank 0 writes it) holding the master's whole dispatch
+    position (master/task_manager.py ``export_data_state``). It rides
+    the step dir's lifecycle — compaction/GC that drops the step drops
+    the sidecar — so ``engine.load`` restores the ledger from exactly
+    the step the model chain landed on (mid-epoch exactly-once resume)."""
+    return os.path.join(step_dir(ckpt_dir, step), "data_state.json")
+
+
+def write_data_state(ckpt_dir: str, step: int, content: str,
+                     storage: Optional[CheckpointStorage] = None) -> str:
+    """Commit the ledger sidecar with the DLR012 atomic discipline
+    (write-temp → ``storage.commit`` chaos site → safe_move)."""
+    storage = storage or get_checkpoint_storage(ckpt_dir)
+    path = data_state_file(ckpt_dir, step)
+    storage.safe_makedirs(os.path.dirname(path))
+    commit_file(storage, content.encode("utf-8"), path,
+                kind="data_state", step=step)
+    return path
+
+
+def read_data_state(ckpt_dir: str, step: int) -> Optional[str]:
+    """The sidecar's content at ``step``, or None when the chain predates
+    the data plane (model-only restore stays valid)."""
+    path = data_state_file(ckpt_dir, step)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        return f.read().decode("utf-8")
+
+
 def parse_manifest_name(name: str) -> Optional[Tuple[int, int]]:
     """``manifest_<node>_<local>.mf`` → (node, local), else None."""
     pre, suf = (CheckpointConstant.MANIFEST_PREFIX,

@@ -236,6 +236,10 @@ class HeartbeatRequest:
     # str(global_rank) (observability/op_telemetry.py wire format) —
     # consumed by master/skew_monitor.py for skew/hang attribution
     op_telemetry: Dict[str, Any] = field(default_factory=dict)
+    # shard completion acks riding the heartbeat (data plane, one-way:
+    # revoke feedback only comes back on the dedicated report_shard_acks
+    # RPC) — [TaskResult]; unknown to old masters, dropped by _decode
+    shard_acks: List[Any] = field(default_factory=list)
 
 
 @message
@@ -274,6 +278,8 @@ class CompoundHeartbeatRequest:
     merged_telemetry: Dict[str, Any] = field(default_factory=dict)
     # journal events the children asked the aggregator to forward
     events: List[Any] = field(default_factory=list)  # [EventReport]
+    # shard completion acks batched from the subtree — [TaskResult]
+    shard_acks: List[Any] = field(default_factory=list)
 
 
 @message
@@ -469,6 +475,26 @@ class ShardCheckpointRequest:
 @message
 class ShardCheckpointResponse:
     content: str = ""
+
+
+@message
+class ShardAckBatch:
+    """Worker → master (directly or via a fan-in aggregator): a batch of
+    shard completion acks. The reply carries the exactly-once verdict
+    counts plus the caller's pending revokes (cooperative stealing)."""
+
+    node_id: int = 0
+    acks: List[Any] = field(default_factory=list)  # [TaskResult]
+
+
+@message
+class ShardAckResponse:
+    accepted: int = 0
+    duplicates: int = 0
+    unknown: int = 0
+    released: int = 0
+    # leases the master wants this node to shed: {dataset: [task_id]}
+    revoked: Dict[str, Any] = field(default_factory=dict)
 
 
 # --------------------------------------------------------------------------

@@ -59,6 +59,15 @@ class DefaultValues:
     ckpt_commit_poll_s: float = 0.1
     # --- data sharding ---
     task_timeout_s: float = 1800.0
+    # per-shard lease: a dispatched shard not acked within this window is
+    # requeued (the holder may have wedged without dying); measured on the
+    # MASTER's monotonic clock only — worker clocks never enter the math
+    shard_lease_timeout_s: float = 600.0
+    # lease-expiry sweep cadence of the task-monitor thread
+    shard_lease_check_s: float = 5.0
+    # bounded prefetch depth of the worker-side shard pipeline (backpressure:
+    # the producer blocks when the consumer falls behind)
+    data_prefetch_depth: int = 4
 
 
 def _cast_env(env: str, default: Any) -> Any:

@@ -12,6 +12,8 @@ one codebase running across the span instead of forking call sites.
 
 import inspect
 
+from dlrover_tpu.common.log import logger
+
 
 def install() -> None:
     """Alias ``jax.experimental.shard_map.shard_map`` as ``jax.shard_map``
@@ -58,6 +60,7 @@ def distributed_initialize(**kwargs) -> None:
 
         current = xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION.value
     except Exception:  # noqa: BLE001 — modern jax: gloo already default
+        logger.debug("cpu-collectives probe unavailable", exc_info=True)
         current = "gloo"
     if "cpu" in platforms and current in (None, "none"):
         try:
@@ -65,7 +68,7 @@ def distributed_initialize(**kwargs) -> None:
                 "jax_cpu_collectives_implementation", "gloo"
             )
         except Exception:  # noqa: BLE001 — never block worker bring-up
-            pass
+            logger.debug("gloo collectives opt-in rejected", exc_info=True)
 
     supported = inspect.signature(jax.distributed.initialize).parameters
     jax.distributed.initialize(

@@ -314,6 +314,7 @@ class RealK8sApi(K8sApi):
         try:  # pragma: no cover
             config.load_incluster_config()
         except Exception:  # noqa: BLE001 — fall back to kubeconfig
+            logger.debug("not in-cluster; using kubeconfig", exc_info=True)
             config.load_kube_config()
         self._core = client.CoreV1Api()
         self._custom = client.CustomObjectsApi()

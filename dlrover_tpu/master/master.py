@@ -126,6 +126,25 @@ class JobMaster:
         self.rdzv_managers[RendezvousName.TRAINING].straggler_history = (
             self.skew_monitor.node_straggler_counts
         )
+        # elastic data plane: the shard ledger journals its dispatch/ack
+        # lifecycle and biases shard stealing by the same straggler
+        # history the rdzv world-cut logic consults
+        self.task_manager.journal = self.event_journal
+        self.task_manager.straggler_history = (
+            self.skew_monitor.node_straggler_counts
+        )
+        # straggler-aware shard stealing: a compute/input verdict sheds
+        # the slow node's tail leases cooperatively (task_manager journals
+        # the steal; the victim learns on its next ack flush)
+        def _steal_on_straggler(event, _tm=self.task_manager):
+            if event["kind"] != JournalEvent.STRAGGLER_DETECTED:
+                return
+            data = event.get("data") or {}
+            node_id = data.get("node_id", -1)
+            if node_id >= 0 and data.get("cause") in ("compute", "input"):
+                _tm.shed_straggler(node_id)
+
+        self.event_journal.add_listener(_steal_on_straggler)
         # hierarchical control-plane fan-in (master/fanin.py): aggregation
         # tree assignment + overload ladder. Backpressure level changes
         # widen the job manager's liveness deadlines — telemetry is shed

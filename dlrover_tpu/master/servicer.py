@@ -223,6 +223,10 @@ class MasterServicer:
                 plane.note_shed()
             else:
                 self._skew_monitor.observe(req.node_id, req.op_telemetry)
+        if req.shard_acks and self._task_manager is not None:
+            # one-way delivery (no revoke feedback on this path — workers
+            # that want the steal signal use rpc_report_shard_acks)
+            self._task_manager.ack_batch(req.node_id, req.shard_acks)
         resp = comm.HeartbeatResponse(
             action_type=action.action_type,
             action_data={"reason": action.reason, **action.data},
@@ -280,6 +284,8 @@ class MasterServicer:
                 ])
         for ev in req.events or []:
             self.rpc_report_event(ev)
+        if req.shard_acks and self._task_manager is not None:
+            self._task_manager.ack_batch(req.agg_node_id, req.shard_acks)
         resp = comm.CompoundHeartbeatResponse(actions=actions)
         if plane is not None:
             plane.note_beats(max(1, len(req.beats)),
@@ -452,6 +458,51 @@ class MasterServicer:
     ) -> comm.BaseResponse:
         if self._task_manager is not None:
             self._task_manager.restore_shard_checkpoint(req.content)
+        return comm.BaseResponse()
+
+    def rpc_recover_shard_tasks(
+        self, req: comm.TaskRequest
+    ) -> comm.BaseResponse:
+        """Requeue every lease a node still holds — the agent calls this
+        around a worker restart so relaunched workers re-pull the shards
+        immediately instead of waiting out the lease timeout."""
+        if self._task_manager is not None:
+            self._task_manager.recover_tasks(req.node_id)
+        return comm.BaseResponse()
+
+    def rpc_report_shard_acks(
+        self, req: comm.ShardAckBatch
+    ) -> comm.ShardAckResponse:
+        """Batched exactly-once acks; reply piggybacks pending revokes so
+        a straggler learns which tail leases to shed cooperatively."""
+        if self._task_manager is None:
+            return comm.ShardAckResponse()
+        counts = self._task_manager.ack_batch(req.node_id, req.acks)
+        return comm.ShardAckResponse(
+            accepted=counts["accepted"],
+            duplicates=counts["duplicates"],
+            unknown=counts["unknown"],
+            released=counts["released"],
+            revoked=counts["revoked"],
+        )
+
+    def rpc_export_data_state(
+        self, req: comm.BaseRequest
+    ) -> comm.ShardCheckpointResponse:
+        """Whole-ledger export for the delta-chain data-state sidecar."""
+        if self._task_manager is None:
+            return comm.ShardCheckpointResponse()
+        return comm.ShardCheckpointResponse(
+            content=self._task_manager.export_data_state()
+        )
+
+    def rpc_import_data_state(
+        self, req: comm.ShardCheckpointResponse
+    ) -> comm.BaseResponse:
+        """Mid-epoch ledger restore from a delta-chain sidecar (called by
+        ``engine.load`` after the model chain lands)."""
+        if self._task_manager is not None:
+            self._task_manager.import_data_state(req.content)
         return comm.BaseResponse()
 
     # -- config ------------------------------------------------------------

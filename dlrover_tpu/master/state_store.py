@@ -10,10 +10,14 @@ agents across the outage without their noticing more than latency.
 
 Persisted (the state whose loss costs correctness or data):
 - the KV store — checkpoint readiness/step keys, user barriers' backing;
-- every registered dataset: its creation params + the shard-queue
-  position (todo/doing re-queued as todo, epochs, completion counts), so
-  a master restart does not re-serve consumed data or drop in-flight
-  shards (reference get_shard_checkpoint semantics, task_manager.py:125);
+- every registered dataset: its creation params + the shard-ledger
+  position (todo/doing re-queued as todo, the ACKED set — the
+  exactly-once idempotence anchor — epochs, completion counts), so a
+  master restart does not re-serve consumed data, drop in-flight shards,
+  or re-train a shard whose late duplicate ack arrives after the restart
+  (reference get_shard_checkpoint semantics, task_manager.py; the same
+  blob also rides the delta-chain checkpoint as the ``data_state.json``
+  sidecar — docs/design/elastic_data_plane.md);
 - the last completed global step (perf monitor seed, so hang detection
   and speed windows restart sane).
 

@@ -1,67 +1,118 @@
-"""Task manager: dispatch dataset shards to workers, re-queue on failure.
+"""Shard ledger: exactly-once dispatch of dataset shards to workers.
 
 Reference: dlrover/python/master/shard/task_manager.py:35
 (``report_dataset_task``:125, ``task_hanged``:144) +
-batch_dataset_manager.py. Workers pull shard *tasks*; tasks held by a dead
-worker go back on the todo queue (the data-loss-free elasticity property);
-the whole dispatch position can be checkpointed/restored so a master restart
-resumes mid-epoch.
+batch_dataset_manager.py. Workers pull shard *tasks* under a per-shard
+LEASE; a completion ACK retires the lease into the ``acked`` set (the
+idempotence anchor — duplicate acks and acks for stolen-then-finished
+shards are no-ops). Leases held by a dead worker are requeued; leases
+that outlive ``shard_lease_timeout_s`` on the MASTER's monotonic clock
+are requeued (DLR001: worker clocks never enter the deadline math); slow
+ranks shed tail leases cooperatively via :meth:`shed_node`. The whole
+dispatch position — including the acked set — can be checkpointed and
+restored, so a master restart resumes mid-epoch without dropping or
+double-training a sample relative to the restored model state.
+
+State machine (docs/design/elastic_data_plane.md):
+
+    TODO --get_task--> LEASED --ack--> ACKED
+      ^                  |  |
+      |---requeue--------+  +--steal--> (revoke-requested LEASED)
+
+Ledger maps are registered with the race detector via ``shared(...)``;
+the tier-1 ``race``-marked drill in tests/test_data_plane.py certifies
+the dispatch/ack/steal cycle.
 """
 
 import json
 import threading
 import time
-from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.comm import DatasetShardParams, Shard, TaskMessage
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.analysis.race_detector import shared
+from dlrover_tpu.chaos.injector import get_injector
 from dlrover_tpu.master.dataset_splitter import DatasetSplitter
+from dlrover_tpu.observability.journal import JournalEvent
+from dlrover_tpu.observability.registry import get_registry
 
 
 class _PendingTask:
-    def __init__(self, task: TaskMessage, node_id: int):
+    def __init__(self, task: TaskMessage, node_id: int, leased_at: float,
+                 deadline: float):
         self.task = task
         self.node_id = node_id
-        self.start_time = time.monotonic()  # hang-detection stamp
+        self.leased_at = leased_at
+        self.deadline = deadline  # master-monotonic lease expiry
+        self.revoke_requested = False
 
 
 class _DatasetManager:
-    def __init__(self, splitter: DatasetSplitter):
+    """One dataset's ledger. All mutations run under the owning
+    TaskManager's RLock (``self._lock`` — reentrant, so callers already
+    holding it recurse safely)."""
+
+    def __init__(self, splitter: DatasetSplitter, lock: threading.RLock):
+        name = splitter.dataset_name
         self.splitter = splitter
-        self.todo: Deque[TaskMessage] = deque()
-        self.doing: Dict[int, _PendingTask] = {}
+        self._lock = lock
+        # list, not deque: the race-detector proxy tracks dict/list/set
+        self.todo: List[TaskMessage] = shared(
+            [], f"TaskManager[{name}].todo")
+        self.doing: Dict[int, _PendingTask] = shared(
+            {}, f"TaskManager[{name}].doing")
+        self.acked = shared(set(), f"TaskManager[{name}].acked")
         self.next_task_id = 0
         self.completed = 0
 
     def refill(self) -> None:
-        if self.todo or self.doing:
-            return
-        if self.splitter.epoch_finished():
-            return
-        for shard in self.splitter.create_shards():
-            self.todo.append(
-                TaskMessage(
-                    task_id=self.next_task_id,
-                    task_type="train",
-                    shard=shard,
-                    dataset_name=self.splitter.dataset_name,
+        with self._lock:
+            if self.todo or self.doing:
+                return
+            if self.splitter.epoch_finished():
+                return
+            for shard in self.splitter.create_shards():
+                self.todo.append(
+                    TaskMessage(
+                        task_id=self.next_task_id,
+                        task_type="train",
+                        shard=shard,
+                        dataset_name=self.splitter.dataset_name,
+                    )
                 )
-            )
-            self.next_task_id += 1
+                self.next_task_id += 1
 
     def finished(self) -> bool:
-        return (
-            self.splitter.epoch_finished()
-            and not self.todo
-            and not self.doing
-        )
+        with self._lock:
+            return (
+                self.splitter.epoch_finished()
+                and not self.todo
+                and not self.doing
+            )
+
+    def requeue(self, pending: _PendingTask) -> None:
+        with self._lock:
+            self.todo.insert(0, pending.task)
 
 
 class TaskManager:
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
+    """Master-side shard ledger with leases, acks, requeue, and stealing.
+
+    ``monotonic`` is injectable for deterministic lease-expiry tests;
+    production always uses ``time.monotonic`` (the master's own clock —
+    the DLR001 discipline for every deadline in this file).
+    """
+
+    def __init__(
+        self,
+        monotonic: Callable[[], float] = time.monotonic,
+        journal=None,
+        straggler_history: Optional[Callable[[], Dict[int, int]]] = None,
+    ) -> None:
+        self._monotonic = monotonic
+        self._lock = threading.RLock()
         self._datasets: Dict[str, _DatasetManager] = {}
         self._params: Dict[str, DatasetShardParams] = {}
         self._stopped = threading.Event()
@@ -70,6 +121,26 @@ class TaskManager:
         # snapshots would vanish on a master crash (clients never re-issue
         # setup_dataset), so registration triggers an immediate snapshot
         self.on_new_dataset = None
+        self.journal = journal
+        # rdzv straggler_history hook: repeat offenders shed more shards
+        self.straggler_history = straggler_history
+        reg = get_registry()
+        self._m_dispatch = reg.counter(
+            "dlrover_data_dispatch_total", "Shard leases handed out")
+        self._m_ack = reg.counter(
+            "dlrover_data_ack_total", "Shard completion acks accepted")
+        self._m_requeue = reg.counter(
+            "dlrover_data_requeue_total",
+            "Shard leases requeued (death, expiry, release)")
+        self._m_steal = reg.counter(
+            "dlrover_data_steal_total", "Shard leases marked for stealing")
+        self._m_inflight = reg.gauge(
+            "dlrover_data_inflight", "Currently leased shards")
+
+    def _journal(self, kind: str, **data) -> None:
+        j = self.journal
+        if j is not None:
+            j.record(kind, source="master", **data)
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -85,7 +156,8 @@ class TaskManager:
             if params.dataset_name in self._datasets:
                 return
             splitter = DatasetSplitter.build(params)
-            self._datasets[params.dataset_name] = _DatasetManager(splitter)
+            self._datasets[params.dataset_name] = _DatasetManager(
+                splitter, self._lock)
             self._params[params.dataset_name] = params
             logger.info("task manager: registered dataset %s (size=%s)",
                         params.dataset_name, params.dataset_size)
@@ -101,6 +173,8 @@ class TaskManager:
         with self._lock:
             return self._params.get(name)
 
+    # -- dispatch ----------------------------------------------------------
+
     def get_task(self, node_id: int, dataset_name: str) -> Optional[TaskMessage]:
         with self._lock:
             ds = self._datasets.get(dataset_name)
@@ -109,65 +183,276 @@ class TaskManager:
             ds.refill()
             if not ds.todo:
                 return None
-            task = ds.todo.popleft()
-            ds.doing[task.task_id] = _PendingTask(task, node_id)
-            return task
+            task = ds.todo.pop(0)
+            now = self._monotonic()
+            deadline = now + get_context().shard_lease_timeout_s
+            ds.doing[task.task_id] = _PendingTask(
+                task, node_id, now, deadline)
+            self._m_dispatch.inc()
+            self._m_inflight.inc()
+        self._journal(
+            JournalEvent.DATA_DISPATCH, dataset=dataset_name,
+            task_id=task.task_id, node_id=node_id,
+        )
+        # chaos site AFTER the lease is recorded: a dropped dispatch loses
+        # only the reply — the lease stays live and re-queues on expiry
+        inj = get_injector()
+        if inj is not None:
+            inj.fire(
+                "data.dispatch", dataset=dataset_name,
+                task_id=task.task_id, node_id=node_id,
+            )
+        return task
+
+    # -- acks --------------------------------------------------------------
+
+    def ack_task(
+        self, dataset_name: str, task_id: int, node_id: int, success: bool
+    ) -> str:
+        """Retire (or release) one lease. Returns the verdict:
+
+        - ``"accepted"``   — first successful ack; shard moves to ACKED.
+        - ``"duplicate"``  — already ACKED (retried ack after a dropped
+          reply, or a stolen shard both holders finished): no-op.
+        - ``"released"``   — failure ack; lease returns to TODO.
+        - ``"unknown"``    — no such lease and not acked (pre-restore id).
+
+        First-ack-wins: if the shard was requeued/stolen and someone else
+        now holds (or queues) it, the FIRST successful ack retires it —
+        the ledger cancels the other copy so it is never trained again.
+        """
+        revoked_other: Optional[_PendingTask] = None
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return "unknown"
+            if task_id in ds.acked:
+                return "duplicate"
+            if not success:
+                pending = ds.doing.pop(task_id, None)
+                if pending is None:
+                    return "unknown"
+                ds.requeue(pending)
+                self._m_requeue.inc()
+                self._m_inflight.dec()
+                verdict = "released"
+            else:
+                pending = ds.doing.pop(task_id, None)
+                if pending is None:
+                    # requeued copy still in TODO? the ack proves the work
+                    # finished — pull it so nobody trains it again
+                    idx = next(
+                        (i for i, t in enumerate(ds.todo)
+                         if t.task_id == task_id), None)
+                    if idx is None:
+                        return "unknown"
+                    ds.todo.pop(idx)
+                else:
+                    if pending.node_id != node_id:
+                        # stolen and redispatched: the other holder's lease
+                        # is cancelled (revoke-notified on its next flush)
+                        revoked_other = pending
+                    self._m_inflight.dec()
+                ds.acked.add(task_id)
+                ds.completed += 1
+                self._m_ack.inc()
+                verdict = "accepted"
+            epoch_done = ds.finished()
+        self._journal(
+            JournalEvent.DATA_ACK, dataset=dataset_name, task_id=task_id,
+            node_id=node_id, verdict=verdict,
+        )
+        if revoked_other is not None:
+            logger.info(
+                "ack of %s:%s by node %s cancels duplicate lease on node %s",
+                dataset_name, task_id, node_id, revoked_other.node_id,
+            )
+        if epoch_done:
+            self._journal(
+                JournalEvent.DATA_EPOCH_COMPLETE, dataset=dataset_name,
+                completed=self.completed_count(dataset_name),
+            )
+        return verdict
+
+    def ack_batch(self, node_id: int, acks: List) -> Dict:
+        """Apply a batch of TaskResult acks; returns counts + the caller's
+        pending revoke list (piggybacked so the victim learns to shed)."""
+        counts = {"accepted": 0, "duplicates": 0, "unknown": 0, "released": 0}
+        for r in acks:
+            verdict = self.ack_task(
+                r.dataset_name, r.task_id,
+                getattr(r, "node_id", node_id), r.success,
+            )
+            if verdict == "accepted":
+                counts["accepted"] += 1
+            elif verdict == "duplicate":
+                counts["duplicates"] += 1
+            elif verdict == "released":
+                counts["released"] += 1
+            else:
+                counts["unknown"] += 1
+        counts["revoked"] = self.pending_revokes(node_id)
+        return counts
 
     def report_task_result(
         self, dataset_name: str, task_id: int, node_id: int, success: bool
     ) -> None:
+        """Backward-compatible single-ack entry point."""
+        self.ack_task(dataset_name, task_id, node_id, success)
+
+    def completed_count(self, dataset_name: str) -> int:
         with self._lock:
             ds = self._datasets.get(dataset_name)
-            if ds is None:
-                return
-            pending = ds.doing.pop(task_id, None)
-            if pending is None:
-                return
-            if success:
-                ds.completed += 1
-            else:
-                ds.todo.appendleft(pending.task)
+            return ds.completed if ds else 0
+
+    # -- recovery ----------------------------------------------------------
 
     def recover_tasks(self, node_id: int) -> None:
         """Re-queue all in-flight tasks of a dead worker (reference
         TaskRescheduleCallback, node/event_callback.py)."""
+        requeued: Dict[str, List[int]] = {}
         with self._lock:
-            for ds in self._datasets.values():
+            for name, ds in self._datasets.items():
                 stale = [
                     tid for tid, p in ds.doing.items() if p.node_id == node_id
                 ]
                 for tid in stale:
-                    ds.todo.appendleft(ds.doing.pop(tid).task)
+                    ds.requeue(ds.doing.pop(tid))
+                    self._m_requeue.inc()
+                    self._m_inflight.dec()
                 if stale:
+                    requeued[name] = stale
                     logger.info(
                         "re-queued %s tasks of dead node %s on dataset %s",
-                        len(stale), node_id, ds.splitter.dataset_name,
+                        len(stale), node_id, name,
                     )
+        for name, tids in requeued.items():
+            self._journal(
+                JournalEvent.DATA_REQUEUE, dataset=name, node_id=node_id,
+                task_ids=tids, count=len(tids), reason="node_dead",
+            )
 
     def finished(self, dataset_name: str) -> bool:
         with self._lock:
             ds = self._datasets.get(dataset_name)
             return ds.finished() if ds else True
 
-    # -- hang detection ----------------------------------------------------
+    # -- stealing (skew-driven) -------------------------------------------
+
+    def shed_node(self, node_id: int, bias: int = 0) -> List[int]:
+        """Mark the tail leases of a slow node revoke-requested.
+
+        Cooperative: the victim learns via the piggybacked ``revoked``
+        list on its next ack flush and releases unstarted tasks itself;
+        a task it already started trains to completion (first-ack-wins
+        keeps that correct). As a backstop for a wedged victim the
+        stolen leases' deadlines are shortened to lease_timeout/4.
+
+        ``bias`` (straggler episode count from the rdzv
+        ``straggler_history`` hook) sheds more aggressively for repeat
+        offenders: keep the oldest ``len >> min(bias, 4)`` leases.
+        """
+        stolen: List[int] = []
+        per_ds: Dict[str, List[int]] = {}
+        with self._lock:
+            now = self._monotonic()
+            grace = get_context().shard_lease_timeout_s / 4.0
+            for name, ds in self._datasets.items():
+                mine = sorted(
+                    (p for p in ds.doing.values() if p.node_id == node_id),
+                    key=lambda p: p.leased_at,
+                )
+                if len(mine) <= 1:
+                    continue
+                keep = max(1, len(mine) >> max(1, min(bias, 4)))
+                here: List[int] = []
+                for p in mine[keep:]:
+                    if not p.revoke_requested:
+                        p.revoke_requested = True
+                        p.deadline = min(p.deadline, now + grace)
+                        here.append(p.task.task_id)
+                        self._m_steal.inc()
+                if here:
+                    per_ds[name] = here
+                    stolen.extend(here)
+        for name, ids in per_ds.items():
+            self._journal(
+                JournalEvent.DATA_STEAL, dataset=name,
+                node_id=node_id, task_ids=ids, bias=bias,
+            )
+        if stolen:
+            logger.info(
+                "shed node %s: %s tail leases revoke-requested (bias=%s)",
+                node_id, len(stolen), bias,
+            )
+        return stolen
+
+    def shed_straggler(self, node_id: int) -> List[int]:
+        """Shed with bias from the rdzv straggler_history hook."""
+        bias = 1
+        hist = self.straggler_history
+        if hist is not None:
+            try:
+                bias = max(1, int(hist().get(node_id, 1)))
+            except Exception:  # noqa: BLE001 — advisory bias only
+                logger.debug("straggler_history hook failed", exc_info=True)
+        return self.shed_node(node_id, bias=bias)
+
+    def pending_revokes(self, node_id: int) -> Dict[str, List[int]]:
+        """Revoke-requested lease ids still held by ``node_id`` (sent back
+        on the ack-flush reply so the victim sheds cooperatively)."""
+        with self._lock:
+            out: Dict[str, List[int]] = {}
+            for name, ds in self._datasets.items():
+                ids = [
+                    tid for tid, p in ds.doing.items()
+                    if p.node_id == node_id and p.revoke_requested
+                ]
+                if ids:
+                    out[name] = ids
+            return out
+
+    def release_task(self, dataset_name: str, task_id: int,
+                     node_id: int) -> None:
+        """Victim-side cooperative release of a revoke-requested (or
+        simply unwanted) lease: back to TODO, trainable by anyone."""
+        self.ack_task(dataset_name, task_id, node_id, success=False)
+
+    # -- lease expiry (master-monotonic; DLR001) ---------------------------
+
+    def check_leases(self) -> int:
+        """Requeue every lease past its deadline. Synchronous and
+        fake-clock-testable; the task-monitor thread calls this on a
+        ``shard_lease_check_s`` cadence. Returns the requeue count."""
+        expired: Dict[str, List[int]] = {}
+        with self._lock:
+            now = self._monotonic()
+            for name, ds in self._datasets.items():
+                hanged = [
+                    tid for tid, p in ds.doing.items() if now > p.deadline
+                ]
+                for tid in hanged:
+                    pending = ds.doing.pop(tid)
+                    ds.requeue(pending)
+                    self._m_requeue.inc()
+                    self._m_inflight.dec()
+                    logger.warning(
+                        "lease %s:%s on node %s expired — re-queued",
+                        name, tid, pending.node_id,
+                    )
+                if hanged:
+                    expired[name] = hanged
+        for name, tids in expired.items():
+            self._journal(
+                JournalEvent.DATA_REQUEUE, dataset=name, task_ids=tids,
+                count=len(tids), reason="lease_expired",
+            )
+        return sum(len(v) for v in expired.values())
 
     def _check_hanged_tasks(self) -> None:
-        timeout = get_context().task_timeout_s
-        while not self._stopped.wait(30.0):
-            now = time.monotonic()
-            with self._lock:
-                for ds in self._datasets.values():
-                    hanged = [
-                        tid for tid, p in ds.doing.items()
-                        if now - p.start_time > timeout
-                    ]
-                    for tid in hanged:
-                        pending = ds.doing.pop(tid)
-                        ds.todo.appendleft(pending.task)
-                        logger.warning(
-                            "task %s on node %s hanged > %.0fs — re-queued",
-                            tid, pending.node_id, timeout,
-                        )
+        poll = get_context().shard_lease_check_s
+        while not self._stopped.wait(poll):
+            self.check_leases()
 
     # -- shard checkpoint (reference task_manager shard checkpoint) --------
 
@@ -196,6 +481,9 @@ class TaskManager:
                 # are the oldest shards (restore preserves rough order)
                 "todo": doing + todo,
                 "shards": shards,
+                # the idempotence anchor survives restore: a late ack for a
+                # pre-snapshot shard stays a duplicate, never a re-train
+                "acked": sorted(ds.acked),
                 "next_task_id": ds.next_task_id,
                 "completed": ds.completed,
             })
@@ -214,6 +502,8 @@ class TaskManager:
                 ds.splitter._offset = offset
             ds.todo.clear()
             ds.doing.clear()
+            ds.acked.clear()
+            ds.acked.update(int(t) for t in data.get("acked", []))
             ds.completed = data.get("completed", 0)
             for tid in data["todo"]:
                 entry = data["shards"][str(tid)] if isinstance(
@@ -235,7 +525,52 @@ class TaskManager:
                     )
                 )
             ds.next_task_id = data["next_task_id"]
+            restored = len(ds.todo)
             logger.info(
                 "restored shard checkpoint for %s: %s pending tasks",
-                data["dataset"], len(ds.todo),
+                data["dataset"], restored,
             )
+        self._journal(
+            JournalEvent.DATA_STATE_RESTORED, dataset=data["dataset"],
+            pending=restored, epoch=data["epoch"],
+        )
+
+    # -- whole-ledger export/import (delta-chain sidecar) ------------------
+
+    def export_data_state(self) -> str:
+        """Everything ``engine.save_to_storage`` folds into the chain:
+        dataset params (so import can re-register from scratch) + the
+        per-dataset shard checkpoint."""
+        import base64
+
+        from dlrover_tpu.common import comm
+
+        with self._lock:
+            names = list(self._datasets)
+        datasets = []
+        for name in names:
+            params = self.dataset_params(name)
+            if params is None:
+                continue
+            datasets.append({
+                "params": base64.b64encode(
+                    comm.serialize(params)).decode("ascii"),
+                "ckpt": self.get_shard_checkpoint(name),
+            })
+        return json.dumps({"v": 1, "datasets": datasets})
+
+    def import_data_state(self, content: str) -> None:
+        """Idempotently re-register datasets and restore their ledgers
+        (the ``engine.load`` mid-epoch resume path)."""
+        import base64
+
+        from dlrover_tpu.common import comm
+
+        if not content:
+            return
+        data = json.loads(content)
+        for entry in data.get("datasets", []):
+            params = comm.deserialize(
+                base64.b64decode(entry["params"].encode("ascii")))
+            self.new_dataset(params)
+            self.restore_shard_checkpoint(entry["ckpt"])

@@ -90,6 +90,19 @@ class JournalEvent:
     SERVE_REQUEST_FAILED = "serve_request_failed"
     SERVE_REROUTED = "serve_rerouted"
     SERVE_SCALE = "serve_scale"
+    # elastic data plane (master/task_manager.py shard ledger): dispatch/
+    # ack are the per-shard lease lifecycle; requeue covers dead-node
+    # recovery, lease expiry, and cooperative releases; steal is the
+    # skew-driven shed request; epoch_complete closes one pass over a
+    # dataset; state_restored marks a mid-epoch ledger import from the
+    # delta-chain sidecar. All informational — no phase transitions (the
+    # input plane never suspends goodput attribution by itself).
+    DATA_DISPATCH = "data_dispatch"
+    DATA_ACK = "data_ack"
+    DATA_REQUEUE = "data_requeue"
+    DATA_STEAL = "data_steal"
+    DATA_EPOCH_COMPLETE = "data_epoch_complete"
+    DATA_STATE_RESTORED = "data_state_restored"
 
     ALL = (
         FAULT_DETECTED, RDZV_START, RDZV_COMPLETE, RESTORE_START,
@@ -101,6 +114,8 @@ class JournalEvent:
         FANIN_REPARENTED, FANIN_BACKPRESSURE, CKPT_CHAIN_TRUNCATED,
         SERVE_REPLICA_UP, SERVE_REPLICA_LOST, SERVE_REPLICA_DRAINED,
         SERVE_REQUEST_FAILED, SERVE_REROUTED, SERVE_SCALE,
+        DATA_DISPATCH, DATA_ACK, DATA_REQUEUE, DATA_STEAL,
+        DATA_EPOCH_COMPLETE, DATA_STATE_RESTORED,
     )
 
 

@@ -20,6 +20,13 @@ TPU notes: a JAX input pipeline is host-side numpy — one process per host
 feeds its addressable shard of the global batch. The sampler therefore
 partitions by *host* (process), and ``device_put`` with the batch
 NamedSharding turns per-host arrays into one global jax.Array.
+
+Exactly-once + prefetch live in trainer/data_plane.py
+(:class:`~dlrover_tpu.trainer.data_plane.DataShardClient` /
+:class:`~dlrover_tpu.trainer.data_plane.PrefetchPipeline`): the classes
+here report completion optimistically (at-most-once on a worker death),
+the data-plane client batches idempotent acks against the master's shard
+ledger so a world cut neither drops nor double-trains a shard.
 """
 
 import json

@@ -1,0 +1,264 @@
+"""Worker-side exactly-once data plane: ack-batching client + prefetch.
+
+Pairs with the master shard ledger (master/task_manager.py). Two pieces:
+
+- :class:`DataShardClient` — pulls shard leases, batches completion acks
+  (flushed through ``report_shard_acks`` — directly to the master or via
+  a fan-in aggregator's child RPC server), and learns which of its
+  leases the master wants stolen (the piggybacked ``revoked`` list on
+  the flush reply). Acks survive dropped flushes by re-staging; the
+  master ledger dedupes, so at-least-once delivery composes into
+  exactly-once accounting.
+- :class:`PrefetchPipeline` — a bounded background producer that keeps
+  the next shards loaded while the current one trains. Backpressure is
+  the queue bound (``data_prefetch_depth``); the consumer-side queue
+  wait is observed into ``op_telemetry``'s ``input`` op-class, so a
+  starved input pipeline surfaces through the SAME skew-attribution
+  plane as a slow compute rank — and a healthy prefetch keeps ``input``
+  out of the straggler verdicts entirely.
+
+Chaos site ``data.report`` fires in :meth:`DataShardClient.flush`
+BEFORE the RPC leaves: a ``drop`` keeps the acks staged (no loss, the
+retry is a duplicate-safe replay); the master-side ``data.dispatch``
+site covers the other direction (docs/design/fault_injection.md).
+"""
+
+import queue
+import threading
+import time
+from typing import Any, Callable, List, Optional, Set, Tuple
+
+from dlrover_tpu.chaos.injector import get_injector
+from dlrover_tpu.common import comm
+from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.op_telemetry import OpClass, get_accumulator
+from dlrover_tpu.observability.registry import get_registry
+
+
+class DataShardClient:
+    """Shard leases in, batched exactly-once acks out.
+
+    ``flush_every`` bounds the ack batch (and the window a master
+    restart can roll back — see the exactly-once argument in
+    docs/design/elastic_data_plane.md); ``flush_every=1`` gives
+    synchronous per-shard acks for drills that need a tight audit.
+    """
+
+    def __init__(
+        self,
+        master_client,
+        dataset_name: str,
+        batch_size: int,
+        dataset_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        num_minibatches_per_shard: int = 2,
+        splitter: str = "batch",
+        storage_type: str = "",
+        flush_every: int = 8,
+    ):
+        self._mc = master_client
+        self.dataset_name = dataset_name
+        self._node_id = getattr(master_client, "_node_id", 0)
+        self._flush_every = max(1, flush_every)
+        self._lock = threading.Lock()
+        self._staged: List[comm.TaskResult] = []
+        self._revoked: Set[Tuple[str, int]] = set()
+        params = comm.DatasetShardParams(
+            batch_size=batch_size,
+            num_epochs=num_epochs,
+            dataset_size=dataset_size,
+            shuffle=shuffle,
+            num_minibatches_per_shard=num_minibatches_per_shard,
+            dataset_name=dataset_name,
+            storage_type=storage_type,
+            splitter=splitter,
+        )
+        self._mc.setup_dataset(params)  # idempotent on the master
+
+    # -- leases ------------------------------------------------------------
+
+    def next_task(self) -> Optional[comm.TaskMessage]:
+        """Next shard lease, or None when the dataset is exhausted."""
+        task = self._mc.get_task(self.dataset_name)
+        if task is None or task.task_id < 0:
+            return None
+        return task
+
+    # -- acks --------------------------------------------------------------
+
+    def complete(self, task: comm.TaskMessage) -> Optional[comm.ShardAckResponse]:
+        """Stage a success ack; flushes when the batch bound is hit.
+        Returns the flush response when one happened (``flush_every=1``
+        callers get the per-shard verdict synchronously)."""
+        return self._stage(task, success=True)
+
+    def release(self, task: comm.TaskMessage) -> Optional[comm.ShardAckResponse]:
+        """Cooperative give-back (revoked or unwanted lease): the shard
+        returns to TODO for anyone to train."""
+        return self._stage(task, success=False)
+
+    def _stage(
+        self, task: comm.TaskMessage, success: bool
+    ) -> Optional[comm.ShardAckResponse]:
+        with self._lock:
+            self._staged.append(
+                comm.TaskResult(
+                    dataset_name=task.dataset_name or self.dataset_name,
+                    task_id=task.task_id,
+                    node_id=self._node_id,
+                    success=success,
+                )
+            )
+            due = len(self._staged) >= self._flush_every
+        if due:
+            return self.flush()
+        return None
+
+    def flush(self) -> Optional[comm.ShardAckResponse]:
+        """Send staged acks. A connection failure re-stages them (the
+        ledger dedupes replays); the reply's ``revoked`` list marks
+        leases this node should shed."""
+        with self._lock:
+            if not self._staged:
+                return None
+            acks = list(self._staged)
+            self._staged.clear()
+        try:
+            inj = get_injector()
+            if inj is not None:
+                inj.fire("data.report", node_id=self._node_id,
+                         count=len(acks))
+            resp = self._mc.report_shard_acks(acks)
+        except (ConnectionError, OSError) as e:
+            with self._lock:
+                self._staged[:0] = acks
+            logger.warning(
+                "shard-ack flush failed (%r): %s acks re-staged",
+                e, len(acks),
+            )
+            return None
+        for ds, ids in (resp.revoked or {}).items():
+            with self._lock:
+                self._revoked.update((ds, int(t)) for t in ids)
+        return resp
+
+    def pending_acks(self) -> int:
+        with self._lock:
+            return len(self._staged)
+
+    # -- stealing ----------------------------------------------------------
+
+    def is_revoked(self, task: comm.TaskMessage) -> bool:
+        """True if the master asked this node to shed the lease. The
+        caller releases tasks it has NOT started; a task mid-training
+        runs to completion (first-ack-wins keeps that exactly-once)."""
+        with self._lock:
+            return (
+                (task.dataset_name or self.dataset_name), task.task_id
+            ) in self._revoked
+
+    # -- epoch -------------------------------------------------------------
+
+    def drain(self) -> None:
+        """Flush until nothing is staged (end-of-epoch barrier)."""
+        while self.pending_acks():
+            if self.flush() is None:
+                time.sleep(0.2)
+
+
+class PrefetchPipeline:
+    """Bounded background shard prefetch with input-op-class telemetry.
+
+    ``loader(task) -> payload`` runs in the producer thread (the host I/O
+    the pipeline exists to hide). Iterating yields ``(task, payload)``;
+    the CALLER acks via ``client.complete(task)`` after the step trains —
+    the pipeline never acks untrained work. Revoked leases are released
+    before they are yielded.
+    """
+
+    def __init__(
+        self,
+        client: DataShardClient,
+        loader: Callable[[comm.TaskMessage], Any],
+        depth: Optional[int] = None,
+    ):
+        self._client = client
+        self._loader = loader
+        self._depth = max(1, depth or get_context().data_prefetch_depth)
+        self._q: "queue.Queue" = queue.Queue(maxsize=self._depth)
+        self._stopped = threading.Event()
+        self._exhausted = threading.Event()
+        get_registry().gauge(
+            "dlrover_data_prefetch_occupancy",
+            "Loaded shards waiting in the worker prefetch queue",
+        ).set_function(self._q.qsize)
+        self._thread = threading.Thread(
+            target=self._produce, name="data-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _produce(self) -> None:
+        try:
+            while not self._stopped.is_set():
+                task = self._client.next_task()
+                if task is None:
+                    break
+                if self._client.is_revoked(task):
+                    self._client.release(task)
+                    continue
+                payload = self._loader(task)
+                while not self._stopped.is_set():
+                    try:  # bounded put = the backpressure point
+                        self._q.put((task, payload), timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+        finally:
+            self._exhausted.set()
+
+    def __iter__(self):
+        while True:
+            t0 = time.monotonic()
+            try:
+                item = self._q.get(timeout=0.2)
+            except queue.Empty:
+                if self._exhausted.is_set() and self._q.empty():
+                    return
+                if self._stopped.is_set():
+                    return
+                continue
+            # consumer-side queue wait IS the input-pipeline health
+            # signal: a warm queue reads ~0, a starved one accumulates
+            # and surfaces as the `input` op class in skew attribution
+            wait_us = (time.monotonic() - t0) * 1e6
+            get_accumulator().observe(OpClass.HOST_INPUT, wait_us)
+            task, payload = item
+            if self._client.is_revoked(task):
+                self._client.release(task)
+                continue
+            yield task, payload
+
+    def occupancy(self) -> int:
+        return self._q.qsize()
+
+    def stop(self, join_s: float = 5.0) -> None:
+        self._stopped.set()
+        self._thread.join(join_s)
+
+
+def make_prefetching_loader(
+    master_client,
+    dataset_name: str,
+    loader: Callable[[comm.TaskMessage], Any],
+    batch_size: int,
+    dataset_size: int,
+    depth: Optional[int] = None,
+    **params,
+) -> Tuple[DataShardClient, PrefetchPipeline]:
+    """Convenience factory: one client + one pipeline over it."""
+    client = DataShardClient(
+        master_client, dataset_name, batch_size, dataset_size, **params
+    )
+    return client, PrefetchPipeline(client, loader, depth=depth)

@@ -794,12 +794,13 @@ def test_package_has_no_stale_noqa():
 @pytest.mark.analysis
 def test_baseline_burn_down_floor():
     """The baseline only shrinks: PR 7 burned it from 95 down to ≤85,
-    PR 9 from 85 down to ≤80, PR 10 from 80 down to ≤76. If this fails
-    with a LOWER count, ratchet the floor down in this test; if with a
-    higher one, a deferral leaked in — fix it instead."""
+    PR 9 from 85 down to ≤80, PR 10 from 80 down to ≤76, PR 11 from 76
+    down to ≤72 (DLR003 logging tails). If this fails with a LOWER
+    count, ratchet the floor down in this test; if with a higher one, a
+    deferral leaked in — fix it instead."""
     baseline_total = sum(load_baseline().values())
-    assert baseline_total <= 76, (
-        f"baseline grew to {baseline_total} entries (must stay ≤76); "
+    assert baseline_total <= 72, (
+        f"baseline grew to {baseline_total} entries (must stay ≤72); "
         "fix the new violations instead of deferring them"
     )
 
