@@ -64,6 +64,7 @@ class ParalConfigTuner:
             "dataloader_version": config.dataloader_version,
             "grad_accum_steps": config.grad_accum_steps,
             "micro_batch_scale": config.micro_batch_scale,
+            "ckpt_interval_s": config.ckpt_interval_s,
             "version": config.version,
         }
         tmp = self.config_path + ".tmp"

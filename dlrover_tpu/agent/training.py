@@ -863,6 +863,26 @@ class ElasticTrainingAgent:
                 # thread because gdb attach can take ~20s per worker
                 self._capture_stack_dump(action_data)
                 continue
+            if action == DiagnosisActionType.CHECKPOINT:
+                # brain-predicted failure on this node: flush the newest
+                # shm frames to durable storage while the workers keep
+                # training — if the prediction hits, lost work shrinks to
+                # the steps since THIS save instead of the last cadence
+                # save. workers_dead=False: peers are alive, so the
+                # normal commit quorum applies.
+                logger.info(
+                    "preemptive checkpoint action (%s)",
+                    action_data.get("reason", ""),
+                )
+                if self._ckpt_saver is not None:
+                    try:
+                        self._ckpt_saver.save_shm_to_storage(
+                            reason="brain preemptive checkpoint",
+                            workers_dead=False,
+                        )
+                    except Exception:  # noqa: BLE001 — advisory save
+                        logger.exception("preemptive checkpoint failed")
+                continue
             if action == DiagnosisActionType.RELAUNCH_WORKER:
                 # pod-level: exit so the master's relaunch ladder replaces
                 # this node (a wedged chip must not be soft-restarted onto)

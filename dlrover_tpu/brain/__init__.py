@@ -11,9 +11,29 @@ count, micro-batch/grad-accum from HBM headroom) instead of PS CPU/memory
 sizing, and a sqlite datastore (stdlib, durable, zero-ops) standing in for
 MySQL — the reference keeps cross-job history so *new* jobs start with
 resources that worked for similar past jobs; that is the property kept.
+
+The predictive loop closes here too: the master's
+:class:`~dlrover_tpu.brain.persister.TelemetryPersister` batches the
+observability spine into the datastore each tick, and the
+:class:`~dlrover_tpu.brain.advisor.BrainAdvisor` turns that history into
+proactive actions (pre-emptive checkpoints, straggler bias, predictive
+serve pre-scaling, ckpt-interval tuning) — every prediction journaled
+and later scored (docs/design/brain_predictive.md).
 """
 
-from dlrover_tpu.brain.datastore import MetricsStore
+from dlrover_tpu.brain.advisor import BrainAdvisor
+from dlrover_tpu.brain.datastore import MetricSample, MetricsStore
+from dlrover_tpu.brain.optimizers import (
+    NodeFailurePrior,
+    StepTimeModel,
+    TrafficForecaster,
+    optimal_ckpt_interval_s,
+)
+from dlrover_tpu.brain.persister import TelemetryPersister
 from dlrover_tpu.brain.service import BrainClient, BrainService
 
-__all__ = ["MetricsStore", "BrainClient", "BrainService"]
+__all__ = [
+    "MetricsStore", "MetricSample", "BrainClient", "BrainService",
+    "TelemetryPersister", "BrainAdvisor", "NodeFailurePrior",
+    "StepTimeModel", "TrafficForecaster", "optimal_ckpt_interval_s",
+]

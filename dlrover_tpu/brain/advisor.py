@@ -1,0 +1,466 @@
+"""BrainAdvisor: learned history → proactive master actions.
+
+The forward half of the brain loop (the back half — telemetry into the
+datastore — is brain/persister.py). The advisor owns three models fed
+from the journal and the serving signal stream:
+
+- :class:`~dlrover_tpu.brain.optimizers.NodeFailurePrior` — recency-
+  decayed per-node failure/straggler history → pre-emptive breakpoint
+  checkpoints before a predicted failure, straggler bias merged into the
+  rdzv ``straggler_history`` hook and the shard-steal policy, and a
+  fleet MTBF estimate feeding Young's-formula ckpt-interval tuning;
+- :class:`~dlrover_tpu.brain.optimizers.TrafficForecaster` — short-
+  horizon load trend → predictive serve-replica pre-scaling that leads
+  the ramp (the reactive cooldown-gated ``ServingOptimizer`` chases it);
+- :class:`~dlrover_tpu.brain.optimizers.StepTimeModel` — per-config
+  step-time memory (observability for the tuner path).
+
+Self-observation contract: every prediction the advisor acts on is
+journaled (``brain_predicted_*``) the moment it is made, held in an open
+ledger with a monotonic deadline, and later scored against the real
+outcome — ``brain_prediction_scored`` with hit/miss plus the
+``dlrover_brain_prediction_scored_total{kind,outcome}`` counter. A
+prediction that can't be traced to a journaled, scored entry is a bug.
+
+Degradation contract (chaos site ``brain.query``): datastore reads are
+advisory. A failed query journals ``brain_degraded`` and returns empty —
+the advisor keeps working from its in-memory models, and the master's
+reactive paths are untouched.
+"""
+
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from dlrover_tpu.analysis.race_detector import shared
+from dlrover_tpu.brain.datastore import MetricsStore
+from dlrover_tpu.brain.optimizers import (
+    NodeFailurePrior,
+    StepTimeModel,
+    TrafficForecaster,
+    optimal_ckpt_interval_s,
+)
+from dlrover_tpu.common.constants import ConfigKey, env_float
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.journal import JournalEvent
+
+DEFAULT_HORIZON_S = 120.0
+DEFAULT_PREEMPT_THRESHOLD = 0.5
+DEFAULT_ACTION_COOLDOWN_S = 60.0
+# straggler-prior score at which the advisor predicts a repeat offender
+DEFAULT_STRAGGLER_BIAS_MIN = 2.0
+# minimum upward load slope (units/s) before a ramp prediction opens —
+# below this the forecast is noise, not a ramp
+DEFAULT_RAMP_MIN_SLOPE = 0.05
+# relative ckpt-interval change worth re-shipping to the tuner
+CKPT_RETUNE_REL = 0.2
+
+
+class BrainAdvisor:
+    """Consulted by the master each brain tick for proactive actions."""
+
+    def __init__(
+        self,
+        store: Optional[MetricsStore] = None,
+        job_uuid: str = "",
+        journal=None,
+        registry=None,
+        prior: Optional[NodeFailurePrior] = None,
+        step_model: Optional[StepTimeModel] = None,
+        forecaster: Optional[TrafficForecaster] = None,
+        horizon_s: Optional[float] = None,
+        preempt_threshold: float = DEFAULT_PREEMPT_THRESHOLD,
+        action_cooldown_s: float = DEFAULT_ACTION_COOLDOWN_S,
+        capacity_per_replica: Optional[float] = None,
+        ramp_min_slope: float = DEFAULT_RAMP_MIN_SLOPE,
+        preempt_ckpt: Optional[Callable[[int, float], None]] = None,
+        ckpt_interval_sink: Optional[Callable[[float], None]] = None,
+        ckpt_cost_s: float = 15.0,
+        monotonic: Callable[[], float] = time.monotonic,
+    ):
+        self._store = store
+        self._job_uuid = job_uuid
+        self._journal = journal
+        self._monotonic = monotonic
+        self._horizon_s = (
+            env_float(ConfigKey.BRAIN_HORIZON_S, DEFAULT_HORIZON_S)
+            if horizon_s is None else float(horizon_s)
+        )
+        self.prior = prior if prior is not None else NodeFailurePrior(
+            monotonic=monotonic)
+        self.step_model = step_model if step_model is not None \
+            else StepTimeModel()
+        self.forecaster = forecaster if forecaster is not None \
+            else TrafficForecaster(monotonic=monotonic)
+        self._preempt_threshold = preempt_threshold
+        self._cooldown_s = action_cooldown_s
+        # per-replica hot-load threshold for pre-scaling: what the
+        # reactive optimizer treats as a deep queue, reused so the two
+        # planes agree on what "one replica's worth of load" means
+        from dlrover_tpu.common.constants import env_int
+
+        self._cap_per_replica = (
+            float(env_int(ConfigKey.SERVE_QUEUE_HI, 8))
+            if capacity_per_replica is None else float(capacity_per_replica)
+        )
+        self._ramp_min_slope = ramp_min_slope
+        self._preempt_ckpt = preempt_ckpt
+        self._ckpt_interval_sink = ckpt_interval_sink
+        self._ckpt_cost_s = ckpt_cost_s
+        self._last_ckpt_interval: Optional[float] = None
+        self._lock = threading.Lock()
+        # open-prediction ledger + per-action cooldown map: touched from
+        # the journal-listener thread AND the brain tick thread, so both
+        # are registered thread-shared for the race certification
+        self._open: List[Dict[str, Any]] = shared(
+            [], "brain.advisor.predictions")
+        self._cooldowns: Dict[str, float] = shared(
+            {}, "brain.advisor.cooldowns")
+        self._next_id = 1
+        self._scored: List[Dict[str, Any]] = []
+        self._actions = 0
+        self._degraded_queries = 0
+        if registry is None:
+            from dlrover_tpu.observability.registry import get_registry
+
+            registry = get_registry()
+        self._c_predictions = registry.counter(
+            "dlrover_brain_predictions_total",
+            "Predictions the advisor acted on, by kind",
+            labelnames=("kind",),
+        )
+        self._c_scored = registry.counter(
+            "dlrover_brain_prediction_scored_total",
+            "Predictions scored against their real outcome",
+            labelnames=("kind", "outcome"),
+        )
+        self._c_actions = registry.counter(
+            "dlrover_brain_actions_total",
+            "Proactive actions the advisor executed, by action",
+            labelnames=("action",),
+        )
+        self._g_degraded = registry.gauge(
+            "dlrover_brain_degraded",
+            "1 while the brain datastore is unreachable (master running "
+            "reactive-only), else 0",
+        )
+        if journal is not None:
+            journal.add_listener(self.observe_event)
+
+    # -- model feeding (journal listener) -----------------------------------
+
+    def observe_event(self, event: Dict[str, Any]) -> None:
+        kind = event.get("kind")
+        data = event.get("data") or {}
+        if kind == JournalEvent.FAULT_DETECTED:
+            node_id = int(data.get("node_id", -1))
+            if node_id >= 0:
+                self.prior.observe_failure(node_id)
+                self._settle("failure", lambda p: p["node_id"] == node_id,
+                             outcome="hit", actual={"node_id": node_id})
+        elif kind == JournalEvent.STRAGGLER_DETECTED:
+            node_id = int(data.get("node_id", -1))
+            if node_id >= 0:
+                self.prior.observe_straggler(node_id)
+                self._settle("straggler",
+                             lambda p: p["node_id"] == node_id,
+                             outcome="hit", actual={"node_id": node_id})
+
+    def observe_step_time(self, config_sig: str, step_time_s: float) -> None:
+        self.step_model.observe(config_sig, step_time_s)
+
+    # -- history seeding (datastore reads via chaos site brain.query) -------
+
+    def _query_guarded(self, kind: str, limit: int = 200) -> List[Any]:
+        if self._store is None or not self._job_uuid:
+            return []
+        from dlrover_tpu.chaos import get_injector
+
+        try:
+            inj = get_injector()
+            if inj is not None:
+                inj.fire("brain.query", job=self._job_uuid, kind=kind)
+            return self._store.query(self._job_uuid, kind=kind, limit=limit)
+        except Exception as e:  # noqa: BLE001 — advisory plane: degrade
+            with self._lock:
+                self._degraded_queries += 1
+            self._g_degraded.set(1.0)
+            logger.warning("brain query degraded (%r): advising from "
+                           "in-memory models only", e)
+            if self._journal is not None:
+                self._journal.record(JournalEvent.BRAIN_DEGRADED,
+                                     source="brain", reason=repr(e),
+                                     path="query")
+            return []
+
+    def seed_from_store(self) -> int:
+        """Warm the failure/straggler priors from event history a previous
+        master incarnation persisted (wall-ts ages convert onto this
+        process's monotonic clock). Returns events absorbed."""
+        samples = self._query_guarded("event")
+        if not samples:
+            return 0
+        now_wall = max(s.ts for s in samples)
+        absorbed = 0
+        for s in samples:
+            payload = s.payload or {}
+            data = payload.get("data") or {}
+            node_id = int(data.get("node_id", -1))
+            if node_id < 0:
+                continue
+            age_s = max(0.0, now_wall - s.ts)
+            if payload.get("event_kind") == JournalEvent.FAULT_DETECTED:
+                self.prior.observe_failure(node_id, age_s=age_s)
+                absorbed += 1
+            elif payload.get("event_kind") == \
+                    JournalEvent.STRAGGLER_DETECTED:
+                self.prior.observe_straggler(node_id, age_s=age_s)
+                absorbed += 1
+        return absorbed
+
+    # -- prediction ledger ---------------------------------------------------
+
+    def _open_prediction(self, kind: str, **data) -> Dict[str, Any]:
+        now = self._monotonic()
+        with self._lock:
+            pred = {
+                "id": self._next_id,
+                "kind": kind,
+                "opened_t": now,
+                "deadline_t": now + self._horizon_s,
+                **data,
+            }
+            self._next_id += 1
+            self._open.append(pred)
+        self._c_predictions.labels(kind=kind).inc()
+        if self._journal is not None:
+            journal_kind = {
+                "failure": JournalEvent.BRAIN_PREDICTED_FAILURE,
+                "ramp": JournalEvent.BRAIN_PREDICTED_RAMP,
+                "straggler": JournalEvent.BRAIN_PREDICTED_STRAGGLER,
+            }[kind]
+            self._journal.record(journal_kind, source="brain",
+                                 prediction_id=pred["id"],
+                                 horizon_s=self._horizon_s, **data)
+        return pred
+
+    def _settle(self, kind: str, match: Callable[[Dict[str, Any]], bool],
+                outcome: str, actual: Optional[Dict[str, Any]] = None
+                ) -> int:
+        """Score every open ``kind`` prediction matching ``match``."""
+        with self._lock:
+            hits = [p for p in self._open
+                    if p["kind"] == kind and match(p)]
+            for p in hits:
+                self._open.remove(p)
+                self._scored.append({**p, "outcome": outcome})
+        for p in hits:
+            self._c_scored.labels(kind=kind, outcome=outcome).inc()
+            if self._journal is not None:
+                self._journal.record(
+                    JournalEvent.BRAIN_PREDICTION_SCORED, source="brain",
+                    prediction_id=p["id"], prediction_kind=kind,
+                    outcome=outcome, **(actual or {}))
+        return len(hits)
+
+    def _expire_predictions(self) -> None:
+        """Any open prediction whose deadline passed without its outcome
+        arriving is a MISS — the loop scores itself honestly."""
+        now = self._monotonic()
+        with self._lock:
+            due = [p for p in self._open if now >= p["deadline_t"]]
+        for p in due:
+            self._settle(p["kind"], lambda q, _p=p: q["id"] == _p["id"],
+                         outcome="miss")
+
+    def _cooled(self, action_key: str) -> bool:
+        """True (and arms the cooldown) if ``action_key`` is off cooldown."""
+        now = self._monotonic()
+        with self._lock:
+            last = self._cooldowns.get(action_key)
+            if last is not None and now - last < self._cooldown_s:
+                return False
+            self._cooldowns[action_key] = now
+            return True
+
+    def _record_action(self, action: str, **data) -> None:
+        with self._lock:
+            self._actions += 1
+        self._c_actions.labels(action=action).inc()
+        if self._journal is not None:
+            self._journal.record(JournalEvent.BRAIN_ACTION, source="brain",
+                                 action=action, **data)
+
+    # -- the advise pass -----------------------------------------------------
+
+    def tick(self, serving_signals=None) -> List[Dict[str, Any]]:
+        """One advise pass: score due predictions, then consider each
+        proactive action. Returns the actions taken (journaled copies)."""
+        self._expire_predictions()
+        actions: List[Dict[str, Any]] = []
+        act = self._preempt_checkpoints()
+        if act:
+            actions.extend(act)
+        act = self._predict_stragglers()
+        if act:
+            actions.extend(act)
+        act = self._tune_ckpt_interval()
+        if act is not None:
+            actions.append(act)
+        if serving_signals is not None:
+            target = self.serve_prescale(serving_signals)
+            if target is not None:
+                actions.append({"action": "serve_prescale",
+                                "target": target})
+        return actions
+
+    def _preempt_checkpoints(self) -> List[Dict[str, Any]]:
+        """Nodes whose decayed failure hazard crosses the threshold get a
+        breakpoint checkpoint BEFORE the predicted failure — lost work on
+        the real failure shrinks to ~one step."""
+        out: List[Dict[str, Any]] = []
+        scores = self.prior.snapshot()["failure_scores"]
+        for node_id in sorted(scores):
+            p = self.prior.failure_probability(node_id, self._horizon_s)
+            if p < self._preempt_threshold:
+                continue
+            with self._lock:
+                already = any(q["kind"] == "failure"
+                              and q["node_id"] == node_id
+                              for q in self._open)
+            if already or not self._cooled(f"preempt_ckpt:{node_id}"):
+                continue
+            self._open_prediction("failure", node_id=node_id,
+                                  probability=round(p, 4))
+            self._record_action("preempt_ckpt", node_id=node_id,
+                                probability=round(p, 4))
+            if self._preempt_ckpt is not None:
+                try:
+                    self._preempt_ckpt(node_id, p)
+                except Exception:  # noqa: BLE001 — advice must not crash
+                    logger.exception("preemptive checkpoint callback "
+                                     "failed for node %s", node_id)
+            out.append({"action": "preempt_ckpt", "node_id": node_id,
+                        "probability": p})
+        return out
+
+    def _predict_stragglers(self) -> List[Dict[str, Any]]:
+        """Repeat-offender nodes (decayed straggler score above the bias
+        floor) are predicted to straggle again; the bias itself flows
+        through :meth:`straggler_bias` into the rdzv world-cut and
+        shard-steal hooks continuously."""
+        out: List[Dict[str, Any]] = []
+        for node_id, bias in sorted(self.prior.straggler_bias().items()):
+            if bias < DEFAULT_STRAGGLER_BIAS_MIN:
+                continue
+            with self._lock:
+                already = any(q["kind"] == "straggler"
+                              and q["node_id"] == node_id
+                              for q in self._open)
+            if already or not self._cooled(f"straggler:{node_id}"):
+                continue
+            self._open_prediction("straggler", node_id=node_id, bias=bias)
+            out.append({"action": "straggler_bias", "node_id": node_id,
+                        "bias": bias})
+        return out
+
+    def _tune_ckpt_interval(self) -> Optional[Dict[str, Any]]:
+        mtbf = self.prior.fleet_mtbf_s()
+        if not math.isfinite(mtbf):
+            return None  # no failure history: leave the operator's setting
+        interval = optimal_ckpt_interval_s(self._ckpt_cost_s, mtbf)
+        last = self._last_ckpt_interval
+        if last is not None and abs(interval - last) < CKPT_RETUNE_REL * last:
+            return None
+        if not self._cooled("ckpt_interval"):
+            return None
+        self._last_ckpt_interval = interval
+        self._record_action("ckpt_interval", interval_s=round(interval, 1),
+                            mtbf_s=round(mtbf, 1),
+                            ckpt_cost_s=self._ckpt_cost_s)
+        if self._ckpt_interval_sink is not None:
+            try:
+                self._ckpt_interval_sink(interval)
+            except Exception:  # noqa: BLE001 — advice must not crash
+                logger.exception("ckpt-interval sink failed")
+        return {"action": "ckpt_interval", "interval_s": interval,
+                "mtbf_s": mtbf}
+
+    def serve_prescale(self, signals) -> Optional[int]:
+        """Predictive replica pre-scaling: observe the current load, and
+        when the short-horizon forecast outgrows the current replica
+        set's hot threshold, return the replica count the PREDICTED load
+        needs — ahead of the reactive optimizer, which only grows +1 per
+        cooldown after the queue is already deep."""
+        load = float(signals.queue_depth + signals.inflight)
+        self.forecaster.observe(load)
+        # an open ramp prediction whose threshold the live load reached is
+        # a hit (the ramp arrived as predicted)
+        self._settle("ramp", lambda p: load >= p["threshold"],
+                     outcome="hit", actual={"load": load})
+        slope = self.forecaster.slope_per_s()
+        if slope < self._ramp_min_slope:
+            return None
+        predicted = self.forecaster.forecast(self._horizon_s)
+        target = signals.target_replicas
+        needed = int(math.ceil(predicted / self._cap_per_replica))
+        if needed <= target:
+            return None
+        if not self._cooled("serve_prescale"):
+            return None
+        # the prediction's claim: load will reach the CURRENT replica
+        # set's hot threshold within the horizon (i.e. the reactive
+        # optimizer would have had to grow — pre-scaling was warranted)
+        threshold = max(1.0, self._cap_per_replica * target)
+        self._open_prediction("ramp", predicted_load=round(predicted, 1),
+                              threshold=threshold,
+                              slope_per_s=round(slope, 4), target=needed)
+        self._record_action("serve_prescale", target=needed,
+                            predicted_load=round(predicted, 1))
+        return needed
+
+    # -- consumers -----------------------------------------------------------
+
+    def straggler_bias(self) -> Dict[int, int]:
+        return self.prior.straggler_bias()
+
+    def combined_straggler_history(
+        self, base: Callable[[], Dict[int, int]]
+    ) -> Callable[[], Dict[int, int]]:
+        """Wrap an existing ``straggler_history`` hook (SkewMonitor's
+        node counts) so learned priors from persisted history bias rdzv
+        world cuts and shard stealing too."""
+        def merged() -> Dict[int, int]:
+            out = dict(base())
+            for node_id, bias in self.straggler_bias().items():
+                out[node_id] = out.get(node_id, 0) + bias
+            return out
+
+        return merged
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            open_preds = [dict(p) for p in self._open]
+            scored = [dict(p) for p in self._scored[-50:]]
+            actions = self._actions
+            degraded_queries = self._degraded_queries
+        hits = sum(1 for p in scored if p["outcome"] == "hit")
+        return {
+            "horizon_s": self._horizon_s,
+            "preempt_threshold": self._preempt_threshold,
+            "actions": actions,
+            "degraded_queries": degraded_queries,
+            "open_predictions": open_preds,
+            "scored_predictions": scored,
+            "scored_hits": hits,
+            "scored_total": len(scored),
+            "models": {
+                "failure_prior": self.prior.snapshot(),
+                "step_time": self.step_model.snapshot(),
+                "traffic": self.forecaster.snapshot(),
+            },
+            "ckpt_interval_s": self._last_ckpt_interval,
+        }

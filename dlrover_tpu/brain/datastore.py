@@ -104,6 +104,23 @@ class MetricsStore:
             )
             self._db.commit()
 
+    def persist_many(self, samples: List[MetricSample]) -> int:
+        """Append a batch in ONE transaction (the TelemetryPersister flushes
+        a whole tick's spine at once — per-sample commits would fsync per
+        row). Returns the number of rows written."""
+        if not samples:
+            return 0
+        now = time.time()
+        rows = []
+        for s in samples:
+            if not s.ts:
+                s.ts = now
+            rows.append((s.job_uuid, s.kind, s.ts, json.dumps(s.payload)))
+        with self._mu:
+            self._db.executemany("INSERT INTO metrics VALUES (?,?,?,?)", rows)
+            self._db.commit()
+        return len(rows)
+
     def query(self, job_uuid: str, kind: Optional[str] = None,
               limit: int = 100) -> List[MetricSample]:
         q = "SELECT job_uuid,kind,ts,payload FROM metrics WHERE job_uuid=?"

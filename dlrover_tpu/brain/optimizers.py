@@ -16,9 +16,11 @@ Plugins run as a chain per phase (reference optprocessor); the first
 non-empty plan wins for its phase.
 """
 
+import math
 import time
+from collections import deque
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from dlrover_tpu.brain.datastore import MetricsStore
 from dlrover_tpu.common import comm
@@ -197,3 +199,183 @@ class OptimizerChain:
                             plan.reason)
                 return plan
         return ResourcePlan()
+
+
+# ---------------------------------------------------------------------------
+# Learned models for the predictive loop (brain/advisor.py). All three are
+# pure in-memory models fed by the TelemetryPersister's spine; every clock
+# is injectable so tests and the bench drill can drive them on a fake
+# monotonic timeline (DLR001 discipline: no wall-clock deadline math).
+# ---------------------------------------------------------------------------
+
+
+class NodeFailurePrior:
+    """Per-node failure/straggler history with exponential recency decay.
+
+    Each observed event contributes ``exp(-(now - t) / tau)`` to a node's
+    score, so a node that failed twice in the last few minutes dominates a
+    node that failed once yesterday. The score behaves like "events in the
+    last ~tau seconds", which makes ``score / tau`` a per-second hazard
+    rate and ``1 - exp(-rate * horizon)`` the probability the node fails
+    within the horizon (Poisson arrival assumption — the same model
+    Young's checkpoint-interval formula assumes)."""
+
+    MAX_EVENTS_PER_NODE = 64
+
+    def __init__(self, tau_s: float = 1800.0,
+                 monotonic: Callable[[], float] = time.monotonic):
+        self._tau = max(1.0, float(tau_s))
+        self._now = monotonic
+        self._failures: Dict[int, Deque[float]] = {}
+        self._stragglers: Dict[int, Deque[float]] = {}
+
+    def _observe(self, table: Dict[int, Deque[float]], node_id: int,
+                 age_s: float) -> None:
+        dq = table.setdefault(
+            int(node_id), deque(maxlen=self.MAX_EVENTS_PER_NODE))
+        dq.append(self._now() - max(0.0, float(age_s)))
+
+    def observe_failure(self, node_id: int, age_s: float = 0.0) -> None:
+        """Record a failure; ``age_s`` back-dates it (used to seed priors
+        from datastore history persisted by earlier incarnations)."""
+        self._observe(self._failures, node_id, age_s)
+
+    def observe_straggler(self, node_id: int, age_s: float = 0.0) -> None:
+        self._observe(self._stragglers, node_id, age_s)
+
+    def _score(self, dq: Deque[float]) -> float:
+        now = self._now()
+        return sum(math.exp(-(now - t) / self._tau) for t in dq)
+
+    def failure_score(self, node_id: int) -> float:
+        return self._score(self._failures.get(int(node_id), deque()))
+
+    def straggler_score(self, node_id: int) -> float:
+        return self._score(self._stragglers.get(int(node_id), deque()))
+
+    def failure_probability(self, node_id: int, horizon_s: float) -> float:
+        rate = self.failure_score(node_id) / self._tau
+        return 1.0 - math.exp(-rate * max(0.0, float(horizon_s)))
+
+    def fleet_mtbf_s(self) -> float:
+        """Mean time between failures across the fleet from the decayed
+        hazard (``inf`` with no history — callers fall back to defaults)."""
+        rate = sum(self._score(dq) for dq in self._failures.values())
+        rate /= self._tau
+        return 1.0 / rate if rate > 0.0 else math.inf
+
+    def straggler_bias(self) -> Dict[int, int]:
+        """Decayed straggler counts rounded to ints — shaped exactly like
+        SkewMonitor.node_straggler_counts() so it can merge into the rdzv
+        ``straggler_history`` hook and the shard-steal policy."""
+        out: Dict[int, int] = {}
+        for node_id, dq in self._stragglers.items():
+            n = int(round(self._score(dq)))
+            if n > 0:
+                out[node_id] = n
+        return out
+
+    def snapshot(self) -> Dict[str, Dict[int, float]]:
+        return {
+            "failure_scores": {n: round(self._score(dq), 4)
+                               for n, dq in self._failures.items()},
+            "straggler_scores": {n: round(self._score(dq), 4)
+                                 for n, dq in self._stragglers.items()},
+        }
+
+
+class StepTimeModel:
+    """Per-config-signature EWMA of step time. The signature is whatever
+    the caller keys on (micro-batch scale, grad accum, world size) — the
+    model just remembers which configs ran fast, so the advisor can veto
+    tuner plans that historically regressed step time."""
+
+    def __init__(self, alpha: float = 0.3):
+        self._alpha = min(1.0, max(0.01, float(alpha)))
+        self._ewma: Dict[str, Tuple[float, int]] = {}
+
+    def observe(self, config_sig: str, step_time_s: float) -> None:
+        if step_time_s <= 0.0:
+            return
+        mean, n = self._ewma.get(config_sig, (step_time_s, 0))
+        mean += self._alpha * (step_time_s - mean)
+        self._ewma[config_sig] = (mean, n + 1)
+
+    def predict(self, config_sig: str) -> Optional[float]:
+        got = self._ewma.get(config_sig)
+        return got[0] if got else None
+
+    def samples(self, config_sig: str) -> int:
+        got = self._ewma.get(config_sig)
+        return got[1] if got else 0
+
+    def best_config(self) -> Optional[str]:
+        if not self._ewma:
+            return None
+        return min(self._ewma, key=lambda sig: self._ewma[sig][0])
+
+    def snapshot(self) -> Dict[str, float]:
+        return {sig: round(mean, 6) for sig, (mean, _) in self._ewma.items()}
+
+
+class TrafficForecaster:
+    """Short-horizon request-arrival forecaster: least-squares linear trend
+    over a sliding window of (t, value) observations. Deliberately simple —
+    the serving ramp the ROSE-style pre-scaler must beat is minutes long,
+    and the reactive optimizer it races is cooldown-gated, so catching the
+    *slope* early is worth more than modelling curvature."""
+
+    def __init__(self, window: int = 16,
+                 monotonic: Callable[[], float] = time.monotonic):
+        self._obs: Deque[Tuple[float, float]] = deque(
+            maxlen=max(3, int(window)))
+        self._now = monotonic
+
+    def observe(self, value: float) -> None:
+        self._obs.append((self._now(), max(0.0, float(value))))
+
+    def slope_per_s(self) -> float:
+        """Least-squares slope of value over time (0.0 with <3 points or a
+        degenerate time axis)."""
+        if len(self._obs) < 3:
+            return 0.0
+        ts = [t for t, _ in self._obs]
+        vs = [v for _, v in self._obs]
+        n = len(ts)
+        t_mean = sum(ts) / n
+        v_mean = sum(vs) / n
+        denom = sum((t - t_mean) ** 2 for t in ts)
+        if denom <= 0.0:
+            return 0.0
+        return sum((t - t_mean) * (v - v_mean)
+                   for t, v in self._obs) / denom
+
+    def current(self) -> float:
+        return self._obs[-1][1] if self._obs else 0.0
+
+    def forecast(self, horizon_s: float) -> float:
+        """Predicted value ``horizon_s`` ahead of the last observation
+        (clamped at 0 — load cannot go negative)."""
+        if not self._obs:
+            return 0.0
+        return max(0.0, self.current() + self.slope_per_s()
+                   * max(0.0, float(horizon_s)))
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "observations": float(len(self._obs)),
+            "current": round(self.current(), 4),
+            "slope_per_s": round(self.slope_per_s(), 6),
+        }
+
+
+def optimal_ckpt_interval_s(ckpt_cost_s: float, mtbf_s: float,
+                            lo_s: float = 30.0,
+                            hi_s: float = 3600.0) -> float:
+    """Young's approximation ``T_opt = sqrt(2 * C * MTBF)`` clamped to an
+    operational band. With no failure history (``mtbf_s`` inf) returns
+    ``hi_s`` — checkpoint rarely when nothing ever fails."""
+    if not math.isfinite(mtbf_s) or mtbf_s <= 0.0:
+        return hi_s
+    t_opt = math.sqrt(2.0 * max(0.0, ckpt_cost_s) * mtbf_s)
+    return min(hi_s, max(lo_s, t_opt))

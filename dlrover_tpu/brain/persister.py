@@ -1,0 +1,320 @@
+"""Master-side telemetry persistence into the brain datastore.
+
+The observability spine (journal, skew windows, goodput phases, perf
+speed, serving traffic, ckpt persist telemetry) is live state that dies
+with the master. :class:`TelemetryPersister` is the back-half: a
+deadline-paced tick that batches the spine into the brain's sqlite
+:class:`~dlrover_tpu.brain.datastore.MetricsStore` so the learned models
+in brain/optimizers.py (and the next incarnation of this job) have
+history to learn from — the reference Brain's ``persist_metrics`` RPC
+collapsed into an in-master component (PAPER.md: gRPC persist over a
+MySQL datastore; same cadence contract, ~one persist per job per tick).
+
+Degradation contract (chaos site ``brain.persist``): the brain is an
+ADVISORY plane. A datastore outage journals ``brain_degraded`` once per
+episode, flips the ``dlrover_brain_degraded`` gauge, keeps buffering
+events (bounded, drop-oldest), and retries on the next tick — training,
+serving and checkpointing never block on it. Recovery journals
+``brain_recovered`` and flushes the backlog.
+
+Sample kinds written per tick (all queryable via ``MetricsStore.query``):
+
+========== =============================================================
+``speed``    steps/s, completed step, goodput, running nodes
+``skew``     per-rank per-op-class window-delta means (SkewMonitor)
+``goodput``  phase-seconds attribution fractions (EventJournal)
+``serving``  queue depth, inflight, TTFT p99, tokens/s, replica counts
+``ckpt``     persist telemetry from the provider (rates, chain depth)
+``event``    buffered journal events (faults, verdicts, serve losses)
+========== =============================================================
+"""
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from dlrover_tpu.analysis.race_detector import shared
+from dlrover_tpu.brain.datastore import MetricSample, MetricsStore
+from dlrover_tpu.common.constants import ConfigKey, env_float
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.journal import JournalEvent
+
+DEFAULT_TICK_S = 15.0
+DEFAULT_MAX_BUFFER = 512
+
+# journal kinds worth remembering across steps/jobs: the fault/straggler
+# history the failure prior learns from, plus recovery/serving lifecycle
+# for post-hoc analysis. Telemetry-about-telemetry (brain_*) is excluded —
+# the brain must not eat its own predictions as training data.
+SPINE_EVENT_KINDS = (
+    JournalEvent.FAULT_DETECTED,
+    JournalEvent.FAULT_INJECTED,
+    JournalEvent.STRAGGLER_DETECTED,
+    JournalEvent.HANG_ATTRIBUTED,
+    JournalEvent.RDZV_START,
+    JournalEvent.RDZV_COMPLETE,
+    JournalEvent.STEP_RESUMED,
+    JournalEvent.SERVE_REPLICA_LOST,
+    JournalEvent.SERVE_SCALE,
+    JournalEvent.CKPT_CHAIN_TRUNCATED,
+)
+
+
+class TelemetryPersister:
+    """Batches the live spine into the brain datastore on a paced tick."""
+
+    def __init__(
+        self,
+        store: MetricsStore,
+        job_uuid: str,
+        job_name: str = "",
+        journal=None,
+        registry=None,
+        skew_monitor=None,
+        perf_monitor=None,
+        serving_signals: Optional[Callable[[], Any]] = None,
+        ckpt_stats: Optional[Callable[[], Optional[Dict[str, Any]]]] = None,
+        tick_s: Optional[float] = None,
+        max_buffer: int = DEFAULT_MAX_BUFFER,
+        monotonic: Callable[[], float] = time.monotonic,
+        on_tick: Optional[Callable[[], None]] = None,
+    ):
+        # on_tick runs after each flush on the persister thread — the
+        # master hangs the BrainAdvisor's advise pass here so ONE paced
+        # loop drives persist → advise (the "consults the brain each
+        # tick" contract) without a second thread
+        self._on_tick = on_tick
+        self._store = store
+        self._job_uuid = job_uuid
+        self._job_name = job_name
+        self._journal = journal
+        self._skew_monitor = skew_monitor
+        self._perf_monitor = perf_monitor
+        self._serving_signals = serving_signals
+        self._ckpt_stats = ckpt_stats
+        self._tick_s = (
+            env_float(ConfigKey.BRAIN_TICK_S, DEFAULT_TICK_S)
+            if tick_s is None else float(tick_s)
+        )
+        self._max_buffer = max(1, int(max_buffer))
+        self._monotonic = monotonic
+        self._lock = threading.Lock()
+        # buffered journal events awaiting the next flush; registered as
+        # thread-shared (journal listener thread vs tick thread) so the
+        # race certification in tests/test_brain_loop.py proxies it
+        self._buffer: List[MetricSample] = shared([], "brain.persister.buffer")
+        self._dropped = 0
+        self._flushes = 0
+        self._failures = 0
+        self._persisted = 0
+        self._degraded = False
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if registry is None:
+            from dlrover_tpu.observability.registry import get_registry
+
+            registry = get_registry()
+        self._c_persisted = registry.counter(
+            "dlrover_brain_samples_persisted_total",
+            "Telemetry samples persisted into the brain datastore",
+            labelnames=("kind",),
+        )
+        self._c_failures = registry.counter(
+            "dlrover_brain_persist_failures_total",
+            "Failed brain-datastore flush attempts",
+        )
+        self._g_degraded = registry.gauge(
+            "dlrover_brain_degraded",
+            "1 while the brain datastore is unreachable (master running "
+            "reactive-only), else 0",
+        )
+        if journal is not None:
+            journal.add_listener(self._on_journal_event)
+
+    # -- ingest -------------------------------------------------------------
+
+    def _on_journal_event(self, event: Dict[str, Any]) -> None:
+        if event.get("kind") not in SPINE_EVENT_KINDS:
+            return
+        sample = MetricSample(
+            job_uuid=self._job_uuid,
+            kind="event",
+            payload={
+                "event_kind": event.get("kind"),
+                "t": event.get("t", 0.0),
+                "source": event.get("source", ""),
+                "data": dict(event.get("data") or {}),
+            },
+            ts=float(event.get("ts") or 0.0),
+        )
+        with self._lock:
+            self._buffer.append(sample)
+            if len(self._buffer) > self._max_buffer:
+                drop = len(self._buffer) - self._max_buffer
+                del self._buffer[:drop]
+                self._dropped += drop
+
+    # -- collection ---------------------------------------------------------
+
+    def collect(self) -> List[MetricSample]:
+        """One tick's snapshot samples (NOT the buffered events — those
+        ride along at flush time)."""
+        samples: List[MetricSample] = []
+
+        def add(kind: str, payload: Optional[Dict[str, Any]]) -> None:
+            if payload:
+                samples.append(MetricSample(
+                    job_uuid=self._job_uuid, kind=kind, payload=payload))
+
+        if self._perf_monitor is not None:
+            add("speed", {
+                "steps_per_s": self._perf_monitor.running_speed(),
+                "global_step": self._perf_monitor.completed_global_step,
+                "goodput": self._perf_monitor.goodput(),
+            })
+        if self._skew_monitor is not None:
+            deltas = self._skew_monitor.window_deltas()
+            if deltas:
+                add("skew", {"window_deltas": deltas})
+        if self._journal is not None:
+            seconds = self._journal.phase_seconds()
+            wall = sum(seconds.values())
+            if wall > 0.0:
+                add("goodput", {
+                    "wall_s": round(wall, 3),
+                    "fractions": {phase: round(v / wall, 4)
+                                  for phase, v in seconds.items() if v > 0.0},
+                })
+        if self._serving_signals is not None:
+            sig = self._serving_signals()
+            if sig is not None:
+                add("serving", {
+                    "live_replicas": sig.live_replicas,
+                    "target_replicas": sig.target_replicas,
+                    "queue_depth": sig.queue_depth,
+                    "inflight": sig.inflight,
+                    "ttft_p99_s": round(sig.ttft_p99_s, 4),
+                    "tokens_per_s": round(sig.tokens_per_s, 2),
+                })
+        if self._ckpt_stats is not None:
+            add("ckpt", self._ckpt_stats())
+        return samples
+
+    # -- flush --------------------------------------------------------------
+
+    def flush(self) -> bool:
+        """Collect + persist one batch. Returns True on success; on any
+        persist failure the master degrades to reactive-only (journaled
+        once per outage episode) and buffered events survive for the
+        next attempt."""
+        with self._lock:
+            pending = list(self._buffer)
+        batch = self.collect() + pending
+        if not batch:
+            return True
+        from dlrover_tpu.chaos import get_injector
+
+        try:
+            inj = get_injector()
+            if inj is not None:
+                inj.fire("brain.persist", job=self._job_uuid,
+                         samples=len(batch))
+            wrote = self._store.persist_many(batch)
+        except Exception as e:  # noqa: BLE001 — advisory plane: degrade
+            logger.debug("brain persist failed: %r", e)
+            self._note_degraded(repr(e))  # journals once per episode
+            return False
+        with self._lock:
+            # only drop what this flush actually shipped — events buffered
+            # DURING the persist call stay queued for the next tick
+            del self._buffer[:len(pending)]
+            self._flushes += 1
+            self._persisted += wrote
+        kinds: Dict[str, int] = {}
+        for s in batch:
+            kinds[s.kind] = kinds.get(s.kind, 0) + 1
+        for kind, n in kinds.items():
+            self._c_persisted.labels(kind=kind).inc(n)
+        self._note_recovered()
+        return True
+
+    def _note_degraded(self, reason: str) -> None:
+        with self._lock:
+            self._failures += 1
+            first = not self._degraded
+            self._degraded = True
+        self._c_failures.inc()
+        self._g_degraded.set(1.0)
+        if first:
+            logger.warning("brain datastore unreachable (%s): degrading "
+                           "to reactive-only", reason)
+            if self._journal is not None:
+                self._journal.record(JournalEvent.BRAIN_DEGRADED,
+                                     source="brain", reason=reason)
+
+    def _note_recovered(self) -> None:
+        with self._lock:
+            was = self._degraded
+            self._degraded = False
+        self._g_degraded.set(0.0)
+        if was:
+            logger.info("brain datastore reachable again")
+            if self._journal is not None:
+                self._journal.record(JournalEvent.BRAIN_RECOVERED,
+                                     source="brain")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="brain-persister", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        # final best-effort flush so a clean shutdown ships the tail
+        try:
+            self.flush()
+        except Exception:  # noqa: BLE001 — shutdown must not fail
+            logger.warning("final brain flush failed", exc_info=True)
+
+    def _loop(self) -> None:
+        # deadline pacing (same discipline as JobAutoScaler._loop): ticks
+        # land on the cadence grid, stop() wakes immediately, an overrun
+        # skips forward instead of bursting
+        next_tick = self._monotonic() + self._tick_s
+        while not self._stopped.wait(
+            max(0.0, next_tick - self._monotonic())
+        ):
+            next_tick += self._tick_s
+            now = self._monotonic()
+            if next_tick <= now:
+                next_tick = now + self._tick_s
+            try:
+                self.flush()
+                if self._on_tick is not None:
+                    self._on_tick()
+            except Exception:  # noqa: BLE001
+                logger.exception("brain persister tick failed")
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return self._degraded
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "job_uuid": self._job_uuid,
+                "tick_s": self._tick_s,
+                "degraded": self._degraded,
+                "buffered_events": len(self._buffer),
+                "dropped_events": self._dropped,
+                "flushes": self._flushes,
+                "failures": self._failures,
+                "samples_persisted": self._persisted,
+            }

@@ -553,6 +553,9 @@ class ParallelConfig:
     # (Brain InitAdjust/OomGuard); grad-accum absorbs it to keep the global
     # batch fixed
     micro_batch_scale: float = 1.0
+    # Young's-formula checkpoint cadence from the BrainAdvisor's learned
+    # fleet MTBF (brain/advisor.py); 0 = untuned, keep the trainer default
+    ckpt_interval_s: float = 0.0
     version: int = 0
 
 

@@ -140,6 +140,10 @@ class DiagnosisActionType:
     RELAUNCH_WORKER = "relaunch_worker"
     # capture py-stacks / xprof from a straggling rank without restarting it
     STACK_DUMP = "stack_dump"
+    # persist the newest shm checkpoint frames to storage NOW, without
+    # touching the workers — the BrainAdvisor's pre-emptive breakpoint
+    # checkpoint ahead of a predicted node failure (brain/advisor.py)
+    CHECKPOINT = "checkpoint"
     # master-level
     MASTER_RELAUNCH_WORKER = "master_relaunch_worker"
     JOB_ABORT = "job_abort"
@@ -305,6 +309,15 @@ class ConfigKey:
     SERVE_QUEUE_HI = "DLROVER_TPU_SERVE_QUEUE_HI"
     SERVE_GROW_COOLDOWN_S = "DLROVER_TPU_SERVE_GROW_COOLDOWN_S"
     SERVE_SHRINK_COOLDOWN_S = "DLROVER_TPU_SERVE_SHRINK_COOLDOWN_S"
+    # brain predictive loop (brain/persister.py, brain/advisor.py): master-
+    # side telemetry persistence + proactive advice on/off (default on),
+    # the sqlite datastore path ("" = per-job in-memory), the persister/
+    # advisor tick cadence, and the prediction horizon the failure prior
+    # and traffic forecaster look ahead over
+    BRAIN = "DLROVER_TPU_BRAIN"
+    BRAIN_DB = "DLROVER_TPU_BRAIN_DB"
+    BRAIN_TICK_S = "DLROVER_TPU_BRAIN_TICK_S"
+    BRAIN_HORIZON_S = "DLROVER_TPU_BRAIN_HORIZON_S"
     # chaos / observability
     FAULT_SCHEDULE = "DLROVER_FAULT_SCHEDULE"
     FAULT_SEED = "DLROVER_FAULT_SEED"

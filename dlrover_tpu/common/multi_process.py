@@ -155,6 +155,8 @@ class LocalIPCServer:
                     result = self._dispatch(req, token)
                     send_msg(conn, {"ok": True, "result": result})
                 except Exception as e:  # noqa: BLE001 — report to client
+                    logger.debug("ipc dispatch error reported to "
+                                 "client: %r", e)
                     send_msg(conn, {"ok": False, "error": repr(e)})
         except (ConnectionError, OSError):
             pass
@@ -512,8 +514,8 @@ def create_shared_memory(
             shm = shared_memory.SharedMemory(name=name, create=True, size=size)
     try:
         resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
-    except Exception:  # noqa: BLE001 — best effort, tracker API is private
-        pass
+    except Exception as e:  # noqa: BLE001 — best effort, tracker is private
+        logger.debug("resource_tracker unregister skipped: %r", e)
     return shm
 
 

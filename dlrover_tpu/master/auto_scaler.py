@@ -43,6 +43,7 @@ class JobAutoScaler:
         serving_signals=None,
         serve_scaler=None,
         event_journal=None,
+        brain_advisor=None,
     ):
         self._job_manager = job_manager
         self._perf_monitor = perf_monitor
@@ -75,6 +76,10 @@ class JobAutoScaler:
         self._serving_signals = serving_signals or (lambda: None)
         self._serve_scaler = serve_scaler
         self._event_journal = event_journal
+        # predictive serve pre-scaling (brain/advisor.py): consulted
+        # BEFORE the reactive optimizer so a forecast ramp grows the
+        # replica set ahead of the queue actually going deep
+        self._brain_advisor = brain_advisor
         # a restore plan re-emits every tick until the replacement
         # registers; journal it once per distinct plan, not per tick
         self._last_serve_plan = None
@@ -144,6 +149,31 @@ class JobAutoScaler:
         signals = self._serving_signals()
         if signals is None:
             return
+        if self._brain_advisor is not None:
+            try:
+                pre = self._brain_advisor.serve_prescale(signals)
+            except Exception:  # noqa: BLE001 — advice must not scale
+                logger.exception("brain serve pre-scale failed")
+                pre = None
+            if pre is not None:
+                # clamp to the reactive optimizer's headroom — the brain
+                # predicts demand, the operator still bounds capacity
+                target = min(pre, self._serving_optimizer.max_replicas)
+                if target > signals.target_replicas:
+                    logger.info("brain pre-scale → %s replicas", target)
+                    if self._event_journal is not None:
+                        from dlrover_tpu.observability.journal import (
+                            JournalEvent,
+                        )
+
+                        self._event_journal.record(
+                            JournalEvent.SERVE_SCALE, source="brain",
+                            target=target, reason="brain pre-scale",
+                        )
+                    if self._serve_scaler is not None:
+                        self._serve_scaler.scale_to(
+                            target, reason="brain pre-scale")
+                    return  # predictive plan owns this tick
         plan = self._serving_optimizer.plan(signals)
         if plan.empty():
             self._last_serve_plan = None

@@ -78,6 +78,28 @@ class SimpleStrategyGenerator:
             logger.info("strategy: micro-batch scale %s applied (%s)",
                         scale, reason)
 
+    def set_ckpt_interval(self, interval_s: float, reason: str = "") -> None:
+        """Push a brain-tuned checkpoint cadence (Young's formula from the
+        learned fleet MTBF, brain/advisor.py). Rides the same versioned
+        ParallelConfig pipe as the batch knobs — the agent tuner re-ships
+        the file on the version bump and the trainer picks the new
+        cadence up between steps."""
+        with self._lock:
+            current = self._config
+            if current.ckpt_interval_s and abs(
+                    current.ckpt_interval_s - interval_s) < 1e-6:
+                return
+            self._config = comm.ParallelConfig(
+                dataloader_batch_size=current.dataloader_batch_size,
+                dataloader_version=current.dataloader_version,
+                grad_accum_steps=current.grad_accum_steps,
+                micro_batch_scale=current.micro_batch_scale,
+                ckpt_interval_s=float(interval_s),
+                version=current.version + 1,
+            )
+            logger.info("strategy: ckpt interval → %.1fs (%s)",
+                        interval_s, reason)
+
     def worst_hbm_frac(self) -> Optional[float]:
         return self._worst_hbm_frac()
 

@@ -8,6 +8,7 @@ node management against a cluster scheduler.
 """
 
 import argparse
+import json as _json
 import os
 import threading
 import time
@@ -18,6 +19,7 @@ from dlrover_tpu.common.constants import (
     JobStage,
     RendezvousName,
     SpanName,
+    env_flag,
     env_float,
     env_str,
 )
@@ -229,6 +231,90 @@ class JobMaster:
             ))
             logger.info("fault injection active on master: %s",
                         _inj.describe())
+        # brain predictive loop (brain/persister.py + brain/advisor.py):
+        # the TelemetryPersister batches the observability spine into the
+        # brain datastore each tick, and the BrainAdvisor turns learned
+        # history into proactive actions. On by default (in-memory store
+        # unless DLROVER_TPU_BRAIN_DB points at a durable sqlite file);
+        # the whole plane is advisory — it degrades to reactive-only on
+        # any datastore fault (chaos sites brain.persist / brain.query).
+        self.brain_store = None
+        self.telemetry_persister = None
+        self.brain_advisor = None
+        # settable provider: () -> ServingSignals for jobs that run a
+        # request router (examples/serving drill wire the real one)
+        self.brain_serving_signals = None
+        if env_flag(ConfigKey.BRAIN, True):
+            import uuid as _uuid
+
+            from dlrover_tpu.brain.advisor import BrainAdvisor
+            from dlrover_tpu.brain.datastore import JobRecord, MetricsStore
+            from dlrover_tpu.brain.persister import TelemetryPersister
+
+            db_path = env_str(ConfigKey.BRAIN_DB) or ":memory:"
+            # same instance-id convention as the BrainClient wiring below:
+            # stable across master restarts of ONE run (k8s CR uid), fresh
+            # across re-runs of the same job name
+            instance = env_str(ConfigKey.JOB_UID, _uuid.uuid4().hex[:8])
+            self._brain_job_uuid = f"{job_name}-{instance}"
+            self.brain_store = MetricsStore(db_path)
+            self.brain_store.upsert_job(JobRecord(
+                uuid=self._brain_job_uuid, name=job_name))
+
+            def _serving_signals():
+                fn = self.brain_serving_signals
+                return fn() if fn is not None else None
+
+            def _preempt_ckpt(node_id, probability):
+                from dlrover_tpu.common.constants import (
+                    DiagnosisActionType as _DAT,
+                )
+                from dlrover_tpu.diagnosis.action import DiagnosisAction
+
+                self.job_manager.enqueue_action(DiagnosisAction(
+                    _DAT.CHECKPOINT,
+                    instance=node_id,
+                    reason=("brain predicted failure "
+                            f"p={probability:.2f}"),
+                ))
+
+            self.brain_advisor = BrainAdvisor(
+                store=self.brain_store,
+                job_uuid=self._brain_job_uuid,
+                journal=self.event_journal,
+                registry=self.metrics_registry,
+                preempt_ckpt=_preempt_ckpt,
+                ckpt_interval_sink=lambda s:
+                    self.strategy_generator.set_ckpt_interval(
+                        s, "brain mtbf tuning"),
+            )
+            # warm the priors from history a previous incarnation of this
+            # job persisted (durable DB); no-op on a fresh in-memory store
+            self.brain_advisor.seed_from_store()
+            self.telemetry_persister = TelemetryPersister(
+                self.brain_store,
+                self._brain_job_uuid,
+                job_name=job_name,
+                journal=self.event_journal,
+                registry=self.metrics_registry,
+                skew_monitor=self.skew_monitor,
+                perf_monitor=self.perf_monitor,
+                serving_signals=_serving_signals,
+                # serving_signals stays None here: serve pre-scaling is
+                # owned by JobAutoScaler.serve_tick (which can actually
+                # execute the plan); calling serve_prescale from the brain
+                # tick too would eat the action cooldown and starve it
+                on_tick=lambda: self.brain_advisor.tick(),
+            )
+            # learned straggler priors bias the SAME hooks the live skew
+            # counts feed: rdzv world cuts and shard stealing see history
+            # the current incarnation hasn't re-observed yet
+            _combined = self.brain_advisor.combined_straggler_history(
+                self.skew_monitor.node_straggler_counts)
+            self.rdzv_managers[RendezvousName.TRAINING].straggler_history = (
+                _combined
+            )
+            self.task_manager.straggler_history = _combined
         self._server = RPCServer(port=port)
         self._server.register_object(self.servicer)
         # fast fault detection: an agent's death closes its heartbeat TCP
@@ -301,6 +387,13 @@ class JobMaster:
                 self._http_server.add_get_route(
                     "/debug/bundle",
                     self.flight_recorder.http_handler(),
+                )
+                self._http_server.add_get_route(
+                    "/brain",
+                    lambda: (
+                        "application/json",
+                        _json.dumps(self.brain_status()),
+                    ),
                 )
             except ValueError:
                 logger.warning(
@@ -391,6 +484,17 @@ class JobMaster:
     def addr(self) -> str:
         return f"127.0.0.1:{self.port}"
 
+    def brain_status(self) -> dict:
+        """The ``GET /brain`` payload: persister flush/degradation stats,
+        model summaries, and the open + recently-scored predictions."""
+        if self.telemetry_persister is None or self.brain_advisor is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "persister": self.telemetry_persister.stats(),
+            "advisor": self.brain_advisor.snapshot(),
+        }
+
     def prepare(self) -> None:
         from dlrover_tpu.common.event import MasterEvent, get_emitter
 
@@ -409,14 +513,26 @@ class JobMaster:
             self.diagnosis_master.start()
         if self._snapshot_loop is not None:
             self._snapshot_loop.start()
+        if self.telemetry_persister is not None:
+            self.telemetry_persister.start()
         logger.info(
             "master for job %s serving on port %s", self.job_name, self.port
         )
 
     def stop(self, job_status: str = "completed") -> None:
-        # job_status is consumed by subclasses reporting run outcomes
-        # (DistributedJobMaster → Brain); the base teardown ignores it
-        del job_status
+        if self.telemetry_persister is not None:
+            # final flush first, then record how the run ended so the
+            # next same-named job's cold-start/priors see the outcome
+            self.telemetry_persister.stop()
+            try:
+                job = self.brain_store.get_job(self._brain_job_uuid)
+                if job is not None:
+                    job.status = job_status
+                    job.final_nodes = len(self.job_manager.nodes)
+                    self.brain_store.upsert_job(job)
+                self.brain_store.close()
+            except Exception:  # noqa: BLE001 — shutdown must not fail
+                logger.warning("brain store close failed", exc_info=True)
         if self._snapshot_loop is not None:
             self._snapshot_loop.stop()
         self.job_manager.stop()
@@ -561,6 +677,8 @@ class DistributedJobMaster(JobMaster):
             metrics_sink=metrics_sink,
             strategy_generator=self.strategy_generator,
             hbm_provider=self.strategy_generator.worst_hbm_frac,
+            brain_advisor=self.brain_advisor,
+            event_journal=self.event_journal,
         )
 
     def prepare(self) -> None:

@@ -326,6 +326,33 @@ class SkewMonitor:
                 else dict(self._current_hang),
             }
 
+    def window_deltas(self) -> Dict[str, Dict[int, Dict[str, float]]]:
+        """op_class → rank → {mean_us, count} over the current fresh
+        window — the per-tick op-histogram delta the TelemetryPersister
+        batches into the brain datastore (same diff math as the straggler
+        verdicts, exposed as data instead of a verdict)."""
+        now = self._monotonic()
+        out: Dict[str, Dict[int, Dict[str, float]]] = {}
+        with self._lock:
+            windows = self._fresh_windows(now)
+            for op_class in _BLAMEABLE_CLASSES:
+                per_rank: Dict[int, Dict[str, float]] = {}
+                for rank, snaps in windows.items():
+                    first = OpClassHistogram.from_wire(
+                        snaps[0].get("classes", {}).get(op_class, {}))
+                    last = OpClassHistogram.from_wire(
+                        snaps[-1].get("classes", {}).get(op_class, {}))
+                    dn = last.count - first.count
+                    dsum = last.sum_us - first.sum_us
+                    if dn > 0 and dsum >= 0:
+                        per_rank[rank] = {
+                            "mean_us": round(dsum / dn, 1),
+                            "count": float(dn),
+                        }
+                if per_rank:
+                    out[op_class] = per_rank
+        return out
+
     def node_straggler_counts(self) -> Dict[int, int]:
         """node_id → accumulated straggler-episode count across its ranks
         — the history rdzv_manager consults when cutting a world down."""

@@ -188,12 +188,14 @@ class FlightRecorder:
         events = tracing.to_chrome_events(finished + live, t0=t0)
         if journal_dict is not None:
             from dlrover_tpu.observability.timeline import (
+                brain_track_events,
                 job_phase_events,
                 skew_track_events,
             )
 
             events.extend(job_phase_events(journal_dict))
             events.extend(skew_track_events(journal_dict))
+            events.extend(brain_track_events(journal_dict))
         with open(os.path.join(bundle_dir, "traces.json"), "w") as f:
             json.dump({"traceEvents": events}, f)
 

@@ -103,6 +103,20 @@ class JournalEvent:
     DATA_STEAL = "data_steal"
     DATA_EPOCH_COMPLETE = "data_epoch_complete"
     DATA_STATE_RESTORED = "data_state_restored"
+    # brain predictive loop (brain/persister.py + brain/advisor.py): every
+    # prediction the advisor acts on is journaled when made
+    # (brain_predicted_*), the action it drove (brain_action), and the
+    # later hit/miss verdict against the real outcome
+    # (brain_prediction_scored). Degraded/recovered bracket a brain
+    # datastore outage episode during which the master runs reactive-only.
+    # All informational — the brain never suspends goodput attribution.
+    BRAIN_PREDICTED_FAILURE = "brain_predicted_failure"
+    BRAIN_PREDICTED_RAMP = "brain_predicted_ramp"
+    BRAIN_PREDICTED_STRAGGLER = "brain_predicted_straggler"
+    BRAIN_PREDICTION_SCORED = "brain_prediction_scored"
+    BRAIN_ACTION = "brain_action"
+    BRAIN_DEGRADED = "brain_degraded"
+    BRAIN_RECOVERED = "brain_recovered"
 
     ALL = (
         FAULT_DETECTED, RDZV_START, RDZV_COMPLETE, RESTORE_START,
@@ -116,6 +130,9 @@ class JournalEvent:
         SERVE_REQUEST_FAILED, SERVE_REROUTED, SERVE_SCALE,
         DATA_DISPATCH, DATA_ACK, DATA_REQUEUE, DATA_STEAL,
         DATA_EPOCH_COMPLETE, DATA_STATE_RESTORED,
+        BRAIN_PREDICTED_FAILURE, BRAIN_PREDICTED_RAMP,
+        BRAIN_PREDICTED_STRAGGLER, BRAIN_PREDICTION_SCORED,
+        BRAIN_ACTION, BRAIN_DEGRADED, BRAIN_RECOVERED,
     )
 
 

@@ -54,6 +54,7 @@ def fetch_journal(master_http_addr: str,
 # pids for the synthetic tracks — far above any worker rank
 _JOB_PHASES_PID = 9999
 _SKEW_TRACK_PID = 9998
+_BRAIN_TRACK_PID = 9997
 
 
 def job_phase_events(journal: dict) -> List[dict]:
@@ -138,6 +139,55 @@ def skew_track_events(journal: dict) -> List[dict]:
     return events
 
 
+def brain_track_events(journal: dict) -> List[dict]:
+    """Chrome-trace events for the brain's predictive loop: an instant
+    per prediction/action (``brain_predicted_*``, ``brain_action``), an
+    instant per hit/miss verdict (``brain_prediction_scored``), and the
+    degraded/recovered outage brackets — so every proactive action lines
+    up with the fault/phase tracks that vindicate (or refute) it."""
+    from dlrover_tpu.observability.journal import JournalEvent
+
+    _NAMES = {
+        JournalEvent.BRAIN_PREDICTED_FAILURE: lambda d:
+            f"predict failure node{d.get('node_id', '?')} "
+            f"p={d.get('probability', '?')}",
+        JournalEvent.BRAIN_PREDICTED_RAMP: lambda d:
+            f"predict ramp → {d.get('target', '?')} replicas",
+        JournalEvent.BRAIN_PREDICTED_STRAGGLER: lambda d:
+            f"predict straggler node{d.get('node_id', '?')}",
+        JournalEvent.BRAIN_PREDICTION_SCORED: lambda d:
+            f"{d.get('prediction_kind', '?')} #"
+            f"{d.get('prediction_id', '?')}: {d.get('outcome', '?')}",
+        JournalEvent.BRAIN_ACTION: lambda d:
+            f"action {d.get('action', '?')}",
+        JournalEvent.BRAIN_DEGRADED: lambda d: "brain degraded",
+        JournalEvent.BRAIN_RECOVERED: lambda d: "brain recovered",
+    }
+    raw = journal.get("events", [])
+    events: List[dict] = [
+        {
+            "ph": "M", "pid": _BRAIN_TRACK_PID, "name": "process_name",
+            "args": {"name": "brain predictions"},
+        },
+        {
+            "ph": "M", "pid": _BRAIN_TRACK_PID, "tid": 0,
+            "name": "thread_name", "args": {"name": "predictions"},
+        },
+    ]
+    for e in raw:
+        kind = e.get("kind", "")
+        namer = _NAMES.get(kind)
+        if namer is None:
+            continue
+        data = e.get("data", {}) or {}
+        events.append({
+            "ph": "i", "pid": _BRAIN_TRACK_PID, "tid": 0, "s": "p",
+            "name": namer(data), "cat": "brain",
+            "ts": float(e.get("t", 0.0)) * 1e6, "args": dict(data),
+        })
+    return events
+
+
 def merge_timelines(
     out_path: str,
     ports: Optional[List[int]] = None,
@@ -171,6 +221,7 @@ def merge_timelines(
         if journal is not None:
             events.extend(job_phase_events(journal))
             events.extend(skew_track_events(journal))
+            events.extend(brain_track_events(journal))
     with open(out_path, "w") as f:
         json.dump({"traceEvents": events}, f)
     return found

@@ -46,7 +46,7 @@ try:  # TPU memory spaces; absent on CPU-only builds
     from jax.experimental.pallas import tpu as pltpu
 
     _VMEM = pltpu.VMEM
-except Exception:  # pragma: no cover
+except (ImportError, AttributeError):  # pragma: no cover
     pltpu = None
     _VMEM = None
 

@@ -66,7 +66,12 @@ class ServingOptimizer:
         queue_hi: Optional[int] = None,
         grow_cooldown_s: Optional[float] = None,
         shrink_cooldown_s: Optional[float] = None,
+        monotonic=time.monotonic,
     ):
+        # injectable clock: the brain bench drill races this reactive
+        # optimizer against the predictive pre-scaler on a simulated
+        # timeline, so cooldown arithmetic must follow the drill's clock
+        self._monotonic = monotonic
         self.min_replicas = min_replicas
         self.max_replicas = max_replicas
         self.ttft_slo_s = (
@@ -88,10 +93,10 @@ class ServingOptimizer:
         # cooldowns gate from CONSTRUCTION, not -inf: a serving plane that
         # comes up with no traffic yet must not shrink (or a cold-start
         # latency blip grow) on the very first tick
-        self._last_grow = self._last_shrink = time.monotonic()
+        self._last_grow = self._last_shrink = self._monotonic()
 
     def plan(self, signals: ServingSignals) -> ServePlan:
-        now = time.monotonic()  # cooldown window arithmetic
+        now = self._monotonic()  # cooldown window arithmetic
         target = signals.target_replicas
         if signals.live_replicas < target:
             # a lost replica: restore immediately (plan the TARGET — the
