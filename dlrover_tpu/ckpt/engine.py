@@ -232,7 +232,7 @@ class CheckpointEngine:
 
         return ReplicaManager(
             self.job_name, self.node_rank, node_num, self._master,
-            service=None, group_size=group,
+            service=None, group_size=group, reporter=self._report_event,
         )
 
     # -- save --------------------------------------------------------------
@@ -926,7 +926,7 @@ class CheckpointEngine:
         restorer = reshard_mod.ReshardRestorer(
             self.job_name, self._master, self.node_rank,
             local_rank=self.local_rank, rank=self.rank,
-            own_shm=self._shm,
+            own_shm=self._shm, reporter=self._report_event,
         )
         try:
             cut = restorer.read_cut()

@@ -18,14 +18,19 @@ restore its shard from a surviving peer. TPU-native redesign:
   replica.py:84 builds gloo groups the same way, over node ranks).
 
 Restore path (engine.load): local shm dead → fetch own frame from any group
-peer → write it back into local shm → normal shm restore continues.
+peer → write it back into local shm → normal shm restore continues. Frame
+downloads ride the striped transfer fabric (``common/fabric.py``): every
+group member that holds a copy serves stripes concurrently, a dying peer
+mid-download only costs the stripes it still owed, and the content CRC
+guards against mixing bytes across a same-step overwrite.
 """
 
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from dlrover_tpu.common import comm
+from dlrover_tpu.common import comm, fabric
+from dlrover_tpu.common.constants import ConfigKey, env_int
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCClient, RPCError, RPCServer, local_host_ip
 
@@ -33,6 +38,11 @@ from dlrover_tpu.common.rpc import RPCClient, RPCError, RPCServer, local_host_ip
 # loop over the remaining peers
 _PEER_ERRORS = (ConnectionError, OSError, RPCError)
 from dlrover_tpu.ckpt.shm_handler import SharedMemoryHandler
+
+
+def frame_key(owner_rank: int, local_rank: int) -> str:
+    """Fabric key one stored checkpoint frame is served under."""
+    return f"frame/{int(owner_rank)}/{int(local_rank)}"
 
 
 def backup_peers(node_rank: int, node_num: int, group_size: int = 2) -> List[int]:
@@ -61,8 +71,12 @@ class ReplicaService:
         self._lock = threading.Lock()
         self._server = RPCServer(host, port)
         self._server.register("replica_put", self._on_put)
-        self._server.register("replica_get", self._on_get)
         self._server.register("replica_list", self._on_list)
+        # frame downloads ride the striped fabric plane (fabric_describe /
+        # fabric_fetch); the store version is the provider etag, so the
+        # fabric's content-CRC memo never outlives a same-step overwrite
+        self.fabric = fabric.FabricServer(server=self._server)
+        self.fabric.register_provider("frame", self._provide_frame)
 
     @property
     def port(self) -> int:
@@ -144,27 +158,16 @@ class ReplicaService:
             self.put(req.owner_rank, req.local_rank, req.step, blob)
         return comm.BoolResponse(value=True)
 
-    def _on_get(self, req: comm.ReplicaGetRequest) -> comm.ReplicaFrameResponse:
-        held = self._get_versioned(req.owner_rank, req.local_rank)
+    def _provide_frame(self, rest: str):
+        """Fabric provider for ``frame/{owner}/{local}``. The captured blob
+        is immutable, so in-flight stripe reads of one resolution stay
+        self-consistent even while a newer push replaces the store entry."""
+        owner_s, _, local_s = rest.partition("/")
+        held = self._get_versioned(int(owner_s), int(local_s))
         if held is None:
-            return comm.ReplicaFrameResponse(
-                found=False, owner_rank=req.owner_rank,
-                local_rank=req.local_rank,
-            )
+            return None
         step, blob, version = held
-        if req.chunk_bytes <= 0:
-            return comm.ReplicaFrameResponse(
-                found=True, owner_rank=req.owner_rank,
-                local_rank=req.local_rank, step=step, blob=blob,
-                version=version,
-            )
-        count = max(1, -(-len(blob) // req.chunk_bytes))
-        lo = req.chunk_index * req.chunk_bytes
-        return comm.ReplicaFrameResponse(
-            found=True, owner_rank=req.owner_rank, local_rank=req.local_rank,
-            step=step, blob=blob[lo : lo + req.chunk_bytes],
-            chunk_index=req.chunk_index, chunk_count=count, version=version,
-        )
+        return step, len(blob), version, lambda off, n: blob[off:off + n]
 
     def _on_list(self, req) -> comm.ReplicaListResponse:
         return comm.ReplicaListResponse(entries=self.entries())
@@ -176,7 +179,8 @@ class ReplicaManager:
     under ``replica/{job}/addr/{node_rank}``."""
 
     # frames can exceed the 4 GiB transport frame limit (big per-host
-    # model+optimizer shards) — split transfers well below it
+    # model+optimizer shards) — split push transfers well below it; it
+    # also caps the fabric stripe size on the fetch side
     CHUNK_BYTES = 256 * 1024 * 1024
 
     def __init__(
@@ -188,6 +192,7 @@ class ReplicaManager:
         service: Optional[ReplicaService] = None,
         group_size: int = 2,
         host: Optional[str] = None,
+        reporter=None,
     ):
         self.job_name = job_name
         self.node_rank = node_rank
@@ -195,9 +200,13 @@ class ReplicaManager:
         self.group_size = group_size
         self._master = master_client
         self._service = service
+        # journal sink for fabric session/failover events (the engine
+        # passes its _report_event; standalone managers run silent)
+        self._reporter = reporter
         # the address peers dial — must be reachable cross-host, never
         # loopback (override with DLROVER_TPU_HOST_IP in pod specs)
         self._host = host or local_host_ip()
+        self._addrs: Dict[int, str] = {}
         self._clients: Dict[int, RPCClient] = {}
         self._backup_thread: Optional[threading.Thread] = None
         if service is not None and master_client is not None:
@@ -211,18 +220,35 @@ class ReplicaManager:
     def peers(self) -> List[int]:
         return backup_peers(self.node_rank, self.node_num, self.group_size)
 
+    def _peer_addr(self, rank: int) -> Optional[str]:
+        addr = self._addrs.get(rank)
+        if addr:
+            return addr
+        if self._master is None:
+            return None
+        raw = self._master.kv_get(self._addr_key(rank))
+        if not raw:
+            return None
+        addr = raw.decode()
+        self._addrs[rank] = addr
+        return addr
+
     def _peer_client(self, rank: int) -> Optional[RPCClient]:
         client = self._clients.get(rank)
         if client is not None:
             return client
-        if self._master is None:
+        addr = self._peer_addr(rank)
+        if addr is None:
             return None
-        addr = self._master.kv_get(self._addr_key(rank))
-        if not addr:
-            return None
-        client = RPCClient(addr.decode(), timeout_s=60.0, retries=3)
+        client = RPCClient(addr, timeout_s=60.0, retries=3)
         self._clients[rank] = client
         return client
+
+    def _drop_peer(self, rank: int) -> None:
+        # a failed peer may come back relaunched under a new address —
+        # forget both the socket and the cached KV lookup
+        self._clients.pop(rank, None)
+        self._addrs.pop(rank, None)
 
     # -- backup ------------------------------------------------------------
 
@@ -263,7 +289,7 @@ class ReplicaManager:
                 acked += 1
             except _PEER_ERRORS as e:
                 logger.warning("replica push to node %s failed: %r", rank, e)
-                self._clients.pop(rank, None)
+                self._drop_peer(rank)
         return acked
 
     def backup(self, shm: SharedMemoryHandler, local_rank: int = 0,
@@ -308,68 +334,59 @@ class ReplicaManager:
 
     # -- restore -----------------------------------------------------------
 
+    def _remote_ranks(self) -> List[int]:
+        return (
+            self.peers if self._service is not None
+            else [self.node_rank, *self.peers]
+        )
+
+    def _fetch_via_fabric(self, owner_rank: int,
+                          local_rank: int) -> Optional[Tuple[int, bytes]]:
+        """Striped multi-source download of one owner's frame from every
+        group store that holds a copy. Retries once on a content mismatch
+        (a same-step overwrite landing mid-transfer changes the assembled
+        bytes; the refreshed describe re-addresses the new version)."""
+        sources = []
+        for rank in self._remote_ranks():
+            addr = self._peer_addr(rank)
+            if addr:
+                sources.append(fabric.FabricSource(addr=addr, rank=rank))
+        if not sources:
+            return None
+        key = frame_key(owner_rank, local_rank)
+        stripe = min(
+            self.CHUNK_BYTES,
+            env_int(ConfigKey.FABRIC_STRIPE_BYTES,
+                    fabric.DEFAULT_STRIPE_BYTES),
+        )
+        for attempt in range(2):
+            try:
+                step, blob, _stats = fabric.fetch(
+                    sources, key, stripe_bytes=stripe, timeout_s=60.0,
+                    local_rank=self.node_rank, reporter=self._reporter,
+                )
+                return step, blob
+            except fabric.FabricAbort as e:
+                if e.reason == "content_mismatch" and attempt == 0:
+                    continue
+                logger.info("replica fabric fetch of %s aborted (%s): %s",
+                            key, e.reason, e)
+                return None
+        return None
+
     def fetch(self, local_rank: int = 0) -> Optional[Tuple[int, bytes]]:
         """Fetch this host's latest frame: local agent store first (worker
-        restart with agent alive), then any group peer (pod relaunch)."""
+        restart with agent alive), then the group stores over the fabric
+        (pod relaunch)."""
         best: Optional[Tuple[int, bytes]] = None
         if self._service is not None:
             held = self._service.get(self.node_rank, local_rank)
             if held is not None:
                 best = held
-        remote_ranks = (
-            self.peers if self._service is not None
-            else [self.node_rank, *self.peers]
-        )
-        for rank in remote_ranks:
-            client = self._peer_client(rank)
-            if client is None:
-                continue
-            try:
-                held = self._fetch_from(client, local_rank)
-            except _PEER_ERRORS:
-                self._clients.pop(rank, None)
-                continue
-            if held is not None and (best is None or held[0] > best[0]):
-                best = held
+        held = self._fetch_via_fabric(self.node_rank, local_rank)
+        if held is not None and (best is None or held[0] > best[0]):
+            best = held
         return best
-
-    def _fetch_from(self, client: RPCClient, local_rank: int,
-                    owner_rank: Optional[int] = None
-                    ) -> Optional[Tuple[int, bytes]]:
-        """Chunked download of one owner's frame from one peer (default:
-        this node's own frame). Restarts once if the peer's stored frame
-        advances mid-download."""
-        owner = self.node_rank if owner_rank is None else owner_rank
-        for _ in range(2):
-            resp = client.call(
-                "replica_get",
-                comm.ReplicaGetRequest(
-                    owner_rank=owner, local_rank=local_rank,
-                    chunk_index=0, chunk_bytes=self.CHUNK_BYTES,
-                ),
-            )
-            if not resp.found:
-                return None
-            step, version = resp.step, resp.version
-            parts = [resp.blob]
-            consistent = True
-            for i in range(1, resp.chunk_count):
-                nxt = client.call(
-                    "replica_get",
-                    comm.ReplicaGetRequest(
-                        owner_rank=owner, local_rank=local_rank,
-                        chunk_index=i, chunk_bytes=self.CHUNK_BYTES,
-                    ),
-                )
-                # a same-step overwrite mid-download changes the store
-                # version — mixing chunks across versions corrupts the frame
-                if not nxt.found or nxt.version != version:
-                    consistent = False
-                    break
-                parts.append(nxt.blob)
-            if consistent:
-                return step, b"".join(parts)
-        return None
 
     # -- peer-frame restore (engine ladder rung before storage) ------------
 
@@ -380,18 +397,14 @@ class ReplicaManager:
         entries: List[Tuple[int, int, int]] = []
         if self._service is not None:
             entries.extend(tuple(e) for e in self._service.entries())
-        remote_ranks = (
-            self.peers if self._service is not None
-            else [self.node_rank, *self.peers]
-        )
-        for rank in remote_ranks:
+        for rank in self._remote_ranks():
             client = self._peer_client(rank)
             if client is None:
                 continue
             try:
                 resp = client.call("replica_list", comm.BaseRequest())
             except _PEER_ERRORS:
-                self._clients.pop(rank, None)
+                self._drop_peer(rank)
                 continue
             entries.extend(
                 (int(o), int(l), int(s)) for o, l, s in resp.entries
@@ -412,30 +425,16 @@ class ReplicaManager:
     def fetch_frame(self, owner_rank: int,
                     local_rank: int = 0) -> Optional[Tuple[int, bytes]]:
         """Fetch ANY owner's frame from whichever store holds the newest
-        copy (local agent first, then group peers) — unlike :meth:`fetch`,
-        which only retrieves this node's own frame."""
+        copy (local agent first, then the group stores over the fabric) —
+        unlike :meth:`fetch`, which only retrieves this node's own frame."""
         best: Optional[Tuple[int, bytes]] = None
         if self._service is not None:
             held = self._service.get(owner_rank, local_rank)
             if held is not None:
                 best = held
-        remote_ranks = (
-            self.peers if self._service is not None
-            else [self.node_rank, *self.peers]
-        )
-        for rank in remote_ranks:
-            client = self._peer_client(rank)
-            if client is None:
-                continue
-            try:
-                held = self._fetch_from(
-                    client, local_rank, owner_rank=owner_rank
-                )
-            except _PEER_ERRORS:
-                self._clients.pop(rank, None)
-                continue
-            if held is not None and (best is None or held[0] > best[0]):
-                best = held
+        held = self._fetch_via_fabric(owner_rank, local_rank)
+        if held is not None and (best is None or held[0] > best[0]):
+            best = held
         return best
 
     def try_restore_shm(self, shm: SharedMemoryHandler,

@@ -30,22 +30,26 @@ survivor whose workers already resumed and sealed a *newer* frame answers
 of mixing steps. Like every recovery path in this repo the transfers ride
 the host TCP plane, never the ICI/DCN data fabric.
 
-Chaos sites: ``reshard.plan`` fires before planning, ``reshard.xfer``
-before every shard fetch — the schedule grammar can kill a transfer
+Transport: shard bytes move over the state-movement fabric
+(``common/fabric.py``) — striped, multi-source (duplicate extents on
+other survivors become alternate sources), CRC-guarded, with mid-stream
+failover. Chaos sites: ``reshard.plan`` fires before planning; the
+transfer itself is exercised through the fabric's ``fabric.connect`` /
+``fabric.stripe`` sites — the schedule grammar can kill a transfer
 mid-flight and the ladder must fall through (tests/test_resharding.py).
 """
 
 import json
 import time
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import msgpack
 import numpy as np
 
 from dlrover_tpu.chaos import get_injector
-from dlrover_tpu.common import comm
+from dlrover_tpu.common import comm, fabric
 from dlrover_tpu.common.constants import (
     ConfigKey,
     EnvKey,
@@ -70,6 +74,22 @@ def cut_key(job_name: str, round_: int) -> str:
 def addr_key(job_name: str, node_rank: int) -> str:
     """KV key under which an agent's ReshardService address is published."""
     return f"reshard/{job_name}/addr/{int(node_rank)}"
+
+
+def shard_key(local_rank: int, shard_index: int, path: str) -> str:
+    """Fabric locator of one saved shard on one survivor: routed to the
+    ``reshard`` provider the agent's :class:`FabricServer` mounts."""
+    return f"reshard/{int(local_rank)}/{int(shard_index)}/{path}"
+
+
+# FabricAbort reasons → the reshard ladder's normalized abort reasons
+_FABRIC_REASONS = {
+    "fault_injected": "fault_injected",
+    "no_sources": "shard_gone",
+    "sources_lost": "transfer_failed",
+    "content_mismatch": "transfer_failed",
+    "timeout": "transfer_failed",
+}
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -100,7 +120,12 @@ class ReshardAbort(RuntimeError):
 
 @dataclass(frozen=True, slots=True)
 class ShardSource:
-    """One saved shard of one leaf, addressable on a survivor host."""
+    """One saved shard of one leaf, addressable on a survivor host.
+    ``alt`` lists ``(node_rank, local_rank, shard_index)`` alternates —
+    other survivors holding the exact same extent (partially-replicated
+    saves). The planner sees one shard per extent (its volume sums assume
+    disjoint sources), but the fabric fans the fetch out across all of
+    them and fails over between them mid-stream."""
 
     path: str
     node_rank: int
@@ -109,6 +134,7 @@ class ShardSource:
     start: Tuple[int, ...]
     shape: Tuple[int, ...]
     nbytes: int
+    alt: Tuple[Tuple[int, int, int], ...] = ()
 
 
 @dataclass(frozen=True, slots=True)
@@ -174,9 +200,10 @@ def layout_from_frames(
     and plain value leaves (restored verbatim, first frame wins).
 
     Exact-duplicate extents (same start+shape, e.g. partially-replicated
-    saves) are dropped so the planner's coverage volume sum — which
-    assumes disjoint sources, the save planner's replica_id==0 invariant —
-    stays exact."""
+    saves) are folded into ONE shard per extent so the planner's coverage
+    volume sum — which assumes disjoint sources, the save planner's
+    replica_id==0 invariant — stays exact; the duplicates are kept as
+    fabric ``alt`` sources for multi-source fan-out and failover."""
     specs: Dict[str, ReshardSpec] = {}
     values: Dict[str, Dict] = {}
     acc: Dict[str, Dict[str, Any]] = {}
@@ -194,14 +221,21 @@ def layout_from_frames(
                     "dtype": leaf.get("dtype", "float32"),
                     "gshape": tuple(leaf.get("gshape", ())),
                     "shards": [],
-                    "extents": set(),
+                    "extents": {},
                 },
             )
             for i, sh in enumerate(leaf.get("shards", [])):
                 extent = (tuple(sh["start"]), tuple(sh["lshape"]))
-                if extent in entry["extents"]:
+                known = entry["extents"].get(extent)
+                if known is not None:
+                    # same extent on another survivor: an alternate
+                    # source for the fabric, not a new planner shard
+                    prev = entry["shards"][known]
+                    entry["shards"][known] = replace(
+                        prev, alt=prev.alt + ((node, local, i),)
+                    )
                     continue
-                entry["extents"].add(extent)
+                entry["extents"][extent] = len(entry["shards"])
                 entry["shards"].append(
                     ShardSource(
                         path=path,
@@ -372,8 +406,11 @@ def execute_plan(
 
 class ReshardService:
     """Runs inside the agent so the last sealed frame survives worker
-    death. Serves frame *metas* and per-shard *byte ranges* — survivors of
-    a world cut feed relaunched peers directly from shm, no storage read.
+    death. Serves frame *metas* over plain RPC and per-shard *byte
+    ranges* through a mounted :class:`~dlrover_tpu.common.fabric.
+    FabricServer` (the ``reshard`` provider) — survivors of a world cut
+    feed relaunched peers directly from shm, striped and step-guarded,
+    no storage read.
 
     ``shm_provider`` returns the live ``SharedMemoryHandler`` list for
     this host's local ranks (the agent attaches by the shm names workers
@@ -384,7 +421,8 @@ class ReshardService:
         self._shm_provider = shm_provider
         self._server = RPCServer(host, port)
         self._server.register("reshard_meta", self._on_meta)
-        self._server.register("reshard_fetch", self._on_fetch)
+        self.fabric = fabric.FabricServer(server=self._server)
+        self.fabric.register_provider("reshard", self._provide_shard)
 
     @property
     def port(self) -> int:
@@ -431,48 +469,41 @@ class ReshardService:
             found=bool(frames), node_rank=node_rank, frames=frames
         )
 
-    def _on_fetch(
-        self, req: comm.ReshardFetchRequest
-    ) -> comm.ReshardBytesResponse:
+    def _provide_shard(self, rest: str):
+        """Fabric provider for ``reshard/{local_rank}/{shard_index}/{path}``
+        keys: a step-etagged ranged reader over one saved shard of the
+        sealed shm frame. The fabric's step guard replaces the old
+        per-fetch check — a host whose workers already sealed a newer
+        frame answers found=False rather than mixing steps."""
+        parts = rest.split("/", 2)
+        if len(parts) != 3:
+            return None
+        local_rank, sidx, path = int(parts[0]), int(parts[1]), parts[2]
         for handler, meta in self._frames():
-            if int(meta.get("local_rank", 0)) != req.local_rank:
+            if int(meta.get("local_rank", 0)) != local_rank:
                 continue
             step = int(meta.get("step", -1))
-            if req.step >= 0 and step != req.step:
-                # this host's workers already sealed a newer frame —
-                # refuse rather than mix steps across the new world
-                return comm.ReshardBytesResponse(found=False, step=step)
             for leaf in meta.get("leaves", []):
-                if leaf.get("path") != req.path:
+                if leaf.get("path") != path:
                     continue
                 shards = leaf.get("shards", [])
-                if not 0 <= req.shard_index < len(shards):
-                    return comm.ReshardBytesResponse(
-                        found=False, step=step
-                    )
-                shard = shards[req.shard_index]
+                if not 0 <= sidx < len(shards):
+                    return None
+                shard = shards[sidx]
                 total = int(shard["nbytes"])
-                offset = max(0, int(req.offset))
-                n = (total - offset if req.nbytes <= 0
-                     else min(int(req.nbytes), total - offset))
-                if n <= 0:
-                    return comm.ReshardBytesResponse(
-                        found=False, step=step
-                    )
-                sub = dict(shard)
-                sub["abs_offset"] = int(shard["abs_offset"]) + offset
-                sub["nbytes"] = n
-                data = handler.read_shard_bytes(sub)
-                if data is None:
-                    return comm.ReshardBytesResponse(
-                        found=False, step=step
-                    )
-                return comm.ReshardBytesResponse(
-                    found=True, step=step, data=bytes(data),
-                    total_nbytes=total,
-                )
-            return comm.ReshardBytesResponse(found=False, step=step)
-        return comm.ReshardBytesResponse(found=False)
+
+                def read_fn(off: int, n: int, handler=handler,
+                            shard=shard, total=total):
+                    if off < 0 or off + n > total:
+                        return None
+                    sub = dict(shard)
+                    sub["abs_offset"] = int(shard["abs_offset"]) + off
+                    sub["nbytes"] = n
+                    return handler.read_shard_bytes(sub)
+
+                return step, total, step, read_fn
+            return None
+        return None
 
 
 # --------------------------------------------------------------------------
@@ -524,12 +555,9 @@ class ReshardRestorer:
     normalized to :class:`ReshardAbort` so the engine's ladder has exactly
     one thing to catch."""
 
-    # transport frame headroom, same bound as ReplicaManager
-    CHUNK_BYTES = 256 * 1024 * 1024
-
     def __init__(self, job_name: str, master_client, node_rank: int,
                  local_rank: int = 0, rank: int = 0, own_shm=None,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None, reporter=None):
         self._job = job_name
         self._master = master_client
         self._node = node_rank
@@ -540,7 +568,11 @@ class ReshardRestorer:
             timeout_s if timeout_s is not None
             else env_float(ConfigKey.RESHARD_TIMEOUT_S, 60.0)
         )
+        # journal sink for fabric session/failover events (the engine
+        # passes its _report_event); best-effort, may be None
+        self._reporter = reporter
         self._clients: Dict[int, RPCClient] = {}
+        self._addrs: Dict[int, str] = {}
         self._cache: Dict[ShardSource, bytes] = {}
         self._source = f"worker_{rank}"
 
@@ -570,17 +602,26 @@ class ReshardRestorer:
             return None
         return cut
 
+    def _addr(self, rank: int) -> Optional[str]:
+        addr = self._addrs.get(rank)
+        if addr is not None:
+            return addr
+        getter = getattr(self._master, "kv_get", None)
+        raw = getter(addr_key(self._job, rank)) if getter else None
+        if not raw:
+            return None
+        addr = bytes(raw).decode()
+        self._addrs[rank] = addr
+        return addr
+
     def _client(self, rank: int) -> Optional[RPCClient]:
         client = self._clients.get(rank)
         if client is not None:
             return client
-        getter = getattr(self._master, "kv_get", None)
-        addr = getter(addr_key(self._job, rank)) if getter else None
-        if not addr:
+        addr = self._addr(rank)
+        if addr is None:
             return None
-        client = RPCClient(
-            bytes(addr).decode(), timeout_s=self._timeout_s, retries=2
-        )
+        client = RPCClient(addr, timeout_s=self._timeout_s, retries=2)
         self._clients[rank] = client
         return client
 
@@ -802,15 +843,11 @@ class ReshardRestorer:
         )
 
     def _shard_bytes(self, src: ShardSource, step: int, inj) -> bytes:
+        # inj unused since the move to the fabric (its fabric.connect /
+        # fabric.stripe sites fire inside fetch); kept for reader parity
         cached = self._cache.get(src)
         if cached is not None:
             return cached
-        if inj is not None:
-            inj.fire(
-                "reshard.xfer",
-                path=src.path, node_rank=src.node_rank,
-                local_rank=src.local_rank, nbytes=src.nbytes,
-            )
         if self._is_own(src, step):
             blob = self._read_own(src)
         else:
@@ -840,33 +877,35 @@ class ReshardRestorer:
         )
 
     def _fetch_remote(self, src: ShardSource, step: int) -> bytes:
-        client = self._client(src.node_rank)
-        if client is None:
+        """One fabric session per shard: the primary holder plus every
+        ``alt`` duplicate become the source swarm, so a survivor dying
+        mid-transfer only re-queues its missing stripes."""
+        sources: List[fabric.FabricSource] = []
+        holders = ((src.node_rank, src.local_rank, src.shard_index),)
+        for node, local, sidx in holders + src.alt:
+            addr = self._addr(node)
+            if addr is None:
+                continue
+            sources.append(fabric.FabricSource(
+                addr=addr, rank=node, key=shard_key(local, sidx, src.path),
+            ))
+        if not sources:
             raise ReshardAbort(
                 "peer_unreachable",
                 f"no reshard service address for node {src.node_rank}",
             )
-        parts: List[bytes] = []
-        offset = 0
-        while offset < src.nbytes:
-            n = min(self.CHUNK_BYTES, src.nbytes - offset)
-            resp = client.call(
-                "reshard_fetch",
-                comm.ReshardFetchRequest(
-                    local_rank=src.local_rank, step=step, path=src.path,
-                    shard_index=src.shard_index, offset=offset, nbytes=n,
-                ),
+        try:
+            _, blob, _ = fabric.fetch(
+                sources,
+                shard_key(src.local_rank, src.shard_index, src.path),
+                expect_step=step, timeout_s=self._timeout_s,
+                local_rank=self._node, reporter=self._reporter,
             )
-            if not resp.found or not resp.data:
-                raise ReshardAbort(
-                    "shard_gone",
-                    f"node {src.node_rank} no longer serves "
-                    f"{src.path}#{src.shard_index} at step {step} "
-                    f"(its frame is at step {resp.step})",
-                )
-            parts.append(resp.data)
-            offset += len(resp.data)
-        blob = b"".join(parts)
+        except fabric.FabricAbort as e:
+            raise ReshardAbort(
+                _FABRIC_REASONS.get(e.reason, "transfer_failed"),
+                f"{src.path}#{src.shard_index}: {e}",
+            ) from e
         if len(blob) != src.nbytes:
             raise ReshardAbort(
                 "short_read",

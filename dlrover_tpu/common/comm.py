@@ -579,29 +579,6 @@ class ReplicaPutRequest:
 
 
 @message
-class ReplicaGetRequest:
-    owner_rank: int = 0
-    local_rank: int = 0
-    chunk_index: int = 0
-    chunk_bytes: int = 0  # 0 = whole frame in one response
-
-
-@message
-class ReplicaFrameResponse:
-    found: bool = False
-    owner_rank: int = 0
-    local_rank: int = 0
-    step: int = -1
-    blob: bytes = b""
-    chunk_index: int = 0
-    chunk_count: int = 1
-    # the peer store's monotonically-increasing version of this frame: a
-    # same-step overwrite changes it, so a chunked download spanning the
-    # overwrite is detected and restarted
-    version: int = 0
-
-
-@message
 class ReplicaListResponse:
     """(owner_rank, local_rank, step) triples held by a peer."""
 
@@ -631,26 +608,52 @@ class ReshardMetaResponse:
     frames: List[List] = field(default_factory=list)
 
 
-@message
-class ReshardFetchRequest:
-    """One byte-range of one saved shard. ``step`` is the consistency
-    guard: the survivor answers found=False if its frame moved on, so a
-    reshard never mixes steps across the new world."""
+# --------------------------------------------------------------------------
+# State-movement fabric (common/fabric.py): content-addressed striped bulk
+# transfers — describe agrees on (step, total_bytes, content_crc), fetch
+# moves one CRC-guarded stripe
+# --------------------------------------------------------------------------
 
-    local_rank: int = 0
+
+@message
+class FabricDescribeRequest:
+    """Ask a peer whether it can serve ``key``. ``step`` is the
+    consistency guard: step >= 0 and a mismatch answers found=False with
+    the peer's current step, so a session never mixes steps across
+    sources."""
+
+    key: str = ""
     step: int = -1
-    path: str = ""
-    shard_index: int = 0
-    offset: int = 0   # byte offset within the shard
-    nbytes: int = 0   # 0 = rest of the shard
 
 
 @message
-class ReshardBytesResponse:
+class FabricDescribeResponse:
     found: bool = False
     step: int = -1
+    total_bytes: int = 0
+    content_crc: int = 0  # crc32 of the whole object, the content address
+
+
+@message
+class FabricFetchRequest:
+    """One stripe of one described object. ``step`` re-guards every
+    stripe: the source answers found=False if its object moved on."""
+
+    key: str = ""
+    step: int = -1
+    offset: int = 0
+    nbytes: int = 0
+
+
+@message
+class FabricStripeResponse:
+    found: bool = False
+    # incast protection: the source is at its concurrent-fetch admission
+    # cap — not a failure, the fetcher backs off and re-queues the stripe
+    busy: bool = False
+    step: int = -1
     data: bytes = b""
-    total_nbytes: int = 0
+    crc: int = 0  # crc32 of data, checked client-side before commit
 
 
 # --------------------------------------------------------------------------

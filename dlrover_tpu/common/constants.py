@@ -270,6 +270,16 @@ class ConfigKey:
     RESHARD = "DLROVER_TPU_RESHARD"
     RESHARD_TIMEOUT_S = "DLROVER_TPU_RESHARD_TIMEOUT_S"
     RESHARD_PORT = "DLROVER_TPU_RESHARD_PORT"
+    # state-movement fabric (common/fabric.py): stripe size (bytes) a bulk
+    # transfer is split into, connections a fetcher opens per source, and
+    # the per-source concurrent-fetch admission cap (incast protection)
+    FABRIC_STRIPE_BYTES = "DLROVER_TPU_FABRIC_STRIPE_BYTES"
+    FABRIC_CONNS = "DLROVER_TPU_FABRIC_CONNS"
+    FABRIC_ADMIT = "DLROVER_TPU_FABRIC_ADMIT"
+    # ops/flash_attention.py backward-pass block overrides (tuned
+    # independently of the forward blocks; read at trace time)
+    FLASH_BWD_BLOCK_Q = "DLROVER_TPU_FLASH_BWD_BLOCK_Q"
+    FLASH_BWD_BLOCK_K = "DLROVER_TPU_FLASH_BWD_BLOCK_K"
     # agent / worker
     HOST_IP = "DLROVER_TPU_HOST_IP"
     AGENT_METRICS_PORT = "DLROVER_TPU_AGENT_METRICS_PORT"
@@ -360,6 +370,9 @@ class SpanName:
     RESHARD_PLAN = "reshard.plan"
     RESHARD_XFER = "reshard.xfer"
     RESHARD_APPLY = "reshard.apply"
+    # state-movement fabric (common/fabric.py): one striped multi-source
+    # transfer session, client side
+    FABRIC_FETCH = "fabric.fetch"
     # scale-plan arc (master/auto_scaler.py → master/job_manager.py)
     SCALE_APPLY = "scale.apply"
     SCALE_RDZV_PARAMS = "scale.update_rdzv_params"

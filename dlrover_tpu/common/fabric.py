@@ -1,0 +1,782 @@
+"""Fault-tolerant state-movement fabric: striped multi-source transfers.
+
+Every recovery and serving path that moves bulk state between hosts —
+live reshard fetches (``ckpt/reshard.py``), peer replica-frame restore
+(``ckpt/replica.py``), serving replica weight loads (``serving/``) —
+rides this one transfer plane instead of its own ad-hoc single-stream
+TCP. A transfer is a **content-addressed session**: a describe phase
+agrees on ``(step, total_bytes, content_crc)`` across the candidate
+sources, the payload is split into fixed-size stripes with a per-stripe
+CRC, and worker threads pull *distinct* stripes from MANY sources at
+once (FlexLink's aggregate-every-link striping + the 100k-GPU paper's
+swarm fan-out, applied to host NICs; ROADMAP item 2).
+
+Failure semantics — the reason this is one plane and not three:
+
+- a stripe is the retry unit: transport errors retry under the BULK
+  budget (``common/retry.py``), a CRC-failed or short stripe fails its
+  *source* immediately (corruption is never transient on a reliable
+  transport, so the refetch always lands on a different source);
+- a dead source's missing stripes re-queue onto the survivors
+  (``fabric_source_failed`` / ``fabric_stripe_retried`` journaled) and
+  the session completes without restarting from zero;
+- a saturated source answers ``busy`` (server-side admission cap, the
+  incast guard) — the fetcher backs off with jitter and re-queues, it
+  is not a failure;
+- zero live sources collapses the session into :class:`FabricAbort`
+  with a normalized reason so the caller's degradation ladder
+  (engine.load) can fall to its next rung.
+
+Serving side: :class:`FabricServer` mounts ``fabric_describe`` /
+``fabric_fetch`` on an existing RPCServer (or owns one) and routes keys
+``<prefix>/<rest>`` to registered providers. A provider answers
+``(step, total_bytes, etag, read_fn)`` where ``read_fn(offset, nbytes)``
+is a ranged read — no whole-object amplification per stripe. The step
+guard rides every message, and the whole-object CRC memo is keyed by the
+provider's etag so a same-step overwrite can never serve a stale CRC.
+
+Chaos sites: ``fabric.connect`` fires before each source's describe,
+``fabric.stripe`` before each stripe fetch (``bitflip``/``torn`` actions
+corrupt the *received* payload, modelling wire corruption the per-stripe
+CRC must catch). Session/stripe maps are registered with ``shared(...)``
+for tier-1 race certification (tests/test_fabric.py).
+"""
+
+import argparse
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.analysis.race_detector import shared
+from dlrover_tpu.chaos import InjectedError, InjectedFault, get_injector
+from dlrover_tpu.common import comm, retry
+from dlrover_tpu.common.constants import ConfigKey, SpanName, env_int
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RPCClient, RPCError, RPCServer
+from dlrover_tpu.observability import tracing
+from dlrover_tpu.observability.journal import JournalEvent
+from dlrover_tpu.observability.registry import get_registry
+
+FABRIC_CONNECT_SITE = "fabric.connect"
+FABRIC_STRIPE_SITE = "fabric.stripe"
+
+DEFAULT_STRIPE_BYTES = 16 * 1024 * 1024
+DEFAULT_CONNS = 4
+DEFAULT_ADMIT = 4
+# jittered backoff after a busy reply — short: busy means the source is
+# healthy but momentarily saturated, and the wait rides the abort Event
+# so a finishing session wakes the fetcher instantly
+BUSY_BACKOFF_S = 0.05
+
+# one bad peer must never abort the loop over the remaining peers
+_PEER_ERRORS = (ConnectionError, OSError, RPCError, retry.CircuitOpenError)
+
+
+class FabricAbort(RuntimeError):
+    """The transfer session cannot complete; the caller's degradation
+    ladder falls to its next rung. ``reason`` is a short machine-readable
+    token: ``no_sources`` (describe found nobody serving the object),
+    ``sources_lost`` (every source died mid-transfer), ``fault_injected``
+    (every failure was chaos-injected — drills assert causality),
+    ``content_mismatch`` (assembled bytes fail the content address) or
+    ``timeout``."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
+
+
+@dataclass(frozen=True, slots=True)
+class FabricSource:
+    """One candidate peer for a session. ``key`` overrides the session
+    key for this source only — content addressing makes locator aliases
+    safe (a reshard alternate at a different shard index serves the same
+    bytes, and the describe CRC proves it)."""
+
+    addr: str
+    rank: int = -1
+    slice_id: str = ""
+    key: str = ""
+
+
+def plan_stripes(total_bytes: int,
+                 stripe_bytes: int) -> List[Tuple[int, int]]:
+    """Split ``total_bytes`` into ``(offset, length)`` stripes. Exact
+    cover, no overlap, last stripe short — the algebra test's invariants."""
+    if total_bytes < 0:
+        raise ValueError(f"negative transfer size {total_bytes}")
+    if stripe_bytes <= 0:
+        raise ValueError(f"non-positive stripe size {stripe_bytes}")
+    return [
+        (off, min(stripe_bytes, total_bytes - off))
+        for off in range(0, total_bytes, stripe_bytes)
+    ]
+
+
+def rank_sources(sources: Sequence[FabricSource], local_slice: str = "",
+                 local_rank: int = -1) -> List[FabricSource]:
+    """Topology-aware preference order: same-slice peers first (ICI-
+    adjacent hosts share a pod network), then nearest rank (rack-adjacent
+    under the usual contiguous placement), then stable by address."""
+
+    def sort_key(src: FabricSource):
+        slice_penalty = 0 if (
+            local_slice and src.slice_id and src.slice_id == local_slice
+        ) else 1
+        distance = (
+            abs(src.rank - local_rank)
+            if src.rank >= 0 and local_rank >= 0 else 1 << 30
+        )
+        return (slice_penalty, distance, src.addr)
+
+    deduped: Dict[str, FabricSource] = {}
+    for src in sources:
+        deduped.setdefault(src.addr, src)
+    return sorted(deduped.values(), key=sort_key)
+
+
+# --------------------------------------------------------------------------
+# Server side: step-guarded stripe service with incast admission
+# --------------------------------------------------------------------------
+
+
+# provider(rest_of_key) -> (step, total_bytes, etag, read_fn) or None;
+# read_fn(offset, nbytes) -> bytes | None (ranged, no amplification)
+Provider = Callable[
+    [str], Optional[Tuple[int, int, int, Callable[[int, int], Any]]]
+]
+
+
+class FabricServer:
+    """Serves ``fabric_describe``/``fabric_fetch`` for registered
+    providers, either mounted on an existing :class:`RPCServer` (the
+    reshard agent service, a serving replica's RPC plane) or owning one.
+
+    Incast guard: concurrent ``fabric_fetch`` admissions are capped; a
+    saturated fetch is answered ``busy=True`` instead of queueing server
+    threads behind each other (the 100k-GPU paper's motivation — a
+    popular source must shed load, not melt). ``max_in_flight`` /
+    ``busy_replies`` expose the high-water marks for the admission tests.
+    """
+
+    def __init__(self, server: Optional[RPCServer] = None,
+                 host: str = "0.0.0.0", port: int = 0,
+                 admit: Optional[int] = None):
+        self._owned = server is None
+        self._server = server if server is not None else RPCServer(host, port)
+        self._providers: Dict[str, Provider] = {}
+        self.admit_cap = max(
+            1, admit if admit is not None
+            else env_int(ConfigKey.FABRIC_ADMIT, DEFAULT_ADMIT)
+        )
+        self._sem = threading.BoundedSemaphore(self.admit_cap)
+        self._lock = threading.Lock()
+        self._in_flight = 0
+        self.max_in_flight = 0
+        self.busy_replies = 0
+        self.stripes_served = 0
+        # content-CRC memo keyed (key, step, total, etag): the etag is the
+        # provider's object version, so a same-step overwrite (replica
+        # store re-push) can never serve the stale CRC
+        self._crc_memo = shared({}, "fabric.crc_memo")
+        self._server.register("fabric_describe", self._on_describe)
+        self._server.register("fabric_fetch", self._on_fetch)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def start(self) -> None:
+        if self._owned:
+            self._server.start()
+
+    def stop(self) -> None:
+        if self._owned:
+            self._server.stop()
+
+    def register_provider(self, prefix: str, provider: Provider) -> None:
+        """Route keys ``<prefix>/<rest>`` to ``provider(rest)``."""
+        self._providers[prefix] = provider
+
+    def _resolve(self, key: str):
+        prefix, _, rest = key.partition("/")
+        provider = self._providers.get(prefix)
+        if provider is None:
+            return None
+        try:
+            return provider(rest)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            # a vanished shm frame / malformed key is "not served here",
+            # never a handler error the client would treat as fatal
+            logger.debug("fabric provider %r failed for %r: %r",
+                         prefix, rest, e)
+            return None
+
+    def _content_crc(self, key: str, step: int, total: int, etag: int,
+                     read_fn) -> Optional[int]:
+        memo_key = (key, step, total, etag)
+        with self._lock:
+            crc = self._crc_memo.get(memo_key)
+        if crc is not None:
+            return crc
+        crc = 0
+        off = 0
+        while off < total:
+            n = min(DEFAULT_STRIPE_BYTES, total - off)
+            data = read_fn(off, n)
+            if data is None or len(data) != n:
+                return None
+            crc = zlib.crc32(data, crc)
+            off += n
+        with self._lock:
+            self._crc_memo[memo_key] = crc
+        return crc
+
+    def _on_describe(
+        self, req: comm.FabricDescribeRequest
+    ) -> comm.FabricDescribeResponse:
+        ans = self._resolve(req.key)
+        if ans is None:
+            return comm.FabricDescribeResponse(found=False)
+        step, total, etag, read_fn = ans
+        if req.step >= 0 and step != req.step:
+            # this host moved on — refuse rather than mix steps
+            return comm.FabricDescribeResponse(found=False, step=step)
+        crc = self._content_crc(req.key, step, total, etag, read_fn)
+        if crc is None:
+            return comm.FabricDescribeResponse(found=False, step=step)
+        return comm.FabricDescribeResponse(
+            found=True, step=step, total_bytes=total, content_crc=crc
+        )
+
+    def _on_fetch(
+        self, req: comm.FabricFetchRequest
+    ) -> comm.FabricStripeResponse:
+        if not self._sem.acquire(blocking=False):
+            with self._lock:
+                self.busy_replies += 1
+            return comm.FabricStripeResponse(found=False, busy=True)
+        try:
+            with self._lock:
+                self._in_flight += 1
+                if self._in_flight > self.max_in_flight:
+                    self.max_in_flight = self._in_flight
+            ans = self._resolve(req.key)
+            if ans is None:
+                return comm.FabricStripeResponse(found=False)
+            step, total, _etag, read_fn = ans
+            if req.step >= 0 and step != req.step:
+                return comm.FabricStripeResponse(found=False, step=step)
+            off = max(0, int(req.offset))
+            n = (total - off if req.nbytes <= 0
+                 else min(int(req.nbytes), total - off))
+            if n <= 0:
+                return comm.FabricStripeResponse(found=False, step=step)
+            data = read_fn(off, n)
+            if data is None or len(data) != n:
+                return comm.FabricStripeResponse(found=False, step=step)
+            data = bytes(data)
+            with self._lock:
+                self.stripes_served += 1
+            return comm.FabricStripeResponse(
+                found=True, step=step, data=data, crc=zlib.crc32(data)
+            )
+        finally:
+            with self._lock:
+                self._in_flight -= 1
+            self._sem.release()
+
+
+# --------------------------------------------------------------------------
+# Client side: one striped multi-source session
+# --------------------------------------------------------------------------
+
+
+def _report(reporter, kind: str, data: Dict[str, Any]) -> None:
+    if reporter is None:
+        return
+    try:
+        reporter(kind, data)
+    except Exception:  # noqa: BLE001 — telemetry must not fail a transfer
+        logger.debug("fabric journal %r failed", kind, exc_info=True)
+
+
+def _is_injected(exc: BaseException) -> bool:
+    # retry_call wraps an exhausted ladder in a plain ConnectionError
+    # whose message embeds the last error's repr — keep the causality
+    # signal so drills can assert the ladder fell BECAUSE of injection
+    return isinstance(exc, (InjectedError, InjectedFault)) or (
+        "Injected" in str(exc)
+    )
+
+
+class _FetchSession:
+    """Mutable state of one running transfer. All stripe/source maps are
+    ``shared(...)``-registered and mutated only under ``self._cond`` —
+    the tier-1 race_guard certifies the fetch/retry/failover cycle."""
+
+    def __init__(self, key: str, step: int, total: int, crc: int,
+                 sources: List[FabricSource],
+                 stripes: List[Tuple[int, int]], reporter=None):
+        self.key = key
+        self.step = step
+        self.total = total
+        self.crc = crc
+        self.sources = list(sources)
+        self.stripes = stripes
+        self.reporter = reporter
+        self._buf = bytearray(total)
+        self._cond = threading.Condition()
+        self._abort_evt = threading.Event()
+        self._missing = shared(set(range(len(stripes))), "fabric.missing")
+        # LIFO take from the tail, failure re-queue at the head: a
+        # re-queued stripe is not immediately re-taken by a sibling
+        # connection of the same saturated/failed source
+        self._pending = shared(list(range(len(stripes))), "fabric.pending")
+        self._failed = shared(set(), "fabric.failed_sources")
+        self._bytes_by_source = shared({}, "fabric.bytes_by_source")
+        self._counters = shared(
+            {"stripe_fetches": 0, "stripe_retries": 0, "busy": 0,
+             "failures": 0},
+            "fabric.counters",
+        )
+        self._state = shared(
+            {"abort": None, "detail": "", "all_injected": True},
+            "fabric.state",
+        )
+
+    # -- worker side -------------------------------------------------------
+
+    def _next_stripe(self, src: FabricSource) -> Optional[int]:
+        with self._cond:
+            while True:
+                if self._state["abort"] is not None or not self._missing:
+                    return None
+                if src.addr in self._failed:
+                    return None
+                if self._pending:
+                    return self._pending.pop()
+                # everything in flight elsewhere — wake on commit/requeue
+                self._cond.wait(0.1)
+
+    def _requeue_busy(self, idx: int) -> None:
+        with self._cond:
+            self._pending.insert(0, idx)
+            self._counters["busy"] += 1
+            self._cond.notify_all()
+        self._abort_evt.wait(retry.jittered(BUSY_BACKOFF_S))
+
+    def _fail_source(self, src: FabricSource, idx: int, detail: str,
+                     injected: bool) -> None:
+        with self._cond:
+            self._counters["stripe_retries"] += 1
+            self._counters["failures"] += 1
+            if not injected:
+                self._state["all_injected"] = False
+            newly_failed = src.addr not in self._failed
+            if newly_failed:
+                self._failed.add(src.addr)
+            self._pending.insert(0, idx)
+            live = [
+                s for s in self.sources if s.addr not in self._failed
+            ]
+            aborted = False
+            if not live and self._missing:
+                self._state["abort"] = (
+                    "fault_injected" if self._state["all_injected"]
+                    else "sources_lost"
+                )
+                self._state["detail"] = detail
+                aborted = True
+            self._cond.notify_all()
+            survivors = len(live)
+            left = len(self._missing)
+        if aborted:
+            self._abort_evt.set()
+        if newly_failed:
+            _report(self.reporter, JournalEvent.FABRIC_SOURCE_FAILED, {
+                "key": self.key, "addr": src.addr, "rank": src.rank,
+                "detail": detail, "survivors": survivors,
+                "stripes_missing": left,
+            })
+            logger.warning(
+                "fabric: source %s failed (%s) — %d stripe(s) re-queued "
+                "onto %d survivor(s)", src.addr, detail, left, survivors,
+            )
+        _report(self.reporter, JournalEvent.FABRIC_STRIPE_RETRIED, {
+            "key": self.key, "stripe": idx, "addr": src.addr,
+            "detail": detail,
+        })
+
+    def _commit(self, src: FabricSource, idx: int, data: bytes) -> None:
+        off, n = self.stripes[idx]
+        with self._cond:
+            self._counters["stripe_fetches"] += 1
+            if idx in self._missing:
+                self._buf[off:off + n] = data
+                self._missing.discard(idx)
+                self._bytes_by_source[src.addr] = (
+                    self._bytes_by_source.get(src.addr, 0) + n
+                )
+            done = not self._missing
+            if done:
+                self._cond.notify_all()
+        if done:
+            self._abort_evt.set()
+
+    def _fetch_one(self, src: FabricSource, client: RPCClient,
+                   idx: int, inj) -> None:
+        off, n = self.stripes[idx]
+        skey = src.key or self.key
+        action = None
+        try:
+            if inj is not None:
+                action = inj.fire(
+                    FABRIC_STRIPE_SITE, key=skey, addr=src.addr,
+                    stripe=idx, offset=off, nbytes=n, step=self.step,
+                )
+            resp = client.call(
+                "fabric_fetch",
+                comm.FabricFetchRequest(
+                    key=skey, step=self.step, offset=off, nbytes=n
+                ),
+                policy=retry.BULK,
+            )
+        except (InjectedError,) as e:
+            self._fail_source(src, idx, repr(e), injected=True)
+            return
+        except _PEER_ERRORS as e:
+            self._fail_source(src, idx, repr(e), injected=_is_injected(e))
+            return
+        if resp.busy:
+            self._requeue_busy(idx)
+            return
+        if not resp.found:
+            self._fail_source(
+                src, idx,
+                f"object gone (source at step {resp.step})",
+                injected=False,
+            )
+            return
+        data = resp.data
+        if action is not None and data:
+            # chaos models wire corruption on the RECEIVED payload; the
+            # per-stripe CRC below must catch it and fail this source
+            mut = bytearray(data)
+            if action["kind"] == "bitflip":
+                mut[int(action["rnd"] * len(mut)) % len(mut)] ^= 0xFF
+            elif action["kind"] == "torn":
+                mut = mut[: len(mut) // 2]
+            data = bytes(mut)
+        if len(data) != n or zlib.crc32(data) != resp.crc:
+            # corruption is never transient on a reliable transport:
+            # fail the source so the refetch lands somewhere else
+            self._fail_source(
+                src, idx, f"stripe CRC/length mismatch ({len(data)}/{n})",
+                injected=action is not None,
+            )
+            return
+        self._commit(src, idx, data)
+
+    def _worker(self, src: FabricSource, client: RPCClient, inj,
+                on_stripe) -> None:
+        while True:
+            idx = self._next_stripe(src)
+            if idx is None:
+                return
+            self._fetch_one(src, client, idx, inj)
+            if on_stripe is not None:
+                try:
+                    on_stripe(idx, src)
+                except Exception:  # noqa: BLE001 — test hook, best-effort
+                    logger.debug("fabric on_stripe hook failed",
+                                 exc_info=True)
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self, clients: Dict[str, RPCClient], conns_per_source: int,
+            timeout_s: float, on_stripe=None) -> Tuple[str, str]:
+        """Drive the transfer; returns ``(abort_reason_or_None, detail)``
+        with the payload left in ``self._buf``."""
+        inj = get_injector()
+        seats: List[FabricSource] = []
+        for _ in range(max(1, conns_per_source)):
+            seats.extend(self.sources)
+        seats = seats[: max(1, min(len(seats), len(self.stripes)))]
+        threads = []
+        for i, src in enumerate(seats):
+            threads.append(threading.Thread(
+                target=self._worker,
+                args=(src, clients[src.addr], inj, on_stripe),
+                name=f"fabric-fetch-{i}",
+                daemon=True,
+            ))
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._missing and self._state["abort"] is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._state["abort"] = "timeout"
+                    self._state["detail"] = (
+                        f"{len(self._missing)} stripe(s) still missing "
+                        f"after {timeout_s:.1f}s"
+                    )
+                    break
+                self._cond.wait(min(0.2, remaining))
+            abort = self._state["abort"]
+            detail = self._state["detail"]
+        self._abort_evt.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        if abort is None:
+            got = zlib.crc32(bytes(self._buf))
+            if got != self.crc:
+                abort = "content_mismatch"
+                detail = (
+                    f"assembled crc {got} != content address {self.crc}"
+                )
+        return abort, detail
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:
+            counters = dict(self._counters)
+            by_source = dict(self._bytes_by_source)
+            failed = sorted(self._failed)
+        return {
+            "step": self.step,
+            "bytes": self.total,
+            "stripes": len(self.stripes),
+            "stripe_fetches": counters["stripe_fetches"],
+            "stripe_retries": counters["stripe_retries"],
+            "busy": counters["busy"],
+            "sources": len(self.sources),
+            "sources_failed": failed,
+            "bytes_by_source": by_source,
+        }
+
+
+def fetch(
+    sources: Sequence[FabricSource],
+    key: str,
+    *,
+    expect_step: int = -1,
+    stripe_bytes: Optional[int] = None,
+    conns_per_source: Optional[int] = None,
+    timeout_s: float = 60.0,
+    local_slice: str = "",
+    local_rank: int = -1,
+    reporter: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+    on_stripe: Optional[Callable[[int, FabricSource], None]] = None,
+) -> Tuple[int, bytes, Dict[str, Any]]:
+    """One resilient bulk transfer: describe, stripe, fan out, fail over.
+
+    Returns ``(step, payload, stats)``; raises :class:`FabricAbort` with
+    a normalized reason when the session cannot complete. ``expect_step``
+    pins the step (``-1`` = newest the sources agree on); ``reporter`` is
+    an ``(kind, data)`` journal sink (the engine passes
+    ``_report_event``); ``on_stripe(idx, source)`` fires after every
+    stripe attempt — the chaos drills use it to SIGKILL a source
+    mid-transfer."""
+    stripe_bytes = (
+        stripe_bytes if stripe_bytes and stripe_bytes > 0
+        else env_int(ConfigKey.FABRIC_STRIPE_BYTES, DEFAULT_STRIPE_BYTES)
+    )
+    conns = (
+        conns_per_source if conns_per_source and conns_per_source > 0
+        else env_int(ConfigKey.FABRIC_CONNS, DEFAULT_CONNS)
+    )
+    t0 = time.monotonic()
+    inj = get_injector()
+    ranked = rank_sources(sources, local_slice=local_slice,
+                          local_rank=local_rank)
+    with tracing.span(
+        SpanName.FABRIC_FETCH, key=key, step=expect_step,
+        candidates=len(ranked),
+    ) as sp:
+        # -- describe phase: agree on the content address ------------------
+        clients: Dict[str, RPCClient] = {}
+        candidates: List[Tuple[FabricSource, Any]] = []
+        failures = injected_failures = 0
+        for src in ranked:
+            client = RPCClient(
+                src.addr, timeout_s=max(5.0, min(timeout_s, 30.0))
+            )
+            try:
+                if inj is not None:
+                    inj.fire(FABRIC_CONNECT_SITE, addr=src.addr, key=key)
+                resp = client.call(
+                    "fabric_describe",
+                    comm.FabricDescribeRequest(
+                        key=src.key or key, step=expect_step
+                    ),
+                    policy=retry.PROBE,
+                )
+            except (InjectedError,) as e:
+                failures += 1
+                injected_failures += 1
+                logger.debug("fabric: describe %s injected: %r",
+                             src.addr, e)
+                continue
+            except _PEER_ERRORS as e:
+                failures += 1
+                if _is_injected(e):
+                    injected_failures += 1
+                logger.info("fabric: source %s unreachable (%r)",
+                            src.addr, e)
+                continue
+            if not resp.found:
+                continue
+            clients[src.addr] = client
+            candidates.append((src, resp))
+        if not candidates:
+            reason = (
+                "fault_injected"
+                if failures and injected_failures == failures
+                else "no_sources"
+            )
+            _abort_session(reporter, key, reason,
+                           f"0 of {len(ranked)} sources serve {key!r}",
+                           t0)
+        # majority (step, total, crc) group among the newest step — a
+        # straggler source one step behind just shrinks the swarm
+        groups: Dict[Tuple[int, int, int], List[FabricSource]] = {}
+        for src, resp in candidates:
+            groups.setdefault(
+                (resp.step, resp.total_bytes, resp.content_crc), []
+            ).append(src)
+        best_step = max(step for step, _, _ in groups)
+        step, total, crc = max(
+            (g for g in groups if g[0] == best_step),
+            key=lambda g: len(groups[g]),
+        )
+        chosen = groups[(step, total, crc)]
+        sp.add_event("described", step=step, bytes=total,
+                     sources=len(chosen))
+
+        # -- stripe phase: fan out, fail over ------------------------------
+        stripes = plan_stripes(total, stripe_bytes)
+        session = _FetchSession(
+            key=key, step=step, total=total, crc=crc, sources=chosen,
+            stripes=stripes, reporter=reporter,
+        )
+        if stripes:
+            abort, detail = session.run(
+                clients, conns, timeout_s, on_stripe=on_stripe
+            )
+        else:
+            abort, detail = None, ""
+        stats = session.stats()
+        duration = time.monotonic() - t0
+        stats["duration_s"] = duration
+        stats["rate_mbps"] = (
+            total / (1024 * 1024) / duration if duration > 0 else 0.0
+        )
+        if abort is not None:
+            stats["reason"] = abort
+            _record_metrics(stats, outcome=abort)
+            _report(reporter, JournalEvent.FABRIC_SESSION_ABORTED, {
+                "key": key, "reason": abort, "detail": detail, **{
+                    k: stats[k] for k in
+                    ("stripes", "stripe_retries", "sources_failed")
+                },
+            })
+            raise FabricAbort(abort, detail)
+        _record_metrics(stats, outcome="complete")
+        _report(reporter, JournalEvent.FABRIC_SESSION_COMPLETE, {
+            "key": key, **{
+                k: stats[k] for k in
+                ("step", "bytes", "stripes", "stripe_fetches",
+                 "stripe_retries", "sources", "duration_s")
+            },
+        })
+        sp.add_event("complete", **{
+            k: stats[k] for k in ("bytes", "stripes", "stripe_retries")
+        })
+        return step, bytes(session._buf), stats
+
+
+def _abort_session(reporter, key: str, reason: str, detail: str,
+                   t0: float) -> None:
+    duration = time.monotonic() - t0
+    get_registry().counter(
+        "dlrover_fabric_sessions_total",
+        "Fabric transfer sessions by outcome",
+        labelnames=("outcome",),
+    ).labels(outcome=reason).inc()
+    _report(reporter, JournalEvent.FABRIC_SESSION_ABORTED, {
+        "key": key, "reason": reason, "detail": detail,
+        "duration_s": duration,
+    })
+    raise FabricAbort(reason, detail)
+
+
+def _record_metrics(stats: Dict[str, Any], outcome: str) -> None:
+    reg = get_registry()
+    by_source = reg.counter(
+        "dlrover_fabric_bytes_total",
+        "Bytes transferred through the fabric, by source address",
+        labelnames=("source",),
+    )
+    for addr, n in stats.get("bytes_by_source", {}).items():
+        by_source.labels(source=addr).inc(n)
+    reg.counter(
+        "dlrover_fabric_stripe_retries_total",
+        "Stripes re-queued after a source failure or CRC reject",
+    ).inc(stats.get("stripe_retries", 0))
+    reg.counter(
+        "dlrover_fabric_sessions_total",
+        "Fabric transfer sessions by outcome",
+        labelnames=("outcome",),
+    ).labels(outcome=outcome).inc()
+    reg.histogram(
+        "dlrover_fabric_session_seconds",
+        "Wall-clock duration of fabric transfer sessions",
+    ).observe(stats.get("duration_s", 0.0))
+
+
+# --------------------------------------------------------------------------
+# Standalone source process (chaos drills SIGKILL these mid-transfer)
+# --------------------------------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    """Host one deterministic seeded blob behind a FabricServer — the
+    SIGKILL failover drill runs two of these and kills one mid-session."""
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--size-bytes", type=int, default=1 << 20)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--key", default="blob/main")
+    parser.add_argument("--step", type=int, default=7)
+    parser.add_argument("--admit", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    import random
+
+    # chunked: a single randbytes() call overflows past 256 MiB (the
+    # bit count no longer fits a C int)
+    rnd = random.Random(args.seed)
+    blob = b"".join(
+        rnd.randbytes(min(1 << 24, args.size_bytes - off))
+        for off in range(0, args.size_bytes, 1 << 24)
+    )
+    server = FabricServer(port=args.port, admit=args.admit)
+
+    def provider(rest: str):
+        return (
+            args.step, len(blob), 0,
+            lambda off, n: blob[off:off + n],
+        )
+
+    server.register_provider(args.key.partition("/")[0], provider)
+    server.start()
+    print(f"PORT={server.port}", flush=True)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -50,9 +50,19 @@ def _owner_alive(owner: Any) -> Optional[bool]:
         return True
 
 
+# below this, header+payload are concatenated into one send (one packet
+# with TCP_NODELAY); above it, the concat would COPY a bulk payload just
+# to save a 4-byte write — two sendalls instead
+_SEND_SPLIT_BYTES = 64 * 1024
+
+
 def send_msg(sock: socket.socket, obj: Any) -> None:
     data = msgpack.packb(obj, use_bin_type=True)
-    sock.sendall(_LEN.pack(len(data)) + data)
+    if len(data) <= _SEND_SPLIT_BYTES:
+        sock.sendall(_LEN.pack(len(data)) + data)
+    else:
+        sock.sendall(_LEN.pack(len(data)))
+        sock.sendall(data)
 
 
 def recv_msg(sock: socket.socket) -> Any:
@@ -61,14 +71,21 @@ def recv_msg(sock: socket.socket) -> Any:
     return msgpack.unpackb(_recv_exact(sock, size), raw=False, strict_map_key=False)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    # preallocated recv_into: the grow-and-extend loop reallocates the
+    # buffer along the way and pays one more full copy at the end —
+    # measurable at checkpoint-frame / fabric-stripe sizes. Returned as
+    # a bytearray on purpose: unpackb reads any buffer, and bytes(buf)
+    # would re-copy the whole payload
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        nread = sock.recv_into(view[got:], n - got)
+        if not nread:
             raise ConnectionError("socket closed")
-        buf.extend(chunk)
-    return bytes(buf)
+        got += nread
+    return buf
 
 
 def ipc_socket_dir(job_name: str, node_rank: int = 0) -> str:
@@ -118,11 +135,12 @@ class LocalIPCServer:
         try:
             self._sock.close()
         except OSError:
-            pass
+            logger.debug("ipc server socket close failed", exc_info=True)
         try:
             os.unlink(self._path)
         except OSError:
-            pass
+            logger.debug("ipc socket unlink failed: %s", self._path,
+                         exc_info=True)
 
     # -- server internals --------------------------------------------------
 
@@ -159,7 +177,8 @@ class LocalIPCServer:
                                  "client: %r", e)
                     send_msg(conn, {"ok": False, "error": repr(e)})
         except (ConnectionError, OSError):
-            pass
+            # normal peer disconnect; worth a trace when debugging hangs
+            logger.debug("ipc peer disconnected", exc_info=True)
         except Exception as e:  # noqa: BLE001 — undecodable frame: drop conn
             logger.warning("ipc connection dropped on bad frame: %r", e)
         finally:
@@ -404,7 +423,8 @@ class _IPCClient:
             try:
                 conn.close()
             except OSError:
-                pass
+                logger.debug("ipc client socket close failed",
+                             exc_info=True)
             self._tls.conn = None
 
 

@@ -117,6 +117,15 @@ class JournalEvent:
     BRAIN_ACTION = "brain_action"
     BRAIN_DEGRADED = "brain_degraded"
     BRAIN_RECOVERED = "brain_recovered"
+    # state-movement fabric (common/fabric.py): a transfer source died /
+    # timed out / served a CRC-failed stripe mid-session (its remaining
+    # stripes re-queue onto survivors), one stripe was re-queued, and the
+    # session outcome pair. All informational — a fabric session always
+    # runs inside some ladder rung whose own events drive the phases.
+    FABRIC_SOURCE_FAILED = "fabric_source_failed"
+    FABRIC_STRIPE_RETRIED = "fabric_stripe_retried"
+    FABRIC_SESSION_COMPLETE = "fabric_session_complete"
+    FABRIC_SESSION_ABORTED = "fabric_session_aborted"
 
     ALL = (
         FAULT_DETECTED, RDZV_START, RDZV_COMPLETE, RESTORE_START,
@@ -133,6 +142,8 @@ class JournalEvent:
         BRAIN_PREDICTED_FAILURE, BRAIN_PREDICTED_RAMP,
         BRAIN_PREDICTED_STRAGGLER, BRAIN_PREDICTION_SCORED,
         BRAIN_ACTION, BRAIN_DEGRADED, BRAIN_RECOVERED,
+        FABRIC_SOURCE_FAILED, FABRIC_STRIPE_RETRIED,
+        FABRIC_SESSION_COMPLETE, FABRIC_SESSION_ABORTED,
     )
 
 

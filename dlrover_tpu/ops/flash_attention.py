@@ -35,18 +35,24 @@ On non-TPU backends (CPU tests) the kernels run in pallas interpret mode.
 """
 
 import functools
-import os
+import logging
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from dlrover_tpu.common.constants import ConfigKey, env_int
+
 try:  # TPU memory spaces; absent on CPU-only builds
     from jax.experimental.pallas import tpu as pltpu
 
     _VMEM = pltpu.VMEM
 except (ImportError, AttributeError):  # pragma: no cover
+    logging.getLogger(__name__).debug(
+        "pallas TPU memory spaces unavailable; using default block specs",
+        exc_info=True,
+    )
     pltpu = None
     _VMEM = None
 
@@ -436,8 +442,8 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     # the backward kernels' working set (5 dots/block, 2-3 f32 scratch
     # accumulators) tiles differently from the forward's — let the bwd
     # blocks be tuned independently (read at trace time)
-    bq = int(os.environ.get("DLROVER_TPU_FLASH_BWD_BLOCK_Q", 0)) or block_q
-    bk = int(os.environ.get("DLROVER_TPU_FLASH_BWD_BLOCK_K", 0)) or block_k
+    bq = env_int(ConfigKey.FLASH_BWD_BLOCK_Q, 0) or block_q
+    bk = env_int(ConfigKey.FLASH_BWD_BLOCK_K, 0) or block_k
     dq, dk, dv = _bwd(
         q, k, v, o, lse, do, dlse, scale=scale, causal=causal,
         block_q=bq, block_k=bk, interpret=interpret,

@@ -337,6 +337,58 @@ class BatchDecodeEngine:
         )
         return [int(t) for t in nxt]
 
+    def set_params(self, params) -> None:
+        """Swap the weights in place (peer warm-start). Params are a jit
+        ARGUMENT, not a captured constant, so no retrace happens — only
+        the slot caches would be stale, and a warm-started replica has no
+        occupants yet."""
+        self._params = params
+        self.params = params
+
+
+def export_params(params) -> bytes:
+    """Serialize a params pytree to one self-describing blob (msgpack of
+    ``{keystr path: {dtype, shape, data}}``) — the payload a serving
+    replica's fabric ``weights`` provider serves to warm-starting peers."""
+    import jax
+    import msgpack
+    import numpy as np
+
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        arr = np.asarray(leaf)
+        out[jax.tree_util.keystr(path)] = {
+            "dtype": arr.dtype.str,
+            "shape": list(arr.shape),
+            "data": arr.tobytes(),
+        }
+    return msgpack.packb(out, use_bin_type=True)
+
+
+def import_params(blob: bytes):
+    """Inverse of :func:`export_params`: rebuild the nested-dict params
+    pytree (all interior nodes are string-keyed dicts, which is what
+    ``models/llama.py`` params look like)."""
+    import re
+
+    import jax.numpy as jnp
+    import msgpack
+    import numpy as np
+
+    tree: dict = {}
+    for path, spec in msgpack.unpackb(blob, raw=False).items():
+        keys = re.findall(r"\['([^']*)'\]", path)
+        if not keys:
+            raise ValueError(f"unsupported params path {path!r}")
+        node = tree
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = jnp.asarray(
+            np.frombuffer(spec["data"], np.dtype(spec["dtype"]))
+            .reshape(spec["shape"])
+        )
+    return tree
+
 
 def build_tiny_engine(slots: int = 4, cache_len: int = 48,
                       vocab: int = 32, dim: int = 16, n_layers: int = 2,

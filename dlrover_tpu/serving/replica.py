@@ -16,6 +16,13 @@ subprocesses of this host (so a chaos SIGKILL is a real process death),
 serving plans through; production deployments would put a pod scaler
 behind the same two methods.
 
+Weight distribution rides the state-movement fabric
+(``common/fabric.py``): a replica whose engine carries real params
+mounts a ``weights`` provider on its RPC server, and a newly grown
+replica warm-starts by striping the exported params from EVERY live
+peer at once (:func:`load_weights_from_peers`) instead of rebuilding
+from seed — the serving-plane slice of ROADMAP item 2.
+
 Chaos site ``serve.replica`` fires in the replica's heartbeat loop: an
 injected error/drop crashes the replica abruptly (no drain, no
 deregister) — the replica-kill drill without process machinery.
@@ -31,15 +38,53 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.agent.master_client import MasterClient
-from dlrover_tpu.common import comm
+from dlrover_tpu.common import comm, fabric
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.constants import SpanName
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCServer
 from dlrover_tpu.observability import tracing
+from dlrover_tpu.observability.registry import get_registry
 from dlrover_tpu.serving.batcher import BatcherClosed, ContinuousBatcher
 
 SERVE_REPLICA_SITE = "serve.replica"
+
+# fabric key serving replicas publish their exported params under
+WEIGHTS_KEY = "weights/current"
+
+
+def load_weights_from_peers(engine, peer_addrs, reporter=None,
+                            timeout_s: float = 60.0) -> bool:
+    """Warm-start ``engine`` from live peer replicas: one striped fabric
+    session across every peer that serves :data:`WEIGHTS_KEY`. Returns
+    False (engine untouched, seed weights stand) when no peer serves
+    weights or the session aborts — growth must never fail on this."""
+    if not hasattr(engine, "set_params") or not peer_addrs:
+        return False
+    t0 = time.monotonic()
+    sources = [fabric.FabricSource(addr=a) for a in peer_addrs]
+    try:
+        _step, blob, stats = fabric.fetch(
+            sources, WEIGHTS_KEY, timeout_s=timeout_s, reporter=reporter,
+        )
+    except fabric.FabricAbort as e:
+        logger.info("peer weight load aborted (%s) — keeping seed weights",
+                    e.reason)
+        return False
+    from dlrover_tpu.serving.engine import import_params
+
+    engine.set_params(import_params(blob))
+    duration = time.monotonic() - t0
+    get_registry().histogram(
+        "dlrover_serving_weight_load_seconds",
+        "Wall-clock time to warm-start a replica's weights from peers",
+    ).observe(duration)
+    logger.info(
+        "warm-started weights from %s peer(s): %s bytes in %.3fs "
+        "(%.1f MB/s)", stats.get("sources"), stats.get("bytes"), duration,
+        stats.get("rate_mbps", 0.0),
+    )
+    return True
 
 
 class DecodeReplica:
@@ -64,6 +109,12 @@ class DecodeReplica:
         )
         self._server = RPCServer(host=host, port=port)
         self._server.register_object(self)
+        # engines with real params serve them over the striped fabric so
+        # grown replicas warm-start from live peers (toy engines don't)
+        self._weights_blob: Optional[bytes] = None
+        if hasattr(engine, "set_params"):
+            self._fabric = fabric.FabricServer(server=self._server)
+            self._fabric.register_provider("weights", self._provide_weights)
         self._host = host
         self._client = MasterClient(master_addr, node_id=node_id)
         self._hb_interval_s = (
@@ -80,10 +131,24 @@ class DecodeReplica:
     def addr(self) -> str:
         return f"{self._host}:{self._server.port}"
 
+    def _provide_weights(self, rest: str):
+        del rest  # one object per replica: weights/current
+        blob = self._weights_blob
+        if blob is None:
+            from dlrover_tpu.serving.engine import export_params
+
+            blob = export_params(self._batcher._engine.params)
+            self._weights_blob = blob
+        # step 0 / etag 0: weights are immutable for a replica's lifetime
+        return 0, len(blob), 0, lambda off, n: blob[off:off + n]
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
         self._server.start()
+        # warm-start BEFORE registering: this replica is not yet in the
+        # membership, so the fetch can only land on live peers
+        self._maybe_warm_start()
         self._batcher.start()
         epoch = self._client.serve_register(self.addr,
                                             self._batcher._engine.slots)
@@ -94,6 +159,19 @@ class DecodeReplica:
             daemon=True,
         )
         self._hb_thread.start()
+
+    def _maybe_warm_start(self) -> None:
+        engine = self._batcher._engine
+        if not hasattr(engine, "set_params"):
+            return
+        try:
+            _epoch, replicas = self._client.serve_replicas()
+        except (ConnectionError, RuntimeError) as e:
+            logger.info("peer listing for warm start failed: %r", e)
+            return
+        peers = [r["addr"] for r in replicas if r["node_id"] != self.node_id]
+        if peers:
+            load_weights_from_peers(engine, peers)
 
     def _hb_loop(self) -> None:
         # deadline pacing (DLR010 discipline): beats land on the cadence

@@ -1,0 +1,334 @@
+"""State-movement fabric (common/fabric.py): stripe-plan algebra,
+multi-source striping with per-source accounting, mid-transfer SIGKILL
+failover onto survivors, chaos bitflip CRC rejection + refetch from a
+different source, zero-source abort, incast admission under concurrent
+fetchers, the race-certified session lifecycle, and the serving
+warm-start path (load_weights_from_peers) end to end."""
+
+import random
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.chaos import configure, reset_injector
+from dlrover_tpu.common import fabric, rpc
+from dlrover_tpu.observability.journal import JournalEvent
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    reset_injector()
+    yield
+    reset_injector()
+
+
+def _serve_blob(blob: bytes, step: int = 7, admit=None,
+                read_delay_s: float = 0.0) -> fabric.FabricServer:
+    server = fabric.FabricServer(host="127.0.0.1", admit=admit)
+
+    def provider(rest: str):
+        def read(off, n):
+            if read_delay_s:
+                time.sleep(read_delay_s)
+            return blob[off:off + n]
+
+        return step, len(blob), 0, read
+
+    server.register_provider("blob", provider)
+    server.start()
+    return server
+
+
+def _spawn_source(size_bytes: int, seed: int = 3):
+    """One standalone source process (the thing the drill SIGKILLs)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dlrover_tpu.common.fabric",
+         "--size-bytes", str(size_bytes), "--seed", str(seed),
+         "--port", "0"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    line = proc.stdout.readline()
+    m = re.search(r"PORT=(\d+)", line)
+    assert m, f"fabric source failed to start: {line!r}"
+    return proc, f"127.0.0.1:{m.group(1)}"
+
+
+def _seeded_blob(size_bytes: int, seed: int = 3) -> bytes:
+    # must mirror fabric.main's chunked generation exactly
+    rnd = random.Random(seed)
+    return b"".join(
+        rnd.randbytes(min(1 << 24, size_bytes - off))
+        for off in range(0, size_bytes, 1 << 24)
+    )
+
+
+# -- stripe plan algebra -----------------------------------------------------
+
+
+def test_stripe_plan_algebra():
+    for total, stripe in ((0, 4), (1, 4), (4, 4), (10, 4), (12, 4),
+                          (1 << 20, 1 << 16), ((1 << 20) + 5, 1 << 16)):
+        plan = fabric.plan_stripes(total, stripe)
+        # exact cover, in order, no overlap, no gap
+        off = 0
+        for start, length in plan:
+            assert start == off and length > 0
+            assert length <= stripe
+            off += length
+        assert off == total
+        # only the LAST stripe may be short
+        assert all(length == stripe for _, length in plan[:-1])
+    assert fabric.plan_stripes(0, 4) == []
+    with pytest.raises(ValueError):
+        fabric.plan_stripes(-1, 4)
+    with pytest.raises(ValueError):
+        fabric.plan_stripes(4, 0)
+
+
+def test_rank_sources_topology_order():
+    mk = fabric.FabricSource
+    srcs = [
+        mk(addr="h3:1", rank=3, slice_id="s1"),
+        mk(addr="h1:1", rank=1, slice_id="s0"),
+        mk(addr="h9:1"),
+        mk(addr="h2:1", rank=2, slice_id="s0"),
+    ]
+    ranked = fabric.rank_sources(srcs, local_slice="s0", local_rank=2)
+    # same-slice first (nearest rank wins), then off-slice by distance,
+    # addressless/rankless last
+    assert [s.addr for s in ranked] == ["h2:1", "h1:1", "h3:1", "h9:1"]
+
+
+# -- transfer + accounting ---------------------------------------------------
+
+
+def test_multi_source_roundtrip_accounting():
+    blob = random.Random(1).randbytes(1 << 20)
+    servers = [_serve_blob(blob), _serve_blob(blob)]
+    try:
+        sources = [fabric.FabricSource(addr=f"127.0.0.1:{s.port}")
+                   for s in servers]
+        step, data, stats = fabric.fetch(
+            sources, "blob/x", stripe_bytes=1 << 16, timeout_s=30.0)
+        assert step == 7
+        assert data == blob
+        assert stats["stripes"] == 16
+        assert stats["stripe_fetches"] == 16
+        assert stats["stripe_retries"] == 0
+        assert stats["sources"] == 2
+        assert sum(stats["bytes_by_source"].values()) == len(blob)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_zero_sources_aborts_with_reason():
+    events = []
+    with pytest.raises(fabric.FabricAbort) as e:
+        fabric.fetch([], "blob/x",
+                     reporter=lambda k, d: events.append((k, d)))
+    assert e.value.reason == "no_sources"
+    # a dead address (nothing listening) is the same normalized reason:
+    # the ladder above the fabric decides what rung comes next
+    port = rpc.find_free_port()
+    with pytest.raises(fabric.FabricAbort) as e:
+        fabric.fetch([fabric.FabricSource(addr=f"127.0.0.1:{port}")],
+                     "blob/x", timeout_s=5.0,
+                     reporter=lambda k, d: events.append((k, d)))
+    assert e.value.reason == "no_sources"
+    kinds = [k for k, _ in events]
+    assert kinds.count(JournalEvent.FABRIC_SESSION_ABORTED) == 2
+
+
+# -- mid-transfer failover ---------------------------------------------------
+
+
+def test_sigkill_mid_transfer_completes_from_survivor():
+    """The drill on the record: two source processes, SIGKILL one after
+    its first served stripe, and the session completes from the survivor
+    with only the missing stripes refetched — never a restart from zero."""
+    size = 8 << 20
+    procs = {}
+    events = []
+    p0, a0 = _spawn_source(size)
+    p1, a1 = _spawn_source(size)
+    procs[a0], procs[a1] = p0, p1
+    killed = []
+
+    def on_stripe(idx, src):
+        if not killed:
+            killed.append(src.addr)
+            procs[src.addr].kill()
+
+    try:
+        sources = [fabric.FabricSource(addr=a0),
+                   fabric.FabricSource(addr=a1)]
+        step, data, stats = fabric.fetch(
+            sources, "blob/main", stripe_bytes=256 << 10,
+            conns_per_source=2, timeout_s=60.0,
+            reporter=lambda k, d: events.append((k, d)),
+            on_stripe=on_stripe,
+        )
+        assert data == _seeded_blob(size)
+        assert step == 7
+        victim = killed[0]
+        survivor = a1 if victim == a0 else a0
+        # every one of the 32 stripes committed exactly once; the
+        # victim's in-flight stripes were re-queued, not the whole object
+        assert stats["stripes"] == 32
+        assert stats["stripe_fetches"] == 32
+        assert stats["stripe_retries"] >= 1
+        assert stats["sources_failed"] == [victim]
+        assert stats["bytes_by_source"][survivor] > 0
+        assert sum(stats["bytes_by_source"].values()) == size
+        kinds = [k for k, _ in events]
+        assert JournalEvent.FABRIC_SOURCE_FAILED in kinds
+        assert JournalEvent.FABRIC_STRIPE_RETRIED in kinds
+        assert JournalEvent.FABRIC_SESSION_COMPLETE in kinds
+        failed = next(d for k, d in events
+                      if k == JournalEvent.FABRIC_SOURCE_FAILED)
+        assert failed["addr"] == victim
+        assert failed["survivors"] == 1
+    finally:
+        for p in procs.values():
+            p.kill()
+
+
+def test_bitflip_stripe_crc_rejected_and_refetched():
+    """A corrupted stripe must be caught by the per-stripe CRC before
+    commit, fail THAT source, and be refetched from the other one —
+    the chaos catalogue's fabric.stripe contract."""
+    blob = random.Random(2).randbytes(256 << 10)
+    servers = [_serve_blob(blob), _serve_blob(blob)]
+    events = []
+    configure("fabric.stripe:bitflip@nth=1")
+    try:
+        sources = [fabric.FabricSource(addr=f"127.0.0.1:{s.port}")
+                   for s in servers]
+        step, data, stats = fabric.fetch(
+            sources, "blob/x", stripe_bytes=64 << 10, timeout_s=30.0,
+            reporter=lambda k, d: events.append((k, d)))
+        assert data == blob
+        assert stats["stripe_retries"] == 1
+        assert len(stats["sources_failed"]) == 1
+        bad = stats["sources_failed"][0]
+        # the corrupted source never contributed the full object; the
+        # clean one filled the gap
+        assert sum(stats["bytes_by_source"].values()) == len(blob)
+        assert stats["bytes_by_source"].get(bad, 0) < len(blob)
+        retried = next(d for k, d in events
+                       if k == JournalEvent.FABRIC_STRIPE_RETRIED)
+        assert "CRC" in retried["detail"]
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_all_sources_injected_dead_aborts_fault_injected():
+    blob = random.Random(4).randbytes(64 << 10)
+    server = _serve_blob(blob)
+    configure("fabric.stripe:error")
+    try:
+        with pytest.raises(fabric.FabricAbort) as e:
+            fabric.fetch(
+                [fabric.FabricSource(addr=f"127.0.0.1:{server.port}")],
+                "blob/x", stripe_bytes=64 << 10, timeout_s=15.0)
+        assert e.value.reason == "fault_injected"
+    finally:
+        server.stop()
+
+
+# -- incast admission --------------------------------------------------------
+
+
+def test_incast_cap_honored_under_concurrent_fetchers():
+    """16 fetchers against ONE source with admit=2: the server must shed
+    load with busy=True (never queue past the cap) and every session must
+    still complete — the busy stripe re-queues and backs off."""
+    blob = random.Random(3).randbytes(256 << 10)
+    server = _serve_blob(blob, admit=2, read_delay_s=0.01)
+    errors = []
+
+    def one_fetch():
+        try:
+            src = [fabric.FabricSource(addr=f"127.0.0.1:{server.port}")]
+            _, data, _ = fabric.fetch(
+                src, "blob/x", stripe_bytes=64 << 10,
+                conns_per_source=2, timeout_s=60.0)
+            assert data == blob
+        except Exception as e:  # noqa: BLE001 — joined + re-raised below
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=one_fetch, daemon=True)
+                   for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert not errors, errors
+        assert server.max_in_flight <= 2
+        assert server.busy_replies > 0
+        assert server.stripes_served >= 16 * 4
+    finally:
+        server.stop()
+
+
+# -- race certification ------------------------------------------------------
+
+
+@pytest.mark.race
+def test_fetch_session_lifecycle_race_certified(race_guard):
+    """Many small stripes over two sources with 4 connections each, plus
+    one injected corruption mid-stream: the session's missing/pending/
+    failed/accounting maps are ``shared(...)``-tracked, so any commit or
+    requeue outside the session condition fails here."""
+    blob = random.Random(5).randbytes(512 << 10)
+    servers = [_serve_blob(blob), _serve_blob(blob)]
+    configure("fabric.stripe:bitflip@nth=3")
+    try:
+        sources = [fabric.FabricSource(addr=f"127.0.0.1:{s.port}")
+                   for s in servers]
+        step, data, stats = fabric.fetch(
+            sources, "blob/x", stripe_bytes=8 << 10,
+            conns_per_source=4, timeout_s=60.0)
+        assert data == blob
+        assert stats["stripes"] == 64
+        assert stats["stripe_retries"] >= 1
+    finally:
+        for s in servers:
+            s.stop()
+    assert race_guard.tracked_created > 0
+    assert race_guard.races == [], race_guard.report()
+
+
+# -- serving warm start ------------------------------------------------------
+
+
+def test_serving_weight_warm_start_roundtrip():
+    """A replica with different seed weights pulls the serving weights
+    over the fabric and ends up bit-identical to the source engine."""
+    from dlrover_tpu.serving.engine import build_tiny_engine, export_params
+    from dlrover_tpu.serving.replica import load_weights_from_peers
+
+    src_engine = build_tiny_engine(seed=0)
+    dst_engine = build_tiny_engine(seed=1)
+    assert export_params(src_engine.params) != export_params(
+        dst_engine.params)
+    blob = export_params(src_engine.params)
+    server = fabric.FabricServer(host="127.0.0.1")
+    server.register_provider(
+        "weights",
+        lambda rest: (0, len(blob), 0, lambda off, n: blob[off:off + n]),
+    )
+    server.start()
+    try:
+        assert load_weights_from_peers(
+            dst_engine, [f"127.0.0.1:{server.port}"])
+        assert export_params(dst_engine.params) == blob
+    finally:
+        server.stop()

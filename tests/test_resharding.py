@@ -365,18 +365,22 @@ def test_coverage_hole_falls_through_to_replica_rung(master, tmp_path,
 
 def test_injected_transfer_fault_falls_through_ladder(master, tmp_path,
                                                       monkeypatch):
-    """Chaos kills the transfer mid-reshard: the rung aborts with the
-    injection named as the reason and the shm rung restores instead."""
-    _write_frame(0, 4, [(0, 8)])
-    svc0 = _serve(0)
+    """Chaos kills every fabric stripe fetch mid-reshard: the rung aborts
+    with the injection named as the reason and the shm rung restores the
+    older local frame instead. The departed host holds the newest step, so
+    the plan is forced onto remote fabric transfers."""
+    _write_frame(0, 4, [(0, 8)])   # own shm: full coverage, one step old
+    _write_frame(1, 6, [(0, 8)])   # departed host sealed the newest step
+    svc0, svc1 = _serve(0), _serve(1)
     try:
         c0 = MasterClient(master.addr, 0)
         svc0.register(c0, JOB, 0)
+        svc1.register(MasterClient(master.addr, 1), JOB, 1)
         ReshardCoordinator(JOB, master.kv_store).on_world_cut(
             [0, 1], [0], 6
         )
         monkeypatch.setenv(EnvKey.RDZV_ROUND, "6")
-        configure("reshard.xfer:error")
+        configure("fabric.stripe:error")
 
         restored, step = _engine(tmp_path, 0, c0).load(_sharded_state())
         assert step == 4
@@ -389,6 +393,7 @@ def test_injected_transfer_fault_falls_through_ladder(master, tmp_path,
         assert fin["data"]["medium"] == "shm"
     finally:
         svc0.stop()
+        svc1.stop()
 
 
 def test_peer_frame_rung_without_master(master, tmp_path):
@@ -430,22 +435,32 @@ def test_reshard_env_gate(master, tmp_path, monkeypatch):
 
 def test_stale_step_fetch_refused(master):
     """A survivor that already sealed a newer frame refuses stale-step
-    fetches — the wire protocol's consistency guard."""
+    describes and fetches — the fabric wire protocol's step guard."""
     _write_frame(0, 21, [(0, 8)])
     svc0 = _serve(0)
     try:
         c0 = MasterClient(master.addr, 0)
         addr = svc0.register(c0, JOB, 0)
+        from dlrover_tpu.ckpt.reshard import shard_key
         from dlrover_tpu.common import comm
         from dlrover_tpu.common.rpc import RPCClient
 
+        key = shard_key(0, 0, W_PATH)
         client = RPCClient(addr, timeout_s=5.0)
-        ok = client.call("reshard_fetch", comm.ReshardFetchRequest(
-            local_rank=0, step=21, path=W_PATH, shard_index=0,
+        desc = client.call("fabric_describe", comm.FabricDescribeRequest(
+            key=key, step=21,
+        ))
+        assert desc.found and desc.total_bytes == _global_w().nbytes
+        ok = client.call("fabric_fetch", comm.FabricFetchRequest(
+            key=key, step=21, offset=0, nbytes=0,
         ))
         assert ok.found and len(ok.data) == _global_w().nbytes
-        stale = client.call("reshard_fetch", comm.ReshardFetchRequest(
-            local_rank=0, step=20, path=W_PATH, shard_index=0,
+        stale_desc = client.call("fabric_describe", comm.FabricDescribeRequest(
+            key=key, step=20,
+        ))
+        assert not stale_desc.found and stale_desc.step == 21
+        stale = client.call("fabric_fetch", comm.FabricFetchRequest(
+            key=key, step=20, offset=0, nbytes=0,
         ))
         assert not stale.found and stale.step == 21
     finally:
