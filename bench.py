@@ -1642,6 +1642,38 @@ def bench_brain(budget_s: float = 60.0) -> dict:
         return {"error": repr(e)}
 
 
+def bench_rl(budget_s: float = 120.0) -> dict:
+    """Agentic-RL rollout plane (rl/drill.py): the seeded chaos drill —
+    a rollout replica AND the learner SIGKILLed mid-episode under the
+    borrow/demand/reborrow elasticity schedule — with the exactly-once
+    content-hash audit on the record. Claims: trajectories/s, weight-sync
+    latency (the fabric pull path), max on-policy staleness vs the
+    bound, and the goodput split between generation and weight movement."""
+    from dlrover_tpu.rl.drill import run_rl_drill
+
+    try:
+        r = run_rl_drill(timeout_s=min(budget_s, 180.0))
+        rep = r["report"]
+        return {
+            "ok": r["ok"],
+            "checks_failed": sorted(
+                k for k, v in r["checks"].items() if not v),
+            "episodes": rep.get("episodes"),
+            "trajectories_per_s": rep.get("trajectories_per_s"),
+            "weight_sync_count": rep.get("weight_sync", {}).get("count"),
+            "weight_sync_mean_s": rep.get("weight_sync", {}).get("mean_s"),
+            "weight_sync_max_s": rep.get("weight_sync", {}).get("max_s"),
+            "learner_restores": rep.get("weight_sync", {}).get("restores"),
+            "max_staleness": rep.get("max_staleness"),
+            "staleness_bound": rep.get("staleness_bound"),
+            "weight_move_frac": r["goodput"].get("weight_move_frac"),
+            "rounds": rep.get("rounds"),
+            "wall_s": rep.get("wall_s"),
+        }
+    except Exception as e:  # noqa: BLE001 — bench must still emit a line
+        return {"error": repr(e)}
+
+
 # Wall-clock discipline (round-4 fix for the r3 rc=124 record hole): the
 # driver runs bench.py under a ~30-min budget; this process budgets
 # BENCH_TIME_BUDGET_S (default 20 min) across sections, RE-PRINTS the
@@ -1669,6 +1701,8 @@ _SECTIONS = (
     ("data", lambda left: bench_data(budget_s=min(left, 90.0)), 30.0),
     # brain: pure simulation on a fake clock — seconds of wall time
     ("brain", lambda left: bench_brain(budget_s=min(left, 60.0)), 15.0),
+    # rl: CPU-sized chaos drill (~10 s of wall; subprocess spawn bound)
+    ("rl", lambda left: bench_rl(budget_s=min(left, 120.0)), 30.0),
     ("ckpt", lambda left: bench_ckpt(budget_s=left), 120.0),
 )
 
@@ -1712,7 +1746,8 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
         name: ("error" if "error" in (detail.get(name) or {})
                else (detail.get(name) or {}).get("skipped") or "ok")
         for name in ("train", "decode", "attn", "goodput", "reshard",
-                     "fabric", "control_plane", "serving", "data", "ckpt")
+                     "fabric", "control_plane", "serving", "data", "brain",
+                     "rl", "ckpt")
         if name in detail
     }
     summary = {
@@ -1759,6 +1794,9 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
         "data": pick(detail.get("data") or {}, (
             "dispatch_ack_per_s", "prefetch_occupancy_mean",
             "requeue_leases", "requeue_latency_ms")),
+        "rl": pick(detail.get("rl") or {}, (
+            "trajectories_per_s", "weight_sync_mean_s", "max_staleness",
+            "ok")),
         "sections": sections,
     }
     return {

@@ -319,6 +319,14 @@ class ConfigKey:
     SERVE_QUEUE_HI = "DLROVER_TPU_SERVE_QUEUE_HI"
     SERVE_GROW_COOLDOWN_S = "DLROVER_TPU_SERVE_GROW_COOLDOWN_S"
     SERVE_SHRINK_COOLDOWN_S = "DLROVER_TPU_SERVE_SHRINK_COOLDOWN_S"
+    # agentic-RL rollout plane (dlrover_tpu/rl/): the on-policy staleness
+    # bound (learner_version − generation_version a trajectory may carry
+    # and still be trained), the trajectory-lease timeout after which an
+    # unacked episode is requeued onto a survivor, and the per-call
+    # timeout for learner→replica weight-sync fabric sessions
+    RL_STALENESS_BOUND = "DLROVER_TPU_RL_STALENESS_BOUND"
+    RL_LEASE_TIMEOUT_S = "DLROVER_TPU_RL_LEASE_TIMEOUT_S"
+    RL_SYNC_TIMEOUT_S = "DLROVER_TPU_RL_SYNC_TIMEOUT_S"
     # brain predictive loop (brain/persister.py, brain/advisor.py): master-
     # side telemetry persistence + proactive advice on/off (default on),
     # the sqlite datastore path ("" = per-job in-memory), the persister/
@@ -388,6 +396,14 @@ class SpanName:
     SERVE_PREFILL = "serve.prefill"
     SERVE_DRAIN = "serve.drain"
     SERVE_SCALE = "serve.scale"
+    # agentic-RL rollout plane (dlrover_tpu/rl/): the learner-side
+    # publish→fan-out of one weight version, the replica-side fabric
+    # import of it (same trace: the sync version rides the wire context),
+    # and one episode-generation call against a rollout replica
+    RL_WEIGHT_SYNC = "rl.weight_sync"
+    RL_WEIGHT_IMPORT = "rl.weight_import"
+    RL_GENERATE = "rl.generate"
+    RL_TRAIN_STEP = "rl.train_step"
     # failure-detect → relaunch arc (master/master.py → agent/training.py)
     FAULT_RELAUNCH = "fault.relaunch"
     AGENT_RESTART_WORKERS = "agent.restart_workers"

@@ -126,6 +126,28 @@ class JournalEvent:
     FABRIC_STRIPE_RETRIED = "fabric_stripe_retried"
     FABRIC_SESSION_COMPLETE = "fabric_session_complete"
     FABRIC_SESSION_ABORTED = "fabric_session_aborted"
+    # unified multi-role layer (unified/failover.py): every ladder-driven
+    # actor/role-group restart, and the job-level verdict when a role's
+    # restart budget is exhausted. Informational — the unified master's
+    # streams attribute their own phases.
+    UNIFIED_FAILOVER = "unified_failover"
+    UNIFIED_JOB_ABORT = "unified_job_abort"
+    # agentic-RL rollout plane (dlrover_tpu/rl/): trajectory-lease
+    # lifecycle (ack/requeue mirror the data plane's shard ledger; a
+    # requeue after an actor death is the steal leg), learner→replica
+    # weight sync sessions with their on-policy staleness accounting,
+    # learner warm-restore from the rollout fleet after a learner death,
+    # and the ROSE elasticity handshake legs (demand → drain → regrow).
+    # All informational — no phase transitions.
+    RL_TRAJECTORY_ACKED = "rl_trajectory_acked"
+    RL_LEASE_REQUEUED = "rl_lease_requeued"
+    RL_TRAIN_COMMIT = "rl_train_commit"
+    RL_WEIGHT_SYNC = "rl_weight_sync"
+    RL_LEARNER_RESTORED = "rl_learner_restored"
+    RL_LEARNER_DEMAND = "rl_learner_demand"
+    RL_ROLLOUT_DRAINED = "rl_rollout_drained"
+    RL_ROLLOUT_REGROWN = "rl_rollout_regrown"
+    RL_STALENESS_VIOLATION = "rl_staleness_violation"
 
     ALL = (
         FAULT_DETECTED, RDZV_START, RDZV_COMPLETE, RESTORE_START,
@@ -144,6 +166,10 @@ class JournalEvent:
         BRAIN_ACTION, BRAIN_DEGRADED, BRAIN_RECOVERED,
         FABRIC_SOURCE_FAILED, FABRIC_STRIPE_RETRIED,
         FABRIC_SESSION_COMPLETE, FABRIC_SESSION_ABORTED,
+        UNIFIED_FAILOVER, UNIFIED_JOB_ABORT,
+        RL_TRAJECTORY_ACKED, RL_LEASE_REQUEUED, RL_TRAIN_COMMIT,
+        RL_WEIGHT_SYNC, RL_LEARNER_RESTORED, RL_LEARNER_DEMAND,
+        RL_ROLLOUT_DRAINED, RL_ROLLOUT_REGROWN, RL_STALENESS_VIOLATION,
     )
 
 

@@ -1,0 +1,206 @@
+"""Trajectory-lease ledger: the exactly-once data plane's shard-lease
+protocol (master/task_manager.py ledger, trainer/data_plane.py client),
+applied to RL episodes.
+
+An episode moves TODO → LEASED → ACKED → COMMITTED:
+
+- ``lease(owner)`` hands the next episode to a rollout replica under a
+  deadline; an expired or owner-died lease requeues (the steal leg — the
+  same first-principle as ``data_requeue``);
+- ``ack`` delivers the generated trajectory; the FIRST ack wins — a late
+  duplicate from a superseded lease is rejected and only counted, so a
+  slow-but-alive replica can never double-deliver;
+- ``commit`` marks a batch trained at a learner version. Ready
+  trajectories are PEEKED, not popped: a learner death between ack and
+  commit re-reads the same batch on the next task-stream entry, which is
+  exactly-once on the *committed* stream (the interrupted update never
+  reached a published weight version, so retraining is not a duplicate).
+
+``audit()`` is the drill's seeded content-hash check: every episode
+committed exactly once, none lost, and the delivered hashes match an
+independent regeneration.
+"""
+
+import hashlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.common.constants import ConfigKey, env_float
+from dlrover_tpu.observability.journal import JournalEvent
+
+TODO = "todo"
+LEASED = "leased"
+ACKED = "acked"
+COMMITTED = "committed"
+
+
+def content_hash(episode_id: int, tokens: Sequence[int]) -> str:
+    """Seeded audit anchor: deterministic engines give the same hash for
+    the same episode no matter which replica (re)generated it."""
+    raw = f"{episode_id}:{','.join(str(t) for t in tokens)}"
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
+@dataclass
+class Trajectory:
+    episode_id: int
+    prompt: List[int]
+    tokens: List[int] = field(default_factory=list)
+    version: int = -1        # policy version the generator held
+    owner: str = ""          # replica that delivered it
+    staleness: int = -1      # stamped at train time by the trainer
+    hash: str = ""
+
+
+class _Entry:
+    __slots__ = ("state", "owner", "deadline", "traj", "commit_version",
+                 "commit_count")
+
+    def __init__(self) -> None:
+        self.state = TODO
+        self.owner = ""
+        self.deadline = 0.0
+        self.traj: Optional[Trajectory] = None
+        self.commit_version = -1
+        self.commit_count = 0
+
+
+class TrajectoryLedger:
+    def __init__(self, prompts: Sequence[Sequence[int]],
+                 lease_timeout_s: Optional[float] = None,
+                 monotonic: Callable[[], float] = time.monotonic,
+                 reporter: Optional[Callable[..., None]] = None):
+        """``monotonic`` is injectable (fake-clock lease-expiry tests);
+        ``reporter(kind, **data)`` is the journal sink."""
+        self._monotonic = monotonic
+        self._timeout = (
+            env_float(ConfigKey.RL_LEASE_TIMEOUT_S, 60.0)
+            if lease_timeout_s is None else lease_timeout_s
+        )
+        self._reporter = reporter
+        self._lock = threading.Lock()
+        self._prompts = [list(p) for p in prompts]
+        self._entries = [_Entry() for _ in self._prompts]
+        self.dup_acks = 0
+
+    def _report(self, kind: str, **data) -> None:
+        if self._reporter is not None:
+            self._reporter(kind, **data)
+
+    # -- lease lifecycle ----------------------------------------------------
+    def _expire_locked(self, now: float) -> None:
+        for eid, e in enumerate(self._entries):
+            if e.state == LEASED and now > e.deadline:
+                self._report(JournalEvent.RL_LEASE_REQUEUED, episode=eid,
+                             owner=e.owner, reason="lease_expired")
+                e.state, e.owner = TODO, ""
+
+    def lease(self, owner: str) -> Optional[Tuple[int, List[int]]]:
+        with self._lock:
+            now = self._monotonic()
+            self._expire_locked(now)
+            for eid, e in enumerate(self._entries):
+                if e.state == TODO:
+                    e.state, e.owner = LEASED, owner
+                    e.deadline = now + self._timeout
+                    return eid, list(self._prompts[eid])
+        return None
+
+    def release(self, episode_id: int, owner: str) -> None:
+        """Cooperative give-back (replica draining / call error)."""
+        with self._lock:
+            e = self._entries[episode_id]
+            if e.state == LEASED and e.owner == owner:
+                e.state, e.owner = TODO, ""
+
+    def requeue_owner(self, owner: str) -> List[int]:
+        """A replica died: steal every lease it held back onto the queue
+        (journaled per episode — the drill's steal evidence)."""
+        out = []
+        with self._lock:
+            for eid, e in enumerate(self._entries):
+                if e.state == LEASED and e.owner == owner:
+                    e.state, e.owner = TODO, ""
+                    out.append(eid)
+        for eid in out:
+            self._report(JournalEvent.RL_LEASE_REQUEUED, episode=eid,
+                         owner=owner, reason="owner_died")
+        return out
+
+    def ack(self, episode_id: int, owner: str, tokens: Sequence[int],
+            version: int) -> bool:
+        """First ack wins. A second delivery (requeued episode whose first
+        owner was merely slow) is rejected — content addressing makes the
+        choice of winner irrelevant for a deterministic engine."""
+        with self._lock:
+            e = self._entries[episode_id]
+            if e.state in (ACKED, COMMITTED):
+                self.dup_acks += 1
+                return False
+            e.state = ACKED
+            e.owner = owner
+            e.traj = Trajectory(
+                episode_id=episode_id, prompt=list(self._prompts[episode_id]),
+                tokens=list(tokens), version=version, owner=owner,
+                hash=content_hash(episode_id, tokens),
+            )
+            return True
+
+    # -- training side ------------------------------------------------------
+    def ready(self, limit: int) -> List[Trajectory]:
+        """PEEK acked-but-uncommitted trajectories in episode order — the
+        commit is what consumes them (see module docstring)."""
+        out = []
+        with self._lock:
+            for e in self._entries:
+                if e.state == ACKED and e.traj is not None:
+                    out.append(e.traj)
+                    if len(out) >= limit:
+                        break
+        return out
+
+    def commit(self, episode_ids: Sequence[int], version: int) -> None:
+        with self._lock:
+            for eid in episode_ids:
+                e = self._entries[eid]
+                e.commit_count += 1
+                if e.state == ACKED:
+                    e.state = COMMITTED
+                    e.commit_version = version
+                    if e.traj is not None:
+                        e.traj.staleness = version - 1 - e.traj.version
+
+    # -- queries ------------------------------------------------------------
+    def backlog(self) -> int:
+        with self._lock:
+            return sum(1 for e in self._entries
+                       if e.state in (TODO, LEASED))
+
+    def acked_pending(self) -> int:
+        with self._lock:
+            return sum(1 for e in self._entries if e.state == ACKED)
+
+    def all_committed(self) -> bool:
+        with self._lock:
+            return all(e.state == COMMITTED for e in self._entries)
+
+    def audit(self) -> Dict[str, object]:
+        """The exactly-once verdict: lost = never committed, duplicates =
+        committed more than once; hashes anchor the seeded content audit."""
+        with self._lock:
+            lost = [eid for eid, e in enumerate(self._entries)
+                    if e.state != COMMITTED]
+            dups = [eid for eid, e in enumerate(self._entries)
+                    if e.commit_count > 1]
+            hashes = {eid: e.traj.hash for eid, e in enumerate(self._entries)
+                      if e.traj is not None}
+            return {
+                "episodes": len(self._entries),
+                "committed": len(self._entries) - len(lost),
+                "lost": lost,
+                "duplicates": dups,
+                "dup_acks": self.dup_acks,
+                "hashes": hashes,
+            }

@@ -1,0 +1,131 @@
+"""Learner→replica weight sync over the state-movement fabric, plus
+on-policy staleness accounting.
+
+The sync is the serving plane's peer warm-start path
+(``dlrover_serving_weight_load_seconds`` in serving/replica.py) reused
+for RL: the learner publishes each new policy version under
+``POLICY_KEY`` with the fabric ``step`` = the version, replicas (and a
+warm-restoring learner) ``pull_policy`` it with ``expect_step`` pinning.
+Every replica that has imported version v also *serves* v, so a learner
+death mid-sync fails over to a synced peer — the fabric's multi-source
+rung, for free.
+
+Latency lands in the ``dlrover_rl_weight_sync_seconds`` histogram, and
+the sync version rides the trace wire context: the trainer opens
+``rl.weight_sync`` around the actor call, the replica activates the wire
+context and opens ``rl.weight_import`` — one trace_id spans learner
+publish → replica import.
+
+:class:`StalenessLedger` is the trainer-side accounting: per-trajectory
+staleness = learner_version − generation_version, journaled, with bound
+violations counted (the drill asserts max ≤ bound).
+"""
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.common import fabric
+from dlrover_tpu.common.constants import ConfigKey, env_float, env_int
+from dlrover_tpu.observability.journal import JournalEvent
+from dlrover_tpu.observability.registry import get_registry
+
+# the fabric key every policy holder serves; the step IS the version
+POLICY_KEY = "policy/current"
+
+RL_WEIGHT_SYNC_SECONDS = "dlrover_rl_weight_sync_seconds"
+RL_TRAJECTORIES_TOTAL = "dlrover_rl_trajectories_total"
+RL_STALENESS_MAX = "dlrover_rl_staleness_max"
+
+DEFAULT_STALENESS_BOUND = 2
+DEFAULT_SYNC_TIMEOUT_S = 30.0
+
+
+def observe_sync_seconds(duration_s: float) -> None:
+    get_registry().histogram(
+        RL_WEIGHT_SYNC_SECONDS,
+        "Wall-clock time of one learner→replica policy weight sync",
+    ).observe(duration_s)
+
+
+def count_trajectory(outcome: str) -> None:
+    get_registry().counter(
+        RL_TRAJECTORIES_TOTAL,
+        "Trajectory deliveries by outcome (acked/duplicate/requeued)",
+        labelnames=("outcome",),
+    ).labels(outcome=outcome).inc()
+
+
+def pull_policy(addrs: Sequence[str], version: int,
+                timeout_s: Optional[float] = None,
+                reporter=None) -> Tuple[int, bytes, Dict[str, object]]:
+    """One weight-sync fetch leg: ``POLICY_KEY`` at exactly ``version``
+    from any source that holds it (the learner, or an already-synced
+    peer replica when the learner just died). Returns
+    ``(version, blob, stats)``; raises ``fabric.FabricAbort`` when no
+    live source serves the pinned version."""
+    timeout = (
+        env_float(ConfigKey.RL_SYNC_TIMEOUT_S, DEFAULT_SYNC_TIMEOUT_S)
+        if timeout_s is None else timeout_s
+    )
+    sources = [fabric.FabricSource(addr=a) for a in addrs]
+    return fabric.fetch(sources, POLICY_KEY, expect_step=version,
+                        timeout_s=timeout, reporter=reporter)
+
+
+class StalenessLedger:
+    """On-policy staleness bookkeeping, owned by the trainer (it survives
+    actor deaths — the actors don't). ``observe`` is idempotent per
+    episode so a commit retry after a learner death re-stamps rather than
+    double-counts."""
+
+    def __init__(self, bound: Optional[int] = None,
+                 reporter: Optional[Callable[..., None]] = None):
+        self.bound = (
+            env_int(ConfigKey.RL_STALENESS_BOUND, DEFAULT_STALENESS_BOUND)
+            if bound is None else bound
+        )
+        self._reporter = reporter
+        self.learner_version = 0
+        self._replica: Dict[str, int] = {}
+        self._per_episode: Dict[int, int] = {}
+        self.violations = 0
+
+    # -- version tracking ---------------------------------------------------
+    def note_learner(self, version: int) -> None:
+        self.learner_version = version
+
+    def note_sync(self, replica: str, version: int) -> None:
+        self._replica[replica] = version
+
+    def note_reset(self, replica: str) -> None:
+        """Replica died: its next incarnation starts at version 0."""
+        self._replica.pop(replica, None)
+
+    def replica_version(self, replica: str) -> int:
+        return self._replica.get(replica, 0)
+
+    def needs_sync(self, replica: str) -> bool:
+        return self.replica_version(replica) < self.learner_version
+
+    # -- per-trajectory accounting ------------------------------------------
+    def observe(self, episode_id: int, generation_version: int) -> int:
+        s = self.learner_version - generation_version
+        self._per_episode[episode_id] = s
+        get_registry().gauge(
+            RL_STALENESS_MAX,
+            "Max on-policy staleness any trained trajectory carried",
+        ).set(float(self.max_staleness))
+        if s > self.bound:
+            self.violations += 1
+            if self._reporter is not None:
+                self._reporter(JournalEvent.RL_STALENESS_VIOLATION,
+                               episode=episode_id, staleness=s,
+                               bound=self.bound)
+        return s
+
+    @property
+    def max_staleness(self) -> int:
+        return max(self._per_episode.values(), default=0)
+
+    def history(self) -> List[Tuple[int, int]]:
+        return sorted(self._per_episode.items())

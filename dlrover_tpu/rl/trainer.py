@@ -1,0 +1,378 @@
+"""The rollout-plane trainer: one task-stream driver composing the
+trajectory-lease ledger, fabric weight sync, learner training, and ROSE
+borrow/handback elasticity.
+
+``fit()`` is re-entrant by construction — the unified master calls it
+again after every failover, and all authoritative state (the ledger, the
+staleness accounting, the current learner version) lives HERE, in the
+master process, not in any killable actor:
+
+- a dead rollout replica → its leases requeue onto survivors
+  (``requeue_owner``) and its tracked policy version resets, so the
+  respawned instance is re-synced before it generates;
+- a dead learner → ``_recover_learner`` warm-restores the last published
+  version from any synced rollout replica over the fabric, then the
+  peeked-but-uncommitted batch re-trains (exactly-once on the committed
+  stream);
+- elasticity → :class:`RolloutCapacity` is the coordinator's
+  ``serve_scaler``; a journaled ``rl_learner_demand`` triggers the ROSE
+  handback (drain borrowed rollout replicas with zero request loss),
+  a later hot tick re-borrows them.
+"""
+
+import time
+from concurrent.futures import wait
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import SpanName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability import tracing
+from dlrover_tpu.observability.journal import JournalEvent
+from dlrover_tpu.rl.buffer import TrajectoryLedger
+from dlrover_tpu.rl.sync import (
+    StalenessLedger,
+    count_trajectory,
+    observe_sync_seconds,
+)
+from dlrover_tpu.serving.autoscaler import (
+    ServingOptimizer,
+    ServingSignals,
+    TrainServeCoordinator,
+)
+from dlrover_tpu.unified.scheduler import ActorCallError, ActorDiedError
+from dlrover_tpu.unified.trainer import BaseTrainer
+
+
+def seeded_prompts(seed: int, n: int) -> List[List[int]]:
+    """Deterministic episode prompts (pure arithmetic — reproducible
+    across the drill, the audit regeneration, and every retry). Lengths
+    4–8 fit the batcher's smallest bucket; tokens stay < 50 so the
+    ToyEngine continuation is stable across vocab choices ≥ 50."""
+    out = []
+    state = seed & 0x7FFFFFFF
+    for i in range(n):
+        state = (state * 1103515245 + 12345) & 0x7FFFFFFF
+        length = 4 + (state % 5)
+        prompt = []
+        for j in range(length):
+            state = (state * 1103515245 + 12345) & 0x7FFFFFFF
+            prompt.append(state % 50)
+        out.append(prompt)
+    return out
+
+
+class RolloutCapacity:
+    """The rollout fleet as a ROSE ``serve_scaler``: ``scale_to`` moves
+    the ACTIVE-replica target; ``reconcile`` turns the delta into drain /
+    regrow rank lists. Ranks are retired highest-first so the base fleet
+    keeps stable identities across a borrow→handback→borrow cycle."""
+
+    def __init__(self, size: int, base: int):
+        self.size = size
+        self.target = base
+        self._active = list(range(base))
+        self.scale_log: List[Tuple[int, str]] = []
+
+    def scale_to(self, n: int, reason: str = "") -> None:
+        self.target = max(1, min(self.size, int(n)))
+        self.scale_log.append((self.target, reason))
+
+    def reconcile(self) -> Tuple[List[int], List[int]]:
+        """Apply ``target``: returns (ranks to drain, ranks regrown)."""
+        drains, grows = [], []
+        while len(self._active) > self.target:
+            drains.append(self._active.pop())
+        while len(self._active) < self.target:
+            rank = len(self._active)
+            self._active.append(rank)
+            grows.append(rank)
+        return drains, grows
+
+    def active_ranks(self) -> List[int]:
+        return list(self._active)
+
+
+class RolloutPlaneTrainer(BaseTrainer):
+    """Roles: ``rollout`` (N RolloutWorkload) + ``actor`` (1 Learner)."""
+
+    def __init__(self, role_groups, config):
+        super().__init__(role_groups, config)
+        cfg = config.get("rl", {}) if config else {}
+        episodes = int(cfg.get("episodes", 10))
+        seed = int(cfg.get("seed", 7))
+        self._max_new = int(cfg.get("max_new_tokens", 6))
+        self._batch = int(cfg.get("train_batch", 4))
+        self._schedule = dict(cfg.get("schedule", {}))
+        self._ledger = TrajectoryLedger(
+            seeded_prompts(seed, episodes),
+            lease_timeout_s=cfg.get("lease_timeout_s"),
+            reporter=self._report,
+        )
+        self._staleness = StalenessLedger(
+            bound=cfg.get("staleness_bound"), reporter=self._report)
+        self._version = 0
+        self._round = 0
+        self._start = time.monotonic()
+        self._sync_stats: List[Dict] = []
+        self._capacity: Optional[RolloutCapacity] = None
+        self._coordinator: Optional[TrainServeCoordinator] = None
+
+    def _report(self, kind: str, **data) -> None:
+        if self.journal is not None:
+            self.journal.record(kind, source="rl", **data)
+
+    # -- lifecycle ----------------------------------------------------------
+    def init(self) -> None:
+        rollout = self.role_groups["rollout"]
+        size = len(rollout.handles)
+        base = int(self.config.get("rl", {}).get("base_active", max(1, size - 1)))
+        base = max(1, min(size, base))
+        self._capacity = RolloutCapacity(size=size, base=base)
+        # the optimizer is pinned (min == max == base, impossible SLO):
+        # the only grow path is the ROSE borrow, the only shrink path the
+        # ROSE handback — the drill's schedule drives both explicitly
+        optimizer = ServingOptimizer(
+            min_replicas=base, max_replicas=base, ttft_slo_s=1e9,
+            queue_hi=0, grow_cooldown_s=0.0, shrink_cooldown_s=1e9)
+        self._coordinator = TrainServeCoordinator(
+            optimizer,
+            serve_scaler=self._capacity,
+            event_journal=self.journal,
+            idle_provider=lambda: 1,
+            max_borrow=size - base,
+            handback_kinds=(JournalEvent.RDZV_START,
+                            JournalEvent.RL_LEARNER_DEMAND),
+        )
+
+    def fit(self) -> None:
+        max_rounds = len(self._ledger._entries) * 6 + 20
+        while not self._ledger.all_committed():
+            self._round += 1
+            if self._round > max_rounds:
+                raise RuntimeError(
+                    f"rollout plane made no progress in {max_rounds} rounds")
+            self._recover_learner()
+            self._elasticity_tick()
+            self._sync_replicas()
+            self._dispatch_round()
+            self._train_step()
+        logger.info("rollout plane done: %s episodes committed at "
+                    "version %s in %s rounds",
+                    len(self._ledger._entries), self._version, self._round)
+
+    # -- learner recovery ---------------------------------------------------
+    def _recover_learner(self) -> None:
+        learner = self.role_groups["actor"]
+        v = learner.call_rank(0, "version", timeout=30)
+        if v == self._version:
+            return
+        if v > self._version:
+            # first entry, or the learner outran our record (it published
+            # before dying after we last read it): adopt its version
+            self._version = v
+            self._staleness.note_learner(v)
+            return
+        # learner restarted below the published version: warm-restore
+        # from any rollout replica that imported self._version
+        sources = self._synced_rollout_addrs(self._version)
+        if not sources:
+            # nobody holds the published blob (death before first sync):
+            # fall back to the learner's own republished state
+            self._version = v
+            self._staleness.note_learner(v)
+            return
+        with tracing.span(SpanName.RL_WEIGHT_SYNC, source="rl-trainer",
+                          version=self._version, direction="restore"):
+            tc = tracing.inject_wire()
+            res = learner.call_rank(0, "restore", sources, self._version,
+                                    tc, timeout=60)
+        self._report(JournalEvent.RL_LEARNER_RESTORED,
+                     version=res["version"], bytes=res["bytes"],
+                     duration_s=res["duration_s"], sources=len(sources))
+        self._sync_stats.append(
+            {"direction": "restore", **{k: res[k]
+                                        for k in ("version", "duration_s",
+                                                  "bytes")}})
+
+    def _synced_rollout_addrs(self, version: int) -> List[str]:
+        rollout = self.role_groups["rollout"]
+        out = []
+        for rank in self._capacity.active_ranks():
+            name = rollout.handles[rank].vertex.name
+            if self._staleness.replica_version(name) >= version:
+                try:
+                    out.append(rollout.call_rank(rank, "fabric_addr",
+                                                 timeout=10))
+                except (ActorCallError, ActorDiedError):
+                    continue
+        return out
+
+    # -- ROSE elasticity ----------------------------------------------------
+    def _elasticity_tick(self) -> None:
+        r = self._round
+        if self._schedule.get("demand_round") == r:
+            # the learner's big-batch surge: the coordinator's journal
+            # listener fires the handback synchronously on this record
+            self._report(JournalEvent.RL_LEARNER_DEMAND, round=r)
+        if r in (self._schedule.get("borrow_round"),
+                 self._schedule.get("reborrow_round")):
+            target = self._capacity.target
+            self._coordinator.maybe_borrow(ServingSignals(
+                live_replicas=target, target_replicas=target,
+                queue_depth=max(1, self._ledger.backlog())))
+        drains, grows = self._capacity.reconcile()
+        rollout = self.role_groups["rollout"]
+        for rank in drains:
+            name = rollout.handles[rank].vertex.name
+            res = rollout.call_rank(rank, "drain", timeout=60)
+            self._report(JournalEvent.RL_ROLLOUT_DRAINED, replica=name,
+                         rank=rank, completed=res["completed"],
+                         lost=res["lost"], round=r)
+        for rank in grows:
+            name = rollout.handles[rank].vertex.name
+            self._report(JournalEvent.RL_ROLLOUT_REGROWN, replica=name,
+                         rank=rank, round=r,
+                         tracked_version=self._staleness.replica_version(name))
+
+    # -- weight sync --------------------------------------------------------
+    def _sync_replicas(self) -> None:
+        if self._version == 0:
+            return
+        rollout = self.role_groups["rollout"]
+        learner = self.role_groups["actor"]
+        learner_addr = learner.call_rank(0, "fabric_addr", timeout=30)
+        active = self._capacity.active_ranks()
+        names = {r: rollout.handles[r].vertex.name for r in active}
+        for rank in active:
+            name = names[rank]
+            # probe: a restarted replica reports version 0 regardless of
+            # what our ledger last recorded for that vertex name
+            observed = rollout.call_rank(rank, "version", timeout=30)
+            self._staleness.note_sync(name, observed)
+            if not self._staleness.needs_sync(name):
+                continue
+            # sources: the learner first, then every OTHER replica our
+            # ledger says already imported this version — if the learner
+            # dies mid-sync the fetch fails over to a synced peer
+            peers = [learner_addr]
+            for other in active:
+                if other == rank:
+                    continue
+                if self._staleness.replica_version(names[other]) >= self._version:
+                    try:
+                        peers.append(rollout.call_rank(other, "fabric_addr",
+                                                       timeout=10))
+                    except (ActorCallError, ActorDiedError):
+                        continue
+            with tracing.span(SpanName.RL_WEIGHT_SYNC, source="rl-trainer",
+                              version=self._version, replica=name):
+                tc = tracing.inject_wire()
+                res = rollout.call_rank(rank, "sync_weights", peers,
+                                        self._version, tc, timeout=60)
+            observe_sync_seconds(res["duration_s"])
+            self._staleness.note_sync(name, res["version"])
+            self._sync_stats.append({"direction": "sync", "replica": name,
+                                     **{k: res[k] for k in
+                                        ("version", "duration_s", "bytes")}})
+            self._report(JournalEvent.RL_WEIGHT_SYNC, replica=name,
+                         version=res["version"], bytes=res["bytes"],
+                         duration_s=res["duration_s"],
+                         sources=len(peers),
+                         stripe_retries=res.get("stripe_retries", 0))
+
+    # -- generation ---------------------------------------------------------
+    def _dispatch_round(self) -> None:
+        rollout = self.role_groups["rollout"]
+        futures = {}
+        for rank in self._capacity.active_ranks():
+            name = rollout.handles[rank].vertex.name
+            leased = self._ledger.lease(owner=name)
+            if leased is None:
+                break
+            eid, prompt = leased
+            fut = rollout._pool.submit(
+                rollout.call_rank, rank, "generate", eid, prompt,
+                self._max_new, timeout=60)
+            futures[fut] = (rank, name, eid)
+        if not futures:
+            return
+        wait(futures)
+        died: Optional[ActorDiedError] = None
+        for fut, (rank, name, eid) in futures.items():
+            exc = fut.exception()
+            if exc is None:
+                res = fut.result()
+                gen_version = int(res.get("version", 0))
+                if self._ledger.ack(eid, name, res["tokens"], gen_version):
+                    count_trajectory("acked")
+                    self._report(
+                        JournalEvent.RL_TRAJECTORY_ACKED, episode=eid,
+                        replica=name, version=gen_version,
+                        hash=self._ledger.audit()["hashes"].get(eid))
+                else:
+                    count_trajectory("duplicate")
+            elif isinstance(exc, ActorDiedError):
+                died = exc
+            else:
+                logger.warning("episode %s on %s failed: %s", eid, name, exc)
+                self._ledger.release(eid, name)
+        if died is not None:
+            # steal the dead replica's leases back, forget its synced
+            # version (the respawn starts at 0), then let the master's
+            # failover restart it — fit() re-enters and carries on
+            for eid in self._ledger.requeue_owner(died.vertex_name):
+                count_trajectory("requeued")
+            self._staleness.note_reset(died.vertex_name)
+            raise died
+
+    # -- training -----------------------------------------------------------
+    def _train_step(self) -> None:
+        batch = self._ledger.ready(self._batch)
+        if not batch:
+            return
+        for t in batch:
+            self._staleness.observe(t.episode_id, t.version)
+        learner = self.role_groups["actor"]
+        with tracing.span(SpanName.RL_TRAIN_STEP, source="rl-trainer",
+                          version=self._version + 1,
+                          episodes=len(batch)):
+            tc = tracing.inject_wire()
+            res = learner.call_rank(
+                0, "train", [list(t.tokens) for t in batch],
+                [t.episode_id for t in batch], tc, timeout=120)
+        self._version = int(res["version"])
+        self._staleness.note_learner(self._version)
+        ids = [t.episode_id for t in batch]
+        self._ledger.commit(ids, self._version)
+        self._report(JournalEvent.RL_TRAIN_COMMIT, version=self._version,
+                     episodes=ids,
+                     staleness_max=self._staleness.max_staleness)
+
+    # -- reporting ----------------------------------------------------------
+    def report(self) -> Dict:
+        wall = time.monotonic() - self._start
+        audit = self._ledger.audit()
+        syncs = [s for s in self._sync_stats if s["direction"] == "sync"]
+        durations = [s["duration_s"] for s in syncs]
+        return {
+            "episodes": audit["episodes"],
+            "committed": audit["committed"],
+            "wall_s": round(wall, 3),
+            "trajectories_per_s": round(audit["committed"] / wall, 3)
+            if wall > 0 else 0.0,
+            "weight_sync": {
+                "count": len(syncs),
+                "mean_s": round(sum(durations) / len(durations), 6)
+                if durations else 0.0,
+                "max_s": round(max(durations), 6) if durations else 0.0,
+                "restores": len(self._sync_stats) - len(syncs),
+            },
+            "max_staleness": self._staleness.max_staleness,
+            "staleness_bound": self._staleness.bound,
+            "staleness_violations": self._staleness.violations,
+            "audit": audit,
+            "version": self._version,
+            "rounds": self._round,
+            "scale_log": list(self._capacity.scale_log)
+            if self._capacity else [],
+        }

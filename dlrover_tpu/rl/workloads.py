@@ -1,0 +1,242 @@
+"""The rollout and learner roles of the RL plane, as unified process
+actors (one OS process per instance, driven by the trainer over the
+scheduler's pipe protocol).
+
+RolloutWorkload IS a serving-plane replica turned inward: a
+ContinuousBatcher over an engine (ToyEngine for CPU drills, the jax
+BatchDecodeEngine behind ``backend: jax``) generates episode
+continuations; a FabricServer on the same RPC plane serves the replica's
+current policy blob so peers (and a warm-restoring learner) can fetch it.
+
+LearnerWorkload holds the policy (a small numpy tree), trains
+deterministically on trajectory batches, and publishes every new version
+through ``export_params`` on its own FabricServer. After a SIGKILL it
+warm-restores the published version back from the rollout fleet — the
+same fabric rung the replicas use, pointed the other way.
+
+Chaos knobs ride ``config["rl"]["chaos"]``; each kill fires only on the
+first incarnation (``ctx.restart_count == 0``) so the respawned actor
+completes the episode.
+"""
+
+import os
+import signal
+import time
+from typing import Dict, List, Optional, Sequence
+
+from dlrover_tpu.common import fabric
+from dlrover_tpu.common.constants import SpanName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.rpc import RPCServer
+from dlrover_tpu.observability import tracing
+from dlrover_tpu.rl.sync import pull_policy
+from dlrover_tpu.serving.batcher import ContinuousBatcher
+from dlrover_tpu.serving.engine import ToyEngine
+from dlrover_tpu.unified.workload import BaseWorkload
+
+
+def _rl_cfg(config: Dict) -> Dict:
+    return config.get("rl", {}) if config else {}
+
+
+class _PolicyHolder(BaseWorkload):
+    """Shared plumbing: a local RPC server with a fabric ``policy``
+    provider serving ``self._blob`` at step ``self._version``."""
+
+    def _start_policy_server(self) -> None:
+        self._version = 0
+        self._blob = b""
+        self._server = RPCServer(host="127.0.0.1", port=0)
+        fs = fabric.FabricServer(server=self._server)
+        fs.register_provider("policy", self._provide_policy)
+        self._server.start()
+
+    def _provide_policy(self, rest: str):
+        blob, version = self._blob, self._version
+        if not blob:
+            return None  # nothing published yet → "not served here"
+        return (version, len(blob), version,
+                lambda off, n: blob[off:off + n])
+
+    def fabric_addr(self) -> str:
+        return f"127.0.0.1:{self._server.port}"
+
+    def version(self) -> int:
+        return self._version
+
+    def teardown(self) -> None:
+        self._server.stop()
+
+
+class RolloutWorkload(_PolicyHolder):
+    def setup(self) -> None:
+        cfg = _rl_cfg(self.config)
+        backend = cfg.get("backend", "toy")
+        if backend == "jax":
+            from dlrover_tpu.serving.engine import build_tiny_engine
+
+            self._engine = build_tiny_engine(
+                slots=int(cfg.get("slots", 4)),
+                cache_len=int(cfg.get("cache_len", 48)),
+                vocab=int(cfg.get("jax_vocab", 64)),
+            )
+        else:
+            self._engine = ToyEngine(
+                slots=int(cfg.get("slots", 4)),
+                vocab=int(cfg.get("vocab", 97)),
+                prefill_delay_s=float(cfg.get("prefill_delay_s", 0.0)),
+                step_delay_s=float(cfg.get("step_delay_s", 0.002)),
+            )
+        self._buckets = tuple(cfg.get("buckets", (8, 16)))
+        self._batcher = ContinuousBatcher(
+            self._engine, buckets=self._buckets, prefill_workers=1)
+        self._batcher.start()
+        self._start_policy_server()
+
+    # -- weight sync (the replica-side import leg) --------------------------
+    def sync_weights(self, addrs: Sequence[str], version: int,
+                     tc: Optional[Dict[str, str]] = None) -> Dict:
+        t0 = time.monotonic()
+        with tracing.activate(tracing.extract_wire(tc)):
+            with tracing.span(SpanName.RL_WEIGHT_IMPORT, source=self.name,
+                              version=version):
+                step, blob, stats = pull_policy(addrs, version)
+                self._blob = blob
+                self._version = step
+                # the policy tree conditions the LEARNER, not the token
+                # generator — generation must stay version-independent or
+                # a requeued episode regenerated at a later version would
+                # break the content-hash audit. The replica's job is to
+                # hold the blob (staleness accounting + serving it as a
+                # fabric source for peers and learner restore).
+        return {
+            "version": self._version,
+            "duration_s": round(time.monotonic() - t0, 6),
+            "bytes": len(blob),
+            "sources": stats.get("sources"),
+            "stripe_retries": stats.get("stripe_retries", 0),
+        }
+
+    # -- episode generation -------------------------------------------------
+    def generate(self, episode_id: int, prompt: Sequence[int],
+                 max_new_tokens: int = 6) -> Dict:
+        chaos = _rl_cfg(self.config).get("chaos", {})
+        die_after = chaos.get("rollout_die_episode")
+        # "first episode ≥ N this rank handles" rather than an exact id:
+        # elasticity shifts the lease order, the kill must not depend on it
+        die = (die_after is not None and episode_id >= die_after
+               and chaos.get("rollout_die_rank", 1) == self.rank
+               and self.ctx.restart_count == 0)
+        with tracing.span(SpanName.RL_GENERATE, source=self.name,
+                          episode=episode_id, version=self._version):
+            req = self._batcher.submit(
+                f"ep-{episode_id}", list(prompt), int(max_new_tokens))
+            if die:
+                # mid-episode kill: the prompt is in flight in the
+                # batcher, the lease is unacked — the ledger must steal
+                # it onto a survivor with no loss and no duplicate
+                time.sleep(0.05)
+                os.kill(os.getpid(), signal.SIGKILL)
+            if not req.done.wait(timeout=30.0):
+                raise TimeoutError(f"episode {episode_id} timed out")
+            if req.error:
+                raise RuntimeError(f"episode {episode_id}: {req.error}")
+        return {"episode_id": int(episode_id), "tokens": list(req.tokens),
+                "version": self._version}
+
+    def drain(self) -> Dict:
+        """ROSE handback leg: complete everything in flight (the batcher
+        invariant — zero request loss), then swap in a fresh batcher so a
+        later regrow re-admits on the same engine and policy version."""
+        ok = self._batcher.drain(timeout_s=30.0)
+        self._batcher.stop()
+        self._batcher = ContinuousBatcher(
+            self._engine, buckets=self._buckets, prefill_workers=1)
+        self._batcher.start()
+        return {"completed": bool(ok), "lost": 0 if ok else -1}
+
+    def teardown(self) -> None:
+        self._batcher.stop()
+        super().teardown()
+
+
+class LearnerWorkload(_PolicyHolder):
+    def setup(self) -> None:
+        import numpy as np
+
+        cfg = _rl_cfg(self.config)
+        rng = np.random.default_rng(int(cfg.get("seed", 7)))
+        dim = int(cfg.get("policy_dim", 256))
+        self._params = {
+            "policy": {"w": rng.standard_normal(dim).astype("float32")},
+            "meta": {"version": np.zeros(1, dtype="int64")},
+        }
+        self._trained = 0
+        self._start_policy_server()
+        self._publish()
+
+    def _publish(self) -> None:
+        import numpy as np
+
+        from dlrover_tpu.serving.engine import export_params
+
+        # the version lives INSIDE the blob: a restore derives it from
+        # content, not from whoever handed over the bytes
+        self._params["meta"]["version"] = np.asarray(
+            [self._version], dtype="int64")
+        self._blob = export_params(self._params)
+
+    def train(self, batches: List[List[int]], episode_ids: List[int],
+              tc: Optional[Dict[str, str]] = None) -> Dict:
+        chaos = _rl_cfg(self.config).get("chaos", {})
+        if (chaos.get("learner_die_version") == self._version + 1
+                and self.ctx.restart_count == 0):
+            # mid-train kill, BEFORE any mutation: the interrupted update
+            # never reaches a published version, so the trainer's commit
+            # retry after restore is exactly-once on the committed stream
+            time.sleep(0.05)
+            os.kill(os.getpid(), signal.SIGKILL)
+        import numpy as np
+
+        with tracing.activate(tracing.extract_wire(tc)):
+            with tracing.span(SpanName.RL_TRAIN_STEP, source=self.name,
+                              version=self._version + 1):
+                w = np.asarray(self._params["policy"]["w"]).copy()
+                for toks in batches:
+                    # deterministic REINFORCE-ish nudge: enough to make
+                    # every version's blob distinct, cheap enough for CPU
+                    idx = np.asarray([t % w.size for t in toks])
+                    np.add.at(w, idx, 1e-3)
+                self._params["policy"]["w"] = w
+                self._version += 1
+                self._trained += len(batches)
+                self._publish()
+        return {"version": self._version, "trained": len(batches),
+                "episodes": list(episode_ids)}
+
+    def restore(self, addrs: Sequence[str], version: int,
+                tc: Optional[Dict[str, str]] = None) -> Dict:
+        """Warm-restore the published policy from the rollout fleet after
+        a learner death (the fabric rung pointed the other way)."""
+        import numpy as np
+
+        t0 = time.monotonic()
+        with tracing.activate(tracing.extract_wire(tc)):
+            with tracing.span(SpanName.RL_WEIGHT_IMPORT, source=self.name,
+                              version=version):
+                step, blob, stats = pull_policy(addrs, version)
+        from dlrover_tpu.serving.engine import import_params
+
+        tree = import_params(blob)
+        self._params = {
+            "policy": {"w": np.asarray(tree["policy"]["w"])},
+            "meta": {"version": np.asarray(tree["meta"]["version"])},
+        }
+        self._version = int(self._params["meta"]["version"][0])
+        if self._version != step:
+            logger.warning("restored blob says version %s but fabric step "
+                           "was %s", self._version, step)
+        self._blob = blob
+        return {"version": self._version,
+                "duration_s": round(time.monotonic() - t0, 6),
+                "bytes": len(blob), "sources": stats.get("sources")}

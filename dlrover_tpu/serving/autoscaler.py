@@ -133,13 +133,18 @@ class TrainServeCoordinator:
     """
 
     def __init__(self, optimizer: ServingOptimizer, serve_scaler=None,
-                 event_journal=None, idle_provider=None, max_borrow: int = 1):
+                 event_journal=None, idle_provider=None, max_borrow: int = 1,
+                 handback_kinds=(JournalEvent.RDZV_START,)):
         self._optimizer = optimizer
         self._scaler = serve_scaler
         self._journal = event_journal
         # () -> int: training nodes currently idle/released and borrowable
         self._idle_provider = idle_provider or (lambda: 0)
         self._max_borrow = max_borrow
+        # which journal kinds mean "training wants its nodes back":
+        # rdzv_start for the elastic-training stream; the RL rollout
+        # plane adds rl_learner_demand (the learner's big-batch surge)
+        self._handback_kinds = tuple(handback_kinds)
         self._lock = threading.Lock()
         self.borrowed = 0
         self._base_max = optimizer.max_replicas
@@ -172,8 +177,8 @@ class TrainServeCoordinator:
         return True
 
     def _on_journal_event(self, event) -> None:
-        if event.get("kind") == JournalEvent.RDZV_START:
-            self.handback(reason="training rendezvous")
+        if event.get("kind") in self._handback_kinds:
+            self.handback(reason=f"training demand ({event.get('kind')})")
 
     def handback(self, reason: str = "training rendezvous") -> None:
         """Training is re-forming: drain every borrowed replica NOW."""

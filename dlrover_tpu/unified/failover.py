@@ -6,12 +6,15 @@ Recovery ladder per failed vertex (mirrors the L1/L2 ladder, SURVEY §5.3):
    that actor;
 2. SPMD role (jax.distributed group; the XLA world is static) → restart the
    whole role group together;
-3. restart budget exhausted → JobAbort.
+3. restart budget exhausted → JobAbort, journaled as a job-level verdict
+   (``unified_job_abort`` carries the full per-role budget table) so the
+   outcome is attributable from the event stream, not just an exit code.
 """
 
-from typing import Dict
+from typing import Dict, Optional
 
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.journal import EventJournal, JournalEvent
 from dlrover_tpu.unified.graph import ExecutionVertex
 from dlrover_tpu.unified.scheduler import ProcessScheduler
 
@@ -21,23 +24,37 @@ class JobAbortError(RuntimeError):
 
 
 class FailoverCoordinator:
-    def __init__(self, scheduler: ProcessScheduler, max_restarts: int = 3):
+    def __init__(self, scheduler: ProcessScheduler, max_restarts: int = 3,
+                 journal: Optional[EventJournal] = None):
         self._scheduler = scheduler
         self._max_restarts = max_restarts
+        self._journal = journal
         self._restarts: Dict[str, int] = {}  # per role
 
     def restart_count(self, role: str) -> int:
         return self._restarts.get(role, 0)
 
+    def _record(self, kind: str, **data) -> None:
+        if self._journal is not None:
+            self._journal.record(kind, source="unified", **data)
+
     def handle_failure(self, vertex: ExecutionVertex) -> None:
         role = vertex.role
         used = self._restarts.get(role, 0)
         if used >= self._max_restarts:
-            raise JobAbortError(
-                f"role {role} exceeded {self._max_restarts} restarts"
-            )
+            verdict = (f"role {role} exceeded {self._max_restarts} restarts "
+                       f"(vertex {vertex.name})")
+            self._record(JournalEvent.UNIFIED_JOB_ABORT, role=role,
+                         vertex=vertex.name, restarts=dict(self._restarts),
+                         max_restarts=self._max_restarts, verdict=verdict)
+            raise JobAbortError(verdict)
         self._restarts[role] = used + 1
-        if vertex.spmd and vertex.world_size > 1:
+        group = vertex.spmd and vertex.world_size > 1
+        self._record(JournalEvent.UNIFIED_FAILOVER, role=role,
+                     vertex=vertex.name,
+                     scope="role_group" if group else "actor",
+                     restart=used + 1, max_restarts=self._max_restarts)
+        if group:
             logger.warning(
                 "failover: SPMD member %s died; restarting role group %s "
                 "(%s/%s)", vertex.name, role, used + 1, self._max_restarts)

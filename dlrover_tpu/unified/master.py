@@ -33,7 +33,8 @@ class UnifiedMaster:
                  backend: str = "process", max_restarts: int = 3,
                  start_method: str = "forkserver",
                  hosts: Optional[Dict[int, str]] = None,
-                 master_addr: str = "", cluster_job: str = ""):
+                 master_addr: str = "", cluster_job: str = "",
+                 journal=None):
         """``hosts`` maps placement node_index → that node's actor-host
         daemon address (unified/remote.py); mapped nodes get their actors
         spawned remotely, unmapped ones locally — so a laptop run and a
@@ -64,7 +65,16 @@ class UnifiedMaster:
             self.graph, job_name, start_method=start_method, hosts=hosts,
             host_secret=os.environ.get("DTPU_ACTOR_HOST_SECRET", ""),
         )
-        self.failover = FailoverCoordinator(self.scheduler, max_restarts)
+        # the observability spine: failover restarts and the job-level
+        # abort verdict are journaled, and the trainer (which runs in this
+        # process) records its task-stream events on the same journal
+        from dlrover_tpu.observability.journal import EventJournal
+
+        self.journal = journal if journal is not None else EventJournal()
+        self.failover = FailoverCoordinator(self.scheduler, max_restarts,
+                                            journal=self.journal)
+        self.trainer = None  # built by _run_task_stream; kept for drills
+        self.verdict = ""    # "" until run() settles the job outcome
 
     # -- setup --------------------------------------------------------------
     def _inject_spmd_env(self) -> None:
@@ -100,9 +110,18 @@ class UnifiedMaster:
             # documented to return an exit code, not leak the exception
             self.scheduler.schedule()
             if self.job.trainer is not None:
-                return self._run_task_stream(timeout_s)
-            return self._run_broadcast(timeout_s)
+                rc = self._run_task_stream(timeout_s)
+            else:
+                rc = self._run_broadcast(timeout_s)
+            self.verdict = self.verdict or (
+                "succeeded" if rc == 0 else "failed")
+            return rc
         except (JobAbortError, ActorDiedError) as e:
+            # the budget-exhaustion path already journaled
+            # unified_job_abort with the per-role table; record the
+            # verdict here for every abort shape so callers never have
+            # to parse logs to learn why the job stopped
+            self.verdict = str(e)
             logger.error("job aborted: %s", e)
             return 1
         finally:
@@ -111,11 +130,17 @@ class UnifiedMaster:
     def _build_trainer(self):
         tc = self.job.trainer
         cls = getattr(importlib.import_module(tc.module_name), tc.class_name)
-        return cls(self.role_groups(), self.job.config)
+        trainer = cls(self.role_groups(), self.job.config)
+        # the trainer runs in this process: give it the master's journal
+        # (one event stream spans failover + task-stream events) and the
+        # master itself (chaos drills reach actor pids through it)
+        trainer.journal = self.journal
+        trainer.unified_master = self
+        return trainer
 
     def _run_task_stream(self, timeout_s: float) -> int:
-        trainer = self._build_trainer()
-        deadline = time.time() + timeout_s
+        trainer = self.trainer = self._build_trainer()
+        deadline = time.monotonic() + timeout_s
         inited = False
         while True:
             try:
@@ -127,8 +152,9 @@ class UnifiedMaster:
                 trainer.fit()
                 return 0
             except ActorDiedError as e:
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     logger.error("task stream timed out during failover")
+                    self.verdict = "task stream timed out during failover"
                     return 1
                 vertex = self.graph.by_name(e.vertex_name)
                 if vertex is None:
@@ -140,17 +166,17 @@ class UnifiedMaster:
         """No trainer: broadcast ``run()`` to every actor, ride out deaths
         with the failover ladder until every instance has returned."""
         pool = self.scheduler._pool  # shared, cleaned up by scheduler
-        deadline = time.time() + timeout_s
+        deadline = time.monotonic() + timeout_s
         pending = {v.name for v in self.graph.vertices()}
         while pending:
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 logger.error("broadcast stream timed out; pending=%s",
                              sorted(pending))
                 return 1
             futs = {
                 name: pool.submit(
                     self.scheduler.handles[name].call, "run",
-                    timeout=max(1.0, deadline - time.time()),
+                    timeout=max(1.0, deadline - time.monotonic()),
                 )
                 for name in list(pending)
             }

@@ -365,9 +365,9 @@ class ProcessScheduler:
 
     @staticmethod
     def _await_ready(handles: List[ActorHandle], timeout_s: float) -> None:
-        deadline = time.time() + timeout_s
+        deadline = time.monotonic() + timeout_s
         for h in handles:
-            remain = max(0.1, deadline - time.time())
+            remain = max(0.1, deadline - time.monotonic())
             if not h._conn.poll(remain):
                 raise ActorDiedError(h.vertex.name, "(never became ready)")
             status, payload = h._conn.recv()

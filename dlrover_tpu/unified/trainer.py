@@ -16,6 +16,12 @@ from dlrover_tpu.unified.scheduler import RoleGroup
 class BaseTrainer:
     """(reference BaseTrainer; RG_* role-group attributes)"""
 
+    # injected by UnifiedMaster._build_trainer (the trainer runs in the
+    # master's process): the job's EventJournal and the master itself.
+    # None when a trainer is constructed directly in unit tests.
+    journal = None
+    unified_master = None
+
     def __init__(self, role_groups: Dict[str, RoleGroup],
                  config: Dict[str, Any]):
         self.role_groups = role_groups

@@ -797,12 +797,13 @@ def test_baseline_burn_down_floor():
     PR 9 from 85 down to ≤80, PR 10 from 80 down to ≤76, PR 11 from 76
     down to ≤72, PR 12 from 72 down to ≤68, PR 13 from 68 down to ≤66
     (flash_attention.py bwd block-size env reads moved onto ConfigKey +
-    env_int). If this fails with a LOWER count, ratchet the floor down
-    in this test; if with a higher one, a deferral leaked in — fix it
-    instead."""
+    env_int), PR 14 from 66 down to ≤59 (unified master/scheduler
+    deadline math moved off time.time() onto time.monotonic()). If this
+    fails with a LOWER count, ratchet the floor down in this test; if
+    with a higher one, a deferral leaked in — fix it instead."""
     baseline_total = sum(load_baseline().values())
-    assert baseline_total <= 66, (
-        f"baseline grew to {baseline_total} entries (must stay ≤66); "
+    assert baseline_total <= 59, (
+        f"baseline grew to {baseline_total} entries (must stay ≤59); "
         "fix the new violations instead of deferring them"
     )
 
