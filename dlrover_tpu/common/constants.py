@@ -319,6 +319,21 @@ class ConfigKey:
     SERVE_QUEUE_HI = "DLROVER_TPU_SERVE_QUEUE_HI"
     SERVE_GROW_COOLDOWN_S = "DLROVER_TPU_SERVE_GROW_COOLDOWN_S"
     SERVE_SHRINK_COOLDOWN_S = "DLROVER_TPU_SERVE_SHRINK_COOLDOWN_S"
+    # serving performance plane (serving/engine.py, serving/prefix_cache.py,
+    # serving/speculative.py): int8 KV cache in the batched engine (0/1,
+    # default off), radix prefix-cache reuse on/off, its byte budget and
+    # match-block quantum (reuse lengths are multiples of the block so the
+    # chunked-prefill trace count stays bounded), and the speculative
+    # draft length k
+    SERVE_QUANT = "DLROVER_TPU_SERVE_QUANT"
+    SERVE_PREFIX = "DLROVER_TPU_SERVE_PREFIX"
+    SERVE_PREFIX_BYTES = "DLROVER_TPU_SERVE_PREFIX_BYTES"
+    SERVE_PREFIX_BLOCK = "DLROVER_TPU_SERVE_PREFIX_BLOCK"
+    SERVE_SPEC_K = "DLROVER_TPU_SERVE_SPEC_K"
+    # models/decode.py fused-kernel routing: 1/0 force the pallas decode
+    # kernel on/off; default "auto" follows the measured policy in
+    # flash_decode_wanted
+    FLASH_DECODE = "DLROVER_TPU_FLASH_DECODE"
     # agentic-RL rollout plane (dlrover_tpu/rl/): the on-policy staleness
     # bound (learner_version − generation_version a trajectory may carry
     # and still be trained), the trajectory-lease timeout after which an

@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, TextIO
 
+from dlrover_tpu.common.constants import ConfigKey, env_str
 from dlrover_tpu.common.log import logger
 
 
@@ -107,7 +108,10 @@ class DurationSpan:
         self._begin_ts: Optional[float] = None
 
     def begin(self) -> "DurationSpan":
-        self._begin_ts = time.time()
+        # records keep the wall timestamp (offline analysis correlates
+        # files across hosts by it); the DURATION is monotonic arithmetic
+        # — an NTP step mid-span must not produce a negative goodput span
+        self._begin_ts = time.monotonic()
         self._emitter._emit(
             self.name, EventPhase.BEGIN, self.event_id, self.content
         )
@@ -115,7 +119,7 @@ class DurationSpan:
 
     def end(self, **extra) -> float:
         """Returns the span duration in seconds."""
-        now = time.time()
+        now = time.monotonic()
         duration = now - (self._begin_ts or now)
         self._emitter._emit(
             self.name, EventPhase.END, self.event_id,
@@ -176,7 +180,7 @@ def get_emitter(target: str = "") -> EventEmitter:
     with _default_lock:
         if target not in _emitters:
             exporters: List[Exporter] = [LogExporter()]
-            event_dir = os.getenv("DLROVER_TPU_EVENT_DIR", "")
+            event_dir = env_str(ConfigKey.EVENT_DIR, "")
             if event_dir:
                 exporters.append(FileExporter(os.path.join(
                     event_dir, f"events_{target or os.getpid()}.jsonl"

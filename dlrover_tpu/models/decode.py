@@ -39,8 +39,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-import os
-
+from dlrover_tpu.common.constants import ConfigKey, env_str
 from dlrover_tpu.models.llama import _mlp, _rms_norm, _rope
 
 # K-block size of the fused decode kernel; caches sized in multiples of
@@ -75,7 +74,7 @@ def flash_decode_wanted(T: int, quantized: bool,
     hold (prompt + budget) when the caller knows it; None means assume
     the cache is fully live.
     """
-    env = os.getenv("DLROVER_TPU_FLASH_DECODE", "auto")
+    env = env_str(ConfigKey.FLASH_DECODE, "auto")
     if env in ("0", "off"):
         return False
     if T % _DECODE_BLOCK_K != 0 or jax.default_backend() != "tpu":
@@ -424,6 +423,80 @@ def decode_step(params: Dict, token, cache: Dict,
     cache["pos"] = pos + 1
     x = _rms_norm(x, params["final_norm"], c.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, cache
+
+
+def decode_window(params: Dict, tokens, cache: Dict,
+                  config) -> Tuple[jnp.ndarray, Dict]:
+    """One batched multi-token step: ``tokens`` (B, K) occupy positions
+    ``pos .. pos+K-1`` → (logits (B, K, V) — row ``i`` is the next-token
+    distribution AFTER ``tokens[:, i]`` — and the cache with ``pos + K``).
+
+    This is the speculative-decoding VERIFY leg: the target model scores
+    all K drafted tokens in one forward instead of K sequential steps.
+    The window's k/v rows are written before the attend (causal mask
+    within the window), so an accepting caller keeps them for free; a
+    rejecting caller rewinds ``cache['pos']`` — rows past ``pos`` are
+    exactly the garbage the step mask already never reveals (the same
+    argument as the zero-initialized cache)."""
+    c = config
+    B, K = tokens.shape
+    T = cache["k"][0].shape[2]
+    pos = cache["pos"]
+    x = params["tok_embed"][tokens]                      # (B, K, D)
+    positions = jnp.broadcast_to((pos + jnp.arange(K))[None], (B, K))
+    # query i sits at absolute position pos+i: attend [0, pos+i]
+    mask = (
+        jnp.arange(T)[None, None, None, :]
+        <= (pos + jnp.arange(K))[None, None, :, None]
+    )
+    scale = c.head_dim ** -0.5
+
+    quantized = "k_scale" in cache
+    cache_keys = ["k", "v"] + (["k_scale", "v_scale"] if quantized else [])
+    bufs = {name: list(cache[name]) for name in cache_keys}
+
+    h = x
+    for li in range(c.n_layers):
+        layer = jax.tree.map(lambda w, li=li: w[li], params["layers"])
+        xn = _rms_norm(h, layer["attn_norm"], c.norm_eps)
+        q = _rope(_split_heads(xn @ layer["wq"], c.n_heads, c.head_dim),
+                  positions, c.rope_theta)
+        k_new = _rope(
+            _split_heads(xn @ layer["wk"], c.n_kv_heads, c.head_dim),
+            positions, c.rope_theta,
+        )
+        v_new = _split_heads(xn @ layer["wv"], c.n_kv_heads, c.head_dim)
+        k_new = jnp.swapaxes(k_new, 1, 2)                # (B, KV, K, Dh)
+        v_new = jnp.swapaxes(v_new, 1, 2)
+        if quantized:
+            kq, ksc = _quantize(k_new)
+            vq, vsc = _quantize(v_new)
+            writes = {"k": kq, "v": vq, "k_scale": ksc, "v_scale": vsc}
+        else:
+            writes = {
+                "k": k_new.astype(bufs["k"][li].dtype),
+                "v": v_new.astype(bufs["v"][li].dtype),
+            }
+        for name, val in writes.items():
+            bufs[name][li] = jax.lax.dynamic_update_slice(
+                bufs[name][li], val, (0, 0, pos) + (0,) * (val.ndim - 3)
+            )
+        if quantized:
+            k_read = _dequantize(bufs["k"][li], bufs["k_scale"][li],
+                                 c.dtype)
+            v_read = _dequantize(bufs["v"][li], bufs["v_scale"][li],
+                                 c.dtype)
+            out = _attend(q, k_read, v_read, mask, scale, pos=None)
+        else:
+            out = _attend(q, bufs["k"][li], bufs["v"][li], mask, scale)
+        h = h + out @ layer["wo"]
+        h = h + _ffn(_rms_norm(h, layer["ffn_norm"], c.norm_eps), layer, c)
+
+    cache = {name: tuple(bufs[name]) for name in cache_keys}
+    cache["pos"] = pos + K
+    x = _rms_norm(h, params["final_norm"], c.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)  # (B, K, V)
     return logits, cache
 
 

@@ -90,6 +90,13 @@ class JournalEvent:
     SERVE_REQUEST_FAILED = "serve_request_failed"
     SERVE_REROUTED = "serve_rerouted"
     SERVE_SCALE = "serve_scale"
+    # serving prefix-cache plane (serving/prefix_cache.py): one reused
+    # prefix (with the rows/tokens it saved), and a cached entry dropped
+    # mid-reuse — injected corruption or eviction under a live lookup —
+    # after which the request fell back to a full cold prefill. Both
+    # informational.
+    SERVE_PREFIX_HIT = "serve_prefix_hit"
+    SERVE_PREFIX_DROPPED = "serve_prefix_dropped"
     # elastic data plane (master/task_manager.py shard ledger): dispatch/
     # ack are the per-shard lease lifecycle; requeue covers dead-node
     # recovery, lease expiry, and cooperative releases; steal is the
@@ -159,6 +166,7 @@ class JournalEvent:
         FANIN_REPARENTED, FANIN_BACKPRESSURE, CKPT_CHAIN_TRUNCATED,
         SERVE_REPLICA_UP, SERVE_REPLICA_LOST, SERVE_REPLICA_DRAINED,
         SERVE_REQUEST_FAILED, SERVE_REROUTED, SERVE_SCALE,
+        SERVE_PREFIX_HIT, SERVE_PREFIX_DROPPED,
         DATA_DISPATCH, DATA_ACK, DATA_REQUEUE, DATA_STEAL,
         DATA_EPOCH_COMPLETE, DATA_STATE_RESTORED,
         BRAIN_PREDICTED_FAILURE, BRAIN_PREDICTED_RAMP,

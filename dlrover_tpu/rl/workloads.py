@@ -87,6 +87,12 @@ class RolloutWorkload(_PolicyHolder):
                 prefill_delay_s=float(cfg.get("prefill_delay_s", 0.0)),
                 step_delay_s=float(cfg.get("step_delay_s", 0.002)),
             )
+        if cfg.get("prefix_cache"):
+            # agentic rollouts replay long shared conversation heads —
+            # the same structure chat serving has, same reuse win
+            from dlrover_tpu.serving.prefix_cache import PrefixCachingEngine
+
+            self._engine = PrefixCachingEngine(self._engine)
         self._buckets = tuple(cfg.get("buckets", (8, 16)))
         self._batcher = ContinuousBatcher(
             self._engine, buckets=self._buckets, prefill_workers=1)

@@ -20,6 +20,21 @@ while requests flow through a serving-specific data plane:
 - :mod:`registry` — the master-side replica table (journal + gauges);
 - :mod:`autoscaler` — the traffic-driven serving optimizer consumed by
   ``master/auto_scaler.py`` and the ROSE train↔serve coordinator;
-- :mod:`drill` — the shared closed-loop load harness (bench / e2e /
-  example) including the chaos replica-kill scenario.
+- :mod:`drill` — the shared load harnesses (bench / e2e / example): the
+  closed-loop chaos replica-kill drill and the open-loop traffic drill.
+
+The production-traffic performance layer (ROADMAP item 1, design in
+docs/design/serving_perf.md) rides on top without touching the
+scheduler contracts:
+
+- :mod:`prefix_cache` — radix trie over prefilled prompts; requests
+  sharing a cached prefix skip recomputing it (token-exact chunked
+  prefill), LRU under a byte budget, chaos site ``serve.prefix``;
+- :mod:`speculative` — draft-and-verify speculative decoding (small
+  drafter + one batched ``decode_window`` verify step per round),
+  greedy-token-identical to stock decode;
+- :mod:`traffic` — the seeded open-loop generator (Poisson/bursty
+  arrivals, diurnal envelopes, shared-prefix prompt mixtures) behind
+  the p99-TTFT-under-burst bench point;
+- int8 batched decode lives in :mod:`engine` (``quantize=True``).
 """

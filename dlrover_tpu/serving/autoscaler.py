@@ -46,6 +46,10 @@ class ServingSignals:
     inflight: int = 0
     ttft_p99_s: float = 0.0
     tokens_per_s: float = 0.0
+    # offered arrival rate (the open-loop generator's envelope view) —
+    # the LEADING signal the brain's pre-scaler trains against, vs the
+    # lagging queue/TTFT signals the reactive rules above use
+    offered_rps: float = 0.0
 
 
 @dataclass

@@ -79,6 +79,10 @@ class ContinuousBatcher:
                 f"{engine.cache_len}")
         self._max_new_cap = max_new_cap
         self._journal_fn = journal_fn
+        if journal_fn is not None and hasattr(engine, "attach_journal"):
+            # engine wrappers (prefix cache) journal into the same stream
+            # as request events — one timeline per replica
+            engine.attach_journal(journal_fn)
         self._idle_wait_s = idle_wait_s
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)

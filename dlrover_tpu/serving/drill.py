@@ -43,6 +43,127 @@ class _NoTrainingPlan:
         return ResourcePlan()
 
 
+def run_traffic_drill(
+    replicas: int = 1,
+    max_replicas: int = 2,
+    backend: str = "toy",
+    profile=None,
+    prefix_cache: bool = False,
+    slots: int = 2,
+    buckets: Sequence[int] = (16, 32, 48),
+    cache_len: int = 64,
+    step_delay_s: float = 0.01,
+    autoscale_interval_s: float = 0.2,
+    queue_hi: int = 3,
+    grow_cooldown_s: float = 0.3,
+    request_timeout_s: float = 30.0,
+    seed: int = 0,
+) -> Dict:
+    """The OPEN-LOOP drill: the traffic generator offers a seeded
+    bursty/ramping schedule that does not slow down when the plane
+    saturates, so the burst actually piles a queue and the reactive
+    autoscaler has something to react to. Returns the generator's
+    latency/throughput digest + the journal's scale decisions — the
+    p99-TTFT-under-burst point the bench records, and the
+    burst→grow-journaled fact the satellite test asserts."""
+    from dlrover_tpu.serving.traffic import OpenLoopGenerator, TrafficProfile
+
+    if profile is None:
+        profile = TrafficProfile(
+            rps=30.0, duration_s=4.0, arrival="bursty", burst_factor=4.0,
+            diurnal="ramp", length_mix=((0.7, 10, 16), (0.3, 16, 28)),
+            shared_prefix_frac=0.6, prefix_len=8, max_new_lo=4,
+            max_new_hi=8, seed=seed,
+        )
+    ctx = get_context()
+    saved = (ctx.heartbeat_interval_s, ctx.conn_drop_grace_s)
+    ctx.heartbeat_interval_s = 0.2
+    ctx.conn_drop_grace_s = 0.2
+    master = LocalJobMaster(job_name="serve-traffic-drill",
+                            node_num=max_replicas, min_nodes=1)
+    master.prepare()
+    manager = LocalReplicaManager(
+        master.addr,
+        live_fn=master.serve_registry.live,
+        backend=backend,
+        slots=slots,
+        buckets=buckets,
+        max_new_cap=profile.max_new_hi,
+        cache_len=cache_len,
+        heartbeat_interval_s=0.2,
+        seed=seed,
+        step_delay_s=step_delay_s if backend == "toy" else 0.0,
+        prefix_cache=prefix_cache,
+    )
+    router = RequestRouter(
+        replicas_fn=master.serve_registry.live,
+        journal_fn=lambda kind, **d: master.event_journal.record(
+            kind, source="router", **d),
+        request_timeout_s=request_timeout_s,
+    )
+    t_start = [0.0]
+
+    def signals() -> ServingSignals:
+        t = time.monotonic() - t_start[0] if t_start[0] else 0.0
+        return ServingSignals(
+            live_replicas=len(master.serve_registry.live()),
+            target_replicas=manager.target,
+            queue_depth=router.inflight(),
+            inflight=router.inflight(),
+            ttft_p99_s=router.ttft_p99(),
+            tokens_per_s=router.tokens_per_s(),
+            # leading signal: the generator's own offered envelope
+            offered_rps=gen.offered_rps(min(t, profile.duration_s)),
+        )
+
+    autoscaler = JobAutoScaler(
+        master.job_manager, master.perf_monitor, scaler=None,
+        optimizer=_NoTrainingPlan(),
+        interval_s=autoscale_interval_s,
+        serving_optimizer=ServingOptimizer(
+            min_replicas=replicas, max_replicas=max_replicas,
+            queue_hi=queue_hi, grow_cooldown_s=grow_cooldown_s,
+            shrink_cooldown_s=3600.0,
+        ),
+        serving_signals=signals,
+        serve_scaler=manager,
+        event_journal=master.event_journal,
+    )
+    gen = OpenLoopGenerator(
+        lambda prompt, max_new: router.submit(
+            prompt, max_new, deadline_s=request_timeout_s),
+        profile,
+    )
+    try:
+        manager.scale_to(replicas, reason="traffic drill start")
+        if not manager.wait_live(replicas, timeout_s=60.0):
+            raise RuntimeError("replicas failed to register")
+        autoscaler.start()
+        t_start[0] = time.monotonic()
+        stats = gen.run()
+        kinds: Dict[str, int] = {}
+        grow_events = 0
+        for e in master.event_journal.events():
+            kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+            if (e["kind"] == JournalEvent.SERVE_SCALE
+                    and "grow" in e.get("data", {}).get("reason", "")):
+                grow_events += 1
+        stats.update({
+            "backend": backend,
+            "replicas_start": replicas,
+            "live_replicas_end": len(master.serve_registry.live()),
+            "grow_events": grow_events,
+            "lost": router.lost,
+            "journal": kinds,
+        })
+        return stats
+    finally:
+        autoscaler.stop()
+        manager.stop_all()
+        master.stop()
+        ctx.heartbeat_interval_s, ctx.conn_drop_grace_s = saved
+
+
 def run_serving_drill(
     replicas: int = 2,
     backend: str = "toy",

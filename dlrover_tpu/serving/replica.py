@@ -40,7 +40,7 @@ from typing import Callable, Dict, List, Optional
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common import comm, fabric
 from dlrover_tpu.common.config import get_context
-from dlrover_tpu.common.constants import SpanName
+from dlrover_tpu.common.constants import ConfigKey, SpanName, env_flag
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCServer
 from dlrover_tpu.observability import tracing
@@ -189,10 +189,19 @@ class DecodeReplica:
             try:
                 if inj is not None:
                     inj.fire(SERVE_REPLICA_SITE, node_id=self.node_id)
-                resp = self._client.heartbeat(gauges={
+                gauges = {
                     "serve_queue_depth": float(self._batcher.queue_depth()),
                     "serve_active_slots": float(self._batcher.active()),
-                })
+                }
+                engine = self._batcher._engine
+                if hasattr(engine, "stats"):  # prefix-caching wrapper:
+                    # hit-rate/savings ride the existing heartbeat gauge
+                    # channel to the master's telemetry spine
+                    st = engine.stats()
+                    gauges["serve_prefix_hit_rate"] = float(st["hit_rate"])
+                    gauges["serve_prefix_tokens_saved"] = float(
+                        st["tokens_saved"])
+                resp = self._client.heartbeat(gauges=gauges)
                 if resp.action_type == "job_abort":
                     logger.warning("replica %s told to abort", self.node_id)
                     self._stop_evt.set()
@@ -302,6 +311,8 @@ class LocalReplicaManager:
         drain_fn: Optional[Callable[[str], None]] = None,
         step_delay_s: float = 0.0,
         prefill_delay_s: float = 0.0,
+        quantize: bool = False,
+        prefix_cache: bool = False,
     ):
         self._master_addr = master_addr
         self._live_fn = live_fn
@@ -318,6 +329,8 @@ class LocalReplicaManager:
         # mid-traffic kill actually lands mid-traffic
         self._step_delay_s = step_delay_s
         self._prefill_delay_s = prefill_delay_s
+        self._quantize = quantize
+        self._prefix_cache = prefix_cache
         self._lock = threading.Lock()
         self._procs: Dict[int, subprocess.Popen] = {}
         self._poll_evt = threading.Event()  # pacing only, never set
@@ -347,6 +360,10 @@ class LocalReplicaManager:
                 "--step-delay-s", str(self._step_delay_s),
                 "--prefill-delay-s", str(self._prefill_delay_s),
             ]
+            if self._quantize:
+                cmd.append("--quantize")
+            if self._prefix_cache:
+                cmd.append("--prefix-cache")
             self._procs[node_id] = subprocess.Popen(cmd,
                                                     env=self._spawn_env())
         logger.info("spawned replica subprocess node %s", node_id)
@@ -443,19 +460,27 @@ class LocalReplicaManager:
 
 
 def _build_engine(args):
+    from dlrover_tpu.serving.prefix_cache import maybe_wrap_prefix_cache
+
     if args.backend == "toy":
         from dlrover_tpu.serving.engine import ToyEngine
 
-        return ToyEngine(slots=args.slots, vocab=args.vocab,
-                         cache_len=args.cache_len,
-                         prefill_delay_s=args.prefill_delay_s,
-                         step_delay_s=args.step_delay_s)
-    from dlrover_tpu.serving.engine import build_tiny_engine
+        engine = ToyEngine(slots=args.slots, vocab=args.vocab,
+                           cache_len=args.cache_len,
+                           prefill_delay_s=args.prefill_delay_s,
+                           step_delay_s=args.step_delay_s)
+    else:
+        from dlrover_tpu.serving.engine import build_tiny_engine
 
-    return build_tiny_engine(
-        slots=args.slots, cache_len=args.cache_len, vocab=args.vocab,
-        dim=args.dim, n_layers=args.n_layers, seed=args.seed,
-    )
+        engine = build_tiny_engine(
+            slots=args.slots, cache_len=args.cache_len, vocab=args.vocab,
+            dim=args.dim, n_layers=args.n_layers, seed=args.seed,
+            quantize=args.quantize,
+        )
+    # prefix reuse is an engine property (the batcher consumes the
+    # wrapper unchanged); the flag defaults to DLROVER_TPU_SERVE_PREFIX
+    return maybe_wrap_prefix_cache(engine,
+                                   enabled=args.prefix_cache or None)
 
 
 def main(argv=None) -> int:
@@ -475,6 +500,16 @@ def main(argv=None) -> int:
     parser.add_argument("--hb-interval-s", type=float, default=None)
     parser.add_argument("--step-delay-s", type=float, default=0.0)
     parser.add_argument("--prefill-delay-s", type=float, default=0.0)
+    # serving-performance knobs; defaults follow the env so a fleet can
+    # be flipped without touching every spawn site
+    parser.add_argument(
+        "--quantize", action="store_true",
+        default=env_flag(ConfigKey.SERVE_QUANT, False),
+        help="int8 KV cache in the batched engine (jax backend)")
+    parser.add_argument(
+        "--prefix-cache", action="store_true",
+        default=env_flag(ConfigKey.SERVE_PREFIX, False),
+        help="radix prefix-cache reuse across requests")
     args = parser.parse_args(argv)
     replica = DecodeReplica(
         master_addr=args.master,

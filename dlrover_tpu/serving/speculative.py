@@ -1,0 +1,176 @@
+"""Draft-and-verify speculative decoding over the stock decode path.
+
+A small DRAFT model proposes ``k`` greedy tokens one step at a time
+(cheap — its forward is a fraction of the target's), then the TARGET
+model verifies all of them in ONE batched window step
+(:func:`models.decode.decode_window`): the window ``[last, d_1 … d_k]``
+produces the target's greedy continuation ``g_1 … g_{k+1}`` in a single
+forward whose cost is close to one decode step (the weights are read
+once, not k+1 times). The longest matching prefix of the draft is
+accepted, plus one token the target computed itself — the correction at
+the first mismatch, or the bonus ``g_{k+1}`` when everything matched.
+
+**Greedy acceptance is token-identical to stock decode**: every emitted
+token is the target's own argmax given the previously emitted tokens —
+accepted drafts BECAUSE they equal ``g_i``, the correction/bonus by
+construction. The draft model affects only throughput (mean accepted
+length), never content. The per-round cache rewind relies on the decode
+mask (`pos`-bounded) making rows past the rewound position invisible:
+rejected draft rows become garbage the next window overwrites before any
+mask reveals it — the same argument that makes the batched engine's
+padded prefill safe.
+
+Per-request stats land in a ``shared``-registered map (the race
+certification drill churns concurrent speculative sessions).
+"""
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.analysis.race_detector import shared
+from dlrover_tpu.common.constants import ConfigKey, env_int
+
+_DEFAULT_K = 4
+
+
+class SpeculativeDecoder:
+    """One target/draft model pair; :meth:`generate` runs greedy
+    speculative decoding for a single sequence. Thread-safe for
+    concurrent ``generate`` calls (each call owns its caches; the shared
+    stats map is lock-guarded)."""
+
+    def __init__(self, target_params, target_config, draft_params,
+                 draft_config, k: Optional[int] = None,
+                 quantize: bool = False):
+        import jax
+
+        from dlrover_tpu.models import decode
+
+        if target_config.vocab_size != draft_config.vocab_size:
+            raise ValueError(
+                "target and draft must share a vocabulary "
+                f"({target_config.vocab_size} vs {draft_config.vocab_size})")
+        self.k = max(1, k if k is not None
+                     else env_int(ConfigKey.SERVE_SPEC_K, _DEFAULT_K))
+        self._tp = target_params
+        self._dp = draft_params
+        self._tc = target_config
+        self._dc = draft_config
+        self._quantize = quantize
+        # one trace per (prompt bucket); the window shape is fixed at
+        # K = k+1 so the verify leg compiles exactly once
+        self._window = jax.jit(
+            lambda p, toks, cache: decode.decode_window(
+                p, toks, cache, target_config))
+        self._tstep = jax.jit(
+            lambda p, tok, cache: decode.decode_step(
+                p, tok, cache, target_config))
+        self._dstep = jax.jit(
+            lambda p, tok, cache: decode.decode_step(
+                p, tok, cache, draft_config))
+        self._lock = threading.Lock()
+        # request_id -> per-request acceptance stats (race-certified)
+        self.sessions = shared({}, "serve.spec_sessions")
+
+    # -- internals ---------------------------------------------------------
+
+    def _prefill(self, params, config, prompt_arr, max_len):
+        from dlrover_tpu.models import decode
+
+        return decode.prefill(params, prompt_arr, config, max_len,
+                              quantize=self._quantize)
+
+    # -- public API --------------------------------------------------------
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int,
+                 request_id: str = "") -> Tuple[List[int], Dict]:
+        """Greedy speculative generation → (tokens, stats). ``tokens``
+        match ``decode.generate(..., temperature=0)`` for the target
+        model; ``stats['mean_accepted']`` is the measured speedup lever
+        (tokens emitted per target window step)."""
+        import jax.numpy as jnp
+
+        P = len(prompt)
+        k = self.k
+        # window rows write up to k+1 slots past the current position
+        max_len = P + max_new_tokens + k + 1
+        prompt_arr = jnp.asarray([list(prompt)], jnp.int32)
+        t_logits, t_cache = self._prefill(self._tp, self._tc, prompt_arr,
+                                          max_len)
+        d_logits, d_cache = self._prefill(self._dp, self._dc, prompt_arr,
+                                          max_len)
+        del d_logits  # the drafter chains from the COMMITTED stream
+        tokens = [int(jnp.argmax(t_logits[0]))]
+        rounds = drafted = accepted = 0
+        while len(tokens) < max_new_tokens:
+            last = tokens[-1]
+            # draft k tokens; the k+1-th step only WRITES d_k's cache row
+            # (needed when every draft is accepted and d_k becomes part
+            # of the committed history the next round attends)
+            drafts: List[int] = []
+            cur = last
+            for i in range(k + 1):
+                lg, d_cache = self._dstep(
+                    self._dp, jnp.asarray([cur], jnp.int32), d_cache)
+                nxt = int(jnp.argmax(lg[0]))
+                if i < k:
+                    drafts.append(nxt)
+                    cur = nxt
+            # verify: one batched target step over the whole window
+            t_pos = int(t_cache["pos"])
+            window = jnp.asarray([[last] + drafts], jnp.int32)
+            wl, t_cache = self._window(self._tp, window, t_cache)
+            greedy = [int(t) for t in jnp.argmax(wl[0], axis=-1)]
+            a = 0
+            while a < k and drafts[a] == greedy[a]:
+                a += 1
+            # accepted drafts + the target's own next token (correction
+            # at the mismatch, bonus g_{k+1} on a full accept)
+            tokens.extend(drafts[:a] + [greedy[a]])
+            rounds += 1
+            drafted += k
+            accepted += a
+            # rewind: rows are valid through the last ACCEPTED token;
+            # later rows are rejected-draft garbage the pos mask hides
+            new_pos = t_pos + 1 + a
+            t_cache["pos"] = jnp.int32(new_pos)
+            d_cache["pos"] = jnp.int32(new_pos)
+        tokens = tokens[:max_new_tokens]
+        stats = {
+            "rounds": rounds,
+            "drafted": drafted,
+            "accepted": accepted,
+            "acceptance_rate": accepted / drafted if drafted else 0.0,
+            # emitted tokens per target window step (prefill token aside)
+            "mean_accepted": ((len(tokens) - 1) / rounds
+                              if rounds else 0.0),
+        }
+        if request_id:
+            with self._lock:
+                self.sessions[request_id] = stats
+        return tokens, stats
+
+
+def build_tiny_spec_pair(vocab: int = 32, cache_len: int = 64,
+                         seed: int = 0, k: Optional[int] = None,
+                         quantize: bool = False) -> SpeculativeDecoder:
+    """CPU-sized target/draft pair sharing a vocabulary: the target is
+    the tiny serving model, the draft a half-width single-layer sibling.
+    Deterministic per seed (the exactness tests replay both sides)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models.llama import LlamaConfig, init_params
+
+    target_config = LlamaConfig(
+        vocab_size=vocab, dim=16, n_layers=2, n_heads=2, n_kv_heads=1,
+        ffn_dim=64, max_seq_len=cache_len, dtype=jnp.float32, remat=False,
+    )
+    draft_config = LlamaConfig(
+        vocab_size=vocab, dim=8, n_layers=1, n_heads=1, n_kv_heads=1,
+        ffn_dim=32, max_seq_len=cache_len, dtype=jnp.float32, remat=False,
+    )
+    target_params = init_params(target_config, jax.random.PRNGKey(seed))
+    draft_params = init_params(draft_config, jax.random.PRNGKey(seed + 1))
+    return SpeculativeDecoder(target_params, target_config, draft_params,
+                              draft_config, k=k, quantize=quantize)

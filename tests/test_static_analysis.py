@@ -798,12 +798,15 @@ def test_baseline_burn_down_floor():
     down to ≤72, PR 12 from 72 down to ≤68, PR 13 from 68 down to ≤66
     (flash_attention.py bwd block-size env reads moved onto ConfigKey +
     env_int), PR 14 from 66 down to ≤59 (unified master/scheduler
-    deadline math moved off time.time() onto time.monotonic()). If this
-    fails with a LOWER count, ratchet the floor down in this test; if
-    with a higher one, a deferral leaked in — fix it instead."""
+    deadline math moved off time.time() onto time.monotonic()), PR 15
+    from 59 down to ≤56 (decode.py FLASH_DECODE env read onto
+    ConfigKey, event.py span durations onto time.monotonic() and
+    EVENT_DIR onto ConfigKey). If this fails with a LOWER count,
+    ratchet the floor down in this test; if with a higher one, a
+    deferral leaked in — fix it instead."""
     baseline_total = sum(load_baseline().values())
-    assert baseline_total <= 59, (
-        f"baseline grew to {baseline_total} entries (must stay ≤59); "
+    assert baseline_total <= 56, (
+        f"baseline grew to {baseline_total} entries (must stay ≤56); "
         "fix the new violations instead of deferring them"
     )
 
