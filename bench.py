@@ -1700,6 +1700,144 @@ def bench_serving_perf(budget_s: float = 120.0) -> dict:
     return out
 
 
+def bench_serving_slo(budget_s: float = 120.0) -> dict:
+    """Request-level serving observability (docs/design/
+    serving_observability.md). Three claims on the record:
+
+    - **tracing overhead ≤ 3%**: the per-request waterfall spans
+      (queue/prefill/first-step/decode on every request) cost under 3%
+      of closed-loop tokens/s vs the DLROVER_TPU_TRACE=0 no-op path;
+    - **burn-rate lead time**: under the bursty mixture with a tight
+      TTFT objective, the SLO plane's journaled ``slo_burn_alert``
+      leads the reactive autoscaler's queue-depth grow (the
+      ``slo_lead_s`` the drill measures from journal timestamps);
+    - **tail-cause histogram**: the attributor's six-cause breakdown of
+      the slow percentile on the chat mixture.
+    """
+    if os.environ.get("BENCH_SKIP_CHAOS"):
+        # subprocess replica drills, like bench_serving — the CI smoke
+        # skips them; every gate is already pinned by tier-1
+        # (tests/test_serving_observability.py)
+        return {"skipped": "BENCH_SKIP_CHAOS set"}
+    import uuid as _uuid
+
+    from dlrover_tpu.common.constants import ConfigKey
+    from dlrover_tpu.observability import tracing
+    from dlrover_tpu.observability.registry import MetricsRegistry
+    from dlrover_tpu.serving.drill import (
+        run_serving_drill,
+        run_traffic_drill,
+    )
+
+    out: dict = {}
+    t_start = time.monotonic()
+
+    # -- tracing on/off throughput (closed loop, throughput bound) -------
+    try:
+        tps = {}
+        saved_trace = os.environ.get(ConfigKey.TRACE)
+        try:
+            for name, flag in (("off", "0"), ("on", "1")):
+                # the env reaches the replica SUBPROCESSES; reset the
+                # local tracer too so the router side matches
+                os.environ[ConfigKey.TRACE] = flag
+                tracing.reset_tracer()
+                best = 0.0
+                for _ in range(2):  # best-of-2: subprocess jitter
+                    r = run_serving_drill(
+                        replicas=1, backend="toy", num_requests=48,
+                        concurrency=8, kill_mid_traffic=False,
+                        step_delay_s=0.002)
+                    best = max(best, r["tokens_per_s"])
+                tps[name] = best
+        finally:
+            if saved_trace is None:
+                os.environ.pop(ConfigKey.TRACE, None)
+            else:
+                os.environ[ConfigKey.TRACE] = saved_trace
+            tracing.reset_tracer()
+        overhead = (1.0 - tps["on"] / tps["off"]) if tps["off"] else 0.0
+        out.update({
+            "tokens_per_s_tracing_off": round(tps["off"], 1),
+            "tokens_per_s_tracing_on": round(tps["on"], 1),
+            "tracing_overhead_frac": round(overhead, 4),
+            "tracing_overhead_ok": overhead <= 0.03,
+        })
+    except Exception as e:  # noqa: BLE001 — record the failure, move on
+        out["overhead_error"] = repr(e)
+
+    # -- burn-rate detection lead vs the reactive grow -------------------
+    try:
+        saved_slo = os.environ.get(ConfigKey.SERVE_TTFT_SLO_S)
+        try:
+            # objective below the contended TTFT so budget burns from
+            # the first burst; the reactive optimizer keeps a LOOSE ttft
+            # threshold so its grow comes from the queue rule alone
+            os.environ[ConfigKey.SERVE_TTFT_SLO_S] = "0.011"
+            r = run_traffic_drill(seed=5, ttft_slo_s=30.0)
+        finally:
+            if saved_slo is None:
+                os.environ.pop(ConfigKey.SERVE_TTFT_SLO_S, None)
+            else:
+                os.environ[ConfigKey.SERVE_TTFT_SLO_S] = saved_slo
+        out.update({
+            "burn_alerts": r["slo_alerts"],
+            "burn_first_alert_t_s": r["first_alert_t"],
+            "reactive_first_grow_t_s": r["first_grow_t"],
+            "burn_lead_s": r["slo_lead_s"],
+            "burn_alert_led_grow": (
+                r["slo_lead_s"] is not None and r["slo_lead_s"] > 0),
+            "burn_drill_lost": r["lost"],
+        })
+    except Exception as e:  # noqa: BLE001
+        out["burn_error"] = repr(e)
+
+    # -- tail-cause histogram on the chat mixture ------------------------
+    try:
+        from dlrover_tpu.serving.batcher import ContinuousBatcher
+        from dlrover_tpu.serving.engine import ToyEngine
+        from dlrover_tpu.serving.tail import TailAttributor
+        from dlrover_tpu.serving.traffic import (
+            OpenLoopGenerator,
+            TrafficProfile,
+        )
+
+        tail = TailAttributor(registry=MetricsRegistry(), min_window=20)
+        # a burst rate past the prefill service rate piles the admission
+        # queue, so the tail mixes queued-out requests (cause "queue")
+        # with slot-sharing decode ones ("batch_interference")
+        batcher = ContinuousBatcher(
+            ToyEngine(slots=4, step_delay_s=0.002,
+                      prefill_delay_s=0.004),
+            buckets=(16, 32), max_new_cap=8, on_complete=tail.observe)
+        batcher.start()
+        try:
+            def submit(prompt, max_new):
+                p = batcher.submit(_uuid.uuid4().hex[:12], prompt,
+                                   max_new)
+                p.done.wait(30.0)
+                return not p.error
+
+            gen = OpenLoopGenerator(submit, TrafficProfile(
+                rps=60.0, duration_s=2.0, arrival="bursty",
+                burst_factor=4.0, shared_prefix_frac=0.6, prefix_len=8,
+                length_mix=((0.6, 10, 16), (0.4, 16, 28)),
+                max_new_lo=4, max_new_hi=8, seed=7), workers=64)
+            stats = gen.run()
+        finally:
+            batcher.stop()
+        out.update({
+            "tail_offered": stats["offered"],
+            "tail_attributed": tail.attributed,
+            "tail_causes": {c: n for c, n in tail.cause_counts.items()
+                            if n},
+        })
+    except Exception as e:  # noqa: BLE001
+        out["tail_error"] = repr(e)
+    out["elapsed_s"] = round(time.monotonic() - t_start, 1)
+    return out
+
+
 def bench_data(budget_s: float = 90.0) -> dict:
     """Elastic data plane (master/task_manager.py +
     trainer/data_plane.py): shard-dispatch throughput through the real
@@ -1882,6 +2020,8 @@ _SECTIONS = (
     ("serving", lambda left: bench_serving(budget_s=min(left, 120.0)), 45.0),
     ("serving_perf",
      lambda left: bench_serving_perf(budget_s=min(left, 120.0)), 45.0),
+    ("serving_slo",
+     lambda left: bench_serving_slo(budget_s=min(left, 120.0)), 40.0),
     ("data", lambda left: bench_data(budget_s=min(left, 90.0)), 30.0),
     # brain: pure simulation on a fake clock — seconds of wall time
     ("brain", lambda left: bench_brain(budget_s=min(left, 60.0)), 15.0),
@@ -1980,6 +2120,9 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
             "prefix_tokens_saved", "prefix_prefill_speedup",
             "spec_mean_accepted_self_draft", "burst_ttft_p99_s",
             "burst_grow_events", "scale_efficiency_2x")),
+        "serving_slo": pick(detail.get("serving_slo") or {}, (
+            "tracing_overhead_frac", "tracing_overhead_ok",
+            "burn_lead_s", "burn_alert_led_grow", "tail_attributed")),
         "data": pick(detail.get("data") or {}, (
             "dispatch_ack_per_s", "prefetch_occupancy_mean",
             "requeue_leases", "requeue_latency_ms")),

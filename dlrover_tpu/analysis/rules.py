@@ -48,6 +48,13 @@ Rule catalogue (motivating incidents in docs/design/static_analysis.md):
   final name (the crash window the chain chaos drills SIGKILL into), and
   a bare ``open(manifest, "w")`` outside ``ckpt/manifest.py`` bypasses
   the write-temp → fsync → atomic-replace commit helper entirely.
+- DLR013: unbounded metric label values. A ``.labels(...)`` value fed
+  from an open set (request ids, prompts, trace ids, addresses, or any
+  f-string/format composition) mints a new timeseries per distinct
+  value — scrape cardinality grows with traffic until the registry IS
+  the memory leak. Label values come from bounded constant vocabularies
+  (``constants.MetricLabel``); per-request detail rides exemplars and
+  traces instead.
 """
 
 import ast
@@ -840,3 +847,70 @@ def rule_dlr012_atomic_commit_discipline(
                 "fsync → atomic replace)",
                 lines,
             )
+
+
+# -- DLR013: unbounded metric label values ------------------------------------
+
+# identifiers whose value is an open set: one timeseries per request /
+# prompt / trace / endpoint. ``source``, ``reason``, ``cause``, ``rank``
+# etc. are deliberately absent — those vocabularies are bounded by the
+# code or the fleet shape.
+_UNBOUNDED_IDENT_RE = re.compile(
+    r"(request_id|prompt|trace|span_id|uuid|addr|host|url|path|token)",
+    re.IGNORECASE,
+)
+
+
+def _unbounded_label_reason(val: ast.expr) -> str:
+    """Why this label-value expression draws from an open set; '' when
+    it looks bounded. Composition (f-string / .format / string +) is
+    unbounded by construction; otherwise any embedded identifier with an
+    id-ish name marks the flow."""
+    if isinstance(val, ast.JoinedStr) and any(
+        isinstance(part, ast.FormattedValue) for part in val.values
+    ):
+        return "f-string composition"
+    for sub in ast.walk(val):
+        if isinstance(sub, ast.Call) and _dotted(sub.func).rsplit(
+            ".", 1
+        )[-1] == "format":
+            return "str.format composition"
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Add) and (
+            isinstance(sub.left, ast.Constant)
+            or isinstance(sub.right, ast.Constant)
+        ):
+            return "string concatenation"
+        ident = ""
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        if ident and _UNBOUNDED_IDENT_RE.search(ident):
+            return f"value flows from {ident!r}"
+    return ""
+
+
+@_rule
+def rule_dlr013_unbounded_metric_labels(
+    tree: ast.AST, path: str, lines: List[str]
+) -> Iterator[Violation]:
+    """metric label values must come from bounded constant sets."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr != "labels":
+            continue
+        for val in list(node.args) + [kw.value for kw in node.keywords]:
+            reason = _unbounded_label_reason(val)
+            if reason:
+                yield _violation(
+                    "DLR013", path, val,
+                    f"metric label value looks unbounded ({reason}) — "
+                    "one timeseries per distinct value melts the scrape; "
+                    "label values come from bounded vocabularies "
+                    "(constants.MetricLabel), per-request detail rides "
+                    "exemplars/traces (or # noqa with why it is bounded)",
+                    lines,
+                )

@@ -400,11 +400,20 @@ class BrainAdvisor:
         self._settle("ramp", lambda p: load >= p["threshold"],
                      outcome="hit", actual={"load": load})
         slope = self.forecaster.slope_per_s()
-        if slope < self._ramp_min_slope:
+        # SLO budget burn is a LEADING breach signal: when the fast
+        # window is burning at >=1x the plane already knows the tier
+        # objective is failing, so bypass the slope gate (a burst can
+        # burn budget before the load slope looks like a ramp)
+        burning = float(getattr(signals, "slo_burn_rate", 0.0)) >= 1.0
+        if slope < self._ramp_min_slope and not burning:
             return None
         predicted = self.forecaster.forecast(self._horizon_s)
         target = signals.target_replicas
         needed = int(math.ceil(predicted / self._cap_per_replica))
+        if burning:
+            # budget is burning NOW — predicted load alone may lag the
+            # burst; demand at least one replica beyond the current set
+            needed = max(needed, target + 1)
         if needed <= target:
             return None
         if not self._cooled("serve_prescale"):

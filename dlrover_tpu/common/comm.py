@@ -397,6 +397,10 @@ class ServeGenerateRequest:
     request_id: str = ""
     prompt: List[int] = field(default_factory=list)
     max_new_tokens: int = 16
+    # set by the router on every attempt after the first: the replica's
+    # tail attributor needs to know a slow request already burned time on
+    # a failed/refusing replica (cause class "reroute")
+    rerouted: bool = False
 
 
 @message
@@ -410,6 +414,9 @@ class ServeGenerateResponse:
     tpot_s: float = 0.0
     queue_depth: int = 0
     replica_id: int = -1
+    # the request's end-to-end trace id (the router's serve.route span
+    # roots it) — responses link back to the waterfall without a label
+    trace_id: str = ""
 
 
 @message

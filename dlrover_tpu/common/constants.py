@@ -362,6 +362,22 @@ class ConfigKey:
     TRACE_RING = "DLROVER_TPU_TRACE_RING"
     TRACE_DIR = "DLROVER_TPU_TRACE_DIR"
     TRACE_BUNDLE_COOLDOWN_S = "DLROVER_TPU_TRACE_BUNDLE_COOLDOWN_S"
+    # serving SLO plane (observability/slo.py): goodput floor (fraction of
+    # requests that must complete OK), the fast/slow burn-rate evaluation
+    # windows, the burn-rate threshold both windows must exceed before an
+    # alert journals, and the alert re-fire cooldown
+    SERVE_GOODPUT_SLO = "DLROVER_TPU_SERVE_GOODPUT_SLO"
+    SERVE_SLO_BURN_FAST_S = "DLROVER_TPU_SERVE_SLO_BURN_FAST_S"
+    SERVE_SLO_BURN_SLOW_S = "DLROVER_TPU_SERVE_SLO_BURN_SLOW_S"
+    SERVE_SLO_BURN_RATE = "DLROVER_TPU_SERVE_SLO_BURN_RATE"
+    SERVE_SLO_ALERT_COOLDOWN_S = "DLROVER_TPU_SERVE_SLO_ALERT_COOLDOWN_S"
+    # tail-latency attribution (serving/tail.py): the slow percentile a
+    # request must exceed to be attributed, the minimum completed-request
+    # window before attribution starts, and how many worst request traces
+    # a replica's flight-recorder bundle carries
+    SERVE_TAIL_PCTL = "DLROVER_TPU_SERVE_TAIL_PCTL"
+    SERVE_TAIL_MIN_WINDOW = "DLROVER_TPU_SERVE_TAIL_MIN_WINDOW"
+    SERVE_TRACE_WORST = "DLROVER_TPU_SERVE_TRACE_WORST"
 
 
 class SpanName:
@@ -411,6 +427,15 @@ class SpanName:
     SERVE_PREFILL = "serve.prefill"
     SERVE_DRAIN = "serve.drain"
     SERVE_SCALE = "serve.scale"
+    # per-request waterfall segments (serving/batcher.py): the TTFT
+    # decomposition queue-wait → prefill-compute → first-step, then one
+    # decode segment spanning t_first → t_done; spec_verify brackets one
+    # speculative verify leg (serving/speculative.py)
+    SERVE_QUEUE_WAIT = "serve.queue_wait"
+    SERVE_PREFILL_COMPUTE = "serve.prefill_compute"
+    SERVE_FIRST_STEP = "serve.first_step"
+    SERVE_DECODE = "serve.decode"
+    SERVE_SPEC_VERIFY = "serve.spec_verify"
     # agentic-RL rollout plane (dlrover_tpu/rl/): the learner-side
     # publish→fan-out of one weight version, the replica-side fabric
     # import of it (same trace: the sync version rides the wire context),
@@ -423,10 +448,35 @@ class SpanName:
     FAULT_RELAUNCH = "fault.relaunch"
     AGENT_RESTART_WORKERS = "agent.restart_workers"
     AGENT_STACK_DUMP = "agent.stack_dump"
-    # span events (retry plane, chaos plane)
+    # span events (retry plane, chaos plane, serving reroutes)
     EVT_RPC_RETRY = "rpc.retry"
     EVT_BREAKER_OPEN = "rpc.breaker_open"
     EVT_FAULT_INJECTED = "chaos.fault_injected"
+    EVT_SERVE_REROUTED = "serve.rerouted"
+
+
+class MetricLabel:
+    """Bounded label-value vocabularies for metric families. Label values
+    drawn from open sets (request ids, prompts, trace ids, addresses)
+    explode scrape cardinality at fleet scale — rule DLR013 rejects
+    ``.labels(...)`` call sites whose values look prompt- or id-derived,
+    so per-request detail rides EXEMPLARS and traces instead of labels."""
+
+    # dominant cause classes the TailAttributor (serving/tail.py) assigns
+    # to a slow-percentile request from its span tree
+    TAIL_QUEUE = "queue"
+    TAIL_PREFILL = "prefill"
+    TAIL_BATCH_INTERFERENCE = "batch_interference"
+    TAIL_SPECULATIVE_MISS = "speculative_miss"
+    TAIL_PREFIX_MISS = "prefix_miss"
+    TAIL_REROUTE = "reroute"
+    TAIL_CAUSES = (
+        TAIL_QUEUE, TAIL_PREFILL, TAIL_BATCH_INTERFERENCE,
+        TAIL_SPECULATIVE_MISS, TAIL_PREFIX_MISS, TAIL_REROUTE,
+    )
+    # SLO burn windows (observability/slo.py)
+    WINDOW_FAST = "fast"
+    WINDOW_SLOW = "slow"
 
 
 class GRPC:

@@ -722,7 +722,7 @@ def _record_metrics(stats: Dict[str, Any], outcome: str) -> None:
         labelnames=("source",),
     )
     for addr, n in stats.get("bytes_by_source", {}).items():
-        by_source.labels(source=addr).inc(n)
+        by_source.labels(source=addr).inc(n)  # noqa: DLR013 — source addresses are bounded by the fleet size, not by traffic
     reg.counter(
         "dlrover_fabric_stripe_retries_total",
         "Stripes re-queued after a source failure or CRC reject",

@@ -1,8 +1,9 @@
 """Process-wide logger (reference: dlrover/python/common/log.py)."""
 
 import logging
-import os
 import sys
+
+from dlrover_tpu.common.constants import ConfigKey, env_str
 
 _FORMAT = (
     "[%(asctime)s] [%(levelname)s] "
@@ -14,7 +15,7 @@ def _build_logger() -> logging.Logger:
     logger = logging.getLogger("dlrover_tpu")
     if logger.handlers:
         return logger
-    level_name = os.getenv("DLROVER_TPU_LOG_LEVEL", "INFO").upper()
+    level_name = env_str(ConfigKey.LOG_LEVEL, "INFO").upper()
     logger.setLevel(getattr(logging, level_name, logging.INFO))
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(logging.Formatter(_FORMAT))

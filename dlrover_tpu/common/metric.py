@@ -41,7 +41,8 @@ class NodeMetrics:
     """One host's sample: CPU/mem + its chips (reference NodeGpuMetric)."""
 
     node_id: int = 0
-    timestamp: float = field(default_factory=time.time)
+    # monotonic: only ever COMPARED (window cutoffs), never reported
+    timestamp: float = field(default_factory=time.monotonic)
     cpu_percent: float = 0.0
     mem_percent: float = 0.0
     mem_used_mb: float = 0.0
@@ -83,7 +84,7 @@ class JobMetricContext:
             return series[-1] if series else None
 
     def window(self, node_id: int, seconds: float) -> List[NodeMetrics]:
-        cutoff = time.time() - seconds
+        cutoff = time.monotonic() - seconds
         with self._lock:
             return [
                 m for m in self._series.get(node_id, [])

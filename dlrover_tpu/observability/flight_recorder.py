@@ -101,11 +101,15 @@ class FlightRecorder:
         journal=None,
         registry=None,
         cooldown_s: Optional[float] = None,
+        worst_traces_fn=None,
     ):
         self.source = source
         self.out_dir = out_dir or default_trace_dir()
         self.journal = journal
         self.registry = registry
+        # () -> list of worst-request summaries (TailAttributor on a
+        # serving replica): bundles then embed the N worst waterfalls
+        self.worst_traces_fn = worst_traces_fn
         self.cooldown_s = (
             env_float(ConfigKey.TRACE_BUNDLE_COOLDOWN_S, DEFAULT_COOLDOWN_S)
             if cooldown_s is None else cooldown_s
@@ -186,6 +190,11 @@ class FlightRecorder:
             now_t = None
             t0 = None
         events = tracing.to_chrome_events(finished + live, t0=t0)
+        from dlrover_tpu.observability.timeline import (
+            serving_request_events,
+        )
+
+        events.extend(serving_request_events(finished + live, t0=t0))
         if journal_dict is not None:
             from dlrover_tpu.observability.timeline import (
                 brain_track_events,
@@ -198,6 +207,26 @@ class FlightRecorder:
             events.extend(brain_track_events(journal_dict))
         with open(os.path.join(bundle_dir, "traces.json"), "w") as f:
             json.dump({"traceEvents": events}, f)
+
+        worst = None
+        if self.worst_traces_fn is not None:
+            try:
+                worst = list(self.worst_traces_fn())
+            except Exception:  # noqa: BLE001 — optional serving detail,
+                # never the reason a crash bundle fails to write
+                logger.warning("worst-request dump failed", exc_info=True)
+            if worst is not None:
+                span_index = {}
+                for sp in finished + live:
+                    span_index.setdefault(sp.trace_id, []).append(
+                        sp.to_dict())
+                with open(os.path.join(bundle_dir, "worst_requests.json"),
+                          "w") as f:
+                    json.dump([
+                        dict(rec, spans=span_index.get(
+                            rec.get("trace_id"), []))
+                        for rec in worst
+                    ], f)
 
         if journal_dict is not None:
             with open(os.path.join(bundle_dir, "journal.json"), "w") as f:

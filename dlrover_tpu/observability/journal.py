@@ -97,6 +97,14 @@ class JournalEvent:
     # informational.
     SERVE_PREFIX_HIT = "serve_prefix_hit"
     SERVE_PREFIX_DROPPED = "serve_prefix_dropped"
+    # serving SLO plane (observability/slo.py): multi-window burn-rate
+    # breach — both the fast and slow windows are consuming error budget
+    # faster than the configured rate; data carries {slo, window, rate}.
+    # tail attribution (serving/tail.py): a slow-percentile request's
+    # dominant cause classified from its span tree; data carries
+    # {cause, trace_id, latency_s, segments}. Both informational.
+    SLO_BURN_ALERT = "slo_burn_alert"
+    REQUEST_TAIL_ATTRIBUTED = "request_tail_attributed"
     # elastic data plane (master/task_manager.py shard ledger): dispatch/
     # ack are the per-shard lease lifecycle; requeue covers dead-node
     # recovery, lease expiry, and cooperative releases; steal is the
@@ -167,6 +175,7 @@ class JournalEvent:
         SERVE_REPLICA_UP, SERVE_REPLICA_LOST, SERVE_REPLICA_DRAINED,
         SERVE_REQUEST_FAILED, SERVE_REROUTED, SERVE_SCALE,
         SERVE_PREFIX_HIT, SERVE_PREFIX_DROPPED,
+        SLO_BURN_ALERT, REQUEST_TAIL_ATTRIBUTED,
         DATA_DISPATCH, DATA_ACK, DATA_REQUEUE, DATA_STEAL,
         DATA_EPOCH_COMPLETE, DATA_STATE_RESTORED,
         BRAIN_PREDICTED_FAILURE, BRAIN_PREDICTED_RAMP,

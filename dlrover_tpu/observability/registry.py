@@ -115,10 +115,14 @@ class _Metric:
         if self.help:
             lines.append(f"# HELP {self.name} {self.help}")
         lines.append(f"# TYPE {self.name} {self.kind}")
-        for suffix, labels_str, value in self._samples():
-            lines.append(
-                f"{self.name}{suffix}{labels_str} {_format_value(value)}"
-            )
+        for suffix, labels_str, value, *rest in self._samples():
+            line = f"{self.name}{suffix}{labels_str} {_format_value(value)}"
+            if rest and rest[0] is not None:
+                # OpenMetrics exemplar: `# {trace_id="..."} <value>`
+                ex_id, ex_val = rest[0]
+                line += (f' # {{trace_id="{_escape_label(ex_id)}"}} '
+                         f"{_format_value(ex_val)}")
+            lines.append(line)
         return "\n".join(lines)
 
 
@@ -198,21 +202,52 @@ class Histogram(_Metric):
         self._counts = [0] * (len(self._buckets) + 1)  # +1 for +Inf
         self._sum = 0.0
         self._count = 0
+        # per-bucket exemplar: (trace_id, value) of the last observation
+        # that landed there — a p99 bucket links to a concrete request
+        # waterfall instead of an anonymous count
+        self._exemplars: Dict[int, Tuple[str, float]] = {}
 
     def labels(self, *values, **kv):
         child = super().labels(*values, **kv)
         child._buckets = self._buckets
         return child
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         with self._lock:
             self._sum += value
             self._count += 1
             for i, b in enumerate(self._buckets):
                 if value <= b:
                     self._counts[i] += 1
+                    if exemplar:
+                        self._exemplars[i] = (str(exemplar), value)
                     return
             self._counts[-1] += 1
+            if exemplar:
+                self._exemplars[len(self._buckets)] = (str(exemplar), value)
+
+    def bucket_counts(self) -> Dict[float, int]:
+        """Cumulative observation count per upper bound (the +Inf bucket
+        keys ``math.inf`` and equals ``count``). The SLO burn-rate
+        evaluator (observability/slo.py) diffs these snapshots over its
+        windows to compute the bad-request fraction."""
+        with self._lock:
+            counts = list(self._counts)
+        out: Dict[float, int] = {}
+        cum = 0
+        for b, c in zip(self._buckets, counts[:-1]):
+            cum += c
+            out[b] = cum
+        out[math.inf] = cum + counts[-1]
+        return out
+
+    def exemplars(self) -> Dict[float, Tuple[str, float]]:
+        """{bucket upper bound → (trace_id, observed value)} for buckets
+        holding an exemplar; the +Inf bucket keys ``math.inf``."""
+        with self._lock:
+            snap = dict(self._exemplars)
+        bounds = list(self._buckets) + [math.inf]
+        return {bounds[i]: ex for i, ex in snap.items()}
 
     @property
     def count(self) -> int:
@@ -228,17 +263,20 @@ class Histogram(_Metric):
         with self._lock:
             counts = list(self._counts)
             total, s = self._count, self._sum
+            exemplars = dict(self._exemplars)
         out = []
         cum = 0
-        for b, c in zip(self._buckets, counts[:-1]):
+        for i, (b, c) in enumerate(zip(self._buckets, counts[:-1])):
             cum += c
             out.append((
                 "_bucket",
                 _render_labels(labels, [("le", _format_value(b))]),
                 float(cum),
+                exemplars.get(i),
             ))
         out.append((
-            "_bucket", _render_labels(labels, [("le", "+Inf")]), float(total)
+            "_bucket", _render_labels(labels, [("le", "+Inf")]),
+            float(total), exemplars.get(len(self._buckets)),
         ))
         out.append(("_sum", _render_labels(labels), s))
         out.append(("_count", _render_labels(labels), float(total)))

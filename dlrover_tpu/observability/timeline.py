@@ -55,6 +55,7 @@ def fetch_journal(master_http_addr: str,
 _JOB_PHASES_PID = 9999
 _SKEW_TRACK_PID = 9998
 _BRAIN_TRACK_PID = 9997
+_SERVING_TRACK_PID = 9996
 
 
 def job_phase_events(journal: dict) -> List[dict]:
@@ -185,6 +186,64 @@ def brain_track_events(journal: dict) -> List[dict]:
             "name": namer(data), "cat": "brain",
             "ts": float(e.get("t", 0.0)) * 1e6, "args": dict(data),
         })
+    return events
+
+
+def serving_request_events(spans: List, t0: Optional[float] = None,
+                           now_t: Optional[float] = None) -> List[dict]:
+    """Chrome-trace events for per-request serving waterfalls: a
+    "serving requests" track with one lane (tid) per trace_id, so each
+    request's queue-wait → prefill-compute → first-step → decode
+    decomposition reads as one left-to-right waterfall. ``spans`` are
+    tracing.Span objects (finished or live); request-lifecycle spans are
+    selected by their ``serve.``-prefixed names. ``t0`` is the raw
+    monotonic instant mapping to timeline zero (same contract as
+    ``tracing.to_chrome_events``)."""
+    import time as _time
+
+    serve_spans = [sp for sp in spans
+                   if str(getattr(sp, "name", "")).startswith("serve.")]
+    if not serve_spans:
+        return []
+    if t0 is None:
+        t0 = min(sp.start_t for sp in serve_spans)
+    if now_t is None:
+        now_t = _time.monotonic()
+    events: List[dict] = [
+        {
+            "ph": "M", "pid": _SERVING_TRACK_PID, "name": "process_name",
+            "args": {"name": "serving requests"},
+        },
+    ]
+    lanes = {}
+    for sp in sorted(serve_spans, key=lambda s: s.start_t):
+        lane = lanes.get(sp.trace_id)
+        if lane is None:
+            lane = lanes[sp.trace_id] = len(lanes)
+            rid = sp.attrs.get("request_id", sp.trace_id)
+            events.append({
+                "ph": "M", "pid": _SERVING_TRACK_PID, "tid": lane,
+                "name": "thread_name", "args": {"name": f"request {rid}"},
+            })
+        end_t = sp.end_t if sp.end_t is not None else max(now_t, sp.start_t)
+        events.append({
+            "ph": "X", "pid": _SERVING_TRACK_PID, "tid": lane,
+            "name": sp.name, "cat": "serve_request",
+            "ts": (sp.start_t - t0) * 1e6,
+            "dur": (end_t - sp.start_t) * 1e6,
+            "args": {
+                "trace_id": sp.trace_id, "span_id": sp.span_id,
+                "parent_id": sp.parent_id, "status": sp.status,
+                **sp.attrs,
+            },
+        })
+        for ev in sp.events:
+            events.append({
+                "ph": "i", "pid": _SERVING_TRACK_PID, "tid": lane,
+                "s": "t", "name": ev["name"], "cat": "serve_request_event",
+                "ts": (ev["t"] - t0) * 1e6,
+                "args": dict(ev.get("attrs", {}), trace_id=sp.trace_id),
+            })
     return events
 
 

@@ -155,8 +155,12 @@ class _NoopSpan:
     """Shared do-nothing stand-in returned when tracing is disabled."""
 
     __slots__ = ()
-    trace_id = span_id = parent_id = None
+    trace_id = span_id = parent_id = context = None
     name = source = ""
+
+    @property
+    def attrs(self) -> Dict[str, Any]:
+        return {}
 
     def __enter__(self) -> "_NoopSpan":
         return self
@@ -224,6 +228,16 @@ class Tracer:
     def live_spans(self) -> List[Span]:
         with self._lock:
             return list(self._live.values())
+
+    def spans_for_trace(self, trace_id: str) -> List[Span]:
+        """Every span (finished or live) still retained for one trace,
+        start-ordered — the request waterfall the TailAttributor and the
+        flight recorder's worst-request dump read."""
+        with self._lock:
+            out = [sp for sp in self._ring if sp.trace_id == trace_id]
+            out.extend(sp for sp in self._live.values()
+                       if sp.trace_id == trace_id)
+        return sorted(out, key=lambda sp: sp.start_t)
 
     def dropped(self) -> int:
         """Finished spans evicted from the ring by overflow."""

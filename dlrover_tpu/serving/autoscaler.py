@@ -50,6 +50,11 @@ class ServingSignals:
     # the LEADING signal the brain's pre-scaler trains against, vs the
     # lagging queue/TTFT signals the reactive rules above use
     offered_rps: float = 0.0
+    # current fast-window SLO burn rate (SLOPlane.burn_rate()) — a
+    # second leading signal: error budget starts burning while queue
+    # depth still looks healthy, so >=1.0 lets the brain pre-scale
+    # before the reactive queue-depth rule would fire
+    slo_burn_rate: float = 0.0
 
 
 @dataclass

@@ -32,7 +32,9 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 from dlrover_tpu.analysis.race_detector import shared
+from dlrover_tpu.common.constants import SpanName
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability import tracing
 from dlrover_tpu.observability.registry import get_registry
 
 
@@ -45,11 +47,13 @@ class ServeRequest:
     wait on ``done``, then read ``tokens``/``error``)."""
 
     def __init__(self, request_id: str, prompt: Sequence[int],
-                 max_new_tokens: int, bucket_len: int):
+                 max_new_tokens: int, bucket_len: int,
+                 rerouted: bool = False):
         self.request_id = request_id
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
         self.bucket_len = bucket_len
+        self.rerouted = rerouted
         self.enqueue_t = time.monotonic()
         self.prefill = None
         self.slot = -1
@@ -58,6 +62,46 @@ class ServeRequest:
         self.t_done = 0.0
         self.error = ""
         self.done = threading.Event()
+        # waterfall bookkeeping (batcher-internal): segment boundary
+        # stamps + the held segment spans ended at each transition. Spans
+        # are created un-entered (they'd pollute another thread's
+        # context) and ended across threads — the Span API supports it.
+        self.trace_ctx = None
+        self.t_dequeue = 0.0
+        self.t_prefill_done = 0.0
+        self.prefix_enabled = False
+        self.prefix_hit = False
+        self.peer_rounds = 0
+        self.peer_sum = 0
+        self.span_queue = None
+        self.span_prefill = None
+        self.span_first = None
+        self.span_decode = None
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace_ctx.trace_id if self.trace_ctx else None
+
+    def segments(self) -> dict:
+        """The TTFT/TPOT decomposition the TailAttributor classifies:
+        queue-wait → prefill-compute → first-step → decode, plus the
+        interference/speculation/prefix context the cause rules need."""
+        rounds = max(0, len(self.tokens) - 1)
+        return {
+            "request_id": self.request_id,
+            "trace_id": self.trace_id,
+            "latency_s": max(0.0, self.t_done - self.enqueue_t),
+            "queue_s": max(0.0, self.t_dequeue - self.enqueue_t),
+            "prefill_s": max(0.0, self.t_prefill_done - self.t_dequeue),
+            "first_step_s": max(0.0, self.t_first - self.t_prefill_done),
+            "decode_s": max(0.0, self.t_done - self.t_first),
+            "rounds": rounds,
+            "mean_peers": (self.peer_sum / self.peer_rounds
+                           if self.peer_rounds else 1.0),
+            "prefix_enabled": self.prefix_enabled,
+            "prefix_hit": self.prefix_hit,
+            "rerouted": self.rerouted,
+        }
 
 
 class ContinuousBatcher:
@@ -70,6 +114,8 @@ class ContinuousBatcher:
         prefill_workers: int = 1,
         idle_wait_s: float = 0.05,
         registry=None,
+        on_complete: Optional[Callable] = None,
+        source: str = "batcher",
     ):
         self._engine = engine
         self._buckets = tuple(sorted(buckets))
@@ -84,6 +130,10 @@ class ContinuousBatcher:
             # as request events — one timeline per replica
             engine.attach_journal(journal_fn)
         self._idle_wait_s = idle_wait_s
+        # called with req.segments() after every successful completion —
+        # the replica wires the TailAttributor here
+        self._on_complete = on_complete
+        self._source = source
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # serving shared state, race-certified (drill in tests):
@@ -148,15 +198,27 @@ class ContinuousBatcher:
             f"{self._buckets[-1]}")
 
     def submit(self, request_id: str, prompt: Sequence[int],
-               max_new_tokens: int) -> ServeRequest:
+               max_new_tokens: int, rerouted: bool = False) -> ServeRequest:
         bucket = self.bucket_for(len(prompt))
         # the cache must hold prompt + continuation; clamp to the cap AND
         # the cache room past the bucket
         max_new = min(max_new_tokens, self._max_new_cap,
                       self._engine.cache_len - bucket)
-        req = ServeRequest(request_id, prompt, max(1, max_new), bucket)
+        req = ServeRequest(request_id, prompt, max(1, max_new), bucket,
+                           rerouted=rerouted)
+        # queue-wait opens NOW, under the submitter's context (the
+        # replica's serve.generate span, which itself rode the wire from
+        # the router's serve.route) — one trace_id router → decode steps
+        req.span_queue = tracing.span(
+            SpanName.SERVE_QUEUE_WAIT, source=self._source,
+            request_id=request_id)
+        # waterfall root context: the active request span when there is
+        # one, else the queue span itself roots a fresh trace
+        req.trace_ctx = (tracing.current_context()
+                         or getattr(req.span_queue, "context", None))
         with self._lock:
             if self._draining or self._stopped.is_set():
+                req.span_queue.end(status="refused")
                 raise BatcherClosed("replica is draining")
             self._queue.append(req)
             self._cond.notify_all()
@@ -198,6 +260,10 @@ class ContinuousBatcher:
             self._slot_map.clear()
         for req in leftovers:
             req.error = req.error or "replica stopped"
+            for sp in (req.span_queue, req.span_prefill, req.span_first,
+                       req.span_decode):
+                if sp is not None:
+                    sp.end(status="aborted")
             req.done.set()
 
     # -- prefill workers (engine.prefill_rows is pure → no engine lock) ----
@@ -210,17 +276,37 @@ class ContinuousBatcher:
                 if self._stopped.is_set():
                     return
                 req = self._queue.pop(0)
+            req.t_dequeue = time.monotonic()
+            req.span_queue.end()
+            req.span_prefill = tracing.span(
+                SpanName.SERVE_PREFILL_COMPUTE, source=self._source,
+                parent=req.trace_ctx, request_id=req.request_id)
+            # prefix-cache attribution: the wrapper's hit counter moving
+            # across OUR call means OUR prompt reused a prefix (exact with
+            # the default single prefill worker; a heuristic beyond that)
+            hits0 = getattr(self._engine, "hits", None)
+            req.prefix_enabled = hits0 is not None
             try:
                 prefill = self._engine.prefill_rows(req.prompt,
                                                     req.bucket_len)
             except Exception:  # noqa: BLE001 — fail the one request, not
                 # the worker thread serving every later request
                 logger.exception("prefill failed for %s", req.request_id)
+                req.span_prefill.end(status="error")
                 req.error = "prefill failed"
                 self.failed += 1
                 self._m_requests.labels(status="error").inc()
                 req.done.set()
                 continue
+            if hits0 is not None:
+                req.prefix_hit = self._engine.hits > hits0
+                req.span_prefill.attrs["prefix_hit"] = req.prefix_hit
+            req.t_prefill_done = time.monotonic()
+            req.span_prefill.end()
+            # first-step covers ready-wait + insert + the first token
+            req.span_first = tracing.span(
+                SpanName.SERVE_FIRST_STEP, source=self._source,
+                parent=req.trace_ctx, request_id=req.request_id)
             with self._lock:
                 req.prefill = prefill
                 self._ready.append(req)
@@ -253,7 +339,13 @@ class ContinuousBatcher:
                     req.t_first = time.monotonic()
                     req.tokens.append(first)
                     self._last_token[req.slot] = first
-                self._m_ttft.observe(req.t_first - req.enqueue_t)
+                if req.span_first is not None:
+                    req.span_first.end()
+                req.span_decode = tracing.span(
+                    SpanName.SERVE_DECODE, source=self._source,
+                    parent=req.trace_ctx, request_id=req.request_id)
+                self._m_ttft.observe(req.t_first - req.enqueue_t,
+                                     exemplar=req.trace_id)
                 self._m_tokens.inc()
             with self._lock:
                 active = [s in self._slot_map
@@ -270,10 +362,15 @@ class ContinuousBatcher:
             finished: List[ServeRequest] = []
             with self._lock:
                 self._step_index += 1
+                co_active = len(self._slot_map)
                 for slot, req in list(self._slot_map.items()):
                     tok = nxt[slot]
                     req.tokens.append(tok)
                     self._last_token[slot] = tok
+                    # batch-interference signal: how crowded were this
+                    # request's decode rounds on average
+                    req.peer_rounds += 1
+                    req.peer_sum += co_active
                     if len(req.tokens) >= req.max_new_tokens:
                         del self._slot_map[slot]
                         self._free.append(slot)
@@ -292,5 +389,19 @@ class ContinuousBatcher:
                 self._m_requests.labels(status="ok").inc()
                 if len(req.tokens) > 1:
                     self._m_tpot.observe(
-                        (req.t_done - req.t_first) / (len(req.tokens) - 1))
+                        (req.t_done - req.t_first) / (len(req.tokens) - 1),
+                        exemplar=req.trace_id)
+                if req.span_decode is not None:
+                    req.span_decode.attrs.update(
+                        rounds=len(req.tokens) - 1,
+                        mean_peers=round(req.peer_sum
+                                         / max(1, req.peer_rounds), 2))
+                    req.span_decode.end()
+                if self._on_complete is not None:
+                    try:
+                        self._on_complete(req.segments())
+                    except Exception:  # noqa: BLE001 — attribution is
+                        # telemetry; it must never wedge the decode loop
+                        logger.warning("on_complete hook failed for %s",
+                                       req.request_id, exc_info=True)
                 req.done.set()

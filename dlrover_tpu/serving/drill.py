@@ -56,6 +56,7 @@ def run_traffic_drill(
     autoscale_interval_s: float = 0.2,
     queue_hi: int = 3,
     grow_cooldown_s: float = 0.3,
+    ttft_slo_s: Optional[float] = None,
     request_timeout_s: float = 30.0,
     seed: int = 0,
 ) -> Dict:
@@ -66,6 +67,7 @@ def run_traffic_drill(
     latency/throughput digest + the journal's scale decisions — the
     p99-TTFT-under-burst point the bench records, and the
     burst→grow-journaled fact the satellite test asserts."""
+    from dlrover_tpu.observability.slo import SLOPlane
     from dlrover_tpu.serving.traffic import OpenLoopGenerator, TrafficProfile
 
     if profile is None:
@@ -101,10 +103,18 @@ def run_traffic_drill(
             kind, source="router", **d),
         request_timeout_s=request_timeout_s,
     )
+    # the SLO burn-rate plane rides the same autoscaler tick: it diffs
+    # the router-side TTFT histogram, journals breaches, and feeds the
+    # fast burn into the signal snapshot as a LEADING scale trigger
+    slo_plane = SLOPlane(
+        journal_fn=lambda kind, **d: master.event_journal.record(
+            kind, source="slo", **d),
+    )
     t_start = [0.0]
 
     def signals() -> ServingSignals:
         t = time.monotonic() - t_start[0] if t_start[0] else 0.0
+        slo_plane.tick()
         return ServingSignals(
             live_replicas=len(master.serve_registry.live()),
             target_replicas=manager.target,
@@ -114,6 +124,7 @@ def run_traffic_drill(
             tokens_per_s=router.tokens_per_s(),
             # leading signal: the generator's own offered envelope
             offered_rps=gen.offered_rps(min(t, profile.duration_s)),
+            slo_burn_rate=slo_plane.burn_rate(),
         )
 
     autoscaler = JobAutoScaler(
@@ -123,6 +134,9 @@ def run_traffic_drill(
         serving_optimizer=ServingOptimizer(
             min_replicas=replicas, max_replicas=max_replicas,
             queue_hi=queue_hi, grow_cooldown_s=grow_cooldown_s,
+            # None → the env knob the SLO plane also reads; the lead-time
+            # test passes a loose value here to isolate the QUEUE rule
+            ttft_slo_s=ttft_slo_s,
             shrink_cooldown_s=3600.0,
         ),
         serving_signals=signals,
@@ -141,19 +155,31 @@ def run_traffic_drill(
         autoscaler.start()
         t_start[0] = time.monotonic()
         stats = gen.run()
+        slo_plane.tick()  # final snapshot after the last completion
         kinds: Dict[str, int] = {}
         grow_events = 0
+        alert_ts: List[float] = []
+        grow_ts: List[float] = []
         for e in master.event_journal.events():
             kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+            if e["kind"] == JournalEvent.SLO_BURN_ALERT:
+                alert_ts.append(e["t"])
             if (e["kind"] == JournalEvent.SERVE_SCALE
                     and "grow" in e.get("data", {}).get("reason", "")):
                 grow_events += 1
+                grow_ts.append(e["t"])
         stats.update({
             "backend": backend,
             "replicas_start": replicas,
             "live_replicas_end": len(master.serve_registry.live()),
             "grow_events": grow_events,
             "lost": router.lost,
+            "slo_alerts": slo_plane.alerts,
+            "first_alert_t": alert_ts[0] if alert_ts else None,
+            "first_grow_t": grow_ts[0] if grow_ts else None,
+            # positive = the burn alert LED the reactive grow
+            "slo_lead_s": (round(grow_ts[0] - alert_ts[0], 3)
+                           if alert_ts and grow_ts else None),
             "journal": kinds,
         })
         return stats

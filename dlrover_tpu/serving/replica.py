@@ -41,11 +41,15 @@ from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common import comm, fabric
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.constants import ConfigKey, SpanName, env_flag
+from dlrover_tpu.common.http_server import HTTPTransportServer
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCServer
 from dlrover_tpu.observability import tracing
+from dlrover_tpu.observability.flight_recorder import FlightRecorder
+from dlrover_tpu.observability.journal import EventJournal
 from dlrover_tpu.observability.registry import get_registry
 from dlrover_tpu.serving.batcher import BatcherClosed, ContinuousBatcher
+from dlrover_tpu.serving.tail import TailAttributor
 
 SERVE_REPLICA_SITE = "serve.replica"
 
@@ -101,12 +105,41 @@ class DecodeReplica:
         request_timeout_s: float = 60.0,
         prefill_workers: int = 1,
         on_crash: Optional[Callable[[], None]] = None,
+        http_port: int = 0,
     ):
         self.node_id = node_id
+        # replica-local observability plane, scrapeable mid-drill like an
+        # agent's: a journal for request/prefix/tail events, the tail
+        # attributor fed by every batcher completion, and a flight
+        # recorder whose bundles embed the worst request waterfalls
+        self.journal = EventJournal()
+        registry = get_registry()
+        self.tail = TailAttributor(
+            journal_fn=lambda kind, **data: self.journal.record(
+                kind, source=f"replica_{node_id}", **data),
+            registry=registry,
+        )
         self._batcher = ContinuousBatcher(
             engine, buckets=buckets, max_new_cap=max_new_cap,
             prefill_workers=prefill_workers,
+            journal_fn=lambda kind, **data: self.journal.record(
+                kind, source=f"replica_{node_id}", **data),
+            on_complete=self.tail.observe,
+            source=f"replica_{node_id}",
         )
+        self.recorder = FlightRecorder(
+            source=f"replica_{node_id}", journal=self.journal,
+            registry=registry, worst_traces_fn=self.tail.worst_requests,
+        )
+        self._http_server = HTTPTransportServer(host=host, port=http_port)
+        self._http_server.add_get_route(
+            "/metrics",
+            lambda: ("text/plain; version=0.0.4", registry.render()))
+        self._http_server.add_get_route(
+            "/events",
+            lambda: ("application/json", self.journal.to_json()))
+        self._http_server.add_get_route(
+            "/debug/bundle", self.recorder.http_handler())
         self._server = RPCServer(host=host, port=port)
         self._server.register_object(self)
         # engines with real params serve them over the striped fabric so
@@ -131,6 +164,12 @@ class DecodeReplica:
     def addr(self) -> str:
         return f"{self._host}:{self._server.port}"
 
+    @property
+    def http_addr(self) -> str:
+        """The observability endpoint (GET /metrics, /events,
+        /debug/bundle, /healthz) — same contract as an agent's."""
+        return f"{self._host}:{self._http_server.port}"
+
     def _provide_weights(self, rest: str):
         del rest  # one object per replica: weights/current
         blob = self._weights_blob
@@ -146,6 +185,9 @@ class DecodeReplica:
 
     def start(self) -> None:
         self._server.start()
+        self._http_server.start()
+        logger.info("replica %s observability http on %s",
+                    self.node_id, self.http_addr)
         # warm-start BEFORE registering: this replica is not yet in the
         # membership, so the fetch can only land on live peers
         self._maybe_warm_start()
@@ -223,6 +265,7 @@ class DecodeReplica:
         self._stop_evt.set()
         self._batcher.stop()
         self._server.stop()
+        self._http_server.stop()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5.0)
 
@@ -233,6 +276,7 @@ class DecodeReplica:
         self.crashed = True
         self._stop_evt.set()
         self._server.stop()
+        self._http_server.stop()
         self._batcher.stop()
         if self._on_crash is not None:
             self._on_crash()
@@ -244,10 +288,12 @@ class DecodeReplica:
     ) -> comm.ServeGenerateResponse:
         with tracing.span(SpanName.SERVE_GENERATE,
                           source=f"replica_{self.node_id}",
-                          request_id=req.request_id):
+                          request_id=req.request_id) as gspan:
+            trace_id = getattr(gspan, "trace_id", None) or ""
             try:
                 pending = self._batcher.submit(
-                    req.request_id, req.prompt, req.max_new_tokens)
+                    req.request_id, req.prompt, req.max_new_tokens,
+                    rerouted=req.rerouted)
             except BatcherClosed:
                 return comm.ServeGenerateResponse(
                     request_id=req.request_id, success=False,
@@ -272,6 +318,7 @@ class DecodeReplica:
                 tpot_s=(pending.t_done - pending.t_first) / n_out,
                 queue_depth=self._batcher.queue_depth(),
                 replica_id=self.node_id,
+                trace_id=pending.trace_id or trace_id,
             )
 
     def rpc_serve_drain(self, req: comm.ServeDrainRequest
@@ -489,6 +536,9 @@ def main(argv=None) -> int:
     parser.add_argument("--node-id", type=int, required=True)
     parser.add_argument("--backend", default="toy", choices=["toy", "jax"])
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--http-port", type=int, default=0,
+                        help="observability endpoint (/metrics /events "
+                             "/debug/bundle); 0 = ephemeral")
     parser.add_argument("--slots", type=int, default=4)
     parser.add_argument("--buckets", default="8,16")
     parser.add_argument("--max-new-cap", type=int, default=16)
@@ -519,6 +569,7 @@ def main(argv=None) -> int:
         max_new_cap=args.max_new_cap,
         port=args.port,
         heartbeat_interval_s=args.hb_interval_s,
+        http_port=args.http_port,
     )
     replica.start()
     code = replica.run()

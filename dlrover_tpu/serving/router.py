@@ -77,6 +77,14 @@ class RequestRouter:
         self._m_rerouted = reg.counter(
             "dlrover_serving_rerouted_total",
             "requests re-routed after a replica failure")
+        # the control-plane view of TTFT (the SLO plane's input on the
+        # router's process; same family+grid as the batcher's on a
+        # replica) — exemplared with the request's trace id
+        self._m_ttft = reg.histogram(
+            "dlrover_serving_ttft_seconds",
+            "request enqueue → first token",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30),
+        )
         reg.gauge(
             "dlrover_serving_router_inflight", "requests in flight",
         ).set_function(lambda: float(sum(self._inflight.values())))
@@ -153,6 +161,9 @@ class RequestRouter:
                     except (ConnectionError, RuntimeError) as e:
                         attempts += 1
                         last_err = f"injected: {e!r}"
+                        tracing.add_span_event(
+                            SpanName.EVT_FAULT_INJECTED,
+                            site=SERVE_REQUEST_SITE, attempt=attempts)
                         self._record(JournalEvent.SERVE_REQUEST_FAILED,
                                      request_id=request_id, node_id=-1,
                                      attempt=attempts, error=repr(e))
@@ -180,6 +191,10 @@ class RequestRouter:
                                    node_id, last_err)
                     self.rerouted += 1
                     self._m_rerouted.inc()
+                    req.rerouted = True
+                    tracing.add_span_event(
+                        SpanName.EVT_SERVE_REROUTED, from_node=node_id,
+                        reason="transport")
                     self._record(JournalEvent.SERVE_REROUTED,
                                  request_id=request_id, from_node=node_id)
                     continue
@@ -195,6 +210,10 @@ class RequestRouter:
                 # draining/timeout refusal: healthy replica, closed door
                 self.rerouted += 1
                 self._m_rerouted.inc()
+                req.rerouted = True
+                tracing.add_span_event(
+                    SpanName.EVT_SERVE_REROUTED, from_node=node_id,
+                    reason=resp.message)
                 self._record(JournalEvent.SERVE_REROUTED,
                              request_id=request_id, from_node=node_id,
                              reason=resp.message)
@@ -219,6 +238,7 @@ class RequestRouter:
             while self._token_marks and self._token_marks[0][0] < cutoff:
                 self._token_marks.pop(0)
         self._m_requests.labels(status="ok").inc()
+        self._m_ttft.observe(resp.ttft_s, exemplar=resp.trace_id or None)
 
     def rpc_serve_submit(self, req: comm.ServeGenerateRequest
                          ) -> comm.ServeGenerateResponse:

@@ -28,7 +28,8 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from dlrover_tpu.analysis.race_detector import shared
-from dlrover_tpu.common.constants import ConfigKey, env_int
+from dlrover_tpu.common.constants import ConfigKey, SpanName, env_int
+from dlrover_tpu.observability import tracing
 
 _DEFAULT_K = 4
 
@@ -116,14 +117,20 @@ class SpeculativeDecoder:
                 if i < k:
                     drafts.append(nxt)
                     cur = nxt
-            # verify: one batched target step over the whole window
+            # verify: one batched target step over the whole window;
+            # the span carries the round's acceptance so a waterfall
+            # shows WHERE speculation stopped paying
             t_pos = int(t_cache["pos"])
             window = jnp.asarray([[last] + drafts], jnp.int32)
-            wl, t_cache = self._window(self._tp, window, t_cache)
-            greedy = [int(t) for t in jnp.argmax(wl[0], axis=-1)]
-            a = 0
-            while a < k and drafts[a] == greedy[a]:
-                a += 1
+            with tracing.span(SpanName.SERVE_SPEC_VERIFY,
+                              source="speculative",
+                              request_id=request_id) as vspan:
+                wl, t_cache = self._window(self._tp, window, t_cache)
+                greedy = [int(t) for t in jnp.argmax(wl[0], axis=-1)]
+                a = 0
+                while a < k and drafts[a] == greedy[a]:
+                    a += 1
+                vspan.attrs.update(k=k, accepted=a)
             # accepted drafts + the target's own next token (correction
             # at the mismatch, bonus g_{k+1} on a full accept)
             tokens.extend(drafts[:a] + [greedy[a]])

@@ -1,0 +1,451 @@
+"""Request-level serving observability (docs/design/
+serving_observability.md): per-request trace waterfalls, the SLO
+burn-rate plane, and tail-latency attribution.
+
+The acceptance pins:
+
+- ONE request routed through a real router→replica RPC hop produces ONE
+  trace_id whose span tree decomposes TTFT into queue-wait /
+  prefill-compute / first-step segments, and whose chrome-trace
+  waterfall (the pid-9996 "serving requests" track) json-serializes;
+- reroutes ride the route span as span events;
+- ``classify`` is the documented six-cause decision table, and
+  ``TailAttributor`` journals/counts what it attributes;
+- ``SLOPlane`` burns budget per the SRE two-window math, alerts once
+  per cooldown, and under the seeded burst drill the journaled
+  ``slo_burn_alert`` LEADS the reactive autoscaler's queue-depth grow;
+- histograms carry per-bucket exemplars through to the rendered text;
+- a serving replica is scrapeable over HTTP like an agent
+  (/metrics, /events, /debug/bundle) and its flight-recorder bundle
+  embeds the worst request waterfalls.
+"""
+
+import json
+import math
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.common.constants import ConfigKey, MetricLabel, SpanName
+from dlrover_tpu.master.master import LocalJobMaster
+from dlrover_tpu.observability import tracing
+from dlrover_tpu.observability.journal import JournalEvent
+from dlrover_tpu.observability.registry import MetricsRegistry
+from dlrover_tpu.observability.slo import ServingSLO, SLOPlane, default_slos
+from dlrover_tpu.observability.timeline import serving_request_events
+from dlrover_tpu.serving.engine import ToyEngine
+from dlrover_tpu.serving.replica import DecodeReplica
+from dlrover_tpu.serving.router import RequestRouter
+from dlrover_tpu.serving.tail import TailAttributor, classify
+
+
+@pytest.fixture(autouse=True)
+def fresh_tracer(tmp_path, monkeypatch):
+    """Every test gets its own tracer ring and a throwaway bundle dir."""
+    monkeypatch.setenv(ConfigKey.TRACE_DIR, str(tmp_path / "bundles"))
+    tracing.reset_tracer()
+    yield
+    tracing.reset_tracer()
+
+
+def _serving_stack(node_id, engine=None, **replica_kw):
+    """One in-process master + replica + router, all sharing the process
+    tracer ring so a test can read both sides of the RPC hop."""
+    master = LocalJobMaster(job_name="serve-obs", node_num=1, min_nodes=1)
+    master.prepare()
+    replica = DecodeReplica(
+        master.addr, node_id=node_id,
+        engine=engine or ToyEngine(slots=2, step_delay_s=0.002),
+        buckets=(8,), heartbeat_interval_s=0.05, **replica_kw,
+    )
+    replica.start()
+    router = RequestRouter(
+        replicas_fn=master.serve_registry.live,
+        registry=MetricsRegistry(),
+        request_timeout_s=30.0,
+    )
+    return master, replica, router
+
+
+# -- the waterfall: one request, one trace, TTFT decomposed -----------------
+
+
+@pytest.mark.serve
+def test_one_request_one_trace_with_ttft_decomposition():
+    """The tentpole's acceptance trace: submit through the router, and
+    the response's trace_id owns a span tree covering BOTH sides of the
+    RPC hop — route (router) + generate/queue/prefill/first/decode
+    (replica) — whose segment spans are contiguous and sum to TTFT."""
+    master, replica, router = _serving_stack(310)
+    try:
+        resp = router.submit([1, 2, 3], max_new_tokens=4,
+                             request_id="obs-0001")
+        assert resp.success, resp.message
+        assert resp.trace_id, "response carries no trace id"
+        spans = tracing.get_tracer().spans_for_trace(resp.trace_id)
+        by_name = {sp.name: sp for sp in spans}
+        assert {
+            SpanName.SERVE_ROUTE, SpanName.SERVE_GENERATE,
+            SpanName.SERVE_QUEUE_WAIT, SpanName.SERVE_PREFILL_COMPUTE,
+            SpanName.SERVE_FIRST_STEP, SpanName.SERVE_DECODE,
+        } <= set(by_name), f"waterfall incomplete: {sorted(by_name)}"
+        # every span in the tree shares the response's trace id
+        assert all(sp.trace_id == resp.trace_id for sp in spans)
+        # the segments are ordered and contiguous...
+        queue = by_name[SpanName.SERVE_QUEUE_WAIT]
+        prefill = by_name[SpanName.SERVE_PREFILL_COMPUTE]
+        first = by_name[SpanName.SERVE_FIRST_STEP]
+        decode = by_name[SpanName.SERVE_DECODE]
+        assert (queue.start_t <= prefill.start_t <= first.start_t
+                <= decode.start_t)
+        # ...and decompose TTFT: queue + prefill + first-step spans the
+        # submit→first-token interval the batcher reported as ttft_s
+        segments_s = sum(
+            sp.end_t - sp.start_t for sp in (queue, prefill, first))
+        assert segments_s == pytest.approx(resp.ttft_s, abs=0.25)
+
+        # the chrome waterfall parses: a "serving requests" track with
+        # one X slice per segment, all on the synthetic serving pid
+        events = json.loads(json.dumps(serving_request_events(spans)))
+        assert events, "no serving track events"
+        pids = {e["pid"] for e in events}
+        assert len(pids) == 1, "serving track leaked onto other pids"
+        track = [e for e in events
+                 if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert track and track[0]["args"]["name"] == "serving requests"
+        slices = {e["name"] for e in events if e.get("ph") == "X"}
+        assert {
+            SpanName.SERVE_QUEUE_WAIT, SpanName.SERVE_PREFILL_COMPUTE,
+            SpanName.SERVE_FIRST_STEP, SpanName.SERVE_DECODE,
+        } <= slices
+        # a non-serving span never lands on the request track
+        with tracing.span("train.step", source="elsewhere"):
+            pass
+        others = serving_request_events(
+            tracing.get_tracer().finished_spans())
+        assert all(e["name"] != "train.step" for e in others)
+    finally:
+        replica.stop()
+        master.stop()
+
+
+@pytest.mark.serve
+def test_reroute_rides_the_route_span_as_event():
+    """A transport-failed attempt shows up ON the request's route span
+    (EVT_SERVE_REROUTED), and the replica-side batcher sees
+    ``rerouted=True`` so the tail attributor can name the cause."""
+    master, replica, router = _serving_stack(311)
+    # a refusing address tops the load order (most free slots), so the
+    # first attempt fails and the request re-routes to the live replica
+    live = master.serve_registry.live
+    router._replicas_fn = lambda: (
+        [{"node_id": 1, "addr": "127.0.0.1:1", "slots": 64}] + live())
+    try:
+        resp = router.submit([4, 5, 6], max_new_tokens=3,
+                             request_id="obs-rr")
+        assert resp.success, resp.message
+        route = [sp for sp in tracing.get_tracer().finished_spans()
+                 if sp.name == SpanName.SERVE_ROUTE]
+        assert route, "route span missing"
+        evs = [e["name"] for sp in route for e in sp.events]
+        assert SpanName.EVT_SERVE_REROUTED in evs
+    finally:
+        replica.stop()
+        master.stop()
+
+
+# -- tail attribution: the six-cause decision table -------------------------
+
+
+@pytest.mark.parametrize("segments,expected", [
+    # a reroute dominates whatever happened after it
+    ({"rerouted": True, "queue_s": 0.1, "decode_s": 2.0},
+     MetricLabel.TAIL_REROUTE),
+    ({"queue_s": 1.0, "prefill_s": 0.1, "decode_s": 0.2},
+     MetricLabel.TAIL_QUEUE),
+    # prefill + first-step together own the TTFT leg
+    ({"queue_s": 0.1, "prefill_s": 0.4, "first_step_s": 0.3,
+      "decode_s": 0.5}, MetricLabel.TAIL_PREFILL),
+    ({"queue_s": 0.1, "prefill_s": 0.8, "decode_s": 0.2,
+      "prefix_enabled": True, "prefix_hit": False},
+     MetricLabel.TAIL_PREFIX_MISS),
+    # a prefix HIT that is still prefill-heavy is plain prefill cost
+    ({"queue_s": 0.1, "prefill_s": 0.8, "decode_s": 0.2,
+      "prefix_enabled": True, "prefix_hit": True},
+     MetricLabel.TAIL_PREFILL),
+    ({"queue_s": 0.1, "prefill_s": 0.2, "decode_s": 0.9},
+     MetricLabel.TAIL_BATCH_INTERFERENCE),
+    ({"queue_s": 0.1, "prefill_s": 0.2, "decode_s": 0.9,
+      "spec_rounds": 4, "spec_accept_rate": 0.2},
+     MetricLabel.TAIL_SPECULATIVE_MISS),
+    # healthy speculation: the decode leg is interference, not a miss
+    ({"queue_s": 0.1, "prefill_s": 0.2, "decode_s": 0.9,
+      "spec_rounds": 4, "spec_accept_rate": 0.9},
+     MetricLabel.TAIL_BATCH_INTERFERENCE),
+])
+def test_classify_decision_table(segments, expected):
+    assert classify(segments) == expected
+    assert classify(segments) in MetricLabel.TAIL_CAUSES
+
+
+def test_tail_attributor_journals_counts_and_retains_worst():
+    """A seeded slow request past the window percentile is attributed,
+    journaled with its trace id, counted under the bounded cause label,
+    and retained (slowest first) for the flight recorder."""
+    journal = []
+    reg = MetricsRegistry()
+    tail = TailAttributor(
+        journal_fn=lambda kind, **d: journal.append((kind, d)),
+        registry=reg, slow_pctl=90.0, min_window=10, worst_n=3,
+    )
+    # 20 fast requests with distinct latencies fill the window; none of
+    # them reaches its own p90 by more than the gate allows
+    for i in range(20):
+        tail.observe({"request_id": f"fast-{i}", "trace_id": f"t{i}",
+                      "latency_s": 0.010 + 0.0001 * i,
+                      "queue_s": 0.001, "prefill_s": 0.001,
+                      "decode_s": 0.008})
+    before = tail.attributed
+    cause = tail.observe({
+        "request_id": "slow-1", "trace_id": "deadbeef",
+        "latency_s": 2.0, "queue_s": 1.6, "prefill_s": 0.1,
+        "first_step_s": 0.1, "decode_s": 0.2,
+    })
+    assert cause == MetricLabel.TAIL_QUEUE
+    assert tail.attributed == before + 1
+    assert tail.cause_counts[MetricLabel.TAIL_QUEUE] >= 1
+    assert reg.counter("dlrover_serving_tail_cause_total").labels(
+        cause=MetricLabel.TAIL_QUEUE).value >= 1
+    kinds = [(k, d) for k, d in journal
+             if k == JournalEvent.REQUEST_TAIL_ATTRIBUTED]
+    assert kinds, "no request_tail_attributed journaled"
+    last = kinds[-1][1]
+    assert last["cause"] == MetricLabel.TAIL_QUEUE
+    assert last["trace_id"] == "deadbeef"
+    worst = tail.worst_requests()
+    assert worst and worst[0]["request_id"] == "slow-1"
+    assert worst[0]["cause"] == MetricLabel.TAIL_QUEUE
+    assert worst == sorted(worst, key=lambda r: -r["latency_s"])
+
+
+# -- the SLO plane: SRE two-window burn rates over the registry -------------
+
+
+def _ttft_hist(reg):
+    return reg.histogram(
+        "dlrover_serving_ttft_seconds", "ttft",
+        buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30))
+
+
+def test_slo_burn_rate_math_and_bucket_quantization():
+    """burn = window bad-fraction / error budget, with "bad" quantized
+    to the histogram's bucket grid (good = count at the largest bound
+    <= the threshold)."""
+    reg = MetricsRegistry()
+    hist = _ttft_hist(reg)
+    t = [0.0]
+    plane = SLOPlane(
+        slos=[ServingSLO(name="t", ttft_threshold_s=0.1, target=0.99)],
+        registry=reg, fast_window_s=1.0, slow_window_s=5.0,
+        burn_threshold=1.0, alert_cooldown_s=10.0,
+        monotonic=lambda: t[0],
+    )
+    plane.tick()  # empty baseline snapshot
+    for _ in range(50):
+        hist.observe(0.05)   # good: within the 0.1 objective
+    for _ in range(50):
+        hist.observe(0.5)    # bad
+    t[0] = 0.5
+    burns = plane.tick()
+    # 50/100 bad over a 0.01 budget = 50x burn
+    assert burns["t"] == pytest.approx(50.0)
+    assert plane.burn_rate() == pytest.approx(50.0)
+    assert plane.burn_rate("t") == pytest.approx(50.0)
+    # 0.1 is itself a bucket bound: an observation AT the threshold is
+    # good — the objective is quantized to the grid, not interpolated
+    hist.observe(0.1)
+    t[0] = 0.6
+    assert plane.tick()["t"] < 50.0
+
+
+def test_slo_alert_needs_both_windows_and_respects_cooldown():
+    reg = MetricsRegistry()
+    hist = _ttft_hist(reg)
+    journal = []
+    t = [0.0]
+    plane = SLOPlane(
+        slos=[ServingSLO(name="t", ttft_threshold_s=0.1, target=0.99)],
+        registry=reg, fast_window_s=1.0, slow_window_s=5.0,
+        burn_threshold=1.0, alert_cooldown_s=10.0,
+        journal_fn=lambda kind, **d: journal.append((kind, d)),
+        monotonic=lambda: t[0],
+    )
+    plane.tick()
+    for _ in range(10):
+        hist.observe(0.5)
+    t[0] = 0.5
+    plane.tick()
+    assert plane.alerts == 1
+    kinds = [k for k, _ in journal]
+    assert kinds.count(JournalEvent.SLO_BURN_ALERT) == 1
+    _, data = journal[0]
+    assert data["slo"] == "t" and data["rate"] >= 1.0
+    assert data["window"] == MetricLabel.WINDOW_FAST
+    # still burning 0.4s later, but inside the cooldown: no re-page
+    for _ in range(10):
+        hist.observe(0.5)
+    t[0] = 0.9
+    plane.tick()
+    assert plane.alerts == 1
+    # past the cooldown AND still burning: page again
+    for _ in range(10):
+        hist.observe(0.5)
+    t[0] = 10.5
+    plane.tick()
+    assert plane.alerts == 2
+    assert reg.counter("dlrover_serving_slo_alerts_total").labels(
+        slo="t").value == 2
+
+
+def test_slo_goodput_objective_reads_outcome_counters():
+    """The goodput objective diffs the status-labelled request counter
+    instead of the latency histogram."""
+    reg = MetricsRegistry()
+    fam = reg.counter("dlrover_serving_requests_total",
+                      "completed requests by outcome",
+                      labelnames=("status",))
+    t = [0.0]
+    journal = []
+    plane = SLOPlane(
+        slos=[ServingSLO(name="gp", tier="interactive",
+                         ttft_threshold_s=math.inf, target=0.95,
+                         goodput_target=0.95)],
+        registry=reg, fast_window_s=1.0, slow_window_s=5.0,
+        burn_threshold=1.0, alert_cooldown_s=10.0,
+        journal_fn=lambda kind, **d: journal.append((kind, d)),
+        monotonic=lambda: t[0],
+    )
+    plane.tick()
+    fam.labels(status="ok").inc(100)
+    fam.labels(status="lost").inc(10)
+    t[0] = 0.5
+    burns = plane.tick()
+    # 10/110 bad over a 0.05 budget ≈ 1.8x: burning
+    assert burns["gp"] == pytest.approx((10 / 110) / 0.05)
+    assert plane.alerts == 1
+
+
+def test_default_slos_read_env_thresholds(monkeypatch):
+    monkeypatch.setenv(ConfigKey.SERVE_TTFT_SLO_S, "0.42")
+    slos = {s.name: s for s in default_slos()}
+    assert slos["interactive_ttft"].ttft_threshold_s == 0.42
+    assert slos["interactive_goodput"].goodput_target > 0.0
+    assert all(s.tier == "interactive" for s in slos.values())
+
+
+# -- exemplars: histogram buckets link to concrete traces -------------------
+
+
+def test_histogram_exemplars_stored_and_rendered():
+    reg = MetricsRegistry()
+    h = reg.histogram("obs_latency_seconds", "latency",
+                      buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="aaa111")
+    h.observe(0.5, exemplar="bbb222")
+    h.observe(0.07, exemplar="ccc333")  # same bucket: last one wins
+    h.observe(7.0, exemplar="ddd444")   # lands in +Inf
+    h.observe(0.06)                     # no exemplar: keeps ccc333
+    ex = h.exemplars()
+    assert ex[0.1] == ("ccc333", 0.07)
+    assert ex[1.0] == ("bbb222", 0.5)
+    assert ex[math.inf] == ("ddd444", 7.0)
+    text = reg.render()
+    assert '# {trace_id="ccc333"} 0.07' in text
+    assert '# {trace_id="bbb222"} 0.5' in text
+    # exemplars ride bucket lines only, never _sum/_count
+    for line in text.splitlines():
+        if "_sum" in line or "_count" in line:
+            assert "trace_id" not in line
+
+
+# -- the replica as a scrape target -----------------------------------------
+
+
+def _http_get(addr, path):
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.mark.serve
+def test_replica_http_endpoints_and_worst_trace_bundle(monkeypatch):
+    """A serving replica exposes /metrics, /events and /debug/bundle
+    over its own HTTP endpoint like an agent, and the bundle embeds the
+    worst request waterfalls (trace ids + spans + attributed cause)."""
+    # window of 1: every completed request is attributable, so a short
+    # drill is enough for worst_requests.json to exist
+    monkeypatch.setenv(ConfigKey.SERVE_TAIL_MIN_WINDOW, "1")
+    master, replica, router = _serving_stack(312)
+    try:
+        for i in range(3):
+            resp = router.submit([1 + i, 2, 3], max_new_tokens=3,
+                                 request_id=f"obs-http-{i}")
+            assert resp.success, resp.message
+
+        status, metrics = _http_get(replica.http_addr, "/metrics")
+        assert status == 200
+        assert "dlrover_serving_ttft_seconds" in metrics
+        assert "dlrover_serving_tail_cause_total" in metrics
+
+        status, events = _http_get(replica.http_addr, "/events")
+        assert status == 200
+        payload = json.loads(events)
+        kinds = {e["kind"] for e in payload["events"]}
+        assert JournalEvent.REQUEST_TAIL_ATTRIBUTED in kinds
+
+        status, body = _http_get(replica.http_addr, "/debug/bundle")
+        assert status == 200
+        bundle = json.loads(body)
+        assert bundle["ok"], bundle
+        assert "worst_requests.json" in bundle["files"]
+        with open(f"{bundle['path']}/worst_requests.json") as f:
+            worst = json.load(f)
+        assert worst, "bundle retained no worst requests"
+        rec = worst[0]
+        assert rec["cause"] in MetricLabel.TAIL_CAUSES
+        assert rec["trace_id"]
+        span_names = {sp["name"] for sp in rec["spans"]}
+        assert SpanName.SERVE_QUEUE_WAIT in span_names
+    finally:
+        replica.stop()
+        master.stop()
+
+
+# -- the leading signal: burn alert fires BEFORE the reactive grow ----------
+
+
+@pytest.mark.serve
+def test_burst_drill_burn_alert_leads_reactive_grow(monkeypatch):
+    """Under the seeded bursty mixture with a tight TTFT objective,
+    the SLO plane journals ``slo_burn_alert`` strictly before the
+    queue-depth rule journals its first grow: budget burn shows up in
+    COMPLETED slow requests while the queue is still filling toward the
+    reactive threshold (and within a tied autoscaler tick, the plane is
+    evaluated before the scale decision)."""
+    from dlrover_tpu.serving.drill import run_traffic_drill
+
+    # objective below the toy engine's contended TTFT: every queued
+    # completion burns budget from the first burst onward. The reactive
+    # optimizer gets a LOOSE ttft threshold (the env knob is shared), so
+    # its first grow comes from the queue-depth rule alone
+    monkeypatch.setenv(ConfigKey.SERVE_TTFT_SLO_S, "0.011")
+    result = run_traffic_drill(seed=5, ttft_slo_s=30.0)
+    assert result["completed"] == result["offered"]
+    assert result["slo_alerts"] >= 1
+    assert result["journal"].get(JournalEvent.SLO_BURN_ALERT, 0) >= 1
+    assert result["grow_events"] >= 1, "burst never triggered the grow"
+    assert result["first_alert_t"] is not None
+    assert result["first_grow_t"] is not None
+    assert result["first_alert_t"] < result["first_grow_t"], (
+        f"burn alert at {result['first_alert_t']:.3f}s did not lead the "
+        f"reactive grow at {result['first_grow_t']:.3f}s")
+    assert result["slo_lead_s"] > 0
