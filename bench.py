@@ -1191,6 +1191,55 @@ def bench_reshard(budget_s: float = 120.0) -> dict:
         master.stop()
 
 
+def bench_redecompose(budget_s: float = 120.0) -> dict:
+    """Elastic mesh re-decomposition (examples/mesh_redecompose.py): the
+    seeded 8→6 cut where the planner re-forms the survivors as
+    DP×TP=3×2 via a live cross-layout reshard. Claims: replan latency,
+    the cost model's predicted step time at the chosen shape vs keeping
+    the old shape, the measured step time that settles the prediction,
+    and the reshard volume moved with ZERO storage reads."""
+    import subprocess
+
+    if os.environ.get("BENCH_SKIP_CHAOS"):
+        return {"skipped": "BENCH_SKIP_CHAOS set"}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "examples", "mesh_redecompose.py")],
+            env=env, capture_output=True, text=True,
+            timeout=max(60.0, budget_s), cwd=repo,
+        )
+        if proc.returncode != 0:
+            return {"error": proc.stderr[-500:]}
+        r = json.loads(proc.stdout.strip().splitlines()[-1])
+        moved = r.get("bytes_moved", 0) + r.get("reshard_bytes_remote", 0)
+        return {
+            "old_decomp": r.get("old_decomp"),
+            "new_decomp": r.get("new_decomp"),
+            "replan_latency_s": r.get("replan_latency_s"),
+            # cost model: chosen shape on the cut world vs the old
+            # shape's step time at the full world (the goodput price of
+            # losing two hosts, as the planner models it)
+            "predicted_step_s": r.get("predicted_step_s"),
+            "old_shape_predicted_s": r.get("old_shape_predicted_s"),
+            "measured_new_step_s": r.get("measured_new_step_s"),
+            "prediction_outcome": r.get("prediction_outcome"),
+            "reshard_bytes_moved": moved,
+            "engine_reshard_s": r.get("engine_reshard_s"),
+            "storage_restores": r.get("storage_restores"),
+            "zero_storage": r.get("storage_restores") == 0
+            and r.get("ckpt_dir_empty") is True,
+            "bit_exact": r.get("bit_exact"),
+        }
+    except subprocess.TimeoutExpired:
+        return {"error": f"drill timed out after {budget_s:.0f}s"}
+    except Exception as e:  # noqa: BLE001 — bench must still emit a line
+        return {"error": repr(e)}
+
+
 def _fabric_spawn_sources(size_bytes: int, n: int, seed: int = 3):
     """Spawn ``n`` standalone fabric source processes (the same
     ``python -m dlrover_tpu.common.fabric`` entrypoint the SIGKILL
@@ -2014,6 +2063,9 @@ _SECTIONS = (
     ("attn", lambda left: bench_attention(), 90.0),
     ("goodput", lambda left: bench_goodput(timeout_s=left - 10.0), 60.0),
     ("reshard", lambda left: bench_reshard(budget_s=min(left, 150.0)), 45.0),
+    # redecompose: one seeded 8→6 chaos drill (~25 s, subprocess bound)
+    ("redecompose",
+     lambda left: bench_redecompose(budget_s=min(left, 120.0)), 40.0),
     ("fabric", lambda left: bench_fabric(budget_s=min(left, 150.0)), 45.0),
     ("control_plane",
      lambda left: bench_control_plane(budget_s=min(left, 240.0)), 60.0),
@@ -2070,8 +2122,8 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
         name: ("error" if "error" in (detail.get(name) or {})
                else (detail.get(name) or {}).get("skipped") or "ok")
         for name in ("train", "decode", "attn", "goodput", "reshard",
-                     "fabric", "control_plane", "serving", "data", "brain",
-                     "rl", "ckpt")
+                     "redecompose", "fabric", "control_plane", "serving",
+                     "data", "brain", "rl", "ckpt")
         if name in detail
     }
     summary = {
@@ -2129,6 +2181,10 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
         "rl": pick(detail.get("rl") or {}, (
             "trajectories_per_s", "weight_sync_mean_s", "max_staleness",
             "ok")),
+        "redecompose": pick(detail.get("redecompose") or {}, (
+            "new_decomp", "replan_latency_s", "predicted_step_s",
+            "old_shape_predicted_s", "prediction_outcome",
+            "reshard_bytes_moved", "zero_storage")),
         "sections": sections,
     }
     return {

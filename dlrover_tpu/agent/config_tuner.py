@@ -65,6 +65,10 @@ class ParalConfigTuner:
             "grad_accum_steps": config.grad_accum_steps,
             "micro_batch_scale": config.micro_batch_scale,
             "ckpt_interval_s": config.ckpt_interval_s,
+            "mesh_data": config.mesh_data,
+            "mesh_fsdp": config.mesh_fsdp,
+            "mesh_tp": config.mesh_tp,
+            "mesh_version": config.mesh_version,
             "version": config.version,
         }
         tmp = self.config_path + ".tmp"

@@ -305,6 +305,81 @@ def needs_from_state(state) -> Dict[str, NeedSpec]:
     return needs
 
 
+def region_for_coords(
+    gshape: Sequence[int],
+    spec: Sequence,
+    axis_sizes: Dict[str, int],
+    coords: Dict[str, int],
+) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """The (start, shape) block one device owns under a jax-style named
+    sharding. ``spec`` assigns each dim None (replicated), one axis name,
+    or a tuple of axis names (row-major combined, the ``PS((fsdp, tp))``
+    idiom); shorter specs leave trailing dims replicated. Uneven dims use
+    jax's ceil-block rule — trailing blocks clamp, possibly to empty."""
+    start: List[int] = []
+    shape: List[int] = []
+    for d, dim in enumerate(gshape):
+        entry = spec[d] if d < len(spec) else None
+        axes = (
+            () if entry is None
+            else (entry,) if isinstance(entry, str) else tuple(entry)
+        )
+        n = 1
+        idx = 0
+        for axis in axes:
+            size = int(axis_sizes.get(axis, 1))
+            n *= size
+            idx = idx * size + int(coords.get(axis, 0)) % max(1, size)
+        if n <= 1:
+            start.append(0)
+            shape.append(int(dim))
+            continue
+        block = -(-int(dim) // n)  # ceil
+        lo = min(idx * block, int(dim))
+        hi = min(lo + block, int(dim))
+        start.append(lo)
+        shape.append(hi - lo)
+    return tuple(start), tuple(shape)
+
+
+def needs_from_layout(
+    leaves: Dict[str, Tuple[str, Tuple[int, ...]]],
+    specs: Dict[str, Sequence],
+    axis_sizes: Dict[str, int],
+    coords_list: Sequence[Dict[str, int]],
+) -> Dict[str, NeedSpec]:
+    """NeedSpecs for a *target* sharding layout that may differ from the
+    source — the cross-layout half of the Need/Source algebra
+    (``plan_reshard`` is already layout-agnostic; this generates the
+    needs without a placed jax state, so the planner can prove coverage
+    before the new mesh even exists). ``leaves`` maps path →
+    (dtype, gshape); ``specs`` maps path → per-dim axis assignment
+    (:func:`region_for_coords`); ``coords_list`` carries the axis
+    coordinates of every device this process materializes for —
+    replicated coordinates dedup to one region, empty clamped blocks of
+    uneven dims drop out."""
+    needs: Dict[str, NeedSpec] = {}
+    for path, (dtype, gshape) in leaves.items():
+        gshape = tuple(int(g) for g in gshape)
+        spec = specs.get(path, ())
+        regions = set()
+        for coords in coords_list:
+            if not gshape:
+                regions.add(((), ()))
+                continue
+            start, shape = region_for_coords(
+                gshape, spec, axis_sizes, coords)
+            if any(s == 0 for s in shape):
+                continue
+            regions.add((start, shape))
+        if regions:
+            needs[path] = NeedSpec(
+                path=path, dtype=dtype, gshape=gshape,
+                regions=tuple(sorted(regions)),
+            )
+    return needs
+
+
 def plan_reshard(
     layout: Dict[str, ReshardSpec],
     needs: Dict[str, NeedSpec],
@@ -515,12 +590,99 @@ class ReshardCoordinator:
     """Attached to the TRAINING rendezvous manager by the master (same
     post-construction hook pattern as journal/straggler_history). On a
     world cut whose rank set actually changed, publishes the cut record
-    relaunched workers key their reshard on, and journals it."""
+    relaunched workers key their reshard on, and journals it.
 
-    def __init__(self, job_name: str, kv_store, journal=None):
+    With a :class:`~dlrover_tpu.parallel.replan.DecompositionPlanner`
+    wired in, every cut also re-plans the (data, fsdp, tp) decomposition
+    for the new world: the cut record carries ``old_decomp``/
+    ``new_decomp`` (+ the bumped ``mesh_version``) and the chosen shape
+    is pushed through the strategy generator's versioned ParallelConfig
+    pipe. Planner failure — including the ``reshard.replan`` chaos site —
+    degrades to a same-decomposition reshard, journaled with its reason:
+    the cut still publishes, survivors still reshard, nothing new breaks
+    the established ladder."""
+
+    def __init__(self, job_name: str, kv_store, journal=None,
+                 planner=None, strategy_generator=None,
+                 replan_enabled: Optional[bool] = None):
+        from dlrover_tpu.common.constants import env_flag
+
         self._job = job_name
         self._kv = kv_store
         self._journal = journal
+        self.planner = planner
+        self._strategy = strategy_generator
+        self._replan_enabled = (
+            replan_enabled if replan_enabled is not None
+            else env_flag(ConfigKey.REPLAN, True)
+        )
+
+    def _current_decomposition(self, old_world: int):
+        """The decomposition the job is running now: the strategy
+        generator's planned mesh when one exists, else the pre-replan
+        implied shape (fsdp absorbs the world, parallel/mesh.py)."""
+        from dlrover_tpu.parallel.replan import Decomposition
+
+        if self._strategy is not None:
+            got = Decomposition.from_config(self._strategy.config)
+            if got is not None:
+                return got
+        return Decomposition(fsdp=max(1, int(old_world)))
+
+    def _replan(self, cut: Dict, old: List[int], new: List[int]) -> None:
+        """Re-decompose for the new world; on any failure keep the old
+        shape (same-decomposition reshard) and journal why."""
+        old_decomp = self._current_decomposition(len(old))
+        cut["old_decomp"] = old_decomp.to_wire()
+        cut["new_decomp"] = old_decomp.to_wire()
+        if self.planner is None or not self._replan_enabled:
+            return
+        from dlrover_tpu.chaos import InjectedError, InjectedFault
+
+        inj = get_injector()
+        try:
+            with tracing.span(
+                SpanName.RESHARD_REPLAN, source="master",
+                round=cut["round"],
+            ) as sp:
+                if inj is not None:
+                    inj.fire(
+                        "reshard.replan", round=cut["round"],
+                        old_world=len(old), new_world=len(new),
+                    )
+                decision = self.planner.plan(
+                    old_decomp, len(new), reason="world_cut")
+                sp.add_event(
+                    "planned", chosen=decision.chosen.sig(),
+                    predicted_s=decision.predicted_step_time_s,
+                )
+        except (InjectedError, InjectedFault) as e:
+            self._degrade(cut, "fault_injected", repr(e))
+            return
+        except (ValueError, RuntimeError, KeyError, TypeError) as e:
+            self._degrade(cut, "planner_error", repr(e))
+            return
+        cut["new_decomp"] = decision.chosen.to_wire()
+        cut["prediction_id"] = decision.prediction_id
+        if self._strategy is not None:
+            config = self._strategy.set_decomposition(
+                decision.chosen.data, decision.chosen.fsdp,
+                decision.chosen.tp,
+                reason=f"replan r{cut['round']}",
+            )
+            cut["mesh_version"] = config.mesh_version
+
+    def _degrade(self, cut: Dict, reason: str, detail: str) -> None:
+        logger.warning(
+            "reshard replan r%s degraded to same-decomposition (%s: %s)",
+            cut["round"], reason, detail,
+        )
+        if self._journal is not None:
+            self._journal.record(
+                JournalEvent.RESHARD_REPLAN_DEGRADED,
+                round=cut["round"], reason=reason,
+                decomp=cut["old_decomp"],
+            )
 
     def on_world_cut(self, old_ranks, new_ranks,
                      round_: int) -> Optional[Dict]:
@@ -529,6 +691,7 @@ class ReshardCoordinator:
         if not old or old == new:
             return None
         cut = {"round": int(round_), "old": old, "new": new}
+        self._replan(cut, old, new)
         self._kv.set(
             cut_key(self._job, round_), json.dumps(cut).encode()
         )
@@ -536,9 +699,12 @@ class ReshardCoordinator:
             self._journal.record(
                 JournalEvent.RESHARD_PLANNED,
                 round=int(round_), old_world=old, new_world=new,
+                old_decomp=cut.get("old_decomp"),
+                new_decomp=cut.get("new_decomp"),
             )
         logger.info(
-            "reshard cut r%s published: old=%s new=%s", round_, old, new
+            "reshard cut r%s published: old=%s new=%s decomp %s→%s",
+            round_, old, new, cut.get("old_decomp"), cut.get("new_decomp"),
         )
         return cut
 
@@ -664,15 +830,34 @@ class ReshardRestorer:
 
     # -- execution ---------------------------------------------------------
 
-    def restore(self, target, assemble,
-                cut: Dict) -> Tuple[Any, int, Dict[str, Any]]:
+    def restore(self, target, assemble, cut: Dict,
+                needs: Optional[Dict[str, NeedSpec]] = None,
+                ) -> Tuple[Any, int, Dict[str, Any]]:
         """Run the full reshard: plan → prefetch → assemble. ``assemble``
         is the engine's ``_assemble(target, lookup, reader)`` callback.
+        ``needs`` overrides the regions to materialize (cross-layout
+        restore planned before the target state exists —
+        :func:`needs_from_layout`); default derives them from ``target``.
         Returns ``(state, step, stats)``; raises :class:`ReshardAbort`."""
+        return self._guarded(
+            lambda: self._restore(target, assemble, cut, needs))
+
+    def restore_regions(
+        self, cut: Dict, needs: Dict[str, NeedSpec],
+    ) -> Tuple[Dict[str, List[np.ndarray]], int, Dict[str, Any]]:
+        """Cross-layout restore without a placed jax state: plan against
+        explicit :class:`NeedSpec`s (a *target* decomposition's regions,
+        :func:`needs_from_layout`), pull over the fabric, and materialize
+        host numpy blocks per region — zero storage reads. Returns
+        ``(regions, step, stats)`` where ``regions[path][i]`` matches
+        ``needs[path].regions[i]``; raises :class:`ReshardAbort`."""
+        return self._guarded(lambda: self._restore_regions(cut, needs))
+
+    def _guarded(self, attempt):
         from dlrover_tpu.chaos import InjectedError, InjectedFault
 
         try:
-            return self._restore(target, assemble, cut)
+            return attempt()
         except ReshardAbort:
             raise
         except CoverageError as e:
@@ -688,9 +873,9 @@ class ReshardRestorer:
             # malformed meta — anything that means this rung cannot win
             raise ReshardAbort("apply_failed", repr(e)) from e
 
-    def _restore(self, target, assemble, cut):
-        inj = get_injector()
-        t0 = time.monotonic()
+    def _plan_from_cut(self, cut, needs, inj):
+        """Shared plan leg: gather survivor frames, walk steps newest
+        first, prove coverage. Returns ``(plan, layout, values, step)``."""
         with tracing.span(
             SpanName.RESHARD_PLAN, source=self._source,
             round=cut.get("round"),
@@ -706,7 +891,6 @@ class ReshardRestorer:
                     "no_sources",
                     "no surviving reshard source is reachable",
                 )
-            needs = needs_from_state(target)
             all_frames = [
                 entry for metas in frames_by_rank.values()
                 for entry in metas
@@ -739,6 +923,39 @@ class ReshardRestorer:
                 "planned", step=chosen, transfers=len(plan.transfers),
                 bytes=plan.total_bytes,
             )
+        return plan, layout, values, chosen
+
+    def _restore_regions(self, cut, needs):
+        inj = get_injector()
+        t0 = time.monotonic()
+        plan, _, _, chosen = self._plan_from_cut(cut, needs, inj)
+        with tracing.span(
+            SpanName.RESHARD_XFER, source=self._source, step=chosen,
+        ) as sp:
+            stats = self._prefetch(plan, chosen, inj)
+            sp.add_event("fetched", **stats)
+        with tracing.span(
+            SpanName.RESHARD_APPLY, source=self._source, step=chosen,
+        ):
+            regions = execute_plan(
+                plan, needs,
+                lambda src: self._shard_bytes(src, chosen, inj),
+            )
+        stats.update(
+            step=chosen,
+            round=int(cut.get("round", -1)),
+            transfers=len(plan.transfers),
+            bytes=plan.total_bytes,
+            duration_s=time.monotonic() - t0,
+        )
+        return regions, chosen, stats
+
+    def _restore(self, target, assemble, cut, needs=None):
+        inj = get_injector()
+        t0 = time.monotonic()
+        if needs is None:
+            needs = needs_from_state(target)
+        plan, layout, values, chosen = self._plan_from_cut(cut, needs, inj)
 
         with tracing.span(
             SpanName.RESHARD_XFER, source=self._source, step=chosen,

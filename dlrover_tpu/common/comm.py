@@ -563,6 +563,15 @@ class ParallelConfig:
     # Young's-formula checkpoint cadence from the BrainAdvisor's learned
     # fleet MTBF (brain/advisor.py); 0 = untuned, keep the trainer default
     ckpt_interval_s: float = 0.0
+    # re-planned (data, fsdp, tp) mesh decomposition from the world-cut
+    # planner (parallel/replan.py via ReshardCoordinator). All-zero =
+    # never planned, keep the launch-time mesh; mesh_version counts
+    # decomposition changes separately from the overall config version so
+    # a batch-size bump never looks like a mesh change to the trainer
+    mesh_data: int = 0
+    mesh_fsdp: int = 0
+    mesh_tp: int = 0
+    mesh_version: int = 0
     version: int = 0
 
 

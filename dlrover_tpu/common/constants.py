@@ -270,6 +270,15 @@ class ConfigKey:
     RESHARD = "DLROVER_TPU_RESHARD"
     RESHARD_TIMEOUT_S = "DLROVER_TPU_RESHARD_TIMEOUT_S"
     RESHARD_PORT = "DLROVER_TPU_RESHARD_PORT"
+    # mesh re-decomposition (parallel/replan.py): enable flag for the
+    # world-cut planner (default on; off = same-decomposition reshard,
+    # the pre-replan behavior), the largest tensor-parallel degree the
+    # planner may pick (model-shape bound), and how long a chosen
+    # decomposition's step-time prediction stays open before it scores
+    # itself a miss
+    REPLAN = "DLROVER_TPU_REPLAN"
+    REPLAN_MAX_TP = "DLROVER_TPU_REPLAN_MAX_TP"
+    REPLAN_HORIZON_S = "DLROVER_TPU_REPLAN_HORIZON_S"
     # state-movement fabric (common/fabric.py): stripe size (bytes) a bulk
     # transfer is split into, connections a fetcher opens per source, and
     # the per-source concurrent-fetch admission cap (incast protection)
@@ -409,6 +418,10 @@ class SpanName:
     RESHARD_PLAN = "reshard.plan"
     RESHARD_XFER = "reshard.xfer"
     RESHARD_APPLY = "reshard.apply"
+    # mesh re-decomposition (parallel/replan.py via ReshardCoordinator):
+    # the master-side planner pass on a world cut — enumerate + score +
+    # publish; shares the cut's journal round for correlation
+    RESHARD_REPLAN = "reshard.replan"
     # state-movement fabric (common/fabric.py): one striped multi-source
     # transfer session, client side
     FABRIC_FETCH = "fabric.fetch"

@@ -10,6 +10,7 @@ grad-accum rebalanced to hold the global batch fixed
 """
 
 import threading
+from dataclasses import replace
 from typing import Optional
 
 from dlrover_tpu.common import comm
@@ -42,11 +43,14 @@ class SimpleStrategyGenerator:
 
     def set_initial(self, batch_size: int, grad_accum: int = 0) -> None:
         with self._lock:
-            self._config = comm.ParallelConfig(
+            # replace() off the current config so a restored/replanned
+            # mesh decomposition survives the batch-knob initialization
+            self._config = replace(
+                self._config,
                 dataloader_batch_size=batch_size,
                 dataloader_version=1,
                 grad_accum_steps=grad_accum,
-                version=1,
+                version=self._config.version + 1,
             )
 
     def apply_scale(self, scale: float, reason: str = "") -> None:
@@ -62,17 +66,17 @@ class SimpleStrategyGenerator:
             if current.dataloader_batch_size > 0:
                 new_bs = max(_MIN_BATCH,
                              int(current.dataloader_batch_size * scale))
-                self._config = comm.ParallelConfig(
+                self._config = replace(
+                    current,
                     dataloader_batch_size=new_bs,
                     dataloader_version=current.dataloader_version + 1,
-                    grad_accum_steps=current.grad_accum_steps,
                     micro_batch_scale=1.0,
                     version=current.version + 1,
                 )
             else:
-                self._config = comm.ParallelConfig(
+                self._config = replace(
+                    current,
                     micro_batch_scale=current.micro_batch_scale * scale,
-                    dataloader_version=current.dataloader_version,
                     version=current.version + 1,
                 )
             logger.info("strategy: micro-batch scale %s applied (%s)",
@@ -89,16 +93,50 @@ class SimpleStrategyGenerator:
             if current.ckpt_interval_s and abs(
                     current.ckpt_interval_s - interval_s) < 1e-6:
                 return
-            self._config = comm.ParallelConfig(
-                dataloader_batch_size=current.dataloader_batch_size,
-                dataloader_version=current.dataloader_version,
-                grad_accum_steps=current.grad_accum_steps,
-                micro_batch_scale=current.micro_batch_scale,
+            self._config = replace(
+                current,
                 ckpt_interval_s=float(interval_s),
                 version=current.version + 1,
             )
             logger.info("strategy: ckpt interval → %.1fs (%s)",
                         interval_s, reason)
+
+    def set_decomposition(self, data: int, fsdp: int, tp: int,
+                          reason: str = "") -> comm.ParallelConfig:
+        """Push a re-planned (data, fsdp, tp) mesh decomposition
+        (parallel/replan.py via the ReshardCoordinator's world-cut hook).
+        Rides the same versioned pipe as the batch knobs — the agent
+        tuner re-ships the file on the version bump and the trainer
+        re-forms the mesh on the mesh_version change. Returns the new
+        config (the coordinator records mesh_version in the cut)."""
+        with self._lock:
+            current = self._config
+            if (current.mesh_data, current.mesh_fsdp,
+                    current.mesh_tp) == (data, fsdp, tp):
+                return current
+            self._config = replace(
+                current,
+                mesh_data=int(data), mesh_fsdp=int(fsdp), mesh_tp=int(tp),
+                mesh_version=current.mesh_version + 1,
+                version=current.version + 1,
+            )
+            logger.info(
+                "strategy: mesh decomposition → data=%s fsdp=%s tp=%s "
+                "v%s (%s)", data, fsdp, tp,
+                self._config.mesh_version, reason,
+            )
+            return self._config
+
+    def restore_config(self, config: Optional[comm.ParallelConfig]) -> None:
+        """Re-seed the active config after a master restart
+        (MasterStateStore) — without this a restarted master would hand
+        every polling agent a default-constructed ParallelConfig and
+        silently revert the mesh to the launch-time shape."""
+        if config is None:
+            return
+        with self._lock:
+            if config.version >= self._config.version:
+                self._config = config
 
     def worst_hbm_frac(self) -> Optional[float]:
         return self._worst_hbm_frac()
@@ -137,11 +175,11 @@ class SimpleStrategyGenerator:
         if new_bs == current.dataloader_batch_size:
             return None
         with self._lock:
-            self._config = comm.ParallelConfig(
+            self._config = replace(
+                self._config,
                 dataloader_batch_size=new_bs,
                 dataloader_version=current.dataloader_version + 1,
-                grad_accum_steps=current.grad_accum_steps,
-                version=current.version + 1,
+                version=self._config.version + 1,
             )
             logger.info(
                 "strategy: micro-batch %s → %s (worst HBM %.0f%%)",

@@ -162,12 +162,39 @@ class JobMaster:
         )
         # live-reshard plane (ckpt/reshard.py): a TRAINING world cut whose
         # rank set changed publishes the cut record relaunched workers key
-        # their checkpoint-free reshard on
+        # their checkpoint-free reshard on. The mesh re-decomposition
+        # planner (parallel/replan.py) rides the same hook: its cost model
+        # reads the fleet compute/collective split off the skew monitor's
+        # op-telemetry windows, and (when the brain is on, below) shares
+        # the advisor's per-decomposition step-time EWMA.
         from dlrover_tpu.ckpt.reshard import ReshardCoordinator
+        from dlrover_tpu.parallel.replan import DecompositionPlanner
 
+        def _op_split(_sm=self.skew_monitor):
+            from dlrover_tpu.observability.op_telemetry import OpClass
+
+            deltas = _sm.window_deltas()
+
+            def _total(cls):
+                return sum(
+                    v["mean_us"] * v["count"]
+                    for v in (deltas.get(cls) or {}).values()
+                )
+
+            compute = _total(OpClass.COMPUTE)
+            collective = _total(OpClass.COLLECTIVE)
+            if compute + collective <= 0:
+                return None
+            return compute, collective
+
+        self.mesh_planner = DecompositionPlanner(
+            op_split=_op_split, journal=self.event_journal
+        )
         self.rdzv_managers[RendezvousName.TRAINING].reshard_coordinator = (
             ReshardCoordinator(
-                job_name, self.kv_store, journal=self.event_journal
+                job_name, self.kv_store, journal=self.event_journal,
+                planner=self.mesh_planner,
+                strategy_generator=self.strategy_generator,
             )
         )
         if diagnosis_master is None:
@@ -291,6 +318,12 @@ class JobMaster:
             # warm the priors from history a previous incarnation of this
             # job persisted (durable DB); no-op on a fresh in-memory store
             self.brain_advisor.seed_from_store()
+            # the mesh planner scores candidates by the SAME step-time
+            # EWMA the advisor's veto logic learns from — a decomposition
+            # the job has measured beats the analytic model
+            self.mesh_planner.step_time_model = (
+                self.brain_advisor.step_model
+            )
             self.telemetry_persister = TelemetryPersister(
                 self.brain_store,
                 self._brain_job_uuid,

@@ -76,6 +76,12 @@ class MasterStateStore:
             # a bound method on the skew monitor, so restoring the
             # monitor's counts re-seeds the bias)
             "straggler": master.skew_monitor.export_straggler_state(),
+            # the active versioned ParallelConfig (mesh decomposition,
+            # batch knobs): without it a restarted master hands polling
+            # agents a default-constructed config and silently reverts a
+            # re-planned mesh to the launch-time shape
+            "paral_config": comm.serialize(
+                master.strategy_generator.config),
         }
 
     def save(self, master) -> None:
@@ -119,6 +125,14 @@ class MasterStateStore:
         master.skew_monitor.restore_straggler_state(
             snap.get("straggler") or {}
         )
+        raw_config = snap.get("paral_config")
+        if raw_config:
+            try:
+                master.strategy_generator.restore_config(
+                    comm.deserialize(raw_config))
+            except (ValueError, TypeError, KeyError):
+                logger.warning("paral_config snapshot unreadable; "
+                               "keeping defaults", exc_info=True)
         logger.info(
             "master state restored from %s: %d kv keys, %d datasets, "
             "step %s (snapshot age %.1fs)",

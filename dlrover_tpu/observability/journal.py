@@ -68,6 +68,11 @@ class JournalEvent:
     RESHARD_START = "reshard_start"
     RESHARD_COMPLETE = "reshard_complete"
     RESHARD_ABORTED = "reshard_aborted"
+    # mesh re-decomposition plane (parallel/replan.py): the planner failed
+    # (or was chaos-injected) on a world cut and the coordinator degraded
+    # to a same-decomposition reshard — informational, the cut record
+    # still publishes and the reshard itself drives the phases
+    RESHARD_REPLAN_DEGRADED = "reshard_replan_degraded"
     # hierarchical fan-in plane (master/fanin.py): a dead aggregator's
     # children were re-parented to a sibling/the master (informational —
     # deliberately NOT a world cut, so no phase transition), and the
@@ -128,6 +133,11 @@ class JournalEvent:
     BRAIN_PREDICTED_FAILURE = "brain_predicted_failure"
     BRAIN_PREDICTED_RAMP = "brain_predicted_ramp"
     BRAIN_PREDICTED_STRAGGLER = "brain_predicted_straggler"
+    # mesh re-decomposition (parallel/replan.py): the planner's chosen
+    # (data, fsdp, tp) factorization with its predicted step time, scored
+    # hit/miss via brain_prediction_scored when the measured step time at
+    # the new decomposition arrives (or the horizon expires)
+    BRAIN_PREDICTED_DECOMPOSITION = "brain_predicted_decomposition"
     BRAIN_PREDICTION_SCORED = "brain_prediction_scored"
     BRAIN_ACTION = "brain_action"
     BRAIN_DEGRADED = "brain_degraded"
@@ -171,6 +181,7 @@ class JournalEvent:
         SHM_ORPHANS_CLEANED, STRAGGLER_DETECTED, HANG_ATTRIBUTED,
         STACK_DUMP_CAPTURED, TRACE_BUNDLE_CAPTURED, RESHARD_PLANNED,
         RESHARD_START, RESHARD_COMPLETE, RESHARD_ABORTED,
+        RESHARD_REPLAN_DEGRADED,
         FANIN_REPARENTED, FANIN_BACKPRESSURE, CKPT_CHAIN_TRUNCATED,
         SERVE_REPLICA_UP, SERVE_REPLICA_LOST, SERVE_REPLICA_DRAINED,
         SERVE_REQUEST_FAILED, SERVE_REROUTED, SERVE_SCALE,
@@ -179,7 +190,8 @@ class JournalEvent:
         DATA_DISPATCH, DATA_ACK, DATA_REQUEUE, DATA_STEAL,
         DATA_EPOCH_COMPLETE, DATA_STATE_RESTORED,
         BRAIN_PREDICTED_FAILURE, BRAIN_PREDICTED_RAMP,
-        BRAIN_PREDICTED_STRAGGLER, BRAIN_PREDICTION_SCORED,
+        BRAIN_PREDICTED_STRAGGLER, BRAIN_PREDICTED_DECOMPOSITION,
+        BRAIN_PREDICTION_SCORED,
         BRAIN_ACTION, BRAIN_DEGRADED, BRAIN_RECOVERED,
         FABRIC_SOURCE_FAILED, FABRIC_STRIPE_RETRIED,
         FABRIC_SESSION_COMPLETE, FABRIC_SESSION_ABORTED,

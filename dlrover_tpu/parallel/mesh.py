@@ -211,6 +211,19 @@ class ElasticMeshManager:
         logger.info("mesh plan for %s devices: %s", usable, self._plan.axes)
         return self._plan
 
+    def apply_plan(self, plan: MeshPlan) -> None:
+        """Adopt an externally re-planned decomposition (the world-cut
+        planner, parallel/replan.py): the model axes it carries become
+        the new fixed axes, so subsequent world-size replans keep the
+        re-decomposed shape instead of the launch-time one."""
+        self._tp = plan.size("tp")
+        self._pp = plan.size("pp")
+        self._ep = plan.size("ep")
+        self._sp = plan.size("sp")
+        self._dcn = plan.size("dcn")
+        self._plan = plan
+        logger.info("mesh plan adopted: %s", plan.axes)
+
     def build(self, devices: Optional[list] = None):
         if self._plan is None:
             import jax

@@ -1,0 +1,414 @@
+"""Mesh re-decomposition: pick a new (data, fsdp, tp) shape on world change.
+
+The live-reshard plane (ckpt/reshard.py) survives a world cut but keeps the
+*same* parallelism decomposition — lose 2 of 8 hosts and the job runs the
+old shape smaller even when the 6 survivors would be better used as
+DP×TP=3×2. This module is the ElasWave move (arxiv 2510.00606): on every
+rendezvous world cut or grow the planner enumerates the feasible
+``(data, fsdp, tp)`` factorizations of the new world size and scores them
+with a cost model calibrated from what the job *measured* about itself —
+the brain's per-decomposition step-time EWMA
+(:class:`~dlrover_tpu.brain.optimizers.StepTimeModel`) and the fleet
+compute/collective split from op telemetry
+(:mod:`dlrover_tpu.observability.op_telemetry` via the skew monitor's
+window deltas). ROSE (arxiv 2605.06534) motivates the other half: the
+decomposition is a *re-plannable runtime object* — the chosen shape rides
+the versioned ``ParallelConfig`` pipe (master/hyperparams.py →
+agent/config_tuner.py) instead of being a launch-time constant.
+
+Cost model (relative step time at a candidate ``c``, calibrated at the old
+decomposition ``o`` from one measured step time ``T`` split into compute
+fraction ``fc`` and collective fraction ``fl``):
+
+- compute: total work ``W = T·fc·|o|`` spreads over ``|c|`` chips —
+  ``t_comp = W/|c|`` (fixed global batch; tp shards the math too);
+- gradient all-reduce: ring term ``ring(n) = (n−1)/n`` over the
+  data-parallel group, volume ∝ ``1/tp`` (tp shards the params being
+  reduced). Calibrated: ``k = T·fl / (ring(o.dp_total)/o.tp)``;
+- tensor-parallel activation collectives: per-layer all-gathers that the
+  old telemetry cannot see when ``o.tp == 1`` — modeled as
+  ``tp_frac · t_comp · (tp−1)`` (deliberately superlinear in tp so the
+  planner never runs tp past what the measured collective share supports);
+- fsdp weight all-gather nudge: ``fsdp_frac · t_comp · ring(fsdp)`` —
+  small, breaks the dp-vs-fsdp tie toward pure replication when params
+  fit, toward fsdp only when the caller biases it.
+
+Honesty rule: a candidate the job has *measured* (the EWMA holds samples
+for its signature) is scored by the measurement, not the model. Every
+chosen plan is journaled ``brain_predicted_decomposition`` and scored
+hit/miss against the measured step time at the new shape — same ledger
+contract as the brain advisor's other predictions.
+"""
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.common.constants import ConfigKey, env_float, env_int
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.journal import JournalEvent
+
+# planner axis order: data outermost (replicas), tp innermost (ICI
+# neighbors) — matches parallel/mesh.py AXIS_ORDER's dp/fsdp/tp suffix
+REPLAN_AXES = ("data", "fsdp", "tp")
+
+_DEFAULT_MAX_TP = 4
+_DEFAULT_HORIZON_S = 600.0
+# calibration-free fallback split when no op telemetry has arrived yet
+_DEFAULT_COMPUTE_FRAC = 0.7
+
+
+def _ring(n: int) -> float:
+    """Ring all-reduce volume factor: (n-1)/n of the payload per member."""
+    return (n - 1) / n if n > 1 else 0.0
+
+
+@dataclass(frozen=True, slots=True)
+class Decomposition:
+    """One (data, fsdp, tp) factorization of the world size. ``data``
+    replicates params across batch shards, ``fsdp`` shards params across
+    batch shards, ``tp`` shards the math within one batch shard."""
+
+    data: int = 1
+    fsdp: int = 1
+    tp: int = 1
+
+    def __post_init__(self):
+        for axis in REPLAN_AXES:
+            if getattr(self, axis) < 1:
+                raise ValueError(f"decomposition axis {axis} must be ≥ 1")
+
+    @property
+    def world(self) -> int:
+        return self.data * self.fsdp * self.tp
+
+    @property
+    def dp_total(self) -> int:
+        """Members of the gradient all-reduce group (data × fsdp: both
+        shard the batch; fsdp additionally shards the params)."""
+        return self.data * self.fsdp
+
+    def sig(self) -> str:
+        """StepTimeModel config signature — the EWMA key."""
+        return f"d{self.data}f{self.fsdp}t{self.tp}"
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"data": self.data, "fsdp": self.fsdp, "tp": self.tp}
+
+    def coords(self, rank: int) -> Dict[str, int]:
+        """Axis coordinates of one rank, row-major over (data, fsdp, tp)."""
+        if not 0 <= rank < self.world:
+            raise ValueError(f"rank {rank} outside world {self.world}")
+        return {
+            "data": rank // (self.fsdp * self.tp),
+            "fsdp": (rank // self.tp) % self.fsdp,
+            "tp": rank % self.tp,
+        }
+
+    def to_wire(self) -> List[int]:
+        return [self.data, self.fsdp, self.tp]
+
+    @classmethod
+    def from_wire(cls, raw: Optional[Sequence[int]]) -> "Decomposition":
+        if not raw:
+            return cls()
+        vals = [int(v) for v in raw] + [1, 1, 1]
+        return cls(data=vals[0], fsdp=vals[1], tp=vals[2])
+
+    @classmethod
+    def from_config(cls, config) -> Optional["Decomposition"]:
+        """The decomposition a ParallelConfig carries, or None when the
+        mesh fields were never planned (all zero = launch default)."""
+        data = int(getattr(config, "mesh_data", 0) or 0)
+        fsdp = int(getattr(config, "mesh_fsdp", 0) or 0)
+        tp = int(getattr(config, "mesh_tp", 0) or 0)
+        if data <= 0 and fsdp <= 0 and tp <= 0:
+            return None
+        return cls(data=max(1, data), fsdp=max(1, fsdp), tp=max(1, tp))
+
+
+def default_leaf_spec(gshape: Sequence[int]) -> Tuple:
+    """The SNIPPETS-[2] SpecLayout rule as a per-dim axis assignment:
+    matrices shard rows over fsdp and columns over tp (``PS(fsdp, tp)``),
+    vectors shard over fsdp, scalars replicate. ``data`` never appears —
+    params replicate across the batch axis, so data-parallel ranks dedup
+    to the same region."""
+    nd = len(gshape)
+    if nd == 0:
+        return ()
+    if nd == 1:
+        return ("fsdp",)
+    return ("fsdp",) + (None,) * (nd - 2) + ("tp",)
+
+
+def enumerate_decompositions(
+    world: int,
+    max_tp: Optional[int] = None,
+    valid_tp: Optional[Sequence[int]] = None,
+) -> List[Decomposition]:
+    """Every (data, fsdp, tp) with data·fsdp·tp == world and tp within the
+    model-shape bound. Order is the deterministic tie-break: more data
+    replicas first (input parallelism is free), then smaller tp, then
+    smaller fsdp — equal-cost candidates resolve to the first."""
+    if world < 1:
+        return []
+    cap = max_tp if max_tp is not None else env_int(
+        ConfigKey.REPLAN_MAX_TP, _DEFAULT_MAX_TP)
+    allowed = set(int(t) for t in valid_tp) if valid_tp else None
+    out: List[Decomposition] = []
+    for tp in range(1, world + 1):
+        if world % tp != 0 or tp > max(1, cap):
+            continue
+        if allowed is not None and tp not in allowed and tp != 1:
+            continue
+        rest = world // tp
+        for fsdp in range(1, rest + 1):
+            if rest % fsdp != 0:
+                continue
+            out.append(Decomposition(data=rest // fsdp, fsdp=fsdp, tp=tp))
+    out.sort(key=lambda d: (-d.data, d.tp, d.fsdp))
+    return out
+
+
+@dataclass(frozen=True, slots=True)
+class CostSignals:
+    """What the cost model is calibrated from: the measured step time at
+    the old decomposition and its compute/collective split."""
+
+    step_time_s: float = 1.0
+    compute_frac: float = _DEFAULT_COMPUTE_FRAC
+    collective_frac: float = 1.0 - _DEFAULT_COMPUTE_FRAC
+
+
+class DecompositionCostModel:
+    """Analytic relative step-time predictor (module docstring has the
+    derivation). ``tp_frac``/``fsdp_frac`` are the two priors the old
+    telemetry cannot calibrate: per-(tp−1) activation-collective cost and
+    the fsdp weight-gather nudge, both as fractions of per-chip compute."""
+
+    def __init__(self, tp_frac: float = 0.15, fsdp_frac: float = 0.02):
+        self.tp_frac = float(tp_frac)
+        self.fsdp_frac = float(fsdp_frac)
+
+    def predict(self, old: Decomposition, signals: CostSignals,
+                cand: Decomposition) -> float:
+        t_comp_old = max(1e-9, signals.step_time_s * signals.compute_frac)
+        work = t_comp_old * old.world
+        t_comp = work / cand.world
+        t_coll_old = max(0.0, signals.step_time_s * signals.collective_frac)
+        denom = _ring(old.dp_total) / old.tp
+        k = t_coll_old / denom if denom > 0 else t_coll_old
+        t_dp = k * _ring(cand.dp_total) / cand.tp
+        t_tp = self.tp_frac * t_comp * (cand.tp - 1)
+        t_fsdp = self.fsdp_frac * t_comp * _ring(cand.fsdp)
+        return t_comp + t_dp + t_tp + t_fsdp
+
+
+@dataclass(slots=True)
+class ReplanDecision:
+    """One planner verdict: the chosen decomposition for the new world,
+    with every candidate's predicted step time for the journal."""
+
+    old: Decomposition
+    chosen: Decomposition
+    new_world: int
+    predicted_step_time_s: float
+    old_predicted_s: float
+    reason: str = "world_cut"
+    measured: bool = False
+    prediction_id: int = -1
+    scores: Dict[str, float] = field(default_factory=dict)
+
+
+class DecompositionPlanner:
+    """Scores the feasible decompositions of a new world size and keeps
+    the brain-style prediction ledger for its choices.
+
+    ``step_time_model`` is shared with the BrainAdvisor when the brain is
+    on (same EWMA the advisor's veto logic uses, keyed by decomposition
+    signature); ``op_split`` returns the fleet ``(compute_frac,
+    collective_frac)`` from the skew monitor's op-telemetry window, or
+    None before any telemetry arrived. Both degrade to priors — the
+    planner must produce a plan on a cold master."""
+
+    def __init__(
+        self,
+        step_time_model=None,
+        op_split: Optional[Callable[[], Optional[Tuple[float, float]]]]
+        = None,
+        journal=None,
+        max_tp: Optional[int] = None,
+        valid_tp: Optional[Sequence[int]] = None,
+        cost_model: Optional[DecompositionCostModel] = None,
+        horizon_s: Optional[float] = None,
+        hit_tolerance: float = 0.25,
+        monotonic: Callable[[], float] = time.monotonic,
+    ):
+        self.step_time_model = step_time_model
+        self._op_split = op_split
+        self._journal = journal
+        self._max_tp = max_tp
+        self._valid_tp = valid_tp
+        self._cost = cost_model or DecompositionCostModel()
+        self._horizon_s = (
+            horizon_s if horizon_s is not None
+            else env_float(ConfigKey.REPLAN_HORIZON_S, _DEFAULT_HORIZON_S)
+        )
+        self._tolerance = float(hit_tolerance)
+        self._monotonic = monotonic
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._open: List[Dict[str, Any]] = []
+        self._scored: List[Dict[str, Any]] = []
+
+    # -- signals -----------------------------------------------------------
+
+    def _signals(self, old: Decomposition) -> CostSignals:
+        step = None
+        if self.step_time_model is not None:
+            step = self.step_time_model.predict(old.sig())
+        split = None
+        if self._op_split is not None:
+            try:
+                split = self._op_split()
+            except Exception:  # noqa: BLE001 — telemetry must not block a replan
+                logger.warning("replan: op-split provider failed",
+                               exc_info=True)
+        if split is not None:
+            compute, collective = split
+            total = compute + collective
+            if total > 0:
+                return CostSignals(
+                    step_time_s=step if step else 1.0,
+                    compute_frac=compute / total,
+                    collective_frac=collective / total,
+                )
+        return CostSignals(step_time_s=step if step else 1.0)
+
+    def _score(self, old: Decomposition, signals: CostSignals,
+               cand: Decomposition) -> Tuple[float, bool]:
+        model = self.step_time_model
+        if model is not None and model.samples(cand.sig()) > 0:
+            measured = model.predict(cand.sig())
+            if measured is not None:
+                return float(measured), True
+        return self._cost.predict(old, signals, cand), False
+
+    # -- planning ----------------------------------------------------------
+
+    def plan(self, old: Decomposition, new_world: int,
+             reason: str = "world_cut") -> ReplanDecision:
+        """Pick the best decomposition of ``new_world``, journal it as an
+        open prediction. Raises ValueError on an unplannable world (the
+        coordinator degrades to a same-decomposition reshard)."""
+        candidates = enumerate_decompositions(
+            new_world, max_tp=self._max_tp, valid_tp=self._valid_tp)
+        if not candidates:
+            raise ValueError(f"no feasible decomposition of world "
+                             f"{new_world}")
+        signals = self._signals(old)
+        best = None
+        best_score = float("inf")
+        best_measured = False
+        scores: Dict[str, float] = {}
+        for cand in candidates:
+            score, measured = self._score(old, signals, cand)
+            scores[cand.sig()] = round(score, 6)
+            if score < best_score:
+                best, best_score, best_measured = cand, score, measured
+        old_pred, _ = self._score(old, signals, old)
+        decision = ReplanDecision(
+            old=old, chosen=best, new_world=int(new_world),
+            predicted_step_time_s=best_score, old_predicted_s=old_pred,
+            reason=reason, measured=best_measured, scores=scores,
+        )
+        decision.prediction_id = self._open_prediction(decision)
+        logger.info(
+            "replan: world %s→%s decomposition %s→%s "
+            "(predicted %.4fs vs old-shape %.4fs, %s)",
+            old.world, new_world, old.sig(), best.sig(),
+            best_score, old_pred,
+            "measured" if best_measured else "modeled",
+        )
+        return decision
+
+    # -- prediction ledger (brain advisor contract) ------------------------
+
+    def _open_prediction(self, decision: ReplanDecision) -> int:
+        now = self._monotonic()
+        with self._lock:
+            pred_id = self._next_id
+            self._next_id += 1
+            self._open.append({
+                "id": pred_id,
+                "sig": decision.chosen.sig(),
+                "predicted_s": decision.predicted_step_time_s,
+                "deadline_t": now + self._horizon_s,
+            })
+        if self._journal is not None:
+            self._journal.record(
+                JournalEvent.BRAIN_PREDICTED_DECOMPOSITION, source="replan",
+                prediction_id=pred_id,
+                old=decision.old.to_wire(),
+                chosen=decision.chosen.to_wire(),
+                new_world=decision.new_world,
+                predicted_step_time_s=round(
+                    decision.predicted_step_time_s, 6),
+                old_shape_predicted_s=round(decision.old_predicted_s, 6),
+                measured=decision.measured,
+                reason=decision.reason,
+                horizon_s=self._horizon_s,
+                candidates=decision.scores,
+            )
+        return pred_id
+
+    def observe_step_time(self, decomp: Decomposition,
+                          step_time_s: float) -> None:
+        """Feed a measured step time at some decomposition: updates the
+        shared EWMA and settles any open prediction for that shape — hit
+        when the measurement lands within ``hit_tolerance`` of (or beats)
+        the prediction, miss otherwise."""
+        if step_time_s <= 0:
+            return
+        if self.step_time_model is not None:
+            self.step_time_model.observe(decomp.sig(), step_time_s)
+        sig = decomp.sig()
+        with self._lock:
+            due = [p for p in self._open if p["sig"] == sig]
+            for p in due:
+                self._open.remove(p)
+        for p in due:
+            hit = step_time_s <= p["predicted_s"] * (1.0 + self._tolerance)
+            self._settle(p, "hit" if hit else "miss",
+                         measured_s=round(step_time_s, 6))
+
+    def expire(self) -> int:
+        """Score overdue open predictions as misses (a decomposition that
+        never reported a step time did not deliver)."""
+        now = self._monotonic()
+        with self._lock:
+            due = [p for p in self._open if now >= p["deadline_t"]]
+            for p in due:
+                self._open.remove(p)
+        for p in due:
+            self._settle(p, "miss")
+        return len(due)
+
+    def _settle(self, pred: Dict[str, Any], outcome: str, **actual) -> None:
+        with self._lock:
+            self._scored.append({**pred, "outcome": outcome, **actual})
+        if self._journal is not None:
+            self._journal.record(
+                JournalEvent.BRAIN_PREDICTION_SCORED, source="replan",
+                prediction_id=pred["id"], prediction_kind="decomposition",
+                outcome=outcome,
+                predicted_s=round(pred["predicted_s"], 6), **actual,
+            )
+
+    def ledger(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "open": [dict(p) for p in self._open],
+                "scored": [dict(p) for p in self._scored],
+            }

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from dlrover_tpu.common.log import logger
-from dlrover_tpu.parallel.mesh import ElasticMeshManager, MeshPlan
+from dlrover_tpu.parallel.mesh import ElasticMeshManager, MeshPlan, plan_mesh
 
 
 class TrainStepResult(NamedTuple):  # NamedTuple ⇒ a pytree, jit can return it
@@ -51,6 +51,7 @@ class ElasticTrainer:
         self._mesh_manager = mesh_manager
         self.grad_accum_steps = 1
         self._train_step = None
+        self._mesh_version = 0
 
     def configure_for_world(self, plan: MeshPlan) -> int:
         """(Re)compute grad-accum for the current mesh
@@ -70,6 +71,37 @@ class ElasticTrainer:
             dp_total, self.grad_accum_steps, self.global_batch_size,
         )
         return self.grad_accum_steps
+
+    def apply_parallel_config(self, config) -> Optional[MeshPlan]:
+        """Re-form the mesh from a re-planned ``ParallelConfig`` — the
+        tuner-shipped JSON dict (agent/config_tuner.py) or the comm
+        message itself. A ``mesh_version`` the trainer has not applied
+        yet turns the (data, fsdp, tp) decomposition into a
+        :class:`MeshPlan`, adopts it on the mesh manager (so later
+        world-size replans keep the shape), and recomputes grad-accum.
+        Returns the new plan, or None when nothing changed."""
+        if isinstance(config, dict):
+            def get(key):
+                return config.get(key, 0)
+        else:
+            def get(key):
+                return getattr(config, key, 0)
+        version = int(get("mesh_version") or 0)
+        data = max(1, int(get("mesh_data") or 0))
+        fsdp = max(1, int(get("mesh_fsdp") or 0))
+        tp = max(1, int(get("mesh_tp") or 0))
+        if version <= self._mesh_version or data * fsdp * tp <= 1:
+            return None
+        plan = plan_mesh(data * fsdp * tp, tp=tp, fsdp=fsdp, dp=data)
+        if self._mesh_manager is not None:
+            self._mesh_manager.apply_plan(plan)
+        self._mesh_version = version
+        self.configure_for_world(plan)
+        logger.info(
+            "elastic trainer: mesh v%s applied — data=%s fsdp=%s tp=%s",
+            version, data, fsdp, tp,
+        )
+        return plan
 
     @property
     def micro_batch_global(self) -> int:

@@ -177,3 +177,50 @@ def test_chaos_direct_goodput_two_faults():
     assert result["psum_ok"] is True
     # THE bar: measured goodput, no extrapolation
     assert result["goodput_pct"] >= 95.0, result
+
+
+@pytest.mark.chaos
+def test_chaos_mesh_redecompose_drill():
+    """ISSUE-17 acceptance drill (examples/mesh_redecompose.py): SIGKILL
+    2 of 8 hosts mid-step; the survivors re-form as DP×TP=3×2 via a live
+    cross-layout reshard with ZERO storage reads, the planner's choice is
+    journaled and scored like any other brain prediction, and a chaos
+    fault at ``reshard.replan`` degrades a later cut to the same
+    decomposition."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "examples", "mesh_redecompose.py"),
+        ],
+        env=env, capture_output=True, text=True, timeout=360, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    # the planner re-decomposed the 6 survivors as data=3, tp=2 and the
+    # versioned ParallelConfig pipe adopted it
+    assert result["old_decomp"] == [2, 4, 1]
+    assert result["new_decomp"] == [3, 1, 2]
+    assert result["config_mesh"] == [3, 1, 2]
+    assert result["mesh_version"] == 2
+    # live cross-layout reshard, zero storage reads: the engine restore
+    # completed on the reshard rung and every target-rank region matched
+    # the canonical global state bit-exactly
+    assert result["reshard_completes"] >= 1
+    assert result["storage_restores"] == 0
+    assert result["ckpt_dir_empty"] is True
+    assert result["bit_exact"] is True
+    assert result["restored_step"] == 42
+    assert result["regions_verified"] > 0
+    assert result["bytes_moved"] > 0
+    # the choice was journaled as an open brain prediction and settled by
+    # the measured step time at the new shape
+    assert result["prediction_outcome"] == "hit"
+    assert result["predicted_step_s"] > 0
+    # planner-failure injection degraded round 2 to a same-decomposition
+    # reshard, journaled with its reason
+    assert result["degraded_round2"]["happened"] is True
+    assert result["degraded_round2"]["reason"] == "fault_injected"
+    assert result["degraded_round2"]["decomp_kept"] is True

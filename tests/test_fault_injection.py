@@ -351,6 +351,53 @@ def test_sync_join_returns_early_on_reset():
 
 
 @pytest.mark.chaos
+def test_reshard_replan_injection_degrades_to_same_decomposition():
+    """A fault at the ``reshard.replan`` site must not lose the cut: the
+    record still publishes with new_decomp == old_decomp (the pre-replan
+    behavior) and the degradation is journaled with its reason."""
+    from dlrover_tpu.ckpt.reshard import ReshardCoordinator
+    from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
+    from dlrover_tpu.parallel.replan import DecompositionPlanner
+
+    class _KV:
+        def __init__(self):
+            self.data = {}
+
+        def set(self, k, v):
+            self.data[k] = v
+
+    class _Journal:
+        def __init__(self):
+            self.events = []
+
+        def record(self, kind, **data):
+            self.events.append({"kind": kind, **data})
+
+    chaos.configure("reshard.replan:error@times=1", seed=7)
+    kv, journal = _KV(), _Journal()
+    strategy = SimpleStrategyGenerator()
+    strategy.set_decomposition(2, 4, 1, reason="seed")
+    coord = ReshardCoordinator(
+        "job", kv, journal=journal,
+        planner=DecompositionPlanner(max_tp=4),
+        strategy_generator=strategy, replan_enabled=True,
+    )
+    cut = coord.on_world_cut(list(range(8)), list(range(6)), round_=1)
+    assert cut is not None
+    assert cut["old_decomp"] == [2, 4, 1]
+    assert cut["new_decomp"] == [2, 4, 1]  # degraded: shape unchanged
+    degraded = [e for e in journal.events
+                if e["kind"] == "reshard_replan_degraded"]
+    assert degraded and degraded[0]["reason"] == "fault_injected"
+    # the strategy pipe saw no mesh bump from the failed replan
+    assert strategy.config.mesh_version == 1
+    # injection window passed (times=1): the next cut re-plans for real
+    cut2 = coord.on_world_cut(list(range(6)), list(range(4)), round_=2)
+    assert cut2["new_decomp"] != cut2["old_decomp"]
+    assert strategy.config.mesh_version == 2
+
+
+@pytest.mark.chaos
 def test_kv_wait_injection_site():
     from dlrover_tpu.master.kv_store import KVStoreService
 

@@ -1,0 +1,508 @@
+"""Mesh re-decomposition tests (parallel/replan.py + the cross-layout half
+of ckpt/reshard.py): planner enumeration/cost-model choices, the brain-style
+prediction ledger, and property-style proofs that plan+execute between
+random (data, fsdp, tp) source/target factorizations reconstructs the
+brute-force gather/scatter bit-exactly — plus the versioned ParallelConfig
+pipe end to end (strategy generator → state store → tuner file → trainer)."""
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.config_tuner import ParalConfigTuner
+from dlrover_tpu.brain.optimizers import StepTimeModel
+from dlrover_tpu.ckpt.reshard import (
+    CoverageError,
+    ReshardAbort,
+    ReshardCoordinator,
+    ReshardRestorer,
+    execute_plan,
+    layout_from_frames,
+    needs_from_layout,
+    plan_reshard,
+    region_for_coords,
+)
+from dlrover_tpu.common import comm
+from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
+from dlrover_tpu.master.master import LocalJobMaster
+from dlrover_tpu.parallel.mesh import ElasticMeshManager
+from dlrover_tpu.parallel.replan import (
+    CostSignals,
+    Decomposition,
+    DecompositionCostModel,
+    DecompositionPlanner,
+    default_leaf_spec,
+    enumerate_decompositions,
+)
+from dlrover_tpu.trainer.elastic import ElasticTrainer
+
+
+class _Journal:
+    def __init__(self):
+        self.events = []
+
+    def record(self, kind, **data):
+        self.events.append({"kind": kind, **data})
+
+    def of(self, kind):
+        return [e for e in self.events if e["kind"] == kind]
+
+
+class _KV:
+    def __init__(self):
+        self.data = {}
+
+    def set(self, k, v):
+        self.data[k] = v
+
+
+# --------------------------------------------------------------------------
+# Decomposition algebra
+# --------------------------------------------------------------------------
+
+
+def test_enumerate_decompositions_order_and_bound():
+    cands = enumerate_decompositions(6, max_tp=4)
+    sigs = [d.sig() for d in cands]
+    # tie-break order: data desc, then tp asc, then fsdp asc
+    assert sigs == [
+        "d6f1t1", "d3f2t1", "d3f1t2", "d2f3t1", "d2f1t3",
+        "d1f6t1", "d1f3t2", "d1f2t3",
+    ]
+    assert all(d.world == 6 for d in cands)
+    assert all(d.tp <= 4 for d in cands)
+
+
+def test_enumerate_valid_tp_filter():
+    cands = enumerate_decompositions(8, max_tp=8, valid_tp=[2])
+    assert all(d.tp in (1, 2) for d in cands)
+    # tp=1 always stays feasible (the degenerate no-tp decomposition)
+    assert any(d.tp == 1 for d in cands)
+
+
+def test_coords_row_major_and_unique():
+    d = Decomposition(data=2, fsdp=3, tp=2)
+    seen = set()
+    for rank in range(d.world):
+        c = d.coords(rank)
+        seen.add((c["data"], c["fsdp"], c["tp"]))
+    assert len(seen) == d.world
+    assert d.coords(0) == {"data": 0, "fsdp": 0, "tp": 0}
+    assert d.coords(d.world - 1) == {"data": 1, "fsdp": 2, "tp": 1}
+    with pytest.raises(ValueError):
+        d.coords(d.world)
+
+
+def test_wire_and_config_roundtrip():
+    d = Decomposition(data=3, fsdp=1, tp=2)
+    assert Decomposition.from_wire(d.to_wire()) == d
+    assert Decomposition.from_wire(None) == Decomposition()
+    cfg = comm.ParallelConfig(mesh_data=3, mesh_fsdp=1, mesh_tp=2,
+                              mesh_version=1)
+    assert Decomposition.from_config(cfg) == d
+    # all-zero mesh fields = never planned
+    assert Decomposition.from_config(comm.ParallelConfig()) is None
+
+
+# --------------------------------------------------------------------------
+# Cost model + planner choice
+# --------------------------------------------------------------------------
+
+
+def test_cost_model_picks_3x2_for_seeded_8_to_6_cut():
+    """The acceptance-drill shape: (2,4,1) on 8 hosts measured at 60/40
+    compute/collective — the 6 survivors are best used as DP×TP=3×2."""
+    model = StepTimeModel()
+    old = Decomposition(data=2, fsdp=4, tp=1)
+    model.observe(old.sig(), 1.0)
+    planner = DecompositionPlanner(
+        step_time_model=model, op_split=lambda: (0.6, 0.4), max_tp=4)
+    decision = planner.plan(old, 6)
+    assert decision.chosen == Decomposition(data=3, fsdp=1, tp=2)
+    assert not decision.measured
+    assert decision.predicted_step_time_s < decision.scores["d6f1t1"]
+    assert decision.predicted_step_time_s < decision.scores["d2f1t3"]
+
+
+def test_planner_works_cold():
+    """No step-time samples, no op telemetry — priors must still plan."""
+    planner = DecompositionPlanner(max_tp=4)
+    decision = planner.plan(Decomposition(data=2, fsdp=4, tp=1), 6)
+    assert decision.chosen.world == 6
+    assert decision.chosen == Decomposition(data=3, fsdp=1, tp=2)
+
+
+def test_measured_candidate_overrides_model():
+    """Honesty rule: a shape the job has MEASURED is scored by the EWMA,
+    not the analytic model."""
+    model = StepTimeModel()
+    old = Decomposition(data=2, fsdp=4, tp=1)
+    model.observe(old.sig(), 1.0)
+    # the job has actually run d6f1t1 and it was great
+    model.observe("d6f1t1", 0.05)
+    planner = DecompositionPlanner(
+        step_time_model=model, op_split=lambda: (0.6, 0.4), max_tp=4)
+    decision = planner.plan(old, 6)
+    assert decision.chosen.sig() == "d6f1t1"
+    assert decision.measured
+    assert decision.scores["d6f1t1"] == pytest.approx(0.05)
+
+
+def test_unplannable_world_raises():
+    with pytest.raises(ValueError):
+        DecompositionPlanner().plan(Decomposition(fsdp=8), 0)
+
+
+def test_cost_model_tp_term_superlinear():
+    """tp must not run away: at equal world, more tp always adds the
+    activation-collective term."""
+    cost = DecompositionCostModel()
+    old = Decomposition(data=2, fsdp=4, tp=1)
+    sig = CostSignals(step_time_s=1.0, compute_frac=0.99,
+                      collective_frac=0.01)
+    t2 = cost.predict(old, sig, Decomposition(data=3, fsdp=1, tp=2))
+    t1 = cost.predict(old, sig, Decomposition(data=6, fsdp=1, tp=1))
+    assert t2 > t1  # nearly-zero collective share: tp buys nothing
+
+
+# --------------------------------------------------------------------------
+# Prediction ledger (brain advisor contract)
+# --------------------------------------------------------------------------
+
+
+def test_prediction_journaled_and_scored_hit():
+    journal = _Journal()
+    clock = [0.0]
+    planner = DecompositionPlanner(
+        step_time_model=StepTimeModel(), journal=journal, max_tp=4,
+        horizon_s=600.0, monotonic=lambda: clock[0])
+    decision = planner.plan(Decomposition(data=2, fsdp=4, tp=1), 6)
+    opened = journal.of("brain_predicted_decomposition")
+    assert len(opened) == 1
+    assert opened[0]["chosen"] == decision.chosen.to_wire()
+    assert opened[0]["prediction_id"] == decision.prediction_id
+    assert "candidates" in opened[0]
+    # measured step time lands within tolerance → hit
+    planner.observe_step_time(
+        decision.chosen, decision.predicted_step_time_s * 1.1)
+    scored = journal.of("brain_prediction_scored")
+    assert len(scored) == 1
+    assert scored[0]["outcome"] == "hit"
+    assert scored[0]["prediction_kind"] == "decomposition"
+    assert not planner.ledger()["open"]
+
+
+def test_prediction_scored_miss_and_expiry():
+    journal = _Journal()
+    clock = [0.0]
+    planner = DecompositionPlanner(
+        journal=journal, max_tp=4, horizon_s=600.0,
+        monotonic=lambda: clock[0])
+    d1 = planner.plan(Decomposition(data=2, fsdp=4, tp=1), 6)
+    # way over the tolerance band → miss
+    planner.observe_step_time(d1.chosen, d1.predicted_step_time_s * 3.0)
+    assert journal.of("brain_prediction_scored")[-1]["outcome"] == "miss"
+    # an open prediction that never reports a step time expires as a miss
+    planner.plan(Decomposition(data=3, fsdp=1, tp=2), 4)
+    assert planner.expire() == 0
+    clock[0] = 601.0
+    assert planner.expire() == 1
+    assert journal.of("brain_prediction_scored")[-1]["outcome"] == "miss"
+    assert not planner.ledger()["open"]
+
+
+# --------------------------------------------------------------------------
+# region_for_coords: jax ceil-block semantics
+# --------------------------------------------------------------------------
+
+
+def test_region_ceil_blocks_uneven_dim():
+    sizes = {"fsdp": 3}
+    got = [
+        region_for_coords((7,), ("fsdp",), sizes, {"fsdp": i})
+        for i in range(3)
+    ]
+    assert got == [((0,), (3,)), ((3,), (3,)), ((6,), (1,))]
+    # 4-way split of 5 rows: the last block clamps to EMPTY
+    sizes = {"fsdp": 4}
+    got = [
+        region_for_coords((5,), ("fsdp",), sizes, {"fsdp": i})
+        for i in range(4)
+    ]
+    assert got == [((0,), (2,)), ((2,), (2,)), ((4,), (1,)), ((5,), (0,))]
+
+
+def test_region_combined_axes_row_major():
+    # PS((fsdp, tp)) on dim0: 2×2 = 4 row-major blocks of an (8, 3)
+    sizes = {"fsdp": 2, "tp": 2}
+    starts = [
+        region_for_coords(
+            (8, 3), (("fsdp", "tp"),), sizes, {"fsdp": f, "tp": t}
+        )[0]
+        for f in range(2) for t in range(2)
+    ]
+    assert starts == [(0, 0), (2, 0), (4, 0), (6, 0)]
+
+
+def test_region_replicated_and_short_spec():
+    # axes of size 1 and dims beyond the spec replicate
+    got = region_for_coords((4, 6), ("fsdp",), {"fsdp": 1}, {"fsdp": 0})
+    assert got == ((0, 0), (4, 6))
+
+
+# --------------------------------------------------------------------------
+# Property: random cross-layout plan+execute == brute force
+# --------------------------------------------------------------------------
+
+
+def _factorizations(world):
+    return enumerate_decompositions(world, max_tp=world)
+
+
+def _source_frames(globals_, decomp):
+    """One frame meta per source rank: its decomposition shard of every
+    leaf (default spec), plus the byte store execute_plan fetches from."""
+    frames, store = [], {}
+    for rank in range(decomp.world):
+        coords = decomp.coords(rank)
+        leaves, offset = [], 0
+        for path, arr in globals_.items():
+            spec = default_leaf_spec(arr.shape)
+            start, shape = region_for_coords(
+                arr.shape, spec, decomp.axis_sizes(), coords)
+            if any(s == 0 for s in shape):
+                continue
+            sl = tuple(slice(l, l + s) for l, s in zip(start, shape))
+            block = np.ascontiguousarray(arr[sl])
+            leaves.append({
+                "path": path, "kind": "array", "dtype": str(arr.dtype),
+                "gshape": list(arr.shape),
+                "shards": [{
+                    "offset": offset, "nbytes": block.nbytes,
+                    "lshape": list(shape), "start": list(start),
+                }],
+            })
+            store[(rank, 0, path)] = block.tobytes()
+            offset += block.nbytes
+        frames.append({
+            "step": 5, "node_rank": rank, "local_rank": 0,
+            "leaves": leaves,
+        })
+    return frames, store
+
+
+def _leaves_decl(globals_):
+    return {p: (str(a.dtype), tuple(a.shape)) for p, a in globals_.items()}
+
+
+def _specs_decl(globals_):
+    return {p: default_leaf_spec(a.shape) for p, a in globals_.items()}
+
+
+def test_random_cross_layout_reshard_bit_exact():
+    rng = random.Random(20260806)
+    nprng = np.random.default_rng(20260806)
+    for trial in range(12):
+        src = rng.choice(_factorizations(rng.choice([4, 6, 8, 12])))
+        tgt = rng.choice(_factorizations(rng.choice([2, 3, 4, 6, 9])))
+        globals_ = {
+            "['w']": nprng.standard_normal(
+                (rng.choice([5, 8, 12]), rng.choice([3, 4, 6]))
+            ).astype(np.float32),
+            "['b']": nprng.standard_normal(
+                (rng.choice([7, 9, 16]),)).astype(np.float32),
+        }
+        frames, store = _source_frames(globals_, src)
+        layout, _ = layout_from_frames(frames)
+        for rank in range(tgt.world):
+            needs = needs_from_layout(
+                _leaves_decl(globals_), _specs_decl(globals_),
+                tgt.axis_sizes(), [tgt.coords(rank)])
+            plan = plan_reshard(layout, needs, step=5)
+            out = execute_plan(
+                plan, needs,
+                lambda s: store[(s.node_rank, s.local_rank, s.path)])
+            for path, need in needs.items():
+                for ridx, (rstart, rshape) in enumerate(need.regions):
+                    sl = tuple(
+                        slice(l, l + s) for l, s in zip(rstart, rshape))
+                    np.testing.assert_array_equal(
+                        out[path][ridx], globals_[path][sl],
+                        err_msg=f"trial {trial} {src.sig()}→{tgt.sig()} "
+                                f"rank {rank} {path} region {ridx}",
+                    )
+
+
+def test_cross_layout_needs_dedup_replicas():
+    """data-parallel target ranks that own the SAME param block dedup to
+    one region (params replicate across data)."""
+    tgt = Decomposition(data=3, fsdp=1, tp=2)
+    leaves = {"['w']": ("float32", (8, 4))}
+    specs = {"['w']": default_leaf_spec((8, 4))}
+    all_coords = [tgt.coords(r) for r in range(tgt.world)]
+    needs = needs_from_layout(leaves, specs, tgt.axis_sizes(), all_coords)
+    # 6 ranks but only fsdp(1)×tp(2) = 2 distinct regions
+    assert len(needs["['w']"].regions) == 2
+    assert needs["['w']"].regions == (((0, 0), (8, 2)), ((0, 2), (8, 2)))
+
+
+def test_coverage_hole_raises_before_any_byte_moves():
+    src = Decomposition(data=1, fsdp=4, tp=1)  # no replicas: every shard unique
+    globals_ = {"['w']": np.arange(32, dtype=np.float32).reshape(8, 4)}
+    frames, _ = _source_frames(globals_, src)
+    layout, _ = layout_from_frames(frames[:3])  # rank 3's rows are GONE
+    tgt = Decomposition(data=2, fsdp=1, tp=1)
+    needs = needs_from_layout(
+        _leaves_decl(globals_), _specs_decl(globals_),
+        tgt.axis_sizes(), [tgt.coords(0)])
+    with pytest.raises(CoverageError):
+        plan_reshard(layout, needs, step=5)
+
+
+def test_stale_step_walkdown_and_refusal():
+    """The plan leg walks steps newest-first: a straggler's older frame is
+    used only when the newest step has a coverage hole; when NO single
+    step covers, the rung refuses rather than mixing steps."""
+    src = Decomposition(data=1, fsdp=2, tp=1)
+    globals_ = {"['w']": np.arange(32, dtype=np.float32).reshape(8, 4)}
+    frames9, _ = _source_frames(globals_, src)
+    frames7, _ = _source_frames(globals_, src)
+    for f in frames9:
+        f["step"] = 9
+    for f in frames7:
+        f["step"] = 7
+
+    class _StubRestorer(ReshardRestorer):
+        def __init__(self, metas):
+            super().__init__("job", None, node_rank=0)
+            self._metas = metas
+
+        def gather_frames(self, source_ranks):
+            out = {}
+            for m in self._metas:
+                out.setdefault(m["node_rank"], []).append(
+                    (m["local_rank"], m["step"], m))
+            return out
+
+    tgt = Decomposition(data=1, fsdp=1, tp=1)
+    needs = needs_from_layout(
+        _leaves_decl(globals_), _specs_decl(globals_),
+        tgt.axis_sizes(), [tgt.coords(0)])
+    cut = {"round": 1, "old": [0, 1], "new": [0]}
+    # step 9 lost rank 1's shard → walk down to complete step 7
+    r = _StubRestorer([frames9[0]] + frames7)
+    plan, _, _, chosen = r._plan_from_cut(cut, needs, None)
+    assert chosen == 7
+    assert plan.total_bytes == globals_["['w']"].nbytes
+    # rank 0 only at step 9, rank 1 only at step 7: no step covers alone
+    r2 = _StubRestorer([frames9[0], frames7[1]])
+    with pytest.raises(ReshardAbort) as ei:
+        r2._plan_from_cut(cut, needs, None)
+    assert ei.value.reason == "coverage"
+
+
+# --------------------------------------------------------------------------
+# The versioned ParallelConfig pipe
+# --------------------------------------------------------------------------
+
+
+def test_coordinator_replans_and_pushes_config():
+    journal, kv = _Journal(), _KV()
+    strategy = SimpleStrategyGenerator()
+    strategy.set_decomposition(2, 4, 1, reason="seed")
+    coord = ReshardCoordinator(
+        "job", kv, journal=journal,
+        planner=DecompositionPlanner(journal=journal, max_tp=4),
+        strategy_generator=strategy, replan_enabled=True,
+    )
+    cut = coord.on_world_cut(list(range(8)), [0, 1, 2, 3, 4, 6], round_=1)
+    assert cut["old_decomp"] == [2, 4, 1]
+    assert cut["new_decomp"] == [3, 1, 2]
+    assert cut["mesh_version"] == 2
+    assert cut["prediction_id"] >= 0
+    cfg = strategy.config
+    assert (cfg.mesh_data, cfg.mesh_fsdp, cfg.mesh_tp) == (3, 1, 2)
+    planned = journal.of("reshard_planned")[0]
+    assert planned["old_decomp"] == [2, 4, 1]
+    assert planned["new_decomp"] == [3, 1, 2]
+    assert journal.of("brain_predicted_decomposition")
+    # the KV cut record carries the decompositions for relaunched workers
+    raw = json.loads(next(iter(kv.data.values())).decode())
+    assert raw["new_decomp"] == [3, 1, 2]
+
+
+def test_coordinator_replan_disabled_keeps_shape():
+    kv = _KV()
+    strategy = SimpleStrategyGenerator()
+    strategy.set_decomposition(2, 4, 1)
+    coord = ReshardCoordinator(
+        "job", kv, planner=DecompositionPlanner(max_tp=4),
+        strategy_generator=strategy, replan_enabled=False,
+    )
+    cut = coord.on_world_cut(list(range(8)), list(range(6)), round_=1)
+    assert cut["new_decomp"] == cut["old_decomp"] == [2, 4, 1]
+    assert strategy.config.mesh_version == 1  # untouched
+
+
+def test_parallel_config_survives_master_restart(tmp_path):
+    job = f"redecomp{os.getpid()}"
+    state_dir = str(tmp_path / "state")
+    m = LocalJobMaster(job_name=job, node_num=1, state_dir=state_dir)
+    m.prepare()
+    try:
+        m.strategy_generator.set_decomposition(3, 1, 2, reason="test")
+        version = m.strategy_generator.config.version
+        m._state_store.save(m)
+    finally:
+        m.stop()
+    m2 = LocalJobMaster(job_name=job, node_num=1, state_dir=state_dir)
+    m2.prepare()
+    try:
+        cfg = m2.strategy_generator.config
+        assert (cfg.mesh_data, cfg.mesh_fsdp, cfg.mesh_tp) == (3, 1, 2)
+        assert cfg.mesh_version == 1
+        assert cfg.version == version
+    finally:
+        m2.stop()
+
+
+def test_tuner_ships_mesh_fields(tmp_path):
+    cfg = comm.ParallelConfig(
+        mesh_data=3, mesh_fsdp=1, mesh_tp=2, mesh_version=1, version=2)
+
+    class _Client:
+        def get_parallel_config(self):
+            return cfg
+
+    path = str(tmp_path / "cfg" / "paral_config.json")
+    tuner = ParalConfigTuner(_Client(), path, interval_s=999)
+    assert tuner.poll_once()
+    with open(path, encoding="utf-8") as f:
+        payload = json.load(f)
+    assert payload["mesh_data"] == 3
+    assert payload["mesh_fsdp"] == 1
+    assert payload["mesh_tp"] == 2
+    assert payload["mesh_version"] == 1
+
+
+def test_trainer_reforms_mesh_from_config():
+    trainer = ElasticTrainer(
+        loss_fn=lambda p, b: 0.0, optimizer=None,
+        global_batch_size=12, micro_batch_per_replica=2,
+        mesh_manager=ElasticMeshManager(),
+    )
+    plan = trainer.apply_parallel_config(
+        {"mesh_version": 1, "mesh_data": 3, "mesh_fsdp": 1, "mesh_tp": 2})
+    assert plan is not None
+    assert plan.size("tp") == 2
+    assert plan.dp_total == 3
+    assert trainer.grad_accum_steps == 2
+    # idempotent: an already-applied version is a no-op
+    assert trainer.apply_parallel_config(
+        {"mesh_version": 1, "mesh_data": 3, "mesh_fsdp": 1,
+         "mesh_tp": 2}) is None
+    # the adopted shape becomes the manager's fixed model axes
+    assert trainer._mesh_manager.min_unit == 2

@@ -214,7 +214,12 @@ def test_coordinator_publishes_and_worker_reads_cut(master):
     assert not master.kv_store.get(cut_key(JOB, 4))
 
     cut = coord.on_world_cut([0, 1], [0], 5)
-    assert cut == {"round": 5, "old": [0, 1], "new": [0]}
+    assert cut["round"] == 5
+    assert cut["old"] == [0, 1]
+    assert cut["new"] == [0]
+    # the mesh re-decomposition fields ride the same record; with no
+    # planner attached the decomposition is inferred and kept as-is
+    assert cut["old_decomp"] == cut["new_decomp"]
     planned = _events_of(master.event_journal, "reshard_planned")
     assert planned and planned[-1]["data"]["old_world"] == [0, 1]
 
