@@ -2043,6 +2043,38 @@ def bench_rl(budget_s: float = 120.0) -> dict:
         return {"error": repr(e)}
 
 
+def bench_static_analysis(budget_s: float = 120.0) -> dict:
+    """Static-analysis plane: wall time of the full two-pass analyzer
+    run — per-file rules DLR001-DLR013 plus the whole-program rules
+    DLR014-DLR017 (package call graph + fixpoint summaries + contract
+    certification) — per-rule violation counts, and whether the run fits
+    the tier-1 runtime budget the CI gate rides on."""
+    from collections import Counter
+
+    from dlrover_tpu.analysis.engine import analyze_package
+
+    try:
+        t0 = time.monotonic()
+        report = analyze_package()
+        wall_s = time.monotonic() - t0
+        per_rule = Counter(v.rule for v in report.violations)
+        runtime_budget_s = 60.0  # tier-1 ceiling; ~5s on a dev box
+        return {
+            "wall_s": round(wall_s, 2),
+            "runtime_budget_s": runtime_budget_s,
+            "runtime_budget_ok": wall_s < runtime_budget_s,
+            "gate_ok": report.ok,
+            "violations": len(report.violations),
+            "new": len(report.new),
+            "baselined": len(report.baselined),
+            "stale_baseline": len(report.stale_baseline),
+            "stale_noqa": len(report.stale_noqa),
+            "per_rule": dict(sorted(per_rule.items())),
+        }
+    except Exception as e:  # noqa: BLE001 — bench must still emit a line
+        return {"error": repr(e)}
+
+
 # Wall-clock discipline (round-4 fix for the r3 rc=124 record hole): the
 # driver runs bench.py under a ~30-min budget; this process budgets
 # BENCH_TIME_BUDGET_S (default 20 min) across sections, RE-PRINTS the
@@ -2079,7 +2111,19 @@ _SECTIONS = (
     ("brain", lambda left: bench_brain(budget_s=min(left, 60.0)), 15.0),
     # rl: CPU-sized chaos drill (~10 s of wall; subprocess spawn bound)
     ("rl", lambda left: bench_rl(budget_s=min(left, 120.0)), 30.0),
-    ("ckpt", lambda left: bench_ckpt(budget_s=left), 120.0),
+    # static_analysis: pure-CPU AST pass (~8 s), no accelerator time.
+    # Floor reserves ckpt's 120 s floor on top of its own cost: the lint
+    # pass must never be the reason ckpt (the section the CI smoke
+    # asserts) gets budget-skipped — under a tight budget it yields.
+    ("static_analysis",
+     lambda left: bench_static_analysis(budget_s=min(left, 120.0)), 150.0),
+    # ckpt's floor is an attempt-guard, not a cost estimate: the section
+    # is budget-aware all the way down (device point gets max(60,
+    # left-110), restore attempts re-check the budget, the weather guard
+    # shrinks the state) — so attempt it whenever a minimal 60 s device
+    # point fits rather than dropping the record's headline number when
+    # cold compiles leave the tail of the budget a few seconds short.
+    ("ckpt", lambda left: bench_ckpt(budget_s=left), 60.0),
 )
 
 
@@ -2123,7 +2167,7 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
                else (detail.get(name) or {}).get("skipped") or "ok")
         for name in ("train", "decode", "attn", "goodput", "reshard",
                      "redecompose", "fabric", "control_plane", "serving",
-                     "data", "brain", "rl", "ckpt")
+                     "data", "brain", "rl", "static_analysis", "ckpt")
         if name in detail
     }
     summary = {
@@ -2181,6 +2225,9 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
         "rl": pick(detail.get("rl") or {}, (
             "trajectories_per_s", "weight_sync_mean_s", "max_staleness",
             "ok")),
+        "static_analysis": pick(detail.get("static_analysis") or {}, (
+            "wall_s", "runtime_budget_ok", "gate_ok", "violations",
+            "new")),
         "redecompose": pick(detail.get("redecompose") or {}, (
             "new_decomp", "replan_latency_s", "predicted_step_s",
             "old_shape_predicted_s", "prediction_outcome",

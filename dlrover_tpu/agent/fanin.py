@@ -40,6 +40,7 @@ from dlrover_tpu.chaos import get_injector
 from dlrover_tpu.common import comm, retry
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.constants import (
+    ChaosSite,
     ConfigKey,
     DiagnosisActionType,
     SpanName,
@@ -203,7 +204,7 @@ class FaninAggregator:
             # children's beats staged. An error kind ⇒ RuntimeError ⇒
             # the flush loop tears this aggregator down, the staged
             # beats still in place for whoever inherits the subtree
-            inj.fire("agg.forward", agg=self._node_id)
+            inj.fire(ChaosSite.AGG_FORWARD, agg=self._node_id)
         with self._lock:
             if not self._beats and not self._events and not self._acks:
                 return
@@ -239,7 +240,7 @@ class FaninAggregator:
                               source=f"agent_{self._node_id}",
                               beats=len(wire_beats)):
                 if inj is not None:
-                    inj.fire("hb.fanin", agg=self._node_id,
+                    inj.fire(ChaosSite.HB_FANIN, agg=self._node_id,
                              beats=len(wire_beats))
                 resp = self._mc.fanin_heartbeat(req)
             self._forwarded += 1

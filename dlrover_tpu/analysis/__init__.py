@@ -31,16 +31,60 @@ DLR010     ``time.sleep`` polling loop on a flag that should block on a
 DLR011     mutation of a thread-shared attribute (marked via
            ``race_detector.shared(...)`` or ``# thread-shared``) outside
            a ``with <lock>:`` body
+DLR012     rename-commit without flush+fsync in the same function, or a
+           bare ``os.rename`` on a commit path
+DLR013     metric label values not drawn from bounded constant sets
+           (cardinality explosions kill the scrape plane)
+=========  ==============================================================
+
+DLR008/DLR009 cover ``ThreadPoolExecutor`` too: a pool without
+``thread_name_prefix=`` is as unattributable as an unnamed thread, and a
+pool handle nobody ``.shutdown()``s (outside a ``with`` block) leaks its
+workers like an unjoined thread.
+
+The whole-program half (:mod:`dlrover_tpu.analysis.callgraph` +
+:mod:`dlrover_tpu.analysis.interproc`) builds a package-wide call graph —
+``self.``-method resolution via a class scan with MRO, aliased imports,
+``Thread(target=...)`` / ``pool.submit(fn)`` / ``functools.partial``
+modeled as thread-entry edges — and propagates per-function facts
+(may-block, locks-acquired, journal kinds emitted with payload keys,
+chaos sites fired) to a fixpoint. Four rules run over the result, behind
+the same noqa/baseline machinery:
+
+=========  ==============================================================
+DLR014     interprocedural blocking-under-lock: a call made while a lock
+           is held into a function that (transitively) may block —
+           DLR004 generalized through the call graph, reported with the
+           full witness chain
+DLR015     static lock-order inversion: a cycle in the whole-program
+           acquired-before graph, reported with both acquisition paths
+           (the static complement of the runtime LockOrderDetector)
+DLR016     chaos-site contract: every ``inj.fire(...)`` site must be
+           declared on ``constants.ChaosSite``, catalogued in
+           ``docs/design/fault_injection.md``, and exercised by a
+           chaos-marked test — bidirectionally (no phantom catalog rows,
+           no dead registry entries)
+DLR017     journal-kind contract: recorded kinds must be declared on
+           ``JournalEvent`` (and listed in ``ALL``); payload keys are
+           aggregated across producers and every consumer read of a
+           key no producer attaches is flagged as a silent ``None``
 =========  ==============================================================
 
 Suppression is explicit: an inline ``# noqa: DLR00X`` (with a reason) on
 the flagged line, or an entry in the checked-in baseline
 (``dlrover_tpu/analysis/baseline.txt``) for violations deliberately
 deferred. ``python -m dlrover_tpu.analysis --check`` exits non-zero on any
-violation not covered by either. Both suppression layers are themselves
-checked for rot: stale baseline entries and stale noqa codes (the line no
-longer trips that rule) are reported, and ``--fix-noqa`` strips the
-latter.
+violation not covered by either — and on suppression rot itself: stale
+baseline entries and stale noqa codes (the line no longer trips that
+rule) fail the gate, and ``--fix-noqa`` strips the latter.
+
+CLI modes beyond ``--check``: ``--contracts`` prints the cross-artifact
+certification matrix (chaos-site fired/declared/catalogued/tested,
+journal kinds with their producer key sets, call-graph stats);
+``--changed-only [BASE]`` scopes the per-file pass to package files
+changed vs a git ref (default ``HEAD``) plus untracked files — the tight
+edit-loop mode; it skips the whole-program pass, which only makes sense
+over the full package.
 
 The runtime half is two detectors that instrument ``threading`` under
 pytest:

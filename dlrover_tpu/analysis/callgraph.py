@@ -1,0 +1,660 @@
+"""Package-wide call graph for the whole-program half of the analyzer.
+
+The per-file rules (DLR001–DLR013) see one function at a time; the bug
+classes that killed real jobs — a blocking RPC reached *through* a helper
+while a lock is held, a lock-order inversion whose two acquisitions live
+in different modules — only exist in the composition. This module builds
+the static structure the interprocedural pass (:mod:`interproc`) runs
+over:
+
+- **Definitions**: every module-level function, class method, and nested
+  function in the package, keyed by dotted qualname
+  (``dlrover_tpu.common.rpc.RpcClient.call``).
+- **Call edges**: bare-name calls, aliased-import calls
+  (``from a.b import f as g; g()``), ``self.``-method calls resolved via
+  a package-wide class scan with single-inheritance MRO walk,
+  ``self._attr.m()`` / ``local.m()`` calls resolved through naive type
+  bindings (``self._attr = ClassName(...)``), and ``functools.partial``
+  unwrapped to its target.
+- **Thread-entry edges**: ``threading.Thread(target=fn)``,
+  ``pool.submit(fn, ...)`` and ``pool.map(fn, ...)`` model ``fn`` as the
+  entry point of ANOTHER thread — the callable is reachable (so its
+  facts exist) but the *caller* does not block in it and holds no lock
+  ordering against it. This is how DLR008/009/011-style thread
+  discipline extends to pool workers.
+- **Per-function facts** consumed by the fixpoint pass: direct blocking
+  calls (DLR004's predicate), locks acquired via ``with`` (with the
+  locks lexically held at every call site), journal-kind emissions with
+  their payload keys, and chaos-site ``fire(...)`` calls.
+
+Identity conventions: a lock attribute ``self._lock`` on class ``C`` of
+module ``m`` normalizes to ``m.C._lock`` — static identity is per
+*class attribute*, not per instance, so re-entering the same attribute
+(RLock reentry) is a self-edge the lock-order check deliberately
+ignores. Module-level locks normalize to ``m._lock``; locals/params to
+``<fn-qualname>:<name>`` (never equal across functions).
+"""
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dlrover_tpu.analysis.rules import (
+    _BLOCKING_TAILS,
+    _BLOCKING_RECEIVER_RE,
+    _IO_TAILS,
+    _JOURNAL_RECEIVER_RE,
+    _LOCKISH_RE,
+    _dotted,
+    attach_parents,
+)
+
+_INJECTOR_RECEIVER_RE = re.compile(r"(^|[._])inj(ector)?s?$", re.IGNORECASE)
+
+
+def is_blocking_call(name: str) -> bool:
+    """DLR004's blocking predicate over a dotted call name — shared so
+    the interprocedural pass and the per-file rule agree on what blocks."""
+    if not name:
+        return False
+    tail = name.rsplit(".", 1)[-1]
+    receiver = name.rsplit(".", 1)[0] if "." in name else ""
+    return tail in _BLOCKING_TAILS or bool(
+        receiver and tail in _IO_TAILS
+        and _BLOCKING_RECEIVER_RE.search(receiver)
+    )
+
+
+@dataclass
+class JournalEmit:
+    """One statically-visible journal emission."""
+
+    kind: Optional[str]  # resolved kind string; None = not resolvable
+    keys: Tuple[str, ...]  # payload keys the producer attaches
+    dynamic: bool  # **kwargs / non-literal payload: keys are open
+    line: int
+    via: str  # "record" | "report_event"
+
+
+@dataclass
+class ChaosFire:
+    """One statically-visible ``inj.fire(site, ...)`` call."""
+
+    site: Optional[str]  # resolved site string; None = not resolvable
+    line: int
+    ctx_keys: Tuple[str, ...] = ()
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str
+    module: str
+    cls: Optional[str]
+    name: str
+    path: str  # repo-relative posix path
+    node: ast.AST
+    lineno: int
+    # local facts (filled by the module scan)
+    blocking: List[Tuple[int, str]] = field(default_factory=list)
+    locks: Dict[str, int] = field(default_factory=dict)  # lock id -> line
+    # every lock acquisition with the locks already held at that point
+    # (the raw material of the acquired-before graph)
+    lock_sites: List[Tuple[str, int, Tuple[str, ...]]] = \
+        field(default_factory=list)
+    journal_emits: List[JournalEmit] = field(default_factory=list)
+    chaos_fires: List[ChaosFire] = field(default_factory=list)
+
+
+@dataclass
+class CallSite:
+    caller: str
+    callee: str
+    path: str
+    line: int
+    locks_held: Tuple[str, ...]  # innermost-last lexical lock context
+    kind: str = "call"  # "call" | "thread" | "partial"
+
+
+class _Module:
+    def __init__(self, name: str, path: str, tree: ast.AST,
+                 lines: List[str]):
+        self.name = name
+        self.path = path
+        self.tree = tree
+        self.lines = lines
+        self.aliases: Dict[str, str] = {}  # local name -> dotted target
+        self.constants: Dict[str, object] = {}  # NAME -> str | ref-str
+
+
+class CallGraph:
+    """The package-wide graph plus the symbol tables used to build it."""
+
+    def __init__(self) -> None:
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.calls: List[CallSite] = []
+        self.modules: Dict[str, _Module] = {}
+        # class qualname -> {method name -> fn qualname}
+        self.class_methods: Dict[str, Dict[str, str]] = {}
+        # class qualname -> base class qualnames (package-internal only)
+        self.class_bases: Dict[str, List[str]] = {}
+        # class qualname -> {self attr -> class qualname} type bindings
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        # global string-constant table: dotted name -> value
+        self.str_constants: Dict[str, str] = {}
+        # thread-entry targets (qualnames reached via Thread/submit/map)
+        self.thread_entries: Set[str] = set()
+        self.calls_by_caller: Dict[str, List[CallSite]] = {}
+
+    # -- lookup helpers ------------------------------------------------------
+
+    def resolve_method(self, cls_qual: str, method: str,
+                       _seen: Optional[Set[str]] = None) -> Optional[str]:
+        """Find ``method`` on ``cls_qual`` or its package-internal bases."""
+        seen = _seen if _seen is not None else set()
+        if cls_qual in seen:
+            return None
+        seen.add(cls_qual)
+        hit = self.class_methods.get(cls_qual, {}).get(method)
+        if hit is not None:
+            return hit
+        for base in self.class_bases.get(cls_qual, ()):
+            hit = self.resolve_method(base, method, seen)
+            if hit is not None:
+                return hit
+        return None
+
+    def resolve_constant(self, dotted: str,
+                         _depth: int = 0) -> Optional[str]:
+        """Value of a string constant by dotted name, following one level
+        of aliasing (``FABRIC_CONNECT_SITE = ChaosSite.FABRIC_CONNECT``)."""
+        if _depth > 4:
+            return None
+        val = self.str_constants.get(dotted)
+        if isinstance(val, str):
+            return val
+        ref = self._const_refs.get(dotted)
+        if ref is not None:
+            return self.resolve_constant(ref, _depth + 1)
+        return None
+
+    _const_refs: Dict[str, str]
+
+
+def _module_name(rel_path: str) -> str:
+    mod = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _iter_own_nodes(fn_node: ast.AST):
+    """Walk a function body without descending into nested function
+    defs (they are separate FunctionInfos). Lambdas stay part of the
+    enclosing function: their bodies run wherever they are invoked and
+    modeling them separately only loses facts."""
+    body = fn_node.body if isinstance(fn_node.body, list) else [fn_node.body]
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _locks_held_at(node: ast.AST, fn_node: ast.AST,
+                   lock_id) -> Tuple[str, ...]:
+    """Lock identities lexically held at ``node`` inside ``fn_node``
+    (outermost first). ``lock_id(expr)`` maps a with-item to an identity
+    or None."""
+    chain: List[str] = []
+    cur = getattr(node, "_dlr_parent", None)
+    while cur is not None and cur is not fn_node:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                lid = lock_id(item.context_expr)
+                if lid:
+                    chain.append(lid)
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break  # nested def boundary: outer locks are not held at run time
+        cur = getattr(cur, "_dlr_parent", None)
+    chain.reverse()
+    return tuple(chain)
+
+
+def build_callgraph(root: str,
+                    package_dirs: Sequence[str] = ("dlrover_tpu",),
+                    ) -> CallGraph:
+    """Parse every ``.py`` file under ``root``'s package dirs and build
+    the graph. ``root`` is the repo root; paths in the graph are
+    repo-relative posix."""
+    graph = CallGraph()
+    graph._const_refs = {}
+    files: List[Tuple[str, str]] = []  # (abs, rel)
+    for pkg in package_dirs:
+        top = os.path.join(root, pkg)
+        if os.path.isfile(top):
+            files.append((top, os.path.relpath(top, root).replace(os.sep, "/")))
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if not d.startswith(".") and d != "__pycache__")
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    ap = os.path.join(dirpath, f)
+                    files.append(
+                        (ap, os.path.relpath(ap, root).replace(os.sep, "/"))
+                    )
+    for abs_path, rel in files:
+        with open(abs_path, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = attach_parents(ast.parse(source))
+        except SyntaxError:
+            continue  # DLR000 surfaces it in the per-file pass
+        mod = _Module(_module_name(rel), rel, tree, source.splitlines())
+        graph.modules[mod.name] = mod
+        _scan_module_symbols(graph, mod)
+    # second pass: per-function facts + call edges need the full symbol
+    # tables (a call into a module scanned later must still resolve)
+    for mod in graph.modules.values():
+        _scan_module_bodies(graph, mod)
+    graph.calls_by_caller = {}
+    for cs in graph.calls:
+        graph.calls_by_caller.setdefault(cs.caller, []).append(cs)
+    return graph
+
+
+# -- pass 1: symbols ---------------------------------------------------------
+
+
+def _scan_module_symbols(graph: CallGraph, mod: _Module) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else alias.name.split(".")[0]
+                mod.aliases[local] = target
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative import: resolve against this module
+                base = mod.name.rsplit(".", node.level)[0]
+                src = f"{base}.{node.module}" if node.module else base
+            else:
+                src = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                mod.aliases[alias.asname or alias.name] = f"{src}.{alias.name}"
+    # module-level constants and functions/classes
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            if isinstance(stmt.value, ast.Constant) and isinstance(
+                stmt.value.value, str
+            ):
+                graph.str_constants[f"{mod.name}.{name}"] = stmt.value.value
+            else:
+                ref = _dotted(stmt.value)
+                if ref:
+                    resolved = _resolve_name(graph, mod, None, ref)
+                    if resolved:
+                        graph._const_refs[f"{mod.name}.{name}"] = resolved
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _register_function(graph, mod, stmt, cls=None)
+        elif isinstance(stmt, ast.ClassDef):
+            _register_class(graph, mod, stmt)
+
+
+def _register_class(graph: CallGraph, mod: _Module, cls: ast.ClassDef) -> None:
+    cls_qual = f"{mod.name}.{cls.name}"
+    methods = graph.class_methods.setdefault(cls_qual, {})
+    graph.class_bases[cls_qual] = [
+        _dotted(b) for b in cls.bases if _dotted(b)
+    ]  # resolved lazily in pass 2 (all symbols exist then)
+    attr_types = graph.attr_types.setdefault(cls_qual, {})
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fq = _register_function(graph, mod, stmt, cls=cls.name)
+            methods[stmt.name] = fq
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            if isinstance(stmt.value, ast.Constant) and isinstance(
+                stmt.value.value, str
+            ):
+                graph.str_constants[
+                    f"{cls_qual}.{stmt.targets[0].id}"
+                ] = stmt.value.value
+    # class scan for self-attribute type bindings: self._x = ClassName(...)
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            continue
+        if isinstance(node.value, ast.Call):
+            ctor = _dotted(node.value.func)
+            if ctor:
+                attr_types.setdefault(tgt.attr, ctor)  # resolved in pass 2
+
+
+def _register_function(graph: CallGraph, mod: _Module, fn: ast.AST,
+                       cls: Optional[str]) -> str:
+    # nested functions get a parent-prefixed qualname via the parent chain
+    parts = [fn.name]
+    cur = getattr(fn, "_dlr_parent", None)
+    while cur is not None and not isinstance(cur, ast.Module):
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        cur = getattr(cur, "_dlr_parent", None)
+    qual = f"{mod.name}." + ".".join(reversed(parts))
+    graph.functions[qual] = FunctionInfo(
+        qualname=qual, module=mod.name, cls=cls, name=fn.name,
+        path=mod.path, node=fn, lineno=fn.lineno,
+    )
+    # register nested defs too (they are their own scopes)
+    for sub in ast.walk(fn):
+        if sub is fn or not isinstance(
+            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue
+        subqual = f"{qual}.{sub.name}"
+        if subqual not in graph.functions:
+            graph.functions[subqual] = FunctionInfo(
+                qualname=subqual, module=mod.name, cls=cls, name=sub.name,
+                path=mod.path, node=sub, lineno=sub.lineno,
+            )
+    return qual
+
+
+# -- pass 2: facts + edges ---------------------------------------------------
+
+
+def _resolve_name(graph: CallGraph, mod: _Module, cls_qual: Optional[str],
+                  dotted: str) -> Optional[str]:
+    """Best-effort resolution of a dotted name written in ``mod`` to a
+    package-global dotted name (function, class, method, or constant)."""
+    if not dotted:
+        return None
+    head, _, rest = dotted.partition(".")
+    target = mod.aliases.get(head)
+    if target is None:
+        # module-local symbol?
+        local = f"{mod.name}.{head}"
+        if (local in graph.functions or local in graph.class_methods
+                or local in graph.str_constants
+                or local in graph._const_refs):
+            target = local
+        else:
+            return None
+    return f"{target}.{rest}" if rest else target
+
+
+def _resolve_callee(graph: CallGraph, mod: _Module, fn: FunctionInfo,
+                    locals_types: Dict[str, str],
+                    dotted: str) -> Optional[str]:
+    """Resolve a call's dotted name to a FunctionInfo qualname."""
+    if not dotted:
+        return None
+    parts = dotted.split(".")
+    cls_qual = f"{mod.name}.{fn.cls}" if fn.cls else None
+    if parts[0] in ("self", "cls") and cls_qual:
+        if len(parts) == 2:
+            return graph.resolve_method(cls_qual, parts[1])
+        if len(parts) == 3:
+            # self._attr.m() through the class-scan type binding
+            attr_cls = graph.attr_types.get(cls_qual, {}).get(parts[1])
+            if attr_cls:
+                resolved_cls = _resolve_name(graph, mod, cls_qual, attr_cls)
+                if resolved_cls in graph.class_methods:
+                    return graph.resolve_method(resolved_cls, parts[2])
+        return None
+    # local variable with a known class type: v = ClassName(...); v.m()
+    if len(parts) == 2 and parts[0] in locals_types:
+        resolved_cls = locals_types[parts[0]]
+        if resolved_cls in graph.class_methods:
+            return graph.resolve_method(resolved_cls, parts[1])
+    resolved = _resolve_name(graph, mod, cls_qual, dotted)
+    if resolved is None:
+        return None
+    if resolved in graph.functions:
+        return resolved
+    if resolved in graph.class_methods:  # ClassName(...) -> __init__
+        return graph.resolve_method(resolved, "__init__")
+    # module.Class.method or module.function through an alias chain
+    if resolved.rsplit(".", 1)[0] in graph.class_methods:
+        owner, meth = resolved.rsplit(".", 1)
+        return graph.resolve_method(owner, meth)
+    return None
+
+
+def _callable_ref(graph: CallGraph, mod: _Module, fn: FunctionInfo,
+                  locals_types: Dict[str, str],
+                  expr: ast.expr) -> Optional[str]:
+    """Resolve a callable-valued expression (a Thread target, a submit
+    arg, a partial target) to a function qualname."""
+    if isinstance(expr, ast.Call):
+        name = _dotted(expr.func)
+        if name.rsplit(".", 1)[-1] == "partial" and (expr.args):
+            return _callable_ref(graph, mod, fn, locals_types, expr.args[0])
+        return None
+    if isinstance(expr, ast.Lambda):
+        return None  # lambda bodies stay part of the enclosing function
+    dotted = _dotted(expr)
+    if not dotted:
+        return None
+    # a bare name may be a nested function of this scope
+    nested = f"{fn.qualname}.{dotted}"
+    if nested in graph.functions:
+        return nested
+    return _resolve_callee(graph, mod, fn, locals_types, dotted)
+
+
+def _lock_identity(graph: CallGraph, mod: _Module, fn: FunctionInfo,
+                   expr: ast.expr) -> Optional[str]:
+    """Normalize a with-item expression to a lock identity, or None when
+    it is not lock-like. See the module docstring for the conventions."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func  # with lock_factory() — use the factory name
+    dotted = _dotted(expr)
+    if not dotted or not _LOCKISH_RE.search(dotted):
+        return None
+    parts = dotted.split(".")
+    if parts[0] == "self" and fn.cls:
+        owner = f"{mod.name}.{fn.cls}"
+        # locks on a typed sub-object: self._conn._lock -> ConnCls._lock
+        if len(parts) == 3:
+            attr_cls = graph.attr_types.get(owner, {}).get(parts[1])
+            if attr_cls:
+                resolved = _resolve_name(graph, mod, owner, attr_cls)
+                if resolved:
+                    return f"{resolved}.{parts[2]}"
+        return f"{owner}." + ".".join(parts[1:])
+    resolved = _resolve_name(graph, mod, None, dotted)
+    if resolved and (resolved.rsplit(".", 1)[0] in graph.modules
+                     or resolved.rsplit(".", 1)[0] in graph.class_methods):
+        return resolved
+    if len(parts) == 1:
+        # module-level lock referenced by bare name, else a local/param
+        mod_level = f"{mod.name}.{parts[0]}"
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == parts[0]
+                for t in stmt.targets
+            ):
+                return mod_level
+        return f"{fn.qualname}:{parts[0]}"
+    return f"{fn.qualname}:{dotted}"
+
+
+def _scan_module_bodies(graph: CallGraph, mod: _Module) -> None:
+    # resolve class bases + attr-type ctor names now that all symbols exist
+    for cls_qual, bases in list(graph.class_bases.items()):
+        if not cls_qual.startswith(mod.name + ".") or \
+                cls_qual.rsplit(".", 1)[0] != mod.name:
+            continue
+        graph.class_bases[cls_qual] = [
+            b for b in (_resolve_name(graph, mod, None, raw) for raw in bases)
+            if b in graph.class_methods
+        ]
+        attr_types = graph.attr_types.get(cls_qual, {})
+        for attr, ctor in list(attr_types.items()):
+            resolved = _resolve_name(graph, mod, cls_qual, ctor)
+            if resolved in graph.class_methods:
+                attr_types[attr] = resolved
+            else:
+                del attr_types[attr]
+    for fn in [f for f in graph.functions.values() if f.module == mod.name]:
+        _scan_function(graph, mod, fn)
+
+
+def _scan_function(graph: CallGraph, mod: _Module, fn: FunctionInfo) -> None:
+    lock_id = lambda e: _lock_identity(graph, mod, fn, e)  # noqa: E731
+    locals_types: Dict[str, str] = {}
+    # naive local type bindings first (v = ClassName(...))
+    for node in _iter_own_nodes(fn.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call):
+            ctor = _resolve_name(graph, mod,
+                                 f"{mod.name}.{fn.cls}" if fn.cls else None,
+                                 _dotted(node.value.func))
+            if ctor in graph.class_methods:
+                locals_types[node.targets[0].id] = ctor
+    for node in _iter_own_nodes(fn.node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            outer = _locks_held_at(node, fn.node, lock_id)
+            acquired_here: List[str] = []
+            for item in node.items:
+                lid = lock_id(item.context_expr)
+                if lid:
+                    fn.locks.setdefault(lid, node.lineno)
+                    # held = enclosing withs + earlier items of this one
+                    fn.lock_sites.append(
+                        (lid, node.lineno, outer + tuple(acquired_here))
+                    )
+                    acquired_here.append(lid)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if not name:
+            continue
+        tail = name.rsplit(".", 1)[-1]
+        receiver = name.rsplit(".", 1)[0] if "." in name else ""
+        held = _locks_held_at(node, fn.node, lock_id)
+        # thread entries: Thread(target=...), pool.submit(fn,...), pool.map
+        target_expr: Optional[ast.expr] = None
+        entry_kind = None
+        if tail == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target_expr = kw.value
+            entry_kind = "thread"
+        elif tail in ("submit", "map") and receiver:
+            if node.args:
+                target_expr = node.args[0]
+            entry_kind = "thread"
+        elif tail == "partial":
+            if node.args:
+                target_expr = node.args[0]
+            entry_kind = "partial"
+        if target_expr is not None and entry_kind:
+            ref = _callable_ref(graph, mod, fn, locals_types, target_expr)
+            if ref:
+                graph.calls.append(CallSite(
+                    caller=fn.qualname, callee=ref, path=mod.path,
+                    line=node.lineno, locks_held=held, kind=entry_kind,
+                ))
+                if entry_kind == "thread":
+                    graph.thread_entries.add(ref)
+        # journal emissions
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            recv = _dotted(node.func.value)
+            if (attr == "record" and _JOURNAL_RECEIVER_RE.search(recv)) or \
+                    attr in ("report_event", "_report_event"):
+                fn.journal_emits.append(
+                    _journal_emit(graph, mod, fn, node, attr)
+                )
+            elif attr == "fire" and _INJECTOR_RECEIVER_RE.search(recv):
+                site = None
+                if node.args:
+                    site = _resolve_str_value(graph, mod, fn, node.args[0])
+                fn.chaos_fires.append(ChaosFire(
+                    site=site, line=node.lineno,
+                    ctx_keys=tuple(sorted(
+                        kw.arg for kw in node.keywords if kw.arg
+                    )),
+                ))
+        # blocking predicate (DLR004's, shared)
+        if is_blocking_call(name):
+            fn.blocking.append((node.lineno, name))
+        # plain call edge
+        callee = _resolve_callee(graph, mod, fn, locals_types, name)
+        if callee is None and "." not in name:
+            nested = f"{fn.qualname}.{name}"
+            if nested in graph.functions:
+                callee = nested
+        if callee is not None and callee != fn.qualname:
+            graph.calls.append(CallSite(
+                caller=fn.qualname, callee=callee, path=mod.path,
+                line=node.lineno, locks_held=held, kind="call",
+            ))
+
+
+def _resolve_str_value(graph: CallGraph, mod: _Module, fn: FunctionInfo,
+                       expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    dotted = _dotted(expr)
+    if not dotted:
+        return None
+    resolved = _resolve_name(
+        graph, mod, f"{mod.name}.{fn.cls}" if fn.cls else None, dotted
+    )
+    if resolved:
+        val = graph.resolve_constant(resolved)
+        if val is not None:
+            return val
+    # direct table hit for module-local names
+    return graph.resolve_constant(f"{mod.name}.{dotted}")
+
+
+def _journal_emit(graph: CallGraph, mod: _Module, fn: FunctionInfo,
+                  node: ast.Call, via: str) -> JournalEmit:
+    kind_expr: Optional[ast.expr] = node.args[0] if node.args else None
+    for kw in node.keywords:
+        if kw.arg == "kind":
+            kind_expr = kw.value
+    kind = (_resolve_str_value(graph, mod, fn, kind_expr)
+            if kind_expr is not None else None)
+    keys: List[str] = []
+    dynamic = False
+    if via == "record":
+        for kw in node.keywords:
+            if kw.arg is None:
+                dynamic = True
+            elif kw.arg not in ("source", "kind"):
+                keys.append(kw.arg)
+    else:  # report_event(kind, {...}) — dict-literal payload
+        payload = node.args[1] if len(node.args) > 1 else None
+        for kw in node.keywords:
+            if kw.arg in ("data", "payload"):
+                payload = kw.value
+        if isinstance(payload, ast.Dict):
+            for k in payload.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.append(k.value)
+                else:
+                    dynamic = True
+        elif payload is not None:
+            dynamic = True
+    return JournalEmit(kind=kind, keys=tuple(sorted(keys)), dynamic=dynamic,
+                       line=node.lineno, via=via)

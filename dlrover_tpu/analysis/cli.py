@@ -1,11 +1,15 @@
 """``python -m dlrover_tpu.analysis`` — run the invariant analyzer.
 
 Exit status is non-zero whenever violations NOT covered by an inline
-``# noqa: DLR00X`` or the baseline exist, so the same invocation gates CI
-and local pre-commit runs. Typical flows::
+``# noqa: DLR00X`` or the baseline exist — and, under ``--check``, when
+the suppressions themselves have rotted (stale baseline entries or stale
+noqa comments) — so the same invocation gates CI and local pre-commit
+runs. Typical flows::
 
     python -m dlrover_tpu.analysis --check          # CI gate
     python -m dlrover_tpu.analysis                  # full listing
+    python -m dlrover_tpu.analysis --contracts      # contract matrices
+    python -m dlrover_tpu.analysis --changed-only   # diff vs HEAD only
     python -m dlrover_tpu.analysis --update-baseline  # accept current state
     python -m dlrover_tpu.analysis --fix-noqa       # strip stale noqa codes
     python -m dlrover_tpu.analysis --list-rules
@@ -13,6 +17,7 @@ and local pre-commit runs. Typical flows::
 
 import argparse
 import os
+import subprocess
 import sys
 from typing import List, Optional
 
@@ -22,6 +27,7 @@ from dlrover_tpu.analysis.engine import (
     check,
     default_baseline_path,
     fix_stale_noqa,
+    interproc_package,
     load_baseline,
     package_root,
     write_baseline,
@@ -29,11 +35,39 @@ from dlrover_tpu.analysis.engine import (
 from dlrover_tpu.analysis.rules import ALL_RULES
 
 
+def changed_files(root: str, base: str = "HEAD") -> List[str]:
+    """Python files under the package changed vs ``base`` (git diff plus
+    untracked), as absolute paths. Deleted files are skipped."""
+    rels: List[str] = []
+    for cmd in (
+        ["git", "-C", root, "diff", "--name-only", base, "--"],
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            out = subprocess.run(
+                cmd, capture_output=True, text=True, check=True, timeout=30,
+            ).stdout
+        except (OSError, subprocess.SubprocessError) as e:
+            raise SystemExit(f"--changed-only: git failed: {e}")
+        rels.extend(line.strip() for line in out.splitlines() if line.strip())
+    files = []
+    for rel in sorted(set(rels)):
+        if not rel.endswith(".py"):
+            continue
+        if not rel.replace(os.sep, "/").startswith("dlrover_tpu/"):
+            continue
+        fpath = os.path.join(root, rel)
+        if os.path.isfile(fpath):
+            files.append(fpath)
+    return files
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m dlrover_tpu.analysis",
         description="dlrover_tpu control-plane invariant analyzer "
-                    "(rules DLR001-DLR011; see docs/design/"
+                    "(per-file rules DLR001-DLR013 plus whole-program "
+                    "rules DLR014-DLR017; see docs/design/"
                     "static_analysis.md and docs/design/"
                     "concurrency_analysis.md)",
     )
@@ -45,7 +79,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--check", action="store_true",
         help="print only NEW violations (not baselined/noqa'd); exit 1 "
-             "if any exist",
+             "if any exist, or if any baseline entry / noqa comment has "
+             "gone stale (suppression hygiene is part of the gate)",
+    )
+    parser.add_argument(
+        "--changed-only", nargs="?", const="HEAD", default=None,
+        metavar="BASE",
+        help="analyze only package files changed vs the given git ref "
+             "(default HEAD) plus untracked files; skips the "
+             "whole-program pass, which needs the full package",
+    )
+    parser.add_argument(
+        "--contracts", action="store_true",
+        help="print the cross-artifact contract report (chaos-site "
+             "matrix, journal kinds/keys, call-graph stats) and exit",
+    )
+    parser.add_argument(
+        "--no-interproc", action="store_true",
+        help="skip the whole-program pass (DLR014-DLR017); per-file "
+             "rules only — faster, for tight edit loops",
     )
     parser.add_argument(
         "--baseline", default=None,
@@ -71,16 +123,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rule in ALL_RULES:
+        from dlrover_tpu.analysis.interproc import INTERPROC_RULES
+        for rule in list(ALL_RULES) + list(INTERPROC_RULES):
             summary = (rule.__doc__ or rule.__name__).strip().splitlines()[0]
             print(f"{rule.rule_id}  {rule.__name__}: {summary}")
         return 0
 
     root = package_root()
-    paths = args.paths or [os.path.join(root, "dlrover_tpu")]
+
+    if args.contracts:
+        from dlrover_tpu.analysis import interproc as ip
+        analysis = ip.analyze(ip.InterprocConfig(root=root))
+        print(ip.contracts_report(analysis))
+        return 0
+
+    if args.changed_only is not None:
+        paths = changed_files(root, args.changed_only)
+        if not paths:
+            print(f"--changed-only: no package .py files changed vs "
+                  f"{args.changed_only}")
+            return 0
+        run_interproc = False
+    else:
+        paths = args.paths or [os.path.join(root, "dlrover_tpu")]
+        # the whole-program pass only makes sense over the whole package
+        run_interproc = not args.paths and not args.no_interproc
+
     stale_noqa: List[StaleNoqa] = []
     violations = analyze_paths(paths, root=root,
                                stale_noqa_out=stale_noqa)
+    if run_interproc:
+        violations = violations + interproc_package(
+            root=root, stale_noqa_out=stale_noqa
+        )
+        violations.sort(key=lambda v: (v.path, v.line, v.rule))
 
     if args.fix_noqa:
         changed = fix_stale_noqa(stale_noqa, root=root)
@@ -100,14 +176,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     report = check(violations, baseline)
     report.stale_noqa = stale_noqa
 
+    # a scoped run (explicit paths / --changed-only / --no-interproc) only
+    # sees a slice of the package, so unmatched baseline entries are not
+    # evidence of rot — judge suppression hygiene on full runs only
+    full_scope = run_interproc and not args.no_baseline
+
     shown = report.new if args.check else report.violations
     baselined_fps = {id(v) for v in report.baselined}
     for v in shown:
         tag = "" if id(v) not in baselined_fps else "  [baselined]"
         print(v.render() + tag)
-    for fp in report.stale_baseline:
-        print(f"stale baseline entry (violation fixed — prune it): "
-              f"{fp[0]} {fp[1]} | {fp[2]}")
+    if full_scope:
+        for fp in report.stale_baseline:
+            print(f"stale baseline entry (violation fixed — prune it): "
+                  f"{fp[0]} {fp[1]} | {fp[2]}")
     for s in report.stale_noqa:
         print(s.render())
     print(report.summary())
@@ -117,6 +199,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             "`# noqa: DLR00X — reason`, or (for deliberate deferral) "
             "re-run with --update-baseline.\n"
             "repro: python -m dlrover_tpu.analysis --check"
+        )
+        return 1
+    if args.check and full_scope and (
+        report.stale_baseline or report.stale_noqa
+    ):
+        print(
+            "\nsuppression rot. Prune stale baseline entries "
+            "(--update-baseline) and strip stale noqa codes (--fix-noqa) "
+            "— dead suppressions hide the next real violation."
         )
         return 1
     return 0

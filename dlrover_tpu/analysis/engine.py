@@ -154,17 +154,70 @@ def package_root() -> str:
     )))
 
 
+def interproc_package(
+    root: Optional[str] = None,
+    rules: Optional[Sequence] = None,
+    stale_noqa_out: Optional[List[StaleNoqa]] = None,
+) -> List[Violation]:
+    """Run the whole-program rules (DLR014–DLR017) over the package:
+    build the call graph, compute the fixpoint summaries, run the rules,
+    then apply the same noqa machinery the per-file pass uses (markdown
+    targets have no noqa — only the baseline can suppress those)."""
+    # local import: callgraph/interproc import from rules; engine is the
+    # composition point, so the cycle is broken here
+    from dlrover_tpu.analysis import interproc as ip
+
+    root = os.path.abspath(root or package_root())
+    analysis = ip.analyze(ip.InterprocConfig(root=root))
+    raw = ip.run_rules(analysis, rules)
+    active = list(rules if rules is not None else ip.INTERPROC_RULES)
+    known = {getattr(r, "rule_id", "") for r in active}
+    out: List[Violation] = []
+    earned: Dict[Tuple[str, int], set] = {}
+    for v in raw:
+        if v.path.endswith(".py"):
+            lines = analysis.lines(v.path)
+            if 0 < v.line <= len(lines) and v.rule in noqa_codes(
+                lines[v.line - 1]
+            ):
+                earned.setdefault((v.path, v.line), set()).add(v.rule)
+                continue
+        out.append(v)
+    if stale_noqa_out is not None:
+        for mod in analysis.graph.modules.values():
+            for lineno, line in enumerate(mod.lines, 1):
+                for code in sorted(noqa_codes(line)):
+                    if code in known and code not in earned.get(
+                        (mod.path, lineno), ()
+                    ):
+                        stale_noqa_out.append(StaleNoqa(
+                            path=mod.path, line=lineno, code=code,
+                            line_text=line.strip(),
+                        ))
+    return out
+
+
 def analyze_package(
     rules: Optional[Sequence[RuleFn]] = None,
     baseline_path: Optional[str] = None,
+    interproc: Optional[bool] = None,
 ) -> "AnalysisReport":
     """Analyze the whole ``dlrover_tpu`` package against the checked-in
-    baseline — the programmatic equivalent of ``--check``."""
+    baseline — the programmatic equivalent of ``--check``. The default
+    run is both passes: per-file rules AND the whole-program rules
+    (DLR014–DLR017). Passing an explicit per-file ``rules`` subset skips
+    the whole-program pass unless ``interproc=True``."""
     root = package_root()
     stale_noqa: List[StaleNoqa] = []
     violations = analyze_paths([os.path.join(root, "dlrover_tpu")],
                                root=root, rules=rules,
                                stale_noqa_out=stale_noqa)
+    run_whole_program = interproc if interproc is not None else rules is None
+    if run_whole_program:
+        violations = violations + interproc_package(
+            root=root, stale_noqa_out=stale_noqa
+        )
+        violations.sort(key=lambda v: (v.path, v.line, v.rule))
     report = check(violations, load_baseline(baseline_path))
     report.stale_noqa = stale_noqa
     return report

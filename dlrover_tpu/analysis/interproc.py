@@ -1,0 +1,831 @@
+"""Interprocedural summaries + whole-program rules DLR014–DLR017.
+
+The per-file rules stop at function boundaries; these run over the
+:mod:`callgraph` and a fixpoint summary pass:
+
+- *may-block*: a function may block if it makes a direct blocking call
+  (DLR004's predicate, shared via :func:`callgraph.is_blocking_call`) or
+  calls — on the SAME thread — a function that may block. Thread-entry
+  edges (``Thread(target=...)``, ``pool.submit``) and ``partial`` wraps
+  do not propagate: handing a blocking callable to another thread is the
+  blessed way to get blocking work out from under a lock.
+- *locks-acquired*: the transitive set of lock identities a call into a
+  function can take, each with a witness chain back to the ``with``.
+- The *acquired-before graph*: an edge A→B whenever B is acquired while
+  A is held — lexically nested ``with`` blocks, or a call made under A
+  into a function that (transitively) takes B. RLock reentry is a
+  self-edge A→A and deliberately ignored.
+
+Rules (registered in :data:`INTERPROC_RULES`, same noqa/baseline
+machinery as the per-file set):
+
+- **DLR014** interprocedural blocking-under-lock: a call made while a
+  lock is held into a function that may block — DLR004 generalized
+  through the call graph, reported with the full chain to the ultimate
+  blocking call. (The direct, same-function case stays DLR004.)
+- **DLR015** static lock-order inversion: a cycle in the whole-program
+  acquired-before graph, reported with both acquisition paths. The
+  static complement of the runtime LockOrderDetector, which only sees
+  interleavings tests happen to exercise.
+- **DLR016** chaos-site contract: every site passed to ``inj.fire`` must
+  be statically resolvable, declared on ``constants.ChaosSite``,
+  catalogued in the ``fault_injection.md`` site table, and exercised by
+  a chaos-marked test — and every declared/catalogued site must be live
+  (no phantom rows, no dead registry entries).
+- **DLR017** journal-kind contract: every recorded kind resolves to a
+  value declared on ``JournalEvent`` (and listed in ``JournalEvent.ALL``);
+  payload keys are aggregated per kind across all producers and checked
+  against every consumer read (``data.get("k")`` under a kind guard) —
+  a consumer reading a key no producer ever attaches is a silent
+  ``None``-path, the cross-process cousin of a typo'd kind.
+"""
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from dlrover_tpu.analysis import callgraph as cg
+from dlrover_tpu.analysis.callgraph import CallGraph, build_callgraph
+from dlrover_tpu.analysis.rules import Violation, _dotted
+
+INTERPROC_RULES: List = []
+
+
+def _interproc_rule(fn):
+    match = re.search(r"dlr(\d{3})", fn.__name__)
+    if match is None:
+        raise ValueError(f"rule function {fn.__name__} must embed its id")
+    fn.rule_id = "DLR" + match.group(1)
+    INTERPROC_RULES.append(fn)
+    return fn
+
+
+@dataclass
+class InterprocConfig:
+    """Where the whole-program pass finds its artifacts. Parameterized so
+    fixture packages in tests can stand in for the real tree."""
+
+    root: str
+    package_dirs: Tuple[str, ...] = ("dlrover_tpu",)
+    constants_rel: str = "dlrover_tpu/common/constants.py"
+    journal_rel: str = "dlrover_tpu/observability/journal.py"
+    chaos_doc_rel: str = "docs/design/fault_injection.md"
+    tests_rel: str = "tests"
+    chaos_site_class: str = "ChaosSite"
+    journal_event_class: str = "JournalEvent"
+
+
+@dataclass
+class Summaries:
+    # fn qualname -> (path, line, chain) anchored at the ultimate
+    # blocking call; chain is human-readable hops, caller-first
+    may_block: Dict[str, Tuple[str, int, Tuple[str, ...]]] = \
+        field(default_factory=dict)
+    # fn qualname -> lock id -> (path, line, via) acquisition witness
+    locks: Dict[str, Dict[str, Tuple[str, int, str]]] = \
+        field(default_factory=dict)
+    # acquired-before edge (held, acquired) -> (path, line, desc) witness
+    order: Dict[Tuple[str, str], Tuple[str, int, str]] = \
+        field(default_factory=dict)
+
+
+@dataclass
+class Analysis:
+    """Everything the interproc rules and the --contracts report consume."""
+
+    graph: CallGraph
+    summaries: Summaries
+    config: InterprocConfig
+    _lines: Dict[str, List[str]] = field(default_factory=dict)
+
+    def lines(self, rel_path: str) -> List[str]:
+        cached = self._lines.get(rel_path)
+        if cached is not None:
+            return cached
+        mod = next((m for m in self.graph.modules.values()
+                    if m.path == rel_path), None)
+        if mod is not None:
+            self._lines[rel_path] = mod.lines
+            return mod.lines
+        fpath = os.path.join(self.config.root, rel_path)
+        try:
+            with open(fpath, "r", encoding="utf-8") as f:
+                out = f.read().splitlines()
+        except OSError:
+            out = []
+        self._lines[rel_path] = out
+        return out
+
+    def violation(self, rule: str, rel_path: str, line: int,
+                  message: str) -> Violation:
+        lines = self.lines(rel_path)
+        text = lines[line - 1].strip() if 0 < line <= len(lines) else ""
+        return Violation(rule=rule, path=rel_path, line=line, col=1,
+                         message=message, line_text=text)
+
+
+_MAX_CHAIN = 6  # witness chains are for humans; cap the hop count
+
+
+def compute_summaries(graph: CallGraph) -> Summaries:
+    s = Summaries()
+    for fn in graph.functions.values():
+        if fn.blocking:
+            line, name = min(fn.blocking)
+            s.may_block[fn.qualname] = (
+                fn.path, line, (f"{name}() at {fn.path}:{line}",)
+            )
+        if fn.lock_sites:
+            per = s.locks.setdefault(fn.qualname, {})
+            for lock, line, _held in fn.lock_sites:
+                per.setdefault(lock, (fn.path, line, "with"))
+    call_edges = [c for c in graph.calls if c.kind == "call"]
+    # fixpoint: propagate may-block and locks-acquired up call edges
+    changed = True
+    passes = 0
+    while changed and passes < 64:
+        changed = False
+        passes += 1
+        for cs in call_edges:
+            callee_block = s.may_block.get(cs.callee)
+            if callee_block is not None and cs.caller not in s.may_block:
+                path, line, chain = callee_block
+                hop = f"{cs.callee} (called at {cs.path}:{cs.line})"
+                s.may_block[cs.caller] = (
+                    path, line, ((hop,) + chain)[:_MAX_CHAIN]
+                )
+                changed = True
+            callee_locks = s.locks.get(cs.callee)
+            if callee_locks:
+                per = s.locks.setdefault(cs.caller, {})
+                for lock in callee_locks:
+                    if lock not in per:
+                        per[lock] = (cs.path, cs.line, f"via {cs.callee}")
+                        changed = True
+    # acquired-before edges: lexical nesting, then call-under-lock
+    for fn in graph.functions.values():
+        for lock, line, held in fn.lock_sites:
+            for h in held:
+                if h != lock:
+                    s.order.setdefault((h, lock), (
+                        fn.path, line,
+                        f"{fn.qualname} acquires {lock} holding {h}",
+                    ))
+    for cs in call_edges:
+        if not cs.locks_held:
+            continue
+        callee_locks = s.locks.get(cs.callee)
+        if not callee_locks:
+            continue
+        for h in cs.locks_held:
+            for lock, (lpath, lline, _via) in callee_locks.items():
+                if lock != h:
+                    s.order.setdefault((h, lock), (
+                        cs.path, cs.line,
+                        f"{cs.caller} calls {cs.callee} holding {h}; "
+                        f"{lock} acquired at {lpath}:{lline}",
+                    ))
+    return s
+
+
+def analyze(config: InterprocConfig) -> Analysis:
+    graph = build_callgraph(config.root, config.package_dirs)
+    return Analysis(graph=graph, summaries=compute_summaries(graph),
+                    config=config)
+
+
+def run_rules(analysis: Analysis,
+              rules: Optional[Sequence] = None) -> List[Violation]:
+    out: List[Violation] = []
+    for rule in (rules if rules is not None else INTERPROC_RULES):
+        out.extend(rule(analysis))
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+# -- DLR014: interprocedural blocking-under-lock -------------------------------
+
+
+@_interproc_rule
+def rule_dlr014_interproc_blocking_under_lock(
+    analysis: Analysis,
+) -> Iterator[Violation]:
+    """call under a held lock into a function that may block."""
+    s = analysis.summaries
+    seen: Set[Tuple[str, int]] = set()
+    for cs in analysis.graph.calls:
+        if cs.kind != "call" or not cs.locks_held:
+            continue
+        block = s.may_block.get(cs.callee)
+        if block is None:
+            continue
+        key = (cs.path, cs.line)
+        if key in seen:
+            continue
+        seen.add(key)
+        _path, _line, chain = block
+        yield analysis.violation(
+            "DLR014", cs.path, cs.line,
+            f"call into {cs.callee}() while holding {cs.locks_held[-1]} — "
+            f"it may block: {' -> '.join(chain)}; the interprocedural form "
+            "of the PR 2 injector-deadlock class; move the call outside "
+            "the lock or hand it to a worker thread",
+        )
+
+
+# -- DLR015: static lock-order inversion ---------------------------------------
+
+
+@_interproc_rule
+def rule_dlr015_lock_order_inversion(
+    analysis: Analysis,
+) -> Iterator[Violation]:
+    """cycles in the whole-program acquired-before graph."""
+    order = analysis.summaries.order
+    adj: Dict[str, Set[str]] = {}
+    for (a, b) in order:
+        adj.setdefault(a, set()).add(b)
+    reported_pairs: Set[frozenset] = set()
+    # 2-cycles first: A→B and B→A, reported with both acquisition paths
+    for (a, b), (path, line, desc) in sorted(order.items()):
+        if (b, a) not in order:
+            continue
+        pair = frozenset((a, b))
+        if pair in reported_pairs:
+            continue
+        reported_pairs.add(pair)
+        rpath, rline, rdesc = order[(b, a)]
+        yield analysis.violation(
+            "DLR015", path, line,
+            f"lock-order inversion between {a} and {b}: "
+            f"[{desc}] vs [{rdesc} at {rpath}:{rline}] — two threads "
+            "taking these in opposite orders deadlock; pick one global "
+            "order (the runtime LockOrderDetector only catches the "
+            "interleavings tests happen to hit)",
+        )
+    # longer cycles: SCCs of size >= 2 not already explained by a 2-cycle
+    for scc in _sccs(adj):
+        if len(scc) < 2:
+            continue
+        if any(frozenset((a, b)) in reported_pairs
+               for a in scc for b in scc if a != b):
+            continue
+        cycle = _find_cycle(adj, scc)
+        if not cycle:
+            continue
+        hops = []
+        for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+            w = order.get((a, b))
+            if w:
+                hops.append(f"{a}->{b} [{w[2]} at {w[0]}:{w[1]}]")
+        first = order[(cycle[0], cycle[1])]
+        yield analysis.violation(
+            "DLR015", first[0], first[1],
+            "lock-order cycle through "
+            + " -> ".join(cycle + [cycle[0]]) + ": " + "; ".join(hops),
+        )
+
+
+def _sccs(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan, iterative; returns SCCs with sorted members."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+    nodes = sorted(set(adj) | {b for bs in adj.values() for b in bs})
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(sorted(comp))
+    return out
+
+
+def _find_cycle(adj: Dict[str, Set[str]],
+                scc: List[str]) -> Optional[List[str]]:
+    members = set(scc)
+    start = scc[0]
+    path = [start]
+    visited = {start}
+    while True:
+        nxts = sorted(n for n in adj.get(path[-1], ()) if n in members)
+        if not nxts:
+            return None
+        nxt = nxts[0]
+        if nxt == start:
+            return path
+        if nxt in visited:
+            # close the cycle at nxt's first occurrence
+            return path[path.index(nxt):]
+        visited.add(nxt)
+        path.append(nxt)
+
+
+# -- DLR016: chaos-site contract -----------------------------------------------
+
+_DOC_SITE_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_.*]+)`\s*\|")
+_CHAOS_MARK_RE = re.compile(r"pytest\.mark\.chaos|pytestmark.*chaos")
+
+
+def _declared_sites(analysis: Analysis) -> Dict[str, Tuple[str, int]]:
+    """ChaosSite attr value -> (attr name, constants.py line)."""
+    cfg = analysis.config
+    mod = next((m for m in analysis.graph.modules.values()
+                if m.path == cfg.constants_rel), None)
+    out: Dict[str, Tuple[str, int]] = {}
+    if mod is None:
+        return out
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == cfg.chaos_site_class):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name) and \
+                    isinstance(stmt.value, ast.Constant) and \
+                    isinstance(stmt.value.value, str):
+                out.setdefault(stmt.value.value,
+                               (stmt.targets[0].id, stmt.lineno))
+    return out
+
+
+def _catalogued_sites(analysis: Analysis) -> Dict[str, int]:
+    """site -> fault_injection.md line of its catalog row."""
+    out: Dict[str, int] = {}
+    for lineno, line in enumerate(
+        analysis.lines(analysis.config.chaos_doc_rel), 1
+    ):
+        m = _DOC_SITE_ROW_RE.match(line.strip())
+        if m and m.group(1) != "site" and "." in m.group(1):
+            out.setdefault(m.group(1), lineno)
+    return out
+
+
+def _site_drilled(site: str, attr: str, tested_text: str) -> bool:
+    """True when a chaos-marked test schedules the site — the literal
+    site string at a word boundary (so ``reshard.plan`` is not satisfied
+    by the ``reshard_planned`` journal kind) or its ChaosSite attr."""
+    if re.search(re.escape(site) + r"(?![a-z0-9_])", tested_text):
+        return True
+    return bool(attr) and f"ChaosSite.{attr}" in tested_text
+
+
+def _chaos_tested_text(analysis: Analysis) -> str:
+    """Concatenated text of every chaos-marked test file."""
+    tests_dir = os.path.join(analysis.config.root,
+                             analysis.config.tests_rel)
+    chunks: List[str] = []
+    if not os.path.isdir(tests_dir):
+        return ""
+    for dirpath, dirnames, filenames in os.walk(tests_dir):
+        dirnames[:] = [d for d in dirnames if not d.startswith(".")
+                       and d != "__pycache__"]
+        for f in sorted(filenames):
+            if not f.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, f), "r",
+                          encoding="utf-8") as fh:
+                    text = fh.read()
+            except OSError:
+                continue
+            if _CHAOS_MARK_RE.search(text):
+                chunks.append(text)
+    return "\n".join(chunks)
+
+
+@_interproc_rule
+def rule_dlr016_chaos_site_contract(
+    analysis: Analysis,
+) -> Iterator[Violation]:
+    """fired ↔ declared ↔ catalogued ↔ chaos-tested, bidirectionally."""
+    cfg = analysis.config
+    declared = _declared_sites(analysis)
+    catalogued = _catalogued_sites(analysis)
+    tested_text = _chaos_tested_text(analysis)
+    fired: Dict[str, Tuple[str, int]] = {}
+    for fn in analysis.graph.functions.values():
+        for fire in fn.chaos_fires:
+            if fire.site is None:
+                yield analysis.violation(
+                    "DLR016", fn.path, fire.line,
+                    "chaos site is not statically resolvable — pass a "
+                    "constants.ChaosSite attribute (the site catalog, the "
+                    "drills, and this contract check all enumerate sites "
+                    "statically)",
+                )
+                continue
+            fired.setdefault(fire.site, (fn.path, fire.line))
+    for site, (path, line) in sorted(fired.items()):
+        if site not in declared:
+            yield analysis.violation(
+                "DLR016", path, line,
+                f"chaos site {site!r} is fired but not declared on "
+                f"constants.{cfg.chaos_site_class} — declare it so drills "
+                "and docs enumerate it from one registry",
+            )
+    for site, (attr, line) in sorted(declared.items()):
+        if site not in fired:
+            yield analysis.violation(
+                "DLR016", cfg.constants_rel, line,
+                f"chaos site {site!r} ({cfg.chaos_site_class}.{attr}) is "
+                "declared but never fired — dead registry entry; remove "
+                "it or wire the site",
+            )
+        if site not in catalogued:
+            yield analysis.violation(
+                "DLR016", cfg.constants_rel, line,
+                f"chaos site {site!r} is missing from the "
+                f"{cfg.chaos_doc_rel} site catalog — every live site is "
+                "documented with its context keys",
+            )
+        if not _site_drilled(site, attr, tested_text):
+            yield analysis.violation(
+                "DLR016", cfg.constants_rel, line,
+                f"chaos site {site!r} is not exercised by any chaos-marked "
+                "test — add a drill that schedules a fault at it",
+            )
+    for site, lineno in sorted(catalogued.items()):
+        if site not in declared:
+            yield analysis.violation(
+                "DLR016", cfg.chaos_doc_rel, lineno,
+                f"catalog row for {site!r} has no matching "
+                f"{cfg.chaos_site_class} declaration — phantom row; the "
+                "site was removed or renamed without updating the doc",
+            )
+
+
+# -- DLR017: journal-kind contract ---------------------------------------------
+
+_KIND_KEYS = ("kind", "event_kind")
+
+
+def _declared_kinds(
+    analysis: Analysis,
+) -> Tuple[Dict[str, Tuple[str, int]], Set[str], Optional[int]]:
+    """(kind value -> (attr, line), attr names in ALL, ALL line)."""
+    cfg = analysis.config
+    mod = next((m for m in analysis.graph.modules.values()
+                if m.path == cfg.journal_rel), None)
+    kinds: Dict[str, Tuple[str, int]] = {}
+    in_all: Set[str] = set()
+    all_line: Optional[int] = None
+    if mod is None:
+        return kinds, in_all, all_line
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == cfg.journal_event_class):
+            continue
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            name = stmt.targets[0].id
+            if isinstance(stmt.value, ast.Constant) and isinstance(
+                stmt.value.value, str
+            ):
+                kinds.setdefault(stmt.value.value, (name, stmt.lineno))
+            elif name == "ALL" and isinstance(stmt.value, ast.Tuple):
+                all_line = stmt.lineno
+                for elt in stmt.value.elts:
+                    if isinstance(elt, ast.Name):
+                        in_all.add(elt.id)
+                    elif isinstance(elt, ast.Attribute):
+                        in_all.add(elt.attr)
+    return kinds, in_all, all_line
+
+
+def _is_key_read(node: ast.AST, keys: Tuple[str, ...]) -> Optional[str]:
+    """'k' when node reads key k (one of ``keys``) off something —
+    ``x["k"]`` or ``x.get("k", ...)``."""
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and sl.value in keys:
+            return sl.value
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr == "get" and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and arg.value in keys:
+                return arg.value
+    return None
+
+
+def _read_base(node: ast.AST) -> Optional[ast.expr]:
+    if isinstance(node, ast.Subscript):
+        return node.value
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        return node.func.value
+    return None
+
+
+def _strip_or_default(expr: ast.expr) -> ast.expr:
+    """``x.get("data") or {}`` → ``x.get("data")``."""
+    if isinstance(expr, ast.BoolOp) and isinstance(expr.op, ast.Or) and \
+            expr.values:
+        return expr.values[0]
+    return expr
+
+
+@dataclass
+class _ConsumerRead:
+    kind: str
+    key: str
+    path: str
+    line: int
+
+
+def _resolve_kind_expr(analysis: Analysis, mod, expr) -> Optional[str]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    dotted = _dotted(expr)
+    if not dotted:
+        return None
+    resolved = cg._resolve_name(analysis.graph, mod, None, dotted)
+    if resolved:
+        return analysis.graph.resolve_constant(resolved)
+    return None
+
+
+def _guard_kinds(analysis: Analysis, mod, test: ast.expr,
+                 kind_vars: Set[str]) -> Tuple[Set[str], bool]:
+    """(kinds named by the guard, negated?). A guard compares a
+    kind-read (or a variable assigned from one) against JournalEvent
+    values with ==/!=/in/not-in."""
+    kinds: Set[str] = set()
+    negated = False
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        sides = [node.left, node.comparators[0]]
+        is_kind_side = [
+            _is_key_read(sd, _KIND_KEYS) is not None
+            or (isinstance(sd, ast.Name) and sd.id in kind_vars)
+            for sd in sides
+        ]
+        if not any(is_kind_side):
+            continue
+        value_side = sides[1] if is_kind_side[0] else sides[0]
+        op = node.ops[0]
+        elts = (value_side.elts
+                if isinstance(value_side, (ast.Tuple, ast.List, ast.Set))
+                else [value_side])
+        resolved = [_resolve_kind_expr(analysis, mod, e) for e in elts]
+        hit = {r for r in resolved if r}
+        if not hit:
+            continue
+        kinds |= hit
+        if isinstance(op, (ast.NotEq, ast.NotIn)):
+            negated = True
+    return kinds, negated
+
+
+def _consumer_reads(analysis: Analysis) -> List[_ConsumerRead]:
+    """Every ``data.get(key)`` / ``data[key]`` read attributable to a
+    journal kind: under an ``if kind == JournalEvent.X`` branch, after an
+    early-return negative guard, or inside a guarded comprehension."""
+    out: List[_ConsumerRead] = []
+    for mod in analysis.graph.modules.values():
+        kind_vars: Set[str] = set()
+        data_vars: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                val = _strip_or_default(node.value)
+                if _is_key_read(val, _KIND_KEYS):
+                    kind_vars.add(node.targets[0].id)
+                elif _is_key_read(val, ("data",)):
+                    data_vars.add(node.targets[0].id)
+        if not kind_vars and not data_vars and \
+                "JournalEvent" not in "".join(mod.aliases):
+            continue
+        # early-return negative guards: function -> (guard line, kinds)
+        early: Dict[int, Tuple[int, Set[str]]] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for stmt in node.body:
+                if not isinstance(stmt, ast.If) or stmt.orelse:
+                    continue
+                kinds, negated = _guard_kinds(analysis, mod, stmt.test,
+                                              kind_vars)
+                if kinds and negated and all(
+                    isinstance(b, (ast.Return, ast.Raise, ast.Continue))
+                    for b in stmt.body
+                ):
+                    early[id(node)] = (stmt.lineno, kinds)
+                    break
+        for node in ast.walk(mod.tree):
+            read_key = None
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)) or (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+            ):
+                b = _read_base(node)
+                if b is not None:
+                    is_data_base = (
+                        (isinstance(b, ast.Name) and b.id in data_vars)
+                        or _is_key_read(b, ("data",)) is not None
+                    )
+                    if is_data_base:
+                        sl = (node.slice if isinstance(node, ast.Subscript)
+                              else (node.args[0] if node.args else None))
+                        if isinstance(sl, ast.Constant) and isinstance(
+                            sl.value, str
+                        ):
+                            read_key = sl.value
+            if read_key is None:
+                continue
+            kinds = _attributed_kinds(analysis, mod, node, kind_vars, early)
+            for kind in sorted(kinds):
+                out.append(_ConsumerRead(kind=kind, key=read_key,
+                                         path=mod.path, line=node.lineno))
+    return out
+
+
+def _attributed_kinds(analysis: Analysis, mod, node: ast.AST,
+                      kind_vars: Set[str],
+                      early: Dict[int, Tuple[int, Set[str]]]) -> Set[str]:
+    """Kinds guarding ``node``: innermost enclosing positive If guard, a
+    guarded comprehension, else the function's early-return guard."""
+    cur = getattr(node, "_dlr_parent", None)
+    prev = node
+    while cur is not None:
+        if isinstance(cur, ast.If):
+            kinds, negated = _guard_kinds(analysis, mod, cur.test, kind_vars)
+            if kinds:
+                in_body = any(prev is b or _contains(b, prev)
+                              for b in cur.body)
+                if (not negated and in_body) or (negated and not in_body):
+                    return kinds
+        elif isinstance(cur, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                              ast.DictComp)):
+            kinds: Set[str] = set()
+            for gen in cur.generators:
+                for cond in gen.ifs:
+                    k, negated = _guard_kinds(analysis, mod, cond, kind_vars)
+                    if k and not negated:
+                        kinds |= k
+            if kinds:
+                return kinds
+        elif isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            guard = early.get(id(cur))
+            if guard and node.lineno > guard[0]:
+                return guard[1]
+            return set()
+        prev = cur
+        cur = getattr(cur, "_dlr_parent", None)
+    return set()
+
+
+def _contains(root: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(root))
+
+
+@_interproc_rule
+def rule_dlr017_journal_kind_contract(
+    analysis: Analysis,
+) -> Iterator[Violation]:
+    """kinds declared + in ALL; consumer payload reads backed by a producer."""
+    cfg = analysis.config
+    kinds, in_all, all_line = _declared_kinds(analysis)
+    # declared kind missing from ALL — replay/doc enumerations walk ALL
+    if all_line is not None:
+        for value, (attr, line) in sorted(kinds.items()):
+            if attr not in in_all:
+                yield analysis.violation(
+                    "DLR017", cfg.journal_rel, line,
+                    f"JournalEvent.{attr} ({value!r}) is declared but "
+                    "missing from JournalEvent.ALL — enumeration-driven "
+                    "consumers (replay, docs, dashboards) will never see "
+                    "it",
+                )
+    # producers: aggregate payload keys per kind
+    produced: Dict[str, Set[str]] = {}
+    dynamic: Set[str] = set()
+    for fn in analysis.graph.functions.values():
+        for emit in fn.journal_emits:
+            if emit.kind is None:
+                continue  # forwarding loops re-emit e["kind"]: not checkable
+            if kinds and emit.kind not in kinds:
+                yield analysis.violation(
+                    "DLR017", fn.path, emit.line,
+                    f"recorded kind {emit.kind!r} is not declared on "
+                    f"{cfg.journal_event_class} — a kind outside the "
+                    "registry silently forks the observability stream",
+                )
+            produced.setdefault(emit.kind, set()).update(emit.keys)
+            if emit.dynamic:
+                dynamic.add(emit.kind)
+    # consumers: every guarded payload read needs a producer for its key
+    seen: Set[Tuple[str, int, str, str]] = set()
+    for read in _consumer_reads(analysis):
+        if read.kind not in produced or read.kind in dynamic:
+            continue
+        if read.key in produced[read.kind]:
+            continue
+        dkey = (read.path, read.line, read.kind, read.key)
+        if dkey in seen:
+            continue
+        seen.add(dkey)
+        keys = ", ".join(sorted(produced[read.kind])) or "<none>"
+        yield analysis.violation(
+            "DLR017", read.path, read.line,
+            f"consumer reads payload key {read.key!r} of kind "
+            f"{read.kind!r}, but no producer attaches it (producers "
+            f"attach: {keys}) — the read is a silent None; fix the key "
+            "or the producer",
+        )
+
+
+# -- contracts report ----------------------------------------------------------
+
+
+def contracts_report(analysis: Analysis) -> str:
+    """Human-readable cross-artifact contract matrix for --contracts."""
+    lines: List[str] = []
+    declared = _declared_sites(analysis)
+    catalogued = _catalogued_sites(analysis)
+    tested_text = _chaos_tested_text(analysis)
+    fired: Dict[str, int] = {}
+    for fn in analysis.graph.functions.values():
+        for fire in fn.chaos_fires:
+            if fire.site:
+                fired[fire.site] = fired.get(fire.site, 0) + 1
+    sites = sorted(set(declared) | set(catalogued) | set(fired))
+    lines.append("chaos-site contract (fired / declared / catalogued / "
+                 "chaos-tested):")
+    for site in sites:
+        marks = "".join((
+            "F" if site in fired else "-",
+            "D" if site in declared else "-",
+            "C" if site in catalogued else "-",
+            "T" if _site_drilled(site, declared.get(site, ("", 0))[0],
+                                 tested_text) else "-",
+        ))
+        lines.append(f"  [{marks}] {site}  "
+                     f"(fires: {fired.get(site, 0)})")
+    kinds, _in_all, _ = _declared_kinds(analysis)
+    produced: Dict[str, Set[str]] = {}
+    dynamic: Set[str] = set()
+    for fn in analysis.graph.functions.values():
+        for emit in fn.journal_emits:
+            if emit.kind is None:
+                continue
+            produced.setdefault(emit.kind, set()).update(emit.keys)
+            if emit.dynamic:
+                dynamic.add(emit.kind)
+    lines.append("")
+    lines.append(f"journal kinds: {len(kinds)} declared, "
+                 f"{len(produced)} statically produced")
+    for kind in sorted(produced):
+        keys = ", ".join(sorted(produced[kind])) or "-"
+        dyn = " (+dynamic)" if kind in dynamic else ""
+        undeclared = "" if (not kinds or kind in kinds) else "  [UNDECLARED]"
+        lines.append(f"  {kind}: {keys}{dyn}{undeclared}")
+    s = analysis.summaries
+    lines.append("")
+    lines.append(f"call graph: {len(analysis.graph.functions)} functions, "
+                 f"{len(analysis.graph.calls)} resolved call edges "
+                 f"({len(analysis.graph.thread_entries)} thread entries); "
+                 f"{len(s.may_block)} may-block, "
+                 f"{len(s.order)} acquired-before edges")
+    return "\n".join(lines)

@@ -530,6 +530,11 @@ def _is_thread_ctor(node: ast.Call) -> bool:
     return bool(name) and name.rsplit(".", 1)[-1] == "Thread"
 
 
+def _is_executor_ctor(node: ast.Call) -> bool:
+    name = _dotted(node.func)
+    return bool(name) and name.rsplit(".", 1)[-1] == "ThreadPoolExecutor"
+
+
 def _kw(node: ast.Call, name: str) -> Optional[ast.expr]:
     for kw in node.keywords:
         if kw.arg == name:
@@ -541,16 +546,29 @@ def _kw(node: ast.Call, name: str) -> Optional[ast.expr]:
 def rule_dlr008_unnamed_thread(
     tree: ast.AST, path: str, lines: List[str]
 ) -> Iterator[Violation]:
-    """threading.Thread created without a name= (unreadable stack dumps)."""
+    """threading.Thread created without a name= (unreadable stack dumps).
+
+    Also covers ThreadPoolExecutor without thread_name_prefix= — pool
+    workers show up in the same stack dumps and race reports."""
     for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not _is_thread_ctor(node):
+        if not isinstance(node, ast.Call):
             continue
-        if _kw(node, "name") is None:
+        if _is_thread_ctor(node) and _kw(node, "name") is None:
             yield _violation(
                 "DLR008", path, node,
                 "Thread created without a name= — stack dumps, the crash "
                 "flight recorder, and race reports all attribute by thread "
                 "name; `Thread-37` attributes nothing",
+                lines,
+            )
+        elif (_is_executor_ctor(node)
+              and _kw(node, "thread_name_prefix") is None):
+            yield _violation(
+                "DLR008", path, node,
+                "ThreadPoolExecutor without thread_name_prefix= — pool "
+                "workers land in the same stack dumps and race reports as "
+                "named threads; `ThreadPoolExecutor-3_0` attributes "
+                "nothing",
                 lines,
             )
 
@@ -559,17 +577,24 @@ def rule_dlr008_unnamed_thread(
 def rule_dlr009_unjoined_thread(
     tree: ast.AST, path: str, lines: List[str]
 ) -> Iterator[Violation]:
-    """non-daemon thread with no join path (process can't shut down)."""
+    """non-daemon thread with no join path (process can't shut down).
+
+    Also covers ThreadPoolExecutor: a pool created outside a ``with``
+    block whose handle is never ``.shutdown()`` leaks its workers the
+    same way an unjoined thread does."""
     # collect every `<target>.join(...)` call and `<target>.daemon = True`
     # assignment in the file, then require each non-daemon Thread(...)
     # creation to be assigned to a target with one of them
     joined: set = set()
     daemoned: set = set()
+    shutdowns: set = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):
             name = _dotted(node.func)
             if name.endswith(".join"):
                 joined.add(name[: -len(".join")])
+            elif name.endswith(".shutdown"):
+                shutdowns.add(name[: -len(".shutdown")])
         elif isinstance(node, ast.Assign):
             for tgt in node.targets:
                 d = _dotted(tgt)
@@ -615,6 +640,27 @@ def rule_dlr009_unjoined_thread(
         if targets and joined:
             continue
         yield _violation("DLR009", path, node, msg, lines)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_executor_ctor(node):
+            continue
+        par = _parent(node)
+        if isinstance(par, ast.withitem):
+            continue  # `with ThreadPoolExecutor(...)` shuts down on exit
+        targets = []
+        if isinstance(par, ast.Assign):
+            targets = [_dotted(t) for t in par.targets]
+        elif isinstance(par, ast.AnnAssign) and par.target is not None:
+            targets = [_dotted(par.target)]
+        if any(t in shutdowns for t in targets if t):
+            continue
+        yield _violation(
+            "DLR009", path, node,
+            "ThreadPoolExecutor with no shutdown path — nobody calls "
+            ".shutdown() on this handle and it isn't a `with` block, so "
+            "its workers outlive the owner; shut it down on the stop "
+            "path (wait=False is fine) or scope it with `with`",
+            lines,
+        )
 
 
 # -- DLR010: sleep-polling loops ----------------------------------------------

@@ -41,7 +41,7 @@ from dlrover_tpu.brain.optimizers import (
     TrafficForecaster,
     optimal_ckpt_interval_s,
 )
-from dlrover_tpu.common.constants import ConfigKey, env_float
+from dlrover_tpu.common.constants import ChaosSite, ConfigKey, env_float
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability.journal import JournalEvent
 
@@ -180,7 +180,7 @@ class BrainAdvisor:
         try:
             inj = get_injector()
             if inj is not None:
-                inj.fire("brain.query", job=self._job_uuid, kind=kind)
+                inj.fire(ChaosSite.BRAIN_QUERY, job=self._job_uuid, kind=kind)
             return self._store.query(self._job_uuid, kind=kind, limit=limit)
         except Exception as e:  # noqa: BLE001 — advisory plane: degrade
             with self._lock:

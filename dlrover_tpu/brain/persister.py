@@ -35,7 +35,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from dlrover_tpu.analysis.race_detector import shared
 from dlrover_tpu.brain.datastore import MetricSample, MetricsStore
-from dlrover_tpu.common.constants import ConfigKey, env_float
+from dlrover_tpu.common.constants import ChaosSite, ConfigKey, env_float
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability.journal import JournalEvent
 
@@ -217,7 +217,7 @@ class TelemetryPersister:
         try:
             inj = get_injector()
             if inj is not None:
-                inj.fire("brain.persist", job=self._job_uuid,
+                inj.fire(ChaosSite.BRAIN_PERSIST, job=self._job_uuid,
                          samples=len(batch))
             wrote = self._store.persist_many(batch)
         except Exception as e:  # noqa: BLE001 — advisory plane: degrade

@@ -3,14 +3,17 @@
 Usage at an injection site::
 
     from dlrover_tpu.chaos import get_injector
+    from dlrover_tpu.common.constants import ChaosSite
 
     inj = get_injector()
     if inj is not None:
-        inj.fire("rpc.send", method=method)   # may sleep or raise
+        inj.fire(ChaosSite.RPC_SEND, method=method)  # may sleep or raise
 
 ``get_injector()`` returns None unless ``DLROVER_FAULT_SCHEDULE`` is set
 (or :func:`configure` was called), so production hot paths pay one cached
-function call.
+function call. Site names are declared on ``constants.ChaosSite`` — rule
+DLR016 certifies that every fired site is declared there, catalogued in
+the fault_injection.md site table, and exercised by a chaos-marked test.
 """
 
 from dlrover_tpu.chaos.injector import (  # noqa: F401

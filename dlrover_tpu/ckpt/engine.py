@@ -1307,7 +1307,9 @@ def _assemble(target, lookup: Dict[str, Dict], reader, reader_into=None):
     named, treedef = _tree_flatten_with_names(target)
     if reader_into is not None:
         _validate_frame_against_target(named, lookup)
-    with ThreadPoolExecutor(_RESTORE_THREADS) as pool:
+    with ThreadPoolExecutor(
+        _RESTORE_THREADS, thread_name_prefix="ckpt-restore",
+    ) as pool:
         packer = _ShardPacker(pool)
         finalizers = []
         for path, leaf in named:

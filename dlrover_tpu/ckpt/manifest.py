@@ -54,6 +54,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import msgpack
 
 from dlrover_tpu.common.constants import (
+    ChaosSite,
     CheckpointConstant,
     ConfigKey,
     env_flag,
@@ -202,7 +203,7 @@ def commit_file(storage: CheckpointStorage, content, path: str,
     storage.write(content, tmp)
     inj = get_injector()
     if inj is not None:
-        inj.fire("storage.commit", path=path, **ctx)
+        inj.fire(ChaosSite.STORAGE_COMMIT, path=path, **ctx)
     storage.safe_move(tmp, path)
 
 

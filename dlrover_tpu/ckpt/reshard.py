@@ -51,6 +51,7 @@ import numpy as np
 from dlrover_tpu.chaos import get_injector
 from dlrover_tpu.common import comm, fabric
 from dlrover_tpu.common.constants import (
+    ChaosSite,
     ConfigKey,
     EnvKey,
     SpanName,
@@ -647,7 +648,7 @@ class ReshardCoordinator:
             ) as sp:
                 if inj is not None:
                     inj.fire(
-                        "reshard.replan", round=cut["round"],
+                        ChaosSite.RESHARD_REPLAN, round=cut["round"],
                         old_world=len(old), new_world=len(new),
                     )
                 decision = self.planner.plan(
@@ -882,7 +883,7 @@ class ReshardRestorer:
         ) as sp:
             if inj is not None:
                 inj.fire(
-                    "reshard.plan",
+                    ChaosSite.RESHARD_PLAN,
                     round=cut.get("round"), node_rank=self._node,
                 )
             frames_by_rank = self.gather_frames(cut.get("old", ()))

@@ -31,7 +31,13 @@ from typing import Any, Callable, Dict, List, Optional
 import msgpack
 import numpy as np
 
-from dlrover_tpu.common.constants import ConfigKey, EnvKey, env_flag, env_str
+from dlrover_tpu.common.constants import (
+    ChaosSite,
+    ConfigKey,
+    EnvKey,
+    env_flag,
+    env_str,
+)
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.multi_process import (
     create_shared_memory,
@@ -304,7 +310,7 @@ class SharedMemoryHandler:
         inj = get_injector()
         if inj is None:
             return
-        act = inj.fire("shm.write", step=meta.get("step"))
+        act = inj.fire(ChaosSite.SHM_WRITE, step=meta.get("step"))
         if act is None:
             return
         shards = [

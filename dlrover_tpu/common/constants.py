@@ -468,6 +468,48 @@ class SpanName:
     EVT_SERVE_REROUTED = "serve.rerouted"
 
 
+class ChaosSite:
+    """Named fault-injection sites for chaos/injector.py. Sites are
+    cross-artifact API surface: drill schedules name them, the
+    ``docs/design/fault_injection.md`` catalog documents them, and
+    chaos-marked tests exercise them — rule DLR016 certifies all four
+    views against this registry bidirectionally (a fired-but-undeclared
+    site, a dead declaration, a missing catalog row, a phantom row, or
+    an undrilled site each fail --check)."""
+
+    # rpc transport (common/rpc.py, common/http_server.py)
+    RPC_SEND = "rpc.send"
+    RPC_RECV = "rpc.recv"
+    # flash-checkpoint shm frame writer (ckpt/shm_handler.py)
+    SHM_WRITE = "shm.write"
+    # master kv/rendezvous services
+    KV_WAIT = "kv.wait"
+    RDZV_JOIN = "rdzv.join"
+    # live reshard planner + world-cut re-decomposition (ckpt/reshard.py)
+    RESHARD_PLAN = "reshard.plan"
+    RESHARD_REPLAN = "reshard.replan"
+    # state-movement fabric (common/fabric.py)
+    FABRIC_CONNECT = "fabric.connect"
+    FABRIC_STRIPE = "fabric.stripe"
+    # heartbeat fan-in plane (agent/fanin.py)
+    HB_FANIN = "hb.fanin"
+    AGG_FORWARD = "agg.forward"
+    # persistent storage commit protocol (common/storage.py,
+    # ckpt/manifest.py)
+    STORAGE_PERSIST = "storage.persist"
+    STORAGE_COMMIT = "storage.commit"
+    # elastic decode-serving plane (dlrover_tpu/serving/)
+    SERVE_REQUEST = "serve.request"
+    SERVE_REPLICA = "serve.replica"
+    SERVE_PREFIX = "serve.prefix"
+    # elastic data plane (master/task_manager.py, trainer/data_plane.py)
+    DATA_DISPATCH = "data.dispatch"
+    DATA_REPORT = "data.report"
+    # brain telemetry/advisory plane (dlrover_tpu/brain/)
+    BRAIN_PERSIST = "brain.persist"
+    BRAIN_QUERY = "brain.query"
+
+
 class MetricLabel:
     """Bounded label-value vocabularies for metric families. Label values
     drawn from open sets (request ids, prompts, trace ids, addresses)

@@ -52,15 +52,20 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from dlrover_tpu.analysis.race_detector import shared
 from dlrover_tpu.chaos import InjectedError, InjectedFault, get_injector
 from dlrover_tpu.common import comm, retry
-from dlrover_tpu.common.constants import ConfigKey, SpanName, env_int
+from dlrover_tpu.common.constants import (
+    ChaosSite,
+    ConfigKey,
+    SpanName,
+    env_int,
+)
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCClient, RPCError, RPCServer
 from dlrover_tpu.observability import tracing
 from dlrover_tpu.observability.journal import JournalEvent
 from dlrover_tpu.observability.registry import get_registry
 
-FABRIC_CONNECT_SITE = "fabric.connect"
-FABRIC_STRIPE_SITE = "fabric.stripe"
+FABRIC_CONNECT_SITE = ChaosSite.FABRIC_CONNECT
+FABRIC_STRIPE_SITE = ChaosSite.FABRIC_STRIPE
 
 DEFAULT_STRIPE_BYTES = 16 * 1024 * 1024
 DEFAULT_CONNS = 4

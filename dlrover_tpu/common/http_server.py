@@ -28,6 +28,7 @@ import msgpack
 
 from dlrover_tpu.chaos import get_injector
 from dlrover_tpu.common import comm, retry
+from dlrover_tpu.common.constants import ChaosSite
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCError
 from dlrover_tpu.observability import tracing
@@ -176,7 +177,7 @@ class HttpRPCClient:
 
         def attempt() -> Any:
             if inj is not None:
-                inj.fire("rpc.send", method=method)
+                inj.fire(ChaosSite.RPC_SEND, method=method)
             req = urllib.request.Request(
                 f"http://{self._addr}/rpc", data=frame,
                 headers={"Content-Type": "application/msgpack"},
@@ -184,7 +185,7 @@ class HttpRPCClient:
             with urllib.request.urlopen(req, timeout=self._timeout_s) as r:
                 resp = msgpack.unpackb(r.read(), raw=False)
             if inj is not None:
-                inj.fire("rpc.recv", method=method)
+                inj.fire(ChaosSite.RPC_RECV, method=method)
             if not resp.get("ok"):
                 ctx = tracing.current_context()
                 trace_id = ctx.trace_id if ctx is not None else "-"

@@ -23,7 +23,7 @@ from typing import Any, Callable, Dict, Optional
 
 from dlrover_tpu.chaos import get_injector
 from dlrover_tpu.common import comm, retry
-from dlrover_tpu.common.constants import ConfigKey, env_str
+from dlrover_tpu.common.constants import ChaosSite, ConfigKey, env_str
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.multi_process import recv_msg, send_msg
 from dlrover_tpu.observability import tracing
@@ -304,12 +304,12 @@ class RPCClient:
         def attempt() -> Any:
             try:
                 if inj is not None:
-                    inj.fire("rpc.send", method=method)
+                    inj.fire(ChaosSite.RPC_SEND, method=method)
                 conn = self._conn()
                 send_msg(conn, frame)
                 resp = recv_msg(conn)
                 if inj is not None:
-                    inj.fire("rpc.recv", method=method)
+                    inj.fire(ChaosSite.RPC_RECV, method=method)
             except (ConnectionError, OSError, socket.timeout):
                 # reconnect on the next attempt; the server's dedup cache
                 # makes the retried frame exactly-once

@@ -14,6 +14,7 @@ import shutil
 from abc import ABC, abstractmethod
 from typing import List, Optional
 
+from dlrover_tpu.common.constants import ChaosSite
 from dlrover_tpu.common.log import logger
 
 
@@ -95,7 +96,7 @@ class CheckpointStorage(ABC):
         buf = bytearray(total)
         for offset, data, ctx in stripes:
             if inj is not None:
-                inj.fire("storage.persist", path=path, offset=offset,
+                inj.fire(ChaosSite.STORAGE_PERSIST, path=path, offset=offset,
                          **(ctx or {}))
             buf[offset : offset + len(data)] = data
         self.write(buf, path)
@@ -169,7 +170,7 @@ class PosixDiskStorage(CheckpointStorage):
 
             def _one(offset, data, ctx):
                 if inj is not None:
-                    inj.fire("storage.persist", path=path, offset=offset,
+                    inj.fire(ChaosSite.STORAGE_PERSIST, path=path, offset=offset,
                              **(ctx or {}))
                 mv = memoryview(data)
                 pos = 0

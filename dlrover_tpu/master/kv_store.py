@@ -30,7 +30,7 @@ from typing import Dict, List, Optional
 
 from dlrover_tpu.analysis.race_detector import shared
 from dlrover_tpu.chaos import get_injector
-from dlrover_tpu.common.constants import ConfigKey, env_int
+from dlrover_tpu.common.constants import ChaosSite, ConfigKey, env_int
 
 DEFAULT_KV_SHARDS = 8
 
@@ -85,7 +85,7 @@ class KVStoreService:
     def wait(self, key: str, timeout_s: float) -> Optional[bytes]:
         inj = get_injector()
         if inj is not None:
-            inj.fire("kv.wait", key=key)
+            inj.fire(ChaosSite.KV_WAIT, key=key)
         deadline = time.monotonic() + timeout_s
         sh = self._shard(key)
         with sh.cond:

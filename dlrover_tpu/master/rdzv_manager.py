@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Tuple
 from dlrover_tpu.common.comm import NodeMeta
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.constants import (
+    ChaosSite,
     NetworkFailureReason,
     RendezvousName,
     SpanName,
@@ -151,7 +152,7 @@ class RendezvousManager(ABC):
             # delay models a slow-to-register master (the client's patient
             # rendezvous policy must absorb it); error surfaces as an RPC
             # handler fault to the joining agent
-            inj.fire("rdzv.join", rdzv=self._name, node_rank=meta.node_rank)
+            inj.fire(ChaosSite.RDZV_JOIN, rdzv=self._name, node_rank=meta.node_rank)
         # the servicer restored the joining agent's trace context, so this
         # span lands inside the agent's rdzv.join arc
         with tracing.span(SpanName.RDZV_JOIN, source="master",

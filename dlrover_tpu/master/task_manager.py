@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.common.comm import DatasetShardParams, Shard, TaskMessage
 from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import ChaosSite
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.analysis.race_detector import shared
 from dlrover_tpu.chaos.injector import get_injector
@@ -199,7 +200,7 @@ class TaskManager:
         inj = get_injector()
         if inj is not None:
             inj.fire(
-                "data.dispatch", dataset=dataset_name,
+                ChaosSite.DATA_DISPATCH, dataset=dataset_name,
                 task_id=task.task_id, node_id=node_id,
             )
         return task

@@ -40,12 +40,12 @@ import threading
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 from dlrover_tpu.analysis.race_detector import shared
-from dlrover_tpu.common.constants import ConfigKey, env_flag, env_int
+from dlrover_tpu.common.constants import ChaosSite, ConfigKey, env_flag, env_int
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability.journal import JournalEvent
 from dlrover_tpu.observability.registry import get_registry
 
-SERVE_PREFIX_SITE = "serve.prefix"
+SERVE_PREFIX_SITE = ChaosSite.SERVE_PREFIX
 
 # defaults: a 64 MiB payload budget holds ~100 2k-token bf16 entries of
 # the bench model; the block keeps suffix traces to a handful per bucket

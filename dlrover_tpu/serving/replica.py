@@ -40,7 +40,12 @@ from typing import Callable, Dict, List, Optional
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common import comm, fabric
 from dlrover_tpu.common.config import get_context
-from dlrover_tpu.common.constants import ConfigKey, SpanName, env_flag
+from dlrover_tpu.common.constants import (
+    ChaosSite,
+    ConfigKey,
+    SpanName,
+    env_flag,
+)
 from dlrover_tpu.common.http_server import HTTPTransportServer
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCServer
@@ -51,7 +56,7 @@ from dlrover_tpu.observability.registry import get_registry
 from dlrover_tpu.serving.batcher import BatcherClosed, ContinuousBatcher
 from dlrover_tpu.serving.tail import TailAttributor
 
-SERVE_REPLICA_SITE = "serve.replica"
+SERVE_REPLICA_SITE = ChaosSite.SERVE_REPLICA
 
 # fabric key serving replicas publish their exported params under
 WEIGHTS_KEY = "weights/current"

@@ -29,14 +29,14 @@ from typing import Callable, Dict, List, Optional
 
 from dlrover_tpu.analysis.race_detector import shared
 from dlrover_tpu.common import comm
-from dlrover_tpu.common.constants import SpanName
+from dlrover_tpu.common.constants import ChaosSite, SpanName
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.rpc import RPCClient
 from dlrover_tpu.observability import tracing
 from dlrover_tpu.observability.journal import JournalEvent
 from dlrover_tpu.observability.registry import get_registry
 
-SERVE_REQUEST_SITE = "serve.request"
+SERVE_REQUEST_SITE = ChaosSite.SERVE_REQUEST
 
 # deterministic refusals: retrying on another replica cannot change them
 _PERMANENT = ("exceeds largest bucket",)

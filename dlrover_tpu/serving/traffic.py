@@ -199,7 +199,9 @@ class OpenLoopGenerator:
         arrivals = self.schedule()
         t0 = time.monotonic()
         futures = []
-        with ThreadPoolExecutor(max_workers=self._workers) as pool:
+        with ThreadPoolExecutor(
+            max_workers=self._workers, thread_name_prefix="traffic-gen",
+        ) as pool:
             for a in arrivals:
                 delay = a.t - (time.monotonic() - t0)
                 if delay > 0:

@@ -31,6 +31,7 @@ from typing import Any, Callable, List, Optional, Set, Tuple
 from dlrover_tpu.chaos.injector import get_injector
 from dlrover_tpu.common import comm
 from dlrover_tpu.common.config import get_context
+from dlrover_tpu.common.constants import ChaosSite
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability.op_telemetry import OpClass, get_accumulator
 from dlrover_tpu.observability.registry import get_registry
@@ -128,7 +129,7 @@ class DataShardClient:
         try:
             inj = get_injector()
             if inj is not None:
-                inj.fire("data.report", node_id=self._node_id,
+                inj.fire(ChaosSite.DATA_REPORT, node_id=self._node_id,
                          count=len(acks))
             resp = self._mc.report_shard_acks(acks)
         except (ConnectionError, OSError) as e:

@@ -159,7 +159,10 @@ class RemoteActorHandle(ActorHandle):
             try:
                 self._conn.send((method, args, kwargs))  # noqa: DLR004
                 if timeout is not None and not self._conn.poll(timeout):  # noqa: DLR004
-                    self.kill()
+                    # poison the conn BEFORE releasing the lock so no
+                    # queued caller reuses the desynced stream; close()
+                    # on a timed-out socket is bounded
+                    self.kill()  # noqa: DLR014
                     raise ActorDiedError(self.vertex.name,
                                          f"(call {method} timed out)")
                 status, payload = self._conn.recv()  # noqa: DLR004
@@ -296,7 +299,8 @@ class ProcessScheduler:
         # actors needs N concurrent in-flight calls or the collective
         # inside them deadlocks behind the pool queue
         self._pool = ThreadPoolExecutor(
-            max_workers=max(32, 2 * len(graph.vertices()))
+            max_workers=max(32, 2 * len(graph.vertices())),
+            thread_name_prefix="scheduler-call",
         )
 
     def _host_client(self, addr: str):

@@ -157,6 +157,7 @@ def test_persister_bounded_buffer_drops_oldest():
     store.close()
 
 
+@pytest.mark.chaos
 def test_persist_outage_degrades_then_recovers_with_backlog():
     """Chaos at ``brain.persist``: the flush fails, the master degrades to
     reactive-only (journaled ONCE per episode), buffered events survive,
@@ -309,6 +310,7 @@ def test_flat_traffic_never_prescales():
         clock.advance(15.0)
 
 
+@pytest.mark.chaos
 def test_query_outage_degrades_advisor_but_not_seeding_contract():
     clock = FakeClock()
     journal = EventJournal()

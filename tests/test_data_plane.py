@@ -255,6 +255,7 @@ def test_straggler_history_bias_hook():
 # -- chaos sites -------------------------------------------------------------
 
 
+@pytest.mark.chaos
 def test_dropped_ack_report_replays_without_double_count():
     tm = _ledger()
     client = DataShardClient(
@@ -280,6 +281,7 @@ def test_dropped_ack_report_replays_without_double_count():
     assert tm.completed_count("ds") == 1
 
 
+@pytest.mark.chaos
 def test_dropped_dispatch_releases_after_timeout_no_double_lease():
     clock = FakeClock()
     tm = _ledger(clock=clock)

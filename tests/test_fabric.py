@@ -198,6 +198,29 @@ def test_sigkill_mid_transfer_completes_from_survivor():
             p.kill()
 
 
+@pytest.mark.chaos
+def test_connect_probe_fault_fails_over_to_other_source():
+    """An injected failure at ``fabric.connect`` (the describe-phase
+    probe) must cost only that source: the session completes entirely
+    from the one that answered — the catalog's fabric.connect contract."""
+    blob = random.Random(5).randbytes(256 << 10)
+    servers = [_serve_blob(blob), _serve_blob(blob)]
+    configure("fabric.connect:error@nth=1")
+    try:
+        sources = [fabric.FabricSource(addr=f"127.0.0.1:{s.port}")
+                   for s in servers]
+        step, data, stats = fabric.fetch(
+            sources, "blob/x", stripe_bytes=64 << 10, timeout_s=30.0)
+        assert data == blob
+        # the probed-out source never joined the session
+        assert stats["sources"] == 1
+        assert sum(stats["bytes_by_source"].values()) == len(blob)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+@pytest.mark.chaos
 def test_bitflip_stripe_crc_rejected_and_refetched():
     """A corrupted stripe must be caught by the per-stripe CRC before
     commit, fail THAT source, and be refetched from the other one —
@@ -228,6 +251,7 @@ def test_bitflip_stripe_crc_rejected_and_refetched():
             s.stop()
 
 
+@pytest.mark.chaos
 def test_all_sources_injected_dead_aborts_fault_injected():
     blob = random.Random(4).randbytes(64 << 10)
     server = _serve_blob(blob)

@@ -409,6 +409,44 @@ def test_kv_wait_injection_site():
     assert store.wait("k", timeout_s=0.05) is None
 
 
+@pytest.mark.chaos
+def test_rdzv_join_injection_site():
+    """An ``error`` at ``rdzv.join`` surfaces as a handler fault to the
+    joining agent (whose patient RENDEZVOUS retry absorbs it); once the
+    injection window passes, the join lands normally."""
+    from dlrover_tpu.common.comm import NodeMeta
+    from dlrover_tpu.master.rdzv_manager import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    chaos.configure("rdzv.join:error@times=1", seed=9)
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(1, 2)
+    with pytest.raises(chaos.InjectedError):
+        mgr.join_rendezvous(NodeMeta(node_id=0, node_rank=0))
+    # the failed join registered nothing: the waiting set is clean
+    assert mgr.num_nodes_waiting() == 0
+    assert mgr.join_rendezvous(NodeMeta(node_id=0, node_rank=0)) >= 0
+    assert mgr.num_nodes_waiting() == 1
+
+
+@pytest.mark.chaos
+def test_reshard_plan_injection_aborts_rung():
+    """A fault at ``reshard.plan`` aborts only that ladder rung — the
+    restorer raises ReshardAbort(reason="fault_injected") before any
+    peer traffic, so the engine's ladder falls through to the next
+    medium (replica/shm/storage) instead of hanging."""
+    from dlrover_tpu.ckpt.reshard import ReshardAbort, ReshardRestorer
+
+    chaos.configure("reshard.plan:error@times=1", seed=11)
+    restorer = ReshardRestorer("job", None, node_rank=0)
+    with pytest.raises(ReshardAbort) as e:
+        restorer.restore_regions(
+            {"round": 3, "old": [0, 1], "new": [0]}, needs={}
+        )
+    assert e.value.reason == "fault_injected"
+
+
 # -- shm incarnation orphan cleanup ----------------------------------------
 
 

@@ -1,0 +1,603 @@
+"""Tests for the whole-program half of the analyzer: the package-wide
+call graph (dlrover_tpu.analysis.callgraph), the fixpoint summaries, and
+rules DLR014–DLR017 — fire/no-fire fixture pairs per rule, the blessed
+concurrency idioms as zero-false-positive checks, and the runtime budget
+of the whole-package run."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.analysis import callgraph as cg
+from dlrover_tpu.analysis import interproc as ip
+
+pytestmark = pytest.mark.analysis
+
+
+def _fixture(tmp_path, files, **cfg_kwargs):
+    """Write a fixture package under tmp_path and analyze it."""
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    defaults = dict(
+        root=str(tmp_path), package_dirs=("pkg",),
+        constants_rel="pkg/constants.py",
+        journal_rel="pkg/journal.py",
+        chaos_doc_rel="docs/faults.md",
+        tests_rel="tests",
+    )
+    defaults.update(cfg_kwargs)
+    return ip.analyze(ip.InterprocConfig(**defaults))
+
+
+def _rules_hit(analysis, rule_fn):
+    return list(rule_fn(analysis))
+
+
+# -- call-graph construction -------------------------------------------------
+
+
+class TestCallGraph:
+    def test_aliased_import_call_edge(self, tmp_path):
+        a = _fixture(tmp_path, {
+            "pkg/util.py": "def helper():\n    return 1\n",
+            "pkg/mod.py": (
+                "from pkg.util import helper as h\n"
+                "def caller():\n"
+                "    return h()\n"
+            ),
+        })
+        edges = {(c.caller, c.callee) for c in a.graph.calls
+                 if c.kind == "call"}
+        assert ("pkg.mod.caller", "pkg.util.helper") in edges
+
+    def test_decorated_function_still_resolves(self, tmp_path):
+        a = _fixture(tmp_path, {
+            "pkg/mod.py": (
+                "import functools\n"
+                "import time\n"
+                "@functools.lru_cache(maxsize=1)\n"
+                "def slow():\n"
+                "    time.sleep(1)\n"
+                "def caller():\n"
+                "    slow()\n"
+            ),
+        })
+        assert "pkg.mod.caller" in a.summaries.may_block
+
+    def test_self_method_and_inherited_method_resolve(self, tmp_path):
+        a = _fixture(tmp_path, {
+            "pkg/base.py": (
+                "import time\n"
+                "class Base:\n"
+                "    def ping(self):\n"
+                "        time.sleep(1)\n"
+            ),
+            "pkg/mod.py": (
+                "from pkg.base import Base\n"
+                "class Child(Base):\n"
+                "    def go(self):\n"
+                "        self.ping()\n"
+            ),
+        })
+        edges = {(c.caller, c.callee) for c in a.graph.calls}
+        assert ("pkg.mod.Child.go", "pkg.base.Base.ping") in edges
+        assert "pkg.mod.Child.go" in a.summaries.may_block
+
+    def test_bound_method_through_local_type_binding(self, tmp_path):
+        a = _fixture(tmp_path, {
+            "pkg/mod.py": (
+                "import time\n"
+                "class Worker:\n"
+                "    def run(self):\n"
+                "        time.sleep(1)\n"
+                "def caller():\n"
+                "    w = Worker()\n"
+                "    w.run()\n"
+            ),
+        })
+        edges = {(c.caller, c.callee) for c in a.graph.calls}
+        assert ("pkg.mod.caller", "pkg.mod.Worker.run") in edges
+
+    def test_partial_unwraps_to_target(self, tmp_path):
+        a = _fixture(tmp_path, {
+            "pkg/mod.py": (
+                "import functools\n"
+                "def worker(n):\n"
+                "    return n\n"
+                "def caller():\n"
+                "    return functools.partial(worker, 1)\n"
+            ),
+        })
+        kinds = {(c.callee, c.kind) for c in a.graph.calls}
+        assert ("pkg.mod.worker", "partial") in kinds
+
+    def test_submit_and_thread_targets_are_thread_entries(self, tmp_path):
+        a = _fixture(tmp_path, {
+            "pkg/mod.py": (
+                "import threading\n"
+                "def worker():\n"
+                "    return 1\n"
+                "def spawner(pool):\n"
+                "    pool.submit(worker)\n"
+                "    t = threading.Thread(target=worker, name='w',\n"
+                "                         daemon=True)\n"
+                "    t.start()\n"
+            ),
+        })
+        assert "pkg.mod.worker" in a.graph.thread_entries
+        thread_edges = [c for c in a.graph.calls if c.kind == "thread"]
+        assert len(thread_edges) == 2
+
+    def test_may_block_propagates_calls_not_thread_edges(self, tmp_path):
+        a = _fixture(tmp_path, {
+            "pkg/mod.py": (
+                "import time\n"
+                "def leaf():\n"
+                "    time.sleep(1)\n"
+                "def mid():\n"
+                "    leaf()\n"
+                "def top():\n"
+                "    mid()\n"
+                "def dispatcher(pool):\n"
+                "    pool.submit(leaf)\n"
+            ),
+        })
+        assert "pkg.mod.top" in a.summaries.may_block
+        # handing the blocking callable to a pool is NOT blocking here
+        assert "pkg.mod.dispatcher" not in a.summaries.may_block
+        # the witness chain walks the hops down to the sleep
+        _path, _line, chain = a.summaries.may_block["pkg.mod.top"]
+        assert any("mid" in hop for hop in chain)
+        assert any("sleep" in hop for hop in chain)
+
+
+# -- DLR014: interprocedural blocking-under-lock -----------------------------
+
+
+class TestDLR014:
+    def test_flags_blocking_chain_under_lock(self, tmp_path):
+        a = _fixture(tmp_path, {
+            "pkg/mod.py": (
+                "import threading\n"
+                "import time\n"
+                "class Svc:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def _helper(self):\n"
+                "        self._deep()\n"
+                "    def _deep(self):\n"
+                "        time.sleep(1)\n"
+                "    def outer(self):\n"
+                "        with self._lock:\n"
+                "            self._helper()\n"
+            ),
+        })
+        hits = _rules_hit(a, ip.rule_dlr014_interproc_blocking_under_lock)
+        assert len(hits) == 1
+        v = hits[0]
+        assert v.rule == "DLR014" and v.path == "pkg/mod.py"
+        assert "Svc._lock" in v.message
+        # the chain names both the hop and the ultimate blocking call
+        assert "_deep" in v.message and "sleep" in v.message
+
+    def test_queue_handoff_under_lock_is_clean(self, tmp_path):
+        a = _fixture(tmp_path, {
+            "pkg/mod.py": (
+                "import queue\n"
+                "import threading\n"
+                "class Svc:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._q = queue.Queue()\n"
+                "    def publish(self, item):\n"
+                "        with self._lock:\n"
+                "            self._q.put_nowait(item)\n"
+            ),
+        })
+        assert _rules_hit(
+            a, ip.rule_dlr014_interproc_blocking_under_lock) == []
+
+    def test_submit_handoff_under_lock_is_clean(self, tmp_path):
+        # handing blocking work to a pool worker under the lock is the
+        # blessed fix for DLR014 — the thread edge must not propagate
+        a = _fixture(tmp_path, {
+            "pkg/mod.py": (
+                "import threading\n"
+                "import time\n"
+                "class Svc:\n"
+                "    def __init__(self, pool):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._pool = pool\n"
+                "    def _slow(self):\n"
+                "        time.sleep(1)\n"
+                "    def kick(self):\n"
+                "        with self._lock:\n"
+                "            self._pool.submit(self._slow)\n"
+            ),
+        })
+        assert _rules_hit(
+            a, ip.rule_dlr014_interproc_blocking_under_lock) == []
+
+    def test_event_publish_under_lock_is_clean(self, tmp_path):
+        a = _fixture(tmp_path, {
+            "pkg/mod.py": (
+                "import threading\n"
+                "class Svc:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._ready = threading.Event()\n"
+                "    def publish(self):\n"
+                "        with self._lock:\n"
+                "            self._ready.set()\n"
+            ),
+        })
+        assert _rules_hit(
+            a, ip.rule_dlr014_interproc_blocking_under_lock) == []
+
+
+# -- DLR015: static lock-order inversion -------------------------------------
+
+
+class TestDLR015:
+    _INVERTED = {
+        "pkg/a.py": (
+            "import threading\n"
+            "from pkg import b\n"
+            "a_lock = threading.Lock()\n"
+            "def take_a():\n"
+            "    with a_lock:\n"
+            "        pass\n"
+            "def a_then_b():\n"
+            "    with a_lock:\n"
+            "        b.take_b()\n"
+        ),
+        "pkg/b.py": (
+            "import threading\n"
+            "from pkg import a\n"
+            "b_lock = threading.Lock()\n"
+            "def take_b():\n"
+            "    with b_lock:\n"
+            "        pass\n"
+            "def b_then_a():\n"
+            "    with b_lock:\n"
+            "        a.take_a()\n"
+        ),
+    }
+
+    def test_flags_cross_module_inversion_with_both_paths(self, tmp_path):
+        a = _fixture(tmp_path, self._INVERTED)
+        hits = _rules_hit(a, ip.rule_dlr015_lock_order_inversion)
+        assert len(hits) == 1
+        v = hits[0]
+        assert v.rule == "DLR015"
+        assert "pkg.a.a_lock" in v.message and "pkg.b.b_lock" in v.message
+        # both acquisition paths are in the report
+        assert "a_then_b" in v.message or "pkg/a.py" in v.message
+        assert "pkg/b.py" in v.message
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        a = _fixture(tmp_path, {
+            "pkg/a.py": (
+                "import threading\n"
+                "from pkg import b\n"
+                "a_lock = threading.Lock()\n"
+                "def path_one():\n"
+                "    with a_lock:\n"
+                "        b.take_b()\n"
+                "def path_two():\n"
+                "    with a_lock:\n"
+                "        b.take_b()\n"
+            ),
+            "pkg/b.py": (
+                "import threading\n"
+                "b_lock = threading.Lock()\n"
+                "def take_b():\n"
+                "    with b_lock:\n"
+                "        pass\n"
+            ),
+        })
+        assert _rules_hit(a, ip.rule_dlr015_lock_order_inversion) == []
+
+    def test_rlock_reentry_is_clean(self, tmp_path):
+        # re-entering the same class-attribute lock is a self-edge the
+        # order graph deliberately ignores (RLock reentry idiom)
+        a = _fixture(tmp_path, {
+            "pkg/mod.py": (
+                "import threading\n"
+                "class R:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.RLock()\n"
+                "    def outer(self):\n"
+                "        with self._lock:\n"
+                "            self.inner()\n"
+                "    def inner(self):\n"
+                "        with self._lock:\n"
+                "            return 1\n"
+            ),
+        })
+        assert _rules_hit(a, ip.rule_dlr015_lock_order_inversion) == []
+        assert _rules_hit(
+            a, ip.rule_dlr014_interproc_blocking_under_lock) == []
+
+    def test_nested_with_orders_consistently(self, tmp_path):
+        # `with a, b:` is a->b; a second site with the same order is clean
+        a = _fixture(tmp_path, {
+            "pkg/mod.py": (
+                "import threading\n"
+                "a_lock = threading.Lock()\n"
+                "b_lock = threading.Lock()\n"
+                "def one():\n"
+                "    with a_lock, b_lock:\n"
+                "        pass\n"
+                "def two():\n"
+                "    with a_lock:\n"
+                "        with b_lock:\n"
+                "            pass\n"
+            ),
+        })
+        assert ("pkg.mod.a_lock", "pkg.mod.b_lock") in a.summaries.order
+        assert _rules_hit(a, ip.rule_dlr015_lock_order_inversion) == []
+
+
+# -- DLR016: chaos-site contract ---------------------------------------------
+
+
+_CHAOS_CLEAN = {
+    "pkg/constants.py": (
+        "class ChaosSite:\n"
+        "    GOOD = \"good.site\"\n"
+    ),
+    "pkg/svc.py": (
+        "from pkg.constants import ChaosSite\n"
+        "def work(inj):\n"
+        "    inj.fire(ChaosSite.GOOD, key=1)\n"
+    ),
+    "docs/faults.md": (
+        "| site | effect |\n"
+        "|---|---|\n"
+        "| `good.site` | boom |\n"
+    ),
+    "tests/test_chaos.py": (
+        "import pytest\n"
+        "pytestmark = pytest.mark.chaos\n"
+        "def test_drill():\n"
+        "    configure('good.site:error')\n"
+    ),
+}
+
+
+class TestDLR016:
+    def test_full_contract_is_clean(self, tmp_path):
+        a = _fixture(tmp_path, _CHAOS_CLEAN)
+        assert _rules_hit(a, ip.rule_dlr016_chaos_site_contract) == []
+
+    def test_uncatalogued_and_undrilled_and_dead_site(self, tmp_path):
+        files = dict(_CHAOS_CLEAN)
+        files["pkg/constants.py"] = (
+            "class ChaosSite:\n"
+            "    GOOD = \"good.site\"\n"
+            "    DEAD = \"dead.site\"\n"
+        )
+        a = _fixture(tmp_path, files)
+        hits = _rules_hit(a, ip.rule_dlr016_chaos_site_contract)
+        msgs = [v.message for v in hits]
+        # dead.site: never fired, not catalogued, not drilled — 3 flavors
+        assert len(hits) == 3
+        assert all(v.path == "pkg/constants.py" for v in hits)
+        assert any("never fired" in m for m in msgs)
+        assert any("missing from the" in m for m in msgs)
+        assert any("not exercised by any chaos-marked test" in m
+                   for m in msgs)
+
+    def test_fired_but_undeclared_site(self, tmp_path):
+        files = dict(_CHAOS_CLEAN)
+        files["pkg/svc.py"] = (
+            "from pkg.constants import ChaosSite\n"
+            "def work(inj):\n"
+            "    inj.fire(ChaosSite.GOOD, key=1)\n"
+            "    inj.fire(\"rogue.site\")\n"
+        )
+        a = _fixture(tmp_path, files)
+        hits = _rules_hit(a, ip.rule_dlr016_chaos_site_contract)
+        assert len(hits) == 1
+        assert hits[0].path == "pkg/svc.py" and hits[0].line == 4
+        assert "'rogue.site'" in hits[0].message
+        assert "not declared" in hits[0].message
+
+    def test_phantom_catalog_row(self, tmp_path):
+        files = dict(_CHAOS_CLEAN)
+        files["docs/faults.md"] = (
+            "| site | effect |\n"
+            "|---|---|\n"
+            "| `good.site` | boom |\n"
+            "| `phantom.site` | gone |\n"
+        )
+        a = _fixture(tmp_path, files)
+        hits = _rules_hit(a, ip.rule_dlr016_chaos_site_contract)
+        assert len(hits) == 1
+        assert hits[0].path == "docs/faults.md" and hits[0].line == 4
+        assert "phantom" in hits[0].message
+
+    def test_unresolvable_site_argument(self, tmp_path):
+        files = dict(_CHAOS_CLEAN)
+        files["pkg/svc.py"] = (
+            "from pkg.constants import ChaosSite\n"
+            "def work(inj):\n"
+            "    inj.fire(ChaosSite.GOOD, key=1)\n"
+            "def dyn(inj, site):\n"
+            "    inj.fire(site)\n"
+        )
+        a = _fixture(tmp_path, files)
+        hits = _rules_hit(a, ip.rule_dlr016_chaos_site_contract)
+        assert len(hits) == 1
+        assert "not statically resolvable" in hits[0].message
+
+    def test_word_boundary_similar_name_does_not_satisfy_drill(
+        self, tmp_path
+    ):
+        # a chaos-marked file mentioning `good.sitexyz`-style supersets
+        # (or `reshard_planned` vs `reshard.plan`) must NOT count as a
+        # drill for the site
+        files = dict(_CHAOS_CLEAN)
+        files["tests/test_chaos.py"] = (
+            "import pytest\n"
+            "pytestmark = pytest.mark.chaos\n"
+            "def test_drill():\n"
+            "    configure('good.site_extended:error')\n"
+        )
+        a = _fixture(tmp_path, files)
+        hits = _rules_hit(a, ip.rule_dlr016_chaos_site_contract)
+        assert len(hits) == 1
+        assert "not exercised by any chaos-marked test" in hits[0].message
+
+
+# -- DLR017: journal-kind contract -------------------------------------------
+
+
+_JOURNAL_CLEAN = {
+    "pkg/journal.py": (
+        "class JournalEvent:\n"
+        "    STEP = \"step_done\"\n"
+        "    ALL = (STEP,)\n"
+    ),
+    "pkg/prod.py": (
+        "from pkg.journal import JournalEvent\n"
+        "def emit(journal):\n"
+        "    journal.record(JournalEvent.STEP, step=3, wall_s=0.5)\n"
+    ),
+    "pkg/cons.py": (
+        "from pkg.journal import JournalEvent\n"
+        "def consume(e):\n"
+        "    if e.get(\"kind\") != JournalEvent.STEP:\n"
+        "        return None\n"
+        "    data = e.get(\"data\") or {}\n"
+        "    return data.get(\"step\")\n"
+    ),
+}
+
+
+class TestDLR017:
+    def test_matched_producer_consumer_is_clean(self, tmp_path):
+        a = _fixture(tmp_path, _JOURNAL_CLEAN)
+        assert _rules_hit(a, ip.rule_dlr017_journal_kind_contract) == []
+
+    def test_consumer_key_no_producer_attaches(self, tmp_path):
+        files = dict(_JOURNAL_CLEAN)
+        files["pkg/cons.py"] = (
+            "from pkg.journal import JournalEvent\n"
+            "def consume(e):\n"
+            "    if e.get(\"kind\") != JournalEvent.STEP:\n"
+            "        return None\n"
+            "    data = e.get(\"data\") or {}\n"
+            "    return data.get(\"duration_ms\")\n"
+        )
+        a = _fixture(tmp_path, files)
+        hits = _rules_hit(a, ip.rule_dlr017_journal_kind_contract)
+        assert len(hits) == 1
+        v = hits[0]
+        assert v.path == "pkg/cons.py" and v.line == 6
+        assert "'duration_ms'" in v.message
+        assert "step" in v.message and "wall_s" in v.message
+
+    def test_positive_if_guard_attributes_kind(self, tmp_path):
+        files = dict(_JOURNAL_CLEAN)
+        files["pkg/cons.py"] = (
+            "from pkg.journal import JournalEvent\n"
+            "def consume(e):\n"
+            "    if e.get(\"kind\") == JournalEvent.STEP:\n"
+            "        data = e.get(\"data\") or {}\n"
+            "        return data.get(\"missing_key\")\n"
+            "    return None\n"
+        )
+        a = _fixture(tmp_path, files)
+        hits = _rules_hit(a, ip.rule_dlr017_journal_kind_contract)
+        assert len(hits) == 1 and "'missing_key'" in hits[0].message
+
+    def test_recorded_kind_not_declared(self, tmp_path):
+        files = dict(_JOURNAL_CLEAN)
+        files["pkg/prod.py"] = (
+            "from pkg.journal import JournalEvent\n"
+            "def emit(journal):\n"
+            "    journal.record(JournalEvent.STEP, step=3, wall_s=0.5)\n"
+            "    journal.record(\"typod_kind\", x=1)\n"
+        )
+        a = _fixture(tmp_path, files)
+        hits = _rules_hit(a, ip.rule_dlr017_journal_kind_contract)
+        assert len(hits) == 1
+        assert hits[0].path == "pkg/prod.py" and hits[0].line == 4
+        assert "'typod_kind'" in hits[0].message
+
+    def test_declared_kind_missing_from_all(self, tmp_path):
+        files = dict(_JOURNAL_CLEAN)
+        files["pkg/journal.py"] = (
+            "class JournalEvent:\n"
+            "    STEP = \"step_done\"\n"
+            "    ORPHAN = \"orphan_kind\"\n"
+            "    ALL = (STEP,)\n"
+        )
+        a = _fixture(tmp_path, files)
+        hits = _rules_hit(a, ip.rule_dlr017_journal_kind_contract)
+        assert len(hits) == 1
+        assert hits[0].path == "pkg/journal.py" and hits[0].line == 3
+        assert "missing from JournalEvent.ALL" in hits[0].message
+
+    def test_dynamic_producer_suppresses_key_check(self, tmp_path):
+        # a **kwargs producer means the static key set is open — consumer
+        # reads of that kind must not be flagged
+        files = dict(_JOURNAL_CLEAN)
+        files["pkg/prod.py"] = (
+            "from pkg.journal import JournalEvent\n"
+            "def emit(journal, extra):\n"
+            "    journal.record(JournalEvent.STEP, step=3, **extra)\n"
+        )
+        files["pkg/cons.py"] = (
+            "from pkg.journal import JournalEvent\n"
+            "def consume(e):\n"
+            "    if e.get(\"kind\") != JournalEvent.STEP:\n"
+            "        return None\n"
+            "    data = e.get(\"data\") or {}\n"
+            "    return data.get(\"anything_goes\")\n"
+        )
+        a = _fixture(tmp_path, files)
+        assert _rules_hit(a, ip.rule_dlr017_journal_kind_contract) == []
+
+
+# -- whole-package run -------------------------------------------------------
+
+
+def test_whole_package_interproc_within_budget():
+    """The whole-program pass must stay cheap enough for tier-1: build
+    the real package graph, compute summaries, and run all four rules
+    within a generous wall-clock budget (it takes ~5s on a dev box; the
+    cap only catches complexity regressions, not slow machines)."""
+    from dlrover_tpu.analysis.engine import interproc_package, package_root
+
+    t0 = time.monotonic()
+    violations = interproc_package(root=package_root())
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60.0, (
+        f"whole-package interproc pass took {elapsed:.1f}s — the "
+        "call-graph build or the fixpoint blew its complexity budget"
+    )
+    # the shipped tree is contract-clean: anything here is a regression
+    assert violations == [], "\n".join(v.render() for v in violations)
+
+
+def test_real_callgraph_covers_known_thread_entries():
+    """Spot-check the graph over the real tree: the scheduler's pool
+    submit target and the chaos fires must be modeled."""
+    from dlrover_tpu.analysis.engine import package_root
+
+    graph = cg.build_callgraph(package_root())
+    assert graph.thread_entries, "no thread entries modeled"
+    fired = {
+        fire.site
+        for fn in graph.functions.values()
+        for fire in fn.chaos_fires if fire.site
+    }
+    assert "rpc.send" in fired and "reshard.plan" in fired
+    blocked = {q for q in graph.functions if q in
+               ip.compute_summaries(graph).may_block}
+    assert blocked, "no may-block functions found in the real tree"

@@ -368,6 +368,7 @@ def test_coverage_hole_falls_through_to_replica_rung(master, tmp_path,
         replica_store.stop()
 
 
+@pytest.mark.chaos
 def test_injected_transfer_fault_falls_through_ladder(master, tmp_path,
                                                       monkeypatch):
     """Chaos kills every fabric stripe fetch mid-reshard: the rung aborts

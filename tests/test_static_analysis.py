@@ -359,6 +359,26 @@ class TestDLR008:
         )
         assert rules_of(src) == []
 
+    def test_flags_executor_without_thread_name_prefix(self):
+        src = (
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "def f():\n"
+            "    with ThreadPoolExecutor(max_workers=4) as pool:\n"
+            "        pool.submit(print)\n"
+        )
+        assert rules_of(src) == ["DLR008"]
+
+    def test_prefixed_executor_is_clean(self):
+        src = (
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "def f():\n"
+            "    with ThreadPoolExecutor(\n"
+            "        max_workers=4, thread_name_prefix='work',\n"
+            "    ) as pool:\n"
+            "        pool.submit(print)\n"
+        )
+        assert rules_of(src) == []
+
 
 class TestDLR009:
     def test_flags_fire_and_forget_thread(self):
@@ -410,6 +430,39 @@ class TestDLR009:
             "    def stop(self):\n"
             "        for t in self._threads:\n"
             "            t.join()\n"
+        )
+        assert rules_of(src) == []
+
+    def test_flags_executor_with_no_shutdown_path(self):
+        src = (
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "class A:\n"
+            "    def start(self):\n"
+            "        self._pool = ThreadPoolExecutor(\n"
+            "            max_workers=2, thread_name_prefix='w')\n"
+        )
+        assert rules_of(src) == ["DLR009"]
+
+    def test_executor_with_shutdown_is_clean(self):
+        src = (
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "class A:\n"
+            "    def start(self):\n"
+            "        self._pool = ThreadPoolExecutor(\n"
+            "            max_workers=2, thread_name_prefix='w')\n"
+            "    def stop(self):\n"
+            "        self._pool.shutdown(wait=False)\n"
+        )
+        assert rules_of(src) == []
+
+    def test_with_block_executor_is_clean(self):
+        src = (
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "def f():\n"
+            "    with ThreadPoolExecutor(\n"
+            "        max_workers=2, thread_name_prefix='w',\n"
+            "    ) as pool:\n"
+            "        pool.submit(print)\n"
         )
         assert rules_of(src) == []
 
@@ -813,13 +866,25 @@ class TestStaleNoqa:
 
 # -- whole-package CI gate ----------------------------------------------------
 
+_PACKAGE_REPORT = []  # memo: analyze_package() now includes the
+# whole-program pass (call graph + fixpoint), so the three gate tests
+# share one run instead of rebuilding the graph each
+
+
+def _package_report():
+    if not _PACKAGE_REPORT:
+        _PACKAGE_REPORT.append(analyze_package())
+    return _PACKAGE_REPORT[0]
+
 
 @pytest.mark.analysis
 def test_package_passes_static_analysis():
     """The tier-1 gate: the analyzer over the whole dlrover_tpu package
-    must report zero violations beyond the checked-in baseline. On
-    failure, conftest prints the triage/repro instructions."""
-    report = analyze_package()
+    (both passes — per-file rules AND the whole-program rules
+    DLR014–DLR017) must report zero violations beyond the checked-in
+    baseline. On failure, conftest prints the triage/repro
+    instructions."""
+    report = _package_report()
     assert report.ok, (
         f"{len(report.new)} new static-analysis violation(s):\n"
         + "\n".join(v.render() for v in report.new)
@@ -831,7 +896,7 @@ def test_package_passes_static_analysis():
 def test_baseline_has_no_stale_entries():
     """A fixed violation must also be pruned from the baseline, or the
     suppression set rots into covering future regressions."""
-    report = analyze_package()
+    report = _package_report()
     assert not report.stale_baseline, (
         "stale baseline entries (violations already fixed — regenerate "
         "with python -m dlrover_tpu.analysis --update-baseline):\n"
@@ -844,7 +909,7 @@ def test_package_has_no_stale_noqa():
     """Mirror of the stale-baseline gate for inline suppressions: a noqa
     whose line stopped tripping its rule is dead weight that will one day
     hide a real regression on that line."""
-    report = analyze_package()
+    report = _package_report()
     assert not report.stale_noqa, (
         "stale noqa comments (strip with python -m dlrover_tpu.analysis "
         "--fix-noqa):\n"
@@ -897,6 +962,37 @@ def test_cli_check_gate_and_exit_codes(tmp_path):
     assert proc.returncode == 1
     assert "DLR001" in proc.stdout
     assert "repro: python -m dlrover_tpu.analysis --check" in proc.stdout
+
+
+def test_cli_check_fails_on_suppression_rot(tmp_path):
+    """--check exits non-zero when the baseline carries an entry for a
+    violation that no longer exists — dead suppressions hide the next
+    real violation. A scoped --changed-only run must NOT fail on this:
+    it only sees a slice of the package, so unmatched entries are not
+    evidence of rot."""
+    import shutil
+
+    from dlrover_tpu.analysis.engine import default_baseline_path
+
+    rotted = tmp_path / "baseline.txt"
+    shutil.copy(default_baseline_path(), rotted)
+    with open(rotted, "a", encoding="utf-8") as f:
+        f.write("DLR001 dlrover_tpu/nonexistent.py | x = time.time()\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dlrover_tpu.analysis", "--check",
+         "--baseline", str(rotted)],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "suppression rot" in proc.stdout
+    assert "stale baseline entry" in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "dlrover_tpu.analysis", "--check",
+         "--changed-only", "HEAD", "--baseline", str(rotted)],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 def test_cli_stays_import_light():
