@@ -972,6 +972,12 @@ def _ckpt_host_scale_point(target_gb: float) -> dict:
         gc.collect()
 
 
+# Incident records from the most recent chaos drill run in this process
+# (bench_goodput stashes them): the recovery section digests these
+# instead of paying for a second drill when goodput already ran one.
+_DRILL_INCIDENTS: list = []
+
+
 def bench_goodput(timeout_s: float = 300.0) -> dict:
     """Fault-injected goodput: the chaos drill (examples/chaos_goodput.py
     — kill one agent, shrink, resume, rejoin; optionally wedge a worker
@@ -1020,6 +1026,10 @@ def bench_goodput(timeout_s: float = 300.0) -> dict:
             return {"error": proc.stderr[-500:]}
         out = json.loads(proc.stdout.strip().splitlines()[-1])
         out.pop("segments", None)
+        # park the per-recovery Incident records for bench_recovery;
+        # they are too bulky for the goodput digest keys themselves
+        global _DRILL_INCIDENTS
+        _DRILL_INCIDENTS = out.pop("incidents", None) or _DRILL_INCIDENTS
         return out
 
     t0 = time.monotonic()
@@ -1052,6 +1062,88 @@ def bench_goodput(timeout_s: float = 300.0) -> dict:
         return out
     except Exception as e:  # noqa: BLE001 — bench must still emit a line
         return {"error": repr(e)}
+
+
+def _recovery_digest(incidents: list) -> dict:
+    """Fold a list of Incident dicts (observability/incidents.py
+    ``to_dict()`` shape) into the recovery section's digest keys: MTTR /
+    MTTD, per-phase goodput loss, rollback distance, restore-rung
+    attribution. Resolved incidents only, unless none resolved."""
+    resolved = [i for i in incidents if i.get("status") == "resolved"]
+    pool = resolved or incidents
+    mttrs = [i["mttr_s"] for i in pool if i.get("mttr_s") is not None]
+    mttds = [i["mttd_s"] for i in pool if i.get("mttd_s") is not None]
+    phase_loss: dict = {}
+    rungs: dict = {}
+    for inc in pool:
+        for ph, secs in (inc.get("phases") or {}).items():
+            if ph in ("productive", "serving"):
+                continue
+            phase_loss[ph] = round(phase_loss.get(ph, 0.0) + secs, 3)
+        rung = inc.get("rung") or "unknown"
+        rungs[rung] = rungs.get(rung, 0) + 1
+    return {
+        "incidents": len(incidents),
+        "resolved": len(resolved),
+        "mttr_s": round(max(mttrs), 3) if mttrs else None,
+        "mttr_mean_s": round(sum(mttrs) / len(mttrs), 3) if mttrs else None,
+        "mttd_s": round(max(mttds), 3) if mttds else None,
+        "rollback_steps": sum(
+            int(i.get("rollback_steps") or 0) for i in pool
+        ),
+        "recompute_s": round(
+            sum(float(i.get("recompute_s") or 0.0) for i in pool), 3
+        ),
+        "goodput_loss_s": round(
+            sum(float(i.get("goodput_loss_s") or 0.0) for i in pool), 3
+        ),
+        "rungs": rungs,
+        "phase_loss_s": phase_loss,
+    }
+
+
+def bench_recovery(timeout_s: float = 120.0) -> dict:
+    """Incident anatomy under a real fault: MTTR / MTTD, phase-by-phase
+    goodput loss, rollback distance, and restore-rung attribution,
+    digested from the Incident records the drill master's
+    ``IncidentStitcher`` folds out of the event journal
+    (docs/design/incident_forensics.md). Reuses the goodput section's
+    drill when it ran in this process; otherwise runs the short
+    one-fault drill (the same args tests/test_chaos_e2e.py asserts)."""
+    import subprocess
+
+    if os.environ.get("BENCH_SKIP_CHAOS"):
+        return {"skipped": "BENCH_SKIP_CHAOS set"}
+    incidents = _DRILL_INCIDENTS
+    source = "goodput_drill"
+    if not incidents:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        repo = os.path.dirname(os.path.abspath(__file__))
+        budget = max(30.0, timeout_s)
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(repo, "examples", "chaos_goodput.py"),
+                    "--steps", "60", "--step-time", "0.15",
+                    "--kill-at-step", "10",
+                ],
+                env=env, capture_output=True, text=True,
+                timeout=budget, cwd=repo,
+            )
+        except subprocess.TimeoutExpired:
+            return {"error": f"drill timed out after {budget:.0f}s"}
+        if proc.returncode != 0:
+            return {"error": proc.stderr[-500:]}
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        incidents = out.get("incidents") or []
+        source = "short_drill"
+    if not incidents:
+        return {"error": "drill produced no incident records"}
+    digest = _recovery_digest(incidents)
+    digest["source"] = source
+    return digest
 
 
 def _reshard_point(master, job: str, target_mb: int) -> dict:
@@ -2094,6 +2186,10 @@ _SECTIONS = (
     ("decode", lambda left: bench_decode(), 150.0),
     ("attn", lambda left: bench_attention(), 90.0),
     ("goodput", lambda left: bench_goodput(timeout_s=left - 10.0), 60.0),
+    # recovery: digests the goodput drill's Incident records (free when
+    # goodput ran); only pays for its own short drill if goodput skipped
+    ("recovery", lambda left: bench_recovery(timeout_s=min(left, 120.0)),
+     20.0),
     ("reshard", lambda left: bench_reshard(budget_s=min(left, 150.0)), 45.0),
     # redecompose: one seeded 8→6 chaos drill (~25 s, subprocess bound)
     ("redecompose",
@@ -2165,9 +2261,10 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
     sections = {
         name: ("error" if "error" in (detail.get(name) or {})
                else (detail.get(name) or {}).get("skipped") or "ok")
-        for name in ("train", "decode", "attn", "goodput", "reshard",
-                     "redecompose", "fabric", "control_plane", "serving",
-                     "data", "brain", "rl", "static_analysis", "ckpt")
+        for name in ("train", "decode", "attn", "goodput", "recovery",
+                     "reshard", "redecompose", "fabric", "control_plane",
+                     "serving", "data", "brain", "rl", "static_analysis",
+                     "ckpt")
         if name in detail
     }
     summary = {
@@ -2189,6 +2286,11 @@ def _summary_line(detail: dict, elapsed: float, git: str) -> dict:
             # journal-derived attribution (observability spine): the
             # system's own /metrics phase gauges, not a bench re-derivation
             "journal_goodput_pct", "metrics_scrape_ok", "phases")),
+        # incident forensics: the stitcher's per-recovery accounting
+        "recovery": pick(detail.get("recovery") or {}, (
+            "incidents", "resolved", "mttr_s", "mttd_s",
+            "rollback_steps", "goodput_loss_s", "rungs",
+            "phase_loss_s")),
         "ckpt": pick(ckpt, (
             "state_gb", "t_block_s", "t_restore_s",
             "restore_link_efficiency", "restore_link_efficiency_met",
@@ -2272,7 +2374,120 @@ def _emit(detail: dict, elapsed: float, git: str = "unknown") -> None:
     print(line, flush=True)
 
 
-def main() -> None:
+def _flatten_digest(summary: dict, prefix: str = "") -> dict:
+    """Flatten a digest's nested dicts into dotted numeric keys
+    (``goodput.goodput_pct``, ``recovery.phase_loss_s.restore``).
+    Non-numeric leaves (status strings, booleans) are dropped — the
+    comparison is about trajectory numbers, not section states."""
+    flat: dict = {}
+    for k, v in (summary or {}).items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flat.update(_flatten_digest(v, key + "."))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            flat[key] = float(v)
+    return flat
+
+
+def _lower_is_better(key: str) -> bool:
+    """Direction heuristic over the flattened key: time/loss/error-like
+    keys regress by going UP, everything else (rates, MFU, hit ratios)
+    by going DOWN. Tuned against the digest's actual key set."""
+    import re
+
+    return bool(re.search(
+        r"(_s$|_ms$|_ms_|mttr|mttd|rollback|loss|latency|staleness"
+        r"|ttft|false_deaths|\blost\b|detect|recover|violations"
+        r"|overhead|step_s|wall)", key))
+
+
+def compare_digests(fresh: dict, prior: dict,
+                    threshold: float = 0.10) -> tuple:
+    """Per-key diff of two digest ``summary`` dicts. Returns
+    ``(regressions, improvements)`` — rows ``{key, prior, fresh,
+    delta_pct}`` where the key moved in its bad (resp. good) direction
+    by more than ``threshold`` relative to the prior value."""
+    f, p = _flatten_digest(fresh), _flatten_digest(prior)
+    regressions, improvements = [], []
+    for key in sorted(set(f) & set(p)):
+        old, new = p[key], f[key]
+        delta = (new - old) / max(abs(old), 1e-9)
+        gain = -delta if _lower_is_better(key) else delta
+        row = {"key": key, "prior": old, "fresh": new,
+               "delta_pct": round(delta * 100.0, 1)}
+        if gain < -threshold:
+            regressions.append(row)
+        elif gain > threshold:
+            improvements.append(row)
+    return regressions, improvements
+
+
+def _load_record_summary(path: str) -> dict:
+    """Pull the digest ``summary`` out of a saved trajectory point —
+    either a driver record (``BENCH_rNN.json``: ``parsed.summary``) or
+    a bare digest line saved from stdout (``summary``)."""
+    with open(path, encoding="utf-8") as fh:
+        rec = json.load(fh)
+    summary = (rec.get("parsed") or {}).get("summary") or rec.get("summary")
+    if not isinstance(summary, dict):
+        raise ValueError(f"{path}: no parsed.summary / summary digest")
+    return summary
+
+
+def _print_compare(fresh_summary: dict, prior_path: str,
+                   threshold: float) -> int:
+    """Print the regression report to STDERR (stdout's last line must
+    stay the digest — the driver tail-parses it). Returns the number of
+    regressed keys (the offline mode's exit code)."""
+    prior = _load_record_summary(prior_path)
+    regressions, improvements = compare_digests(
+        fresh_summary, prior, threshold)
+    w = sys.stderr
+    print(f"compare vs {prior_path} (threshold {threshold:.0%}):", file=w)
+    for row in regressions:
+        print(
+            f"  REGRESSION {row['key']}: {row['prior']} -> {row['fresh']}"
+            f" ({row['delta_pct']:+.1f}%)", file=w)
+    for row in improvements:
+        print(
+            f"  improved   {row['key']}: {row['prior']} -> {row['fresh']}"
+            f" ({row['delta_pct']:+.1f}%)", file=w)
+    if not regressions and not improvements:
+        print(f"  no keys moved past the {threshold:.0%} threshold",
+              file=w)
+    print(f"  {len(regressions)} regression(s),"
+          f" {len(improvements)} improvement(s)", file=w)
+    return len(regressions)
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="dlrover_tpu benchmark suite")
+    parser.add_argument(
+        "--compare", metavar="BENCH_rNN.json", default=None,
+        help="after the run, diff the fresh digest against this prior "
+             "trajectory point and print per-key regressions (stderr)")
+    parser.add_argument(
+        "--fresh", metavar="RECORD.json", default=None,
+        help="with --compare: diff this saved record instead of running "
+             "the bench; exits non-zero on regressions")
+    parser.add_argument(
+        "--compare-threshold", type=float, default=0.10,
+        help="relative move past which a key counts as a regression "
+             "(default 0.10 = 10%%)")
+    args = parser.parse_args(argv)
+
+    if args.fresh and not args.compare:
+        parser.error("--fresh requires --compare")
+    if args.compare and args.fresh:
+        # offline mode: pure record diff, no accelerator time
+        n_reg = _print_compare(
+            _load_record_summary(args.fresh), args.compare,
+            args.compare_threshold)
+        raise SystemExit(1 if n_reg else 0)
+
     # the framework's persistent XLA compilation cache (worker.py): the
     # bench pays tens of seconds of compiles per section otherwise, all
     # charged against its own wall-clock budget — and a re-run (the
@@ -2296,6 +2511,14 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001 — keep the record
                 detail[name] = {"error": repr(e)}
         _emit(detail, time.monotonic() - t_start, git)
+    if args.compare:
+        elapsed = time.monotonic() - t_start
+        try:
+            _print_compare(
+                _summary_line(detail, elapsed, git)["summary"],
+                args.compare, args.compare_threshold)
+        except (OSError, ValueError) as e:
+            print(f"compare failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

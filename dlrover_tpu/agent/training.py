@@ -33,6 +33,7 @@ from dlrover_tpu.common.constants import (
     ConfigKey,
     DiagnosisActionType,
     EnvKey,
+    MetricLabel,
     NodeStatus,
     RendezvousName,
     SharedResourceName,
@@ -660,6 +661,13 @@ class ElasticTrainingAgent:
             self._client.report_event(
                 JournalEvent.SHM_ORPHANS_CLEANED, {"segments": removed}
             )
+        if self._ckpt_saver is not None:
+            # every tracker move this host leads lands in the master's
+            # journal as ckpt_committed {step, trigger, frames} — the
+            # incident stitcher scores pre-emptive saves from these
+            self._ckpt_saver.set_reporter(
+                lambda kind, data: self._client.report_event(kind, data)
+            )
         inj = get_injector()
         if inj is not None:
             # injected faults land in the master's journal via the
@@ -879,6 +887,7 @@ class ElasticTrainingAgent:
                         self._ckpt_saver.save_shm_to_storage(
                             reason="brain preemptive checkpoint",
                             workers_dead=False,
+                            trigger=MetricLabel.CKPT_TRIGGER_PREEMPTIVE,
                         )
                     except Exception:  # noqa: BLE001 — advisory save
                         logger.exception("preemptive checkpoint failed")

@@ -68,6 +68,11 @@ DLR017     journal-kind contract: recorded kinds must be declared on
            ``JournalEvent`` (and listed in ``ALL``); payload keys are
            aggregated across producers and every consumer read of a
            key no producer attaches is flagged as a silent ``None``
+DLR018     incident-schema contract: every ``JournalEvent`` kind the
+           incident stitcher consumes must be a JOURNAL→PHASE
+           transition or listed in its ``CORRELATED_KINDS`` table, and
+           every ``Phase.ALL`` member must be reachable from some
+           journal kind
 =========  ==============================================================
 
 Suppression is explicit: an inline ``# noqa: DLR00X`` (with a reason) on

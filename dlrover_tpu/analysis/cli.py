@@ -67,7 +67,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m dlrover_tpu.analysis",
         description="dlrover_tpu control-plane invariant analyzer "
                     "(per-file rules DLR001-DLR013 plus whole-program "
-                    "rules DLR014-DLR017; see docs/design/"
+                    "rules DLR014-DLR018; see docs/design/"
                     "static_analysis.md and docs/design/"
                     "concurrency_analysis.md)",
     )
@@ -96,7 +96,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "--no-interproc", action="store_true",
-        help="skip the whole-program pass (DLR014-DLR017); per-file "
+        help="skip the whole-program pass (DLR014-DLR018); per-file "
              "rules only — faster, for tight edit loops",
     )
     parser.add_argument(

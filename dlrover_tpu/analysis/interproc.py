@@ -1,4 +1,4 @@
-"""Interprocedural summaries + whole-program rules DLR014–DLR017.
+"""Interprocedural summaries + whole-program rules DLR014–DLR018.
 
 The per-file rules stop at function boundaries; these run over the
 :mod:`callgraph` and a fixpoint summary pass:
@@ -38,6 +38,13 @@ machinery as the per-file set):
   against every consumer read (``data.get("k")`` under a kind guard) —
   a consumer reading a key no producer ever attaches is a silent
   ``None``-path, the cross-process cousin of a typo'd kind.
+- **DLR018** incident-schema contract: every ``JournalEvent`` kind the
+  incident stitcher (observability/incidents.py) consumes must have a
+  declared role — a JOURNAL→PHASE ``_TRANSITIONS`` key or an entry in
+  the stitcher's ``CORRELATED_KINDS`` table — and every ``Phase.ALL``
+  member must be reachable from some journal kind, so a new phase (or a
+  newly consumed kind) can't drift in without the map entry that makes
+  it attributable.
 """
 
 import ast
@@ -75,6 +82,8 @@ class InterprocConfig:
     tests_rel: str = "tests"
     chaos_site_class: str = "ChaosSite"
     journal_event_class: str = "JournalEvent"
+    incidents_rel: str = "dlrover_tpu/observability/incidents.py"
+    phase_class: str = "Phase"
 
 
 @dataclass
@@ -774,6 +783,164 @@ def rule_dlr017_journal_kind_contract(
             f"attach: {keys}) — the read is a silent None; fix the key "
             "or the producer",
         )
+
+
+def _journal_transitions(
+    analysis: Analysis,
+) -> Tuple[Set[str], Set[str], Optional[int]]:
+    """(JournalEvent attrs keying _TRANSITIONS, Phase attrs it reaches,
+    _TRANSITIONS line) from the journal module's JOURNAL→PHASE map."""
+    cfg = analysis.config
+    mod = next((m for m in analysis.graph.modules.values()
+                if m.path == cfg.journal_rel), None)
+    keys: Set[str] = set()
+    phases: Set[str] = set()
+    line: Optional[int] = None
+    if mod is None:
+        return keys, phases, line
+    for node in ast.walk(mod.tree):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            target = node.target.id
+        if target != "_TRANSITIONS" or not isinstance(
+            node.value, ast.Dict
+        ):
+            continue
+        line = node.lineno
+        for k in node.value.keys:
+            if isinstance(k, ast.Attribute):
+                keys.add(k.attr)
+        for v in node.value.values:
+            if isinstance(v, ast.Attribute):
+                phases.add(v.attr)
+    return keys, phases, line
+
+
+def _declared_phases(analysis: Analysis) -> Dict[str, int]:
+    """Phase attr names in Phase.ALL (journal module) -> ALL line."""
+    cfg = analysis.config
+    mod = next((m for m in analysis.graph.modules.values()
+                if m.path == cfg.journal_rel), None)
+    out: Dict[str, int] = {}
+    if mod is None:
+        return out
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == cfg.phase_class):
+            continue
+        for stmt in node.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "ALL"
+                    and isinstance(stmt.value, ast.Tuple)):
+                for elt in stmt.value.elts:
+                    if isinstance(elt, ast.Name):
+                        out[elt.id] = stmt.lineno
+                    elif isinstance(elt, ast.Attribute):
+                        out[elt.attr] = stmt.lineno
+    return out
+
+
+def _correlation_table(analysis: Analysis) -> Tuple[Set[str], Dict[str, int]]:
+    """The incident stitcher's CORRELATED_KINDS declaration: (attr names
+    listed, attr -> line)."""
+    cfg = analysis.config
+    mod = next((m for m in analysis.graph.modules.values()
+                if m.path == cfg.incidents_rel), None)
+    attrs: Set[str] = set()
+    lines: Dict[str, int] = {}
+    if mod is None:
+        return attrs, lines
+    for node in ast.walk(mod.tree):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            target = node.target.id
+        if target != "CORRELATED_KINDS" or node.value is None:
+            continue
+        elts = (node.value.elts
+                if isinstance(node.value, (ast.Tuple, ast.List))
+                else [])
+        for elt in elts:
+            if isinstance(elt, ast.Attribute):
+                attrs.add(elt.attr)
+                lines[elt.attr] = elt.lineno
+    return attrs, lines
+
+
+@_interproc_rule
+def rule_dlr018_incident_schema_contract(
+    analysis: Analysis,
+) -> Iterator[Violation]:
+    """every kind the incident stitcher consumes has a declared role
+    (JOURNAL→PHASE key or correlation-table entry), and every Phase.ALL
+    member is reachable from some journal kind."""
+    cfg = analysis.config
+    stitcher = next((m for m in analysis.graph.modules.values()
+                     if m.path == cfg.incidents_rel), None)
+    if stitcher is None:
+        return
+    kinds, _in_all, _ = _declared_kinds(analysis)
+    declared_attrs = {attr for attr, _line in kinds.values()}
+    transition_keys, reached_phases, transitions_line = \
+        _journal_transitions(analysis)
+    correlated, correlated_lines = _correlation_table(analysis)
+    # (a) correlation-table entries must be declared journal kinds —
+    # a typo'd entry would silently certify nothing
+    for attr in sorted(correlated):
+        if declared_attrs and attr not in declared_attrs:
+            yield analysis.violation(
+                "DLR018", cfg.incidents_rel,
+                correlated_lines.get(attr, 1),
+                f"CORRELATED_KINDS entry {cfg.journal_event_class}."
+                f"{attr} is not declared on {cfg.journal_event_class} — "
+                "the correlation table certifies a kind that cannot be "
+                "journaled",
+            )
+    # (b) every JournalEvent.X the stitcher touches needs a declared
+    # role: a phase transition or an explicit correlation-table entry
+    covered = transition_keys | correlated
+    flagged: Set[str] = set()
+    for node in ast.walk(stitcher.tree):
+        if not (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == cfg.journal_event_class):
+            continue
+        attr = node.attr
+        if attr in covered or attr in flagged or attr == "ALL":
+            continue
+        flagged.add(attr)
+        yield analysis.violation(
+            "DLR018", cfg.incidents_rel, node.lineno,
+            f"incident stitcher consumes {cfg.journal_event_class}."
+            f"{attr} but it is neither a JOURNAL→PHASE transition nor "
+            "listed in CORRELATED_KINDS — declare its role so the "
+            "incident schema can't drift from the journal's",
+        )
+    # (c) every Phase.ALL member must be reachable from some journal
+    # kind — a phase no event can enter is dead weight in every
+    # waterfall and gauge family
+    for phase_attr, line in sorted(_declared_phases(analysis).items()):
+        if phase_attr == "PRODUCTIVE":
+            continue  # the state machine's start phase, entered at t=0
+        if phase_attr not in reached_phases:
+            yield analysis.violation(
+                "DLR018", cfg.journal_rel,
+                transitions_line or line,
+                f"{cfg.phase_class}.{phase_attr} is in {cfg.phase_class}"
+                ".ALL but no journal kind transitions into it — the "
+                "phase can never accrue seconds; add a _TRANSITIONS "
+                "entry or retire the phase",
+            )
 
 
 # -- contracts report ----------------------------------------------------------

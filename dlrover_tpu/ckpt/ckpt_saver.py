@@ -31,6 +31,7 @@ from dlrover_tpu.analysis.race_detector import shared
 from dlrover_tpu.common.config import get_context
 from dlrover_tpu.common.constants import (
     CheckpointConstant,
+    MetricLabel,
     SharedResourceName,
     SpanName,
 )
@@ -208,7 +209,32 @@ class AsyncCheckpointSaver:
         # async breakpoint-commit threads (the monotonic check is useless
         # if two commits interleave between check and move)
         self._commit_lock = threading.Lock()
+        # best-effort commit telemetry: the agent wires this to its
+        # master client so every tracker move lands in the journal as
+        # ckpt_committed {step, trigger, frames} — the incident
+        # stitcher's counterfactual line scores the brain's pre-emptive
+        # saves against the last periodic commit from these records
+        self._reporter = None
         AsyncCheckpointSaver._instance = self
+
+    def set_reporter(self, fn) -> None:
+        """``fn(kind: str, data: dict)`` — typically the agent's
+        ``client.report_event``; commit telemetry must never block or
+        fail a commit, so calls are wrapped."""
+        self._reporter = fn
+
+    def _report_commit(self, step: int, trigger: str, frames: int) -> None:
+        if self._reporter is None:
+            return
+        from dlrover_tpu.observability.journal import JournalEvent
+
+        try:
+            self._reporter(
+                JournalEvent.CKPT_COMMITTED,
+                {"step": step, "trigger": trigger, "frames": frames},
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            logger.warning("ckpt_committed report failed", exc_info=True)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -413,12 +439,15 @@ class AsyncCheckpointSaver:
     def commit_checkpoint(
         self, path: str, step: int, timeout_s: Optional[float] = None,
         expected_frames: Optional[int] = None,
+        trigger: str = MetricLabel.CKPT_TRIGGER_PERIODIC,
     ) -> bool:
         """Wait for all expected done files, then move the tracker
         (reference ``commit_checkpoint``:992). ``expected_frames``
         overrides the world-derived default — the saver group's size as
         recorded in the frame meta (a single-writer job commits on ONE
-        frame regardless of world size)."""
+        frame regardless of world size). ``trigger`` names what caused
+        the save (MetricLabel.CKPT_TRIGGER_*) and rides the journaled
+        ckpt_committed record."""
         timeout_s = timeout_s or CheckpointConstant.SAVE_TIMEOUT_S
         expected = expected_frames or self._expected_frames
         done_dir = os.path.join(step_dir(path, step), CheckpointConstant.DONE_DIR)
@@ -451,6 +480,7 @@ class AsyncCheckpointSaver:
                     self._storage.safe_move(tmp, tracker)
                 logger.info("checkpoint step %s committed (%s frames)",
                             step, count)
+                self._report_commit(step, trigger, count)
                 if self._deletion_strategy is not None:
                     # chain-aware GC: never collects a link on a live
                     # tip's digest walk or a payload a live link resolves
@@ -471,6 +501,7 @@ class AsyncCheckpointSaver:
     def save_shm_to_storage(
         self, reason: str = "", workers_dead: bool = False,
         async_commit: bool = False,
+        trigger: str = MetricLabel.CKPT_TRIGGER_BREAKPOINT,
     ) -> int:
         """Persist any shm frame newer than what's on disk — called when
         workers fail, membership changes, or the agent gets SIGTERM
@@ -530,12 +561,14 @@ class AsyncCheckpointSaver:
                         threading.Thread(
                             target=self.commit_checkpoint,
                             args=(self.ckpt_dir, step),
-                            kwargs={"timeout_s": 30.0},
+                            kwargs={"timeout_s": 30.0,
+                                    "trigger": trigger},
                             name=f"bp-commit-{step}", daemon=True,
                         ).start()
                     else:
                         self.commit_checkpoint(
-                            self.ckpt_dir, step, timeout_s=30.0
+                            self.ckpt_dir, step, timeout_s=30.0,
+                            trigger=trigger,
                         )
             logger.info(
                 "breakpoint save (%s): persisted %s frame(s) to %s",

@@ -532,6 +532,26 @@ class MetricLabel:
     # SLO burn windows (observability/slo.py)
     WINDOW_FAST = "fast"
     WINDOW_SLOW = "slow"
+    # restore-ladder rung attribution (observability/incidents.py): the
+    # rung that won a fault→recovery episode, as journaled by
+    # ckpt/engine.py's restore_complete {medium} — plus "unknown" for an
+    # incident whose window never saw a restore land
+    RUNG_RESHARD = "reshard"
+    RUNG_SHM = "shm"
+    RUNG_CHAIN = "chain"
+    RUNG_REPLICA = "replica"
+    RUNG_STORAGE = "storage"
+    RUNG_UNKNOWN = "unknown"
+    RESTORE_RUNGS = (
+        RUNG_RESHARD, RUNG_SHM, RUNG_CHAIN, RUNG_REPLICA, RUNG_STORAGE,
+        RUNG_UNKNOWN,
+    )
+    # checkpoint-commit triggers (ckpt/ckpt_saver.py → ckpt_committed
+    # journal events): the cadence save, a membership-change/SIGTERM
+    # breakpoint save, and the brain's predicted-failure pre-emptive save
+    CKPT_TRIGGER_PERIODIC = "periodic"
+    CKPT_TRIGGER_BREAKPOINT = "breakpoint"
+    CKPT_TRIGGER_PREEMPTIVE = "preemptive"
 
 
 class GRPC:

@@ -89,6 +89,26 @@ class JobMaster:
         )
         # first step report after a recovery phase closes it (step_resumed)
         self.perf_monitor.journal = self.event_journal
+        # incident forensics: fold the journal into per-recovery Incident
+        # records (MTTR/MTTD, phase waterfall, rollback, rung
+        # attribution) — the step-time estimate converts rollback steps
+        # into recompute seconds (brain EWMA preferred, measured running
+        # speed as fallback; wired after the advisor exists below)
+        from dlrover_tpu.observability.incidents import IncidentStitcher
+
+        def _step_time_estimate():
+            advisor = getattr(self, "brain_advisor", None)
+            if advisor is not None:
+                best = advisor.step_model.best_config()
+                if best is not None:
+                    return advisor.step_model.predict(best)
+            speed = self.perf_monitor.running_speed()
+            return (1.0 / speed) if speed > 0.0 else None
+
+        self.incident_stitcher = IncidentStitcher(
+            self.event_journal, step_time_fn=_step_time_estimate,
+        )
+        self.incident_stitcher.attach_metrics(self.metrics_registry)
         self.metric_context = JobMetricContext()
         from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
         from dlrover_tpu.master.stats import JobMetricCollector
@@ -422,6 +442,13 @@ class JobMaster:
                     self.flight_recorder.http_handler(),
                 )
                 self._http_server.add_get_route(
+                    "/incidents",
+                    lambda: (
+                        "application/json",
+                        self.incident_stitcher.to_json(),
+                    ),
+                )
+                self._http_server.add_get_route(
                     "/brain",
                     lambda: (
                         "application/json",
@@ -473,13 +500,18 @@ class JobMaster:
             with tracing.span(
                 SpanName.FAULT_RELAUNCH, source="master",
                 node_id=event.node.id, status=event.node.status,
-            ):
+            ) as fault_span:
                 self.task_manager.recover_tasks(event.node.id)
                 self.fanin_plane.on_connection_lost(event.node.id)
+                # step + trace_id ride the fault record so the incident
+                # stitcher can compute rollback distance and join the
+                # incident to this fault-broadcast arc's span tree
                 self.event_journal.record(
                     JournalEvent.FAULT_DETECTED,
                     node_id=event.node.id,
                     status=event.node.status,
+                    step=self.perf_monitor.completed_global_step,
+                    trace_id=fault_span.trace_id,
                 )
                 for manager in self.rdzv_managers.values():
                     manager.remove_alive_node(event.node.rank)

@@ -5,6 +5,12 @@ TPU redesign of the reference xpu_timer stack (xpu_timer/: LD_PRELOAD CUDA
 hook + brpc daemon + py tools) — see tpu_timer/README.md for the mapping.
 """
 
+from dlrover_tpu.observability.incidents import (
+    Incident,
+    IncidentStitcher,
+    stitch_incidents,
+    stitch_journal_dict,
+)
 from dlrover_tpu.observability.journal import (
     EventJournal,
     JournalEvent,
@@ -34,7 +40,9 @@ from dlrover_tpu.observability.tpu_timer import (
 __all__ = [
     "TpuTimer", "find_library", "install_tracepoints", "trace_function",
     "EventJournal", "JournalEvent", "Phase", "attribute_phases",
-    "phase_segments", "MetricsRegistry", "get_registry", "reset_registry",
+    "phase_segments", "Incident", "IncidentStitcher", "stitch_incidents",
+    "stitch_journal_dict",
+    "MetricsRegistry", "get_registry", "reset_registry",
     "OpClass", "OpClassHistogram", "OpTelemetryAccumulator",
     "get_accumulator", "reset_accumulator",
 ]

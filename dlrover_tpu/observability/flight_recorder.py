@@ -12,6 +12,8 @@ process:
                         spans) merged with timeline.py's "job phases" and
                         "cross-worker skew" journal tracks, on one clock
         journal.json    the event journal tail (EventJournal.to_json())
+        incidents.json  stitched fault→recovery Incident records for the
+                        same journal tail (observability/incidents.py)
         metrics.prom    a /metrics snapshot (MetricsRegistry.render())
         config.json     config fingerprint: every ConfigKey/EnvKey knob
                         currently set in the environment
@@ -198,6 +200,7 @@ class FlightRecorder:
         if journal_dict is not None:
             from dlrover_tpu.observability.timeline import (
                 brain_track_events,
+                incident_track_events,
                 job_phase_events,
                 skew_track_events,
             )
@@ -205,6 +208,7 @@ class FlightRecorder:
             events.extend(job_phase_events(journal_dict))
             events.extend(skew_track_events(journal_dict))
             events.extend(brain_track_events(journal_dict))
+            events.extend(incident_track_events(journal_dict))
         with open(os.path.join(bundle_dir, "traces.json"), "w") as f:
             json.dump({"traceEvents": events}, f)
 
@@ -231,6 +235,20 @@ class FlightRecorder:
         if journal_dict is not None:
             with open(os.path.join(bundle_dir, "journal.json"), "w") as f:
                 json.dump(journal_dict, f)
+            # the stitched fault→recovery forensics for the same journal
+            # tail — the bundle answers "which incident cost what"
+            # without re-running the stitcher offline
+            from dlrover_tpu.observability.incidents import (
+                stitch_journal_dict,
+            )
+
+            incidents = stitch_journal_dict(journal_dict)
+            with open(os.path.join(bundle_dir, "incidents.json"),
+                      "w") as f:
+                json.dump({
+                    "now_t": journal_dict.get("now_t", 0.0),
+                    "incidents": [inc.to_dict() for inc in incidents],
+                }, f)
 
         if self.registry is not None:
             with open(os.path.join(bundle_dir, "metrics.prom"), "w") as f:

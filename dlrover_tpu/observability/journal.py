@@ -60,6 +60,18 @@ class JournalEvent:
     # flight recorder (observability/flight_recorder.py) wrote a
     # post-mortem bundle — informational, no phase transition
     TRACE_BUNDLE_CAPTURED = "trace_bundle_captured"
+    # the journal ring itself overflowed (events dropped from the head):
+    # emitted once per overflow *episode* (drop bursts separated by a
+    # quiet gap), so the record of pressure survives even though the
+    # dropped events themselves do not — ROADMAP item 5 names ring
+    # pressure as a scale limit. Informational.
+    JOURNAL_RING_OVERFLOW = "journal_ring_overflow"
+    # a checkpoint step's tracker moved (ckpt/ckpt_saver.py commit):
+    # data carries {step, trigger, frames} with trigger one of
+    # periodic / breakpoint / preemptive — the incident stitcher's
+    # counterfactual line (observability/incidents.py) scores the brain's
+    # pre-emptive saves against the last periodic commit. Informational.
+    CKPT_COMMITTED = "ckpt_committed"
     # live-reshard plane (ckpt/reshard.py + master/rdzv_manager.py):
     # reshard_planned is the master's cut-side announcement (informational);
     # reshard_start/complete/aborted bracket the worker-side execution and
@@ -179,7 +191,8 @@ class JournalEvent:
         RESTORE_COMPLETE, RECOMPILE_START, RECOMPILE_COMPLETE, STEP_RESUMED,
         FAULT_INJECTED, CKPT_CORRUPT, CKPT_REPAIRED, PARTITION_RESYNC,
         SHM_ORPHANS_CLEANED, STRAGGLER_DETECTED, HANG_ATTRIBUTED,
-        STACK_DUMP_CAPTURED, TRACE_BUNDLE_CAPTURED, RESHARD_PLANNED,
+        STACK_DUMP_CAPTURED, TRACE_BUNDLE_CAPTURED,
+        JOURNAL_RING_OVERFLOW, CKPT_COMMITTED, RESHARD_PLANNED,
         RESHARD_START, RESHARD_COMPLETE, RESHARD_ABORTED,
         RESHARD_REPLAN_DEGRADED,
         FANIN_REPARENTED, FANIN_BACKPRESSURE, CKPT_CHAIN_TRUNCATED,
@@ -253,7 +266,8 @@ class EventJournal:
     """Append-only bounded ring of typed events with job-relative
     monotonic timestamps. Thread-safe; one instance per master."""
 
-    def __init__(self, capacity: int = 4096):
+    def __init__(self, capacity: int = 4096,
+                 overflow_note_gap_s: float = 60.0):
         self._capacity = capacity
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
@@ -263,6 +277,11 @@ class EventJournal:
         self._wall0 = time.time()
         self._listeners: List[Callable[[Dict[str, Any]], None]] = []
         self._phase = Phase.PRODUCTIVE
+        # overflow-episode bookkeeping: drop bursts closer together than
+        # the gap are ONE episode → one journal_ring_overflow note, so a
+        # sustained overflow can't spam the very ring that is overflowing
+        self._overflow_note_gap_s = overflow_note_gap_s
+        self._last_drop_t: Optional[float] = None
 
     @property
     def start_wall_ts(self) -> float:
@@ -295,11 +314,26 @@ class EventJournal:
             }
             self._events.append(event)
             self._phase = _TRANSITIONS.get(event["kind"], self._phase)
+            overflow_note = None
             if len(self._events) > self._capacity:
                 drop = len(self._events) - self._capacity
                 del self._events[:drop]
                 self._dropped += drop
+                gap = (None if self._last_drop_t is None
+                       else event["t"] - self._last_drop_t)
+                self._last_drop_t = event["t"]
+                if ((gap is None or gap > self._overflow_note_gap_s)
+                        and event["kind"]
+                        != JournalEvent.JOURNAL_RING_OVERFLOW):
+                    overflow_note = {
+                        "dropped_total": self._dropped,
+                        "capacity": self._capacity,
+                    }
             listeners = list(self._listeners)
+        if overflow_note is not None:
+            # recorded outside the lock; the kind guard above breaks any
+            # recursion (the note itself dropping an event never re-notes)
+            self.record(JournalEvent.JOURNAL_RING_OVERFLOW, **overflow_note)
         for fn in listeners:
             try:
                 fn(event)
@@ -365,6 +399,11 @@ class EventJournal:
         events_total = registry.gauge(
             "dlrover_journal_events", "Events currently in the journal ring"
         )
+        dropped_total = registry.counter(
+            "dlrover_journal_dropped_total",
+            "Journal events dropped from the ring by overflow",
+        )
+        exported = {"dropped": 0}
 
         def collect() -> None:
             now_t = self.now()
@@ -373,6 +412,10 @@ class EventJournal:
                 g.set(seconds.get(phase, 0.0))
             wall.set(now_t)
             events_total.set(len(self))
+            d = self.dropped
+            if d > exported["dropped"]:
+                dropped_total.inc(d - exported["dropped"])
+                exported["dropped"] = d
 
         registry.add_collect_hook(collect)
 

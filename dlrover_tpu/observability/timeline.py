@@ -56,6 +56,20 @@ _JOB_PHASES_PID = 9999
 _SKEW_TRACK_PID = 9998
 _BRAIN_TRACK_PID = 9997
 _SERVING_TRACK_PID = 9996
+_INCIDENTS_PID = 9995
+
+# chrome-trace palette names per goodput phase, so an incident's
+# waterfall reads at a glance (green = productive, red = waiting on
+# detection, shades in between for the recovery legs)
+_PHASE_CNAME = {
+    "productive": "good",
+    "detect": "terrible",
+    "rendezvous": "yellow",
+    "restore": "olive",
+    "recompile": "grey",
+    "reshard": "rail_animation",
+    "serving": "good",
+}
 
 
 def job_phase_events(journal: dict) -> List[dict]:
@@ -189,6 +203,57 @@ def brain_track_events(journal: dict) -> List[dict]:
     return events
 
 
+def incident_track_events(journal: dict) -> List[dict]:
+    """Chrome-trace events for stitched fault→recovery incidents
+    (observability/incidents.py): an "incidents" track with one lane
+    (tid) per incident, complete ("X") slices per phase-waterfall segment
+    colored by phase, and instants for the rungs that aborted — so each
+    recovery's anatomy reads as one left-to-right waterfall under the
+    same clock as the job-phases track."""
+    from dlrover_tpu.observability.incidents import stitch_journal_dict
+
+    incidents = stitch_journal_dict(journal)
+    if not incidents:
+        return []
+    events: List[dict] = [
+        {
+            "ph": "M", "pid": _INCIDENTS_PID, "name": "process_name",
+            "args": {"name": "incidents"},
+        },
+    ]
+    for lane, inc in enumerate(incidents):
+        events.append({
+            "ph": "M", "pid": _INCIDENTS_PID, "tid": lane,
+            "name": "thread_name",
+            "args": {"name": (f"incident {inc.incident_id}: "
+                              f"node {inc.node_id} ({inc.resolution})")},
+        })
+        for seg in inc.waterfall:
+            events.append({
+                "ph": "X", "pid": _INCIDENTS_PID, "tid": lane,
+                "name": seg["phase"], "cat": "incident",
+                "cname": _PHASE_CNAME.get(seg["phase"], "grey"),
+                "ts": seg["begin"] * 1e6,
+                "dur": (seg["end"] - seg["begin"]) * 1e6,
+                "args": {
+                    "incident_id": inc.incident_id,
+                    "mttr_s": inc.mttr_s,
+                    "rung": inc.rung,
+                    "rollback_steps": inc.rollback_steps,
+                    "trace_id": inc.trace_id,
+                },
+            })
+        for failed in inc.rungs_failed:
+            events.append({
+                "ph": "i", "pid": _INCIDENTS_PID, "tid": lane, "s": "t",
+                "name": (f"rung {failed.get('rung', '?')} aborted "
+                         f"({failed.get('reason', '?')})"),
+                "cat": "incident", "ts": inc.t_fault * 1e6,
+                "args": dict(failed),
+            })
+    return events
+
+
 def serving_request_events(spans: List, t0: Optional[float] = None,
                            now_t: Optional[float] = None) -> List[dict]:
     """Chrome-trace events for per-request serving waterfalls: a
@@ -281,6 +346,7 @@ def merge_timelines(
             events.extend(job_phase_events(journal))
             events.extend(skew_track_events(journal))
             events.extend(brain_track_events(journal))
+            events.extend(incident_track_events(journal))
     with open(out_path, "w") as f:
         json.dump({"traceEvents": events}, f)
     return found

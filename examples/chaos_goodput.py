@@ -549,6 +549,20 @@ def main(argv=None) -> int:
             e["args"]["trace_id"] for e in rdzv_spans
             if "trace_id" in e.get("args", {})
         }
+        # the incidents track (timeline.incident_track_events): the
+        # bundle was captured AT the fault, so its journal already holds
+        # an open incident — the track must parse with >=1 slice
+        incident_slices = [
+            e for e in trace_events
+            if e.get("ph") == "X" and e.get("cat") == "incident"
+        ]
+        # incident forensics (observability/incidents.py): the drill's
+        # fault→recovery episodes as first-class records — the chaos e2e
+        # test and bench's recovery section assert MTTR / rung / rollback
+        # from these instead of re-deriving them from raw events
+        incident_records = [
+            inc.to_dict() for inc in master.incident_stitcher.stitch()
+        ]
         # this scenario packs one kill + one rejoin into a ~20 s toy job,
         # so the raw fraction is dominated by the fixed recovery cost; the
         # extrapolated figure charges the same measured unproductive time
@@ -586,6 +600,7 @@ def main(argv=None) -> int:
             ),
             "journal_goodput_pct": journal_goodput_pct,
             "journal_events": len(master.event_journal),
+            "incidents": incident_records,
             # checkpoint-free elastic resharding (ckpt/reshard.py): both
             # world cuts recovered by pulling state over the host links —
             # storage_restores counts step>=0 storage reads (must be 0)
@@ -617,6 +632,7 @@ def main(argv=None) -> int:
             "trace_bundle_files": bundle_files,
             "trace_rdzv_spans": len(rdzv_spans),
             "trace_rdzv_trace_ids": len(trace_ids),
+            "trace_incident_slices": len(incident_slices),
             "w_final": max(
                 (d.get("w_final", -1.0) for d in dones), default=-1.0
             ),

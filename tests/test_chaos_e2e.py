@@ -129,6 +129,44 @@ def test_chaos_kill_shrink_resume_rejoin():
     }, result["trace_bundle_files"]
     assert result["trace_rdzv_spans"] >= 2, result["trace_rdzv_spans"]
     assert result["trace_rdzv_trace_ids"] >= 1, result
+    # incident forensics (observability/incidents.py): the SIGKILL shows
+    # up as exactly one RESOLVED Incident whose anatomy is fully
+    # populated — the rejoin is a planned world change, not a fault, so
+    # it must NOT open a second one
+    incidents = result["incidents"]
+    resolved = [i for i in incidents if i["resolution"] == "resolved"]
+    assert len(resolved) == 1, incidents
+    inc = resolved[0]
+    # the phase waterfall tiles the detect→first-step window exactly:
+    # segment spans and phase totals both sum to the MTTR
+    assert inc["waterfall"], inc
+    covered = sum(seg["end"] - seg["begin"] for seg in inc["waterfall"])
+    assert abs(covered - inc["mttr_s"]) < 1e-6, inc
+    assert abs(sum(inc["phases"].values()) - inc["mttr_s"]) < 1e-6, inc
+    # rung attribution matches the journal: checkpoint-free recovery won
+    # on the live-reshard rung (the same fact storage_restores==0 proves)
+    assert inc["rung"] == "reshard", inc
+    # rollback distance is exact step arithmetic, not an estimate
+    assert inc["step_at_fault"] is not None, inc
+    assert inc["restored_step"] is not None, inc
+    assert inc["rollback_steps"] == (
+        inc["step_at_fault"] - inc["restored_step"]
+    ), inc
+    assert inc["rollback_steps"] >= 0, inc
+    # the incident joins the span plane via the fault-broadcast arc
+    assert inc["trace_id"], inc
+    # MTTD (fault → first recovery action) is inside the MTTR window
+    assert inc["mttd_s"] is not None, inc
+    assert 0 <= inc["mttd_s"] <= inc["mttr_s"], inc
+    # the loss is attributed to phases, and a real recovery costs > 0
+    assert inc["goodput_loss_s"] > 0, inc
+    # the bundle carries incidents.json and its chrome-trace incidents
+    # track parsed with at least one slice (the fault-time bundle holds
+    # the then-open incident)
+    assert "incidents.json" in result["trace_bundle_files"], (
+        result["trace_bundle_files"]
+    )
+    assert result["trace_incident_slices"] >= 1, result
 
 
 @pytest.mark.slow

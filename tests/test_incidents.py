@@ -1,0 +1,426 @@
+"""Incident forensics (observability/incidents.py): stitcher algebra on
+synthetic journals with a fake clock, rollback / counterfactual math,
+the metric families' export-once semantics, the journal-ring overflow
+satellite, the incidents chrome-trace track, and the post-mortem report
+CLI's golden output. All pure CPU — the chaos-e2e drill covers the same
+machinery against real processes.
+"""
+
+import json
+
+import pytest
+
+from dlrover_tpu.observability.incidents import (
+    RESOLVED,
+    UNRESOLVED,
+    IncidentStitcher,
+    stitch_incidents,
+    stitch_journal_dict,
+)
+from dlrover_tpu.observability.journal import (
+    EventJournal,
+    JournalEvent,
+    Phase,
+)
+from dlrover_tpu.observability.registry import MetricsRegistry
+
+
+def _ev(seq, t, kind, **data):
+    return {"seq": seq, "t": t, "kind": kind, "source": "master",
+            "data": data}
+
+
+def _kill_recovery(t0=10.0, node=3, step=100, restored=97, resumed=98,
+                   seq0=1):
+    """One fault→recovery episode: detect at t0, rdzv +1s, restore
+    (shm rung) +2s..+3.5s, recompile to +6s, resume at t0+6."""
+    s = seq0
+    events = []
+    for dt, kind, data in (
+        (0.0, JournalEvent.FAULT_DETECTED,
+         {"node_id": node, "status": "failed", "step": step,
+          "trace_id": "aaaa1111"}),
+        (1.0, JournalEvent.RDZV_START, {"round": 2}),
+        (2.0, JournalEvent.RDZV_COMPLETE, {"world": 1}),
+        (2.0, JournalEvent.RESTORE_START, {}),
+        (3.5, JournalEvent.RESTORE_COMPLETE,
+         {"medium": "shm", "step": restored, "duration_s": 1.5}),
+        (3.5, JournalEvent.RECOMPILE_START, {}),
+        (6.0, JournalEvent.STEP_RESUMED, {"step": resumed}),
+    ):
+        events.append(_ev(s, t0 + dt, kind, **data))
+        s += 1
+    return events
+
+
+# -- stitcher algebra -------------------------------------------------------
+
+
+def test_single_fault_incident_anatomy():
+    events = _kill_recovery()
+    incidents = stitch_incidents(events, now_t=20.0, step_time_s=0.5)
+    assert len(incidents) == 1
+    inc = incidents[0]
+    assert inc.resolution == RESOLVED
+    assert inc.node_id == 3
+    assert inc.trace_id == "aaaa1111"
+    assert inc.mttr_s == pytest.approx(6.0)
+    # MTTD: fault at 10.0, rdzv_start at 11.0
+    assert inc.mttd_s == pytest.approx(1.0)
+    # rollback: step 100 at fault, restored from 97, at 0.5 s/step
+    assert inc.step_at_fault == 100
+    assert inc.restored_step == 97
+    assert inc.resumed_step == 98
+    assert inc.rollback_steps == 3
+    assert inc.recompute_s == pytest.approx(1.5)
+    assert inc.rung == "shm"
+    assert inc.rungs_failed == []
+    # the phase attribution tiles the MTTR window exactly, and so does
+    # the waterfall's segment list
+    assert sum(inc.phases.values()) == pytest.approx(inc.mttr_s)
+    covered = sum(seg["end"] - seg["begin"] for seg in inc.waterfall)
+    assert covered == pytest.approx(inc.mttr_s)
+    assert inc.phases[Phase.DETECT] == pytest.approx(1.0)
+    assert inc.phases[Phase.RENDEZVOUS] == pytest.approx(1.0)
+    assert inc.phases[Phase.RESTORE] == pytest.approx(1.5)
+    assert inc.phases[Phase.RECOMPILE] == pytest.approx(2.5)
+    # nothing productive inside a fault window → loss == mttr
+    assert inc.goodput_loss_s == pytest.approx(6.0)
+    # round-trips through the serialized form
+    d = inc.to_dict()
+    assert d["mttr_s"] == pytest.approx(6.0)
+    assert d["rung"] == "shm"
+    json.dumps(d)
+
+
+def test_overlapping_faults_get_separate_incidents():
+    """A second fault mid-recovery opens ANOTHER incident; both close at
+    the shared step_resumed, each with its own MTTR."""
+    events = _kill_recovery(t0=10.0, node=1, seq0=1)
+    # second node dies during the rendezvous (t=11.5)
+    events.append(_ev(50, 11.5, JournalEvent.FAULT_DETECTED,
+                      node_id=2, status="failed", step=100,
+                      trace_id="bbbb2222"))
+    incidents = stitch_incidents(events, now_t=20.0)
+    assert len(incidents) == 2
+    first = next(i for i in incidents if i.node_id == 1)
+    second = next(i for i in incidents if i.node_id == 2)
+    assert first.resolution == RESOLVED
+    assert second.resolution == RESOLVED
+    assert first.mttr_s == pytest.approx(6.0)
+    assert second.mttr_s == pytest.approx(4.5)
+    # distinct stable ids (the opening event's seq) and trace arcs
+    assert first.incident_id != second.incident_id
+    assert {first.trace_id, second.trace_id} == {"aaaa1111", "bbbb2222"}
+    # both saw the same recovery tail
+    assert first.rung == second.rung == "shm"
+
+
+def test_missing_terminator_leaves_incident_unresolved():
+    events = _kill_recovery()
+    # cut the stream before step_resumed
+    events = [e for e in events
+              if e["kind"] != JournalEvent.STEP_RESUMED]
+    incidents = stitch_incidents(events, now_t=30.0)
+    assert len(incidents) == 1
+    inc = incidents[0]
+    assert inc.resolution == UNRESOLVED
+    assert inc.resumed_step is None
+    # open incidents accrue MTTR up to now_t
+    assert inc.t_end == pytest.approx(30.0)
+    assert inc.mttr_s == pytest.approx(20.0)
+    assert sum(inc.phases.values()) == pytest.approx(20.0)
+
+
+def test_serving_events_never_open_or_recolor_an_incident():
+    """SERVE-plane events are the serving registry's business: a replica
+    death must not open an incident, and serving churn inside a training
+    fault window must not enter its waterfall."""
+    serving_only = [
+        _ev(1, 5.0, JournalEvent.SERVE_REPLICA_LOST, replica_id="r0"),
+        _ev(2, 6.0, JournalEvent.SERVE_REPLICA_UP, replica_id="r1"),
+        _ev(3, 7.0, JournalEvent.SERVE_REROUTED, n=4),
+    ]
+    assert stitch_incidents(serving_only, now_t=10.0) == []
+    # serving events inside a fault window: waterfall unchanged
+    events = _kill_recovery()
+    clean = stitch_incidents(list(events), now_t=20.0)[0]
+    events.append(_ev(60, 12.2, JournalEvent.SERVE_REPLICA_LOST,
+                      replica_id="r9"))
+    events.append(_ev(61, 12.4, JournalEvent.SERVE_REPLICA_UP,
+                      replica_id="r10"))
+    noisy = stitch_incidents(events, now_t=20.0)[0]
+    assert noisy.event_count == clean.event_count
+    assert noisy.phases == clean.phases
+    assert Phase.SERVING not in {
+        seg["phase"] for seg in noisy.waterfall
+    }
+
+
+def test_rung_ladder_attribution_records_failed_rungs():
+    """An aborted reshard then a chain truncation both land in
+    rungs_failed with reasons; the LAST restore_complete wins."""
+    t0 = 10.0
+    events = [
+        _ev(1, t0, JournalEvent.FAULT_DETECTED,
+            node_id=0, status="failed", step=50),
+        _ev(2, t0 + 0.5, JournalEvent.RESHARD_PLANNED, round=1),
+        _ev(3, t0 + 1.0, JournalEvent.RESHARD_ABORTED,
+            reason="peer_lost", round=1),
+        _ev(4, t0 + 1.5, JournalEvent.CKPT_CHAIN_TRUNCATED,
+            step=48, reason="crc_mismatch"),
+        _ev(5, t0 + 2.0, JournalEvent.RESTORE_COMPLETE,
+            medium="storage", step=45),
+        _ev(6, t0 + 3.0, JournalEvent.STEP_RESUMED, step=46),
+    ]
+    inc = stitch_incidents(events, now_t=20.0, step_time_s=2.0)[0]
+    assert inc.rung == "storage"
+    assert [(r["rung"], r["reason"]) for r in inc.rungs_failed] == [
+        ("reshard", "peer_lost"),
+        ("chain", "crc_mismatch"),
+    ]
+    # MTTD from reshard_planned (the first recovery action here)
+    assert inc.mttd_s == pytest.approx(0.5)
+    assert inc.rollback_steps == 5
+    assert inc.recompute_s == pytest.approx(10.0)
+
+
+def test_degraded_replan_lands_in_rungs_failed():
+    events = _kill_recovery()
+    events.insert(2, _ev(40, 11.2, JournalEvent.RESHARD_REPLAN_DEGRADED,
+                         round=2, reason="fault_injected"))
+    inc = stitch_incidents(events, now_t=20.0)[0]
+    assert {"rung": "reshard",
+            "reason": "replan_degraded:fault_injected"} in inc.rungs_failed
+
+
+def test_unknown_restore_medium_maps_to_unknown_rung():
+    events = _kill_recovery()
+    for e in events:
+        if e["kind"] == JournalEvent.RESTORE_COMPLETE:
+            e["data"]["medium"] = "quantum_tunnel"
+    inc = stitch_incidents(events, now_t=20.0)[0]
+    assert inc.rung == "unknown"
+
+
+# -- counterfactual accounting ----------------------------------------------
+
+
+def test_counterfactual_scores_preemptive_checkpoint():
+    """Brain preempt → preemptive commit at step 97 vs last periodic at
+    90: the fault 'would have' rolled back 7 more steps without it."""
+    events = [
+        _ev(1, 5.0, JournalEvent.CKPT_COMMITTED, step=90,
+            trigger="periodic"),
+        _ev(2, 8.0, JournalEvent.BRAIN_ACTION, action="preempt_ckpt",
+            node_id=3, probability=0.9),
+        _ev(3, 9.0, JournalEvent.CKPT_COMMITTED, step=97,
+            trigger="preemptive"),
+    ] + _kill_recovery(t0=10.0, node=3, seq0=4)
+    inc = stitch_incidents(events, now_t=20.0, step_time_s=0.5)[0]
+    cf = inc.counterfactual
+    assert cf is not None
+    assert cf["steps_saved"] == 7
+    assert cf["goodput_saved_s"] == pytest.approx(3.5)
+    assert cf["committed_step"] == 97
+    assert cf["last_periodic_step"] == 90
+    # the brain predicted the node that actually died
+    assert cf["hit"] is True
+    assert cf["probability"] == pytest.approx(0.9)
+
+
+def test_counterfactual_not_recredited_to_later_incidents():
+    """One pre-emptive save is scored against the first fault it
+    precedes — a later, unrelated fault gets no counterfactual."""
+    events = [
+        _ev(1, 8.0, JournalEvent.BRAIN_ACTION, action="preempt_ckpt",
+            node_id=3, probability=0.8),
+        _ev(2, 9.0, JournalEvent.CKPT_COMMITTED, step=97,
+            trigger="preemptive"),
+    ]
+    events += _kill_recovery(t0=10.0, node=3, seq0=3)
+    events += _kill_recovery(t0=30.0, node=5, seq0=20)
+    first, second = stitch_incidents(events, now_t=50.0)
+    assert first.counterfactual is not None
+    assert second.counterfactual is None
+
+
+def test_counterfactual_miss_marks_wrong_node():
+    events = [
+        _ev(1, 8.0, JournalEvent.BRAIN_ACTION, action="preempt_ckpt",
+            node_id=7, probability=0.6),
+        _ev(2, 9.0, JournalEvent.CKPT_COMMITTED, step=95,
+            trigger="preemptive"),
+    ] + _kill_recovery(t0=10.0, node=3, seq0=3)
+    inc = stitch_incidents(events, now_t=20.0)[0]
+    assert inc.counterfactual["hit"] is False
+
+
+# -- offline twin + live stitcher -------------------------------------------
+
+
+def test_stitch_journal_dict_is_the_offline_twin():
+    events = _kill_recovery()
+    journal = {"events": events, "now_t": 20.0}
+    offline = stitch_journal_dict(journal, step_time_s=0.5)
+    live = stitch_incidents(events, 20.0, step_time_s=0.5)
+    assert [i.to_dict() for i in offline] == [i.to_dict() for i in live]
+    # degenerate payloads stitch to nothing instead of raising
+    assert stitch_journal_dict({}) == []
+    assert stitch_journal_dict({"events": None, "now_t": 1}) == []
+
+
+class _FakeJournal:
+    def __init__(self, events, now_t):
+        self._events, self._now = list(events), now_t
+
+    def events(self):
+        return list(self._events)
+
+    def now(self):
+        return self._now
+
+
+def test_incident_stitcher_to_json_and_step_time_fallback():
+    stitcher = IncidentStitcher(
+        _FakeJournal(_kill_recovery(), 20.0),
+        step_time_fn=lambda: 0.5,
+    )
+    payload = json.loads(stitcher.to_json())
+    assert payload["resolved"] == 1
+    assert payload["now_t"] == 20.0
+    assert payload["incidents"][0]["recompute_s"] == pytest.approx(1.5)
+    # a throwing / bogus estimator degrades to None, never raises
+    for bad_fn in (lambda: (_ for _ in ()).throw(RuntimeError("x")),
+                   lambda: 0.0, lambda: -1.0, None):
+        s = IncidentStitcher(_FakeJournal([], 0.0), step_time_fn=bad_fn)
+        assert s.step_time_s() is None
+
+
+def test_attach_metrics_exports_each_resolved_incident_once():
+    journal = _FakeJournal(_kill_recovery(), 20.0)
+    stitcher = IncidentStitcher(journal, step_time_fn=lambda: 0.5)
+    reg = MetricsRegistry()
+    stitcher.attach_metrics(reg)
+    first = reg.render()
+    assert 'dlrover_incident_total{resolution="resolved"} 1' in first
+    assert 'dlrover_incident_restore_rung_total{rung="shm"} 1' in first
+    assert "dlrover_incident_mttr_seconds_count 1" in first
+    # a second scrape must NOT double-count the same incident
+    second = reg.render()
+    assert 'dlrover_incident_total{resolution="resolved"} 1' in second
+    assert "dlrover_incident_mttr_seconds_count 1" in second
+    # per-phase goodput loss carried the whole window
+    assert 'dlrover_incident_goodput_loss_seconds_total{phase="detect"}' \
+        in second
+    # unresolved incidents are not exported (they'd export again later)
+    open_journal = _FakeJournal(
+        [_ev(1, 5.0, JournalEvent.FAULT_DETECTED, node_id=1,
+             status="failed")], 9.0)
+    reg2 = MetricsRegistry()
+    IncidentStitcher(open_journal).attach_metrics(reg2)
+    text = reg2.render()
+    assert 'dlrover_incident_total{resolution=' not in text
+
+
+# -- journal ring overflow satellite ----------------------------------------
+
+
+def test_ring_overflow_notes_once_per_episode_and_counts_drops():
+    journal = EventJournal(capacity=8, overflow_note_gap_s=60.0)
+    seen = []
+    journal.add_listener(
+        lambda e: seen.append(e["kind"])
+        if e["kind"] == JournalEvent.JOURNAL_RING_OVERFLOW else None)
+    for _ in range(12):
+        journal.record(JournalEvent.STEP_RESUMED, step=1)
+    # one burst → exactly one overflow note, carrying the running total
+    assert seen.count(JournalEvent.JOURNAL_RING_OVERFLOW) == 1
+    assert journal.dropped >= 4
+    note = [e for e in journal.events()
+            if e["kind"] == JournalEvent.JOURNAL_RING_OVERFLOW]
+    assert note and note[0]["data"]["capacity"] == 8
+    assert note[0]["data"]["dropped_total"] >= 1
+    # the counter exports the drop total through the registry
+    reg = MetricsRegistry()
+    journal.attach_gauges(reg)
+    text = reg.render()
+    dropped = journal.dropped
+    assert f"dlrover_journal_dropped_total {float(dropped)}" in text \
+        or f"dlrover_journal_dropped_total {dropped}" in text
+
+
+# -- incidents chrome-trace track -------------------------------------------
+
+
+def test_incident_track_events_parse_and_carry_anatomy():
+    from dlrover_tpu.observability.timeline import incident_track_events
+
+    journal = {"events": _kill_recovery(), "now_t": 20.0}
+    track = incident_track_events(journal)
+    assert track, "expected a non-empty incidents track"
+    json.dumps(track)  # chrome traces must serialize
+    slices = [e for e in track if e.get("ph") == "X"]
+    assert slices and all(e["cat"] == "incident" for e in slices)
+    assert {e["args"]["rung"] for e in slices} == {"shm"}
+    # the slice spans tile the MTTR in trace microseconds
+    total_us = sum(e["dur"] for e in slices)
+    assert total_us == pytest.approx(6.0e6, rel=1e-3)
+    # empty journal → empty track (no stray metadata rows)
+    assert incident_track_events({"events": [], "now_t": 1.0}) == []
+
+
+# -- post-mortem report CLI -------------------------------------------------
+
+
+def test_report_cli_golden_output(tmp_path, capsys):
+    events = [
+        _ev(1, 5.0, JournalEvent.CKPT_COMMITTED, step=90,
+            trigger="periodic"),
+        _ev(2, 8.0, JournalEvent.BRAIN_ACTION, action="preempt_ckpt",
+            node_id=3, probability=0.9),
+        _ev(3, 9.0, JournalEvent.CKPT_COMMITTED, step=97,
+            trigger="preemptive"),
+    ] + _kill_recovery(t0=10.0, node=3, seq0=4)
+    path = tmp_path / "journal.json"
+    path.write_text(json.dumps({"events": events, "now_t": 20.0}))
+
+    from dlrover_tpu.observability import report
+
+    rc = report.main([str(path), "--step-time-s", "0.5"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out == """\
+incident report: 1 incident(s), 1 resolved, journal window 20.00s
+  id    node  status     rung          mttr     mttd rollback recompute resolution
+----------------------------------------------------------------------------------
+   4       3  failed     shm          6.00s    1.00s        3     1.50s resolved
+      counterfactual: brain preempt ckpt (hit=True) saved 7 step(s) vs last periodic (~3.50s goodput)
+
+goodput waterfall (seconds lost per phase, all incidents):
+  detect           1.00  ##########
+  rendezvous       1.00  ##########
+  restore          1.50  ##############
+  recompile        2.50  ########################
+  total            6.00
+"""
+
+
+def test_report_cli_reads_bundle_dir_and_rejects_garbage(tmp_path,
+                                                         capsys):
+    from dlrover_tpu.observability import report
+
+    bundle = tmp_path / "bundle"
+    bundle.mkdir()
+    (bundle / "journal.json").write_text(
+        json.dumps({"events": _kill_recovery(), "now_t": 20.0}))
+    assert report.main([str(bundle)]) == 0
+    assert "1 resolved" in capsys.readouterr().out
+    # malformed JSON and non-journal payloads exit 2, not a traceback
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert report.main([str(bad)]) == 2
+    notj = tmp_path / "notj.json"
+    notj.write_text(json.dumps({"foo": 1}))
+    assert report.main([str(notj)]) == 2
+    assert report.main([str(tmp_path / "missing.json")]) == 2

@@ -1,6 +1,6 @@
 """Tests for the whole-program half of the analyzer: the package-wide
 call graph (dlrover_tpu.analysis.callgraph), the fixpoint summaries, and
-rules DLR014–DLR017 — fire/no-fire fixture pairs per rule, the blessed
+rules DLR014–DLR018 — fire/no-fire fixture pairs per rule, the blessed
 concurrency idioms as zero-false-positive checks, and the runtime budget
 of the whole-package run."""
 
@@ -562,6 +562,118 @@ class TestDLR017:
         )
         a = _fixture(tmp_path, files)
         assert _rules_hit(a, ip.rule_dlr017_journal_kind_contract) == []
+
+
+# -- DLR018: incident-schema contract ----------------------------------------
+
+
+_INCIDENT_CLEAN = {
+    "pkg/journal.py": (
+        "class JournalEvent:\n"
+        "    FAULT = \"fault_detected\"\n"
+        "    RESUMED = \"step_resumed\"\n"
+        "    PLANNED = \"reshard_planned\"\n"
+        "    ALL = (FAULT, RESUMED, PLANNED)\n"
+        "class Phase:\n"
+        "    PRODUCTIVE = \"productive\"\n"
+        "    DETECT = \"detect\"\n"
+        "    ALL = (PRODUCTIVE, DETECT)\n"
+        "_TRANSITIONS = {\n"
+        "    JournalEvent.FAULT: Phase.DETECT,\n"
+        "    JournalEvent.RESUMED: Phase.PRODUCTIVE,\n"
+        "}\n"
+    ),
+    "pkg/incidents.py": (
+        "from pkg.journal import JournalEvent\n"
+        "CORRELATED_KINDS = (JournalEvent.PLANNED,)\n"
+        "def stitch(events):\n"
+        "    return [e for e in events\n"
+        "            if e.get(\"kind\") == JournalEvent.FAULT\n"
+        "            or e.get(\"kind\") == JournalEvent.PLANNED]\n"
+    ),
+}
+
+_INCIDENT_CFG = dict(incidents_rel="pkg/incidents.py")
+
+
+class TestDLR018:
+    def test_full_contract_is_clean(self, tmp_path):
+        a = _fixture(tmp_path, _INCIDENT_CLEAN, **_INCIDENT_CFG)
+        assert _rules_hit(
+            a, ip.rule_dlr018_incident_schema_contract) == []
+
+    def test_consumed_kind_with_no_declared_role(self, tmp_path):
+        # declared on JournalEvent, but neither a phase transition nor a
+        # correlation-table entry → the stitcher's schema drifted
+        files = dict(_INCIDENT_CLEAN)
+        files["pkg/journal.py"] = files["pkg/journal.py"].replace(
+            "    ALL = (FAULT, RESUMED, PLANNED)\n",
+            "    ORPHAN = \"orphan_kind\"\n"
+            "    ALL = (FAULT, RESUMED, PLANNED, ORPHAN)\n",
+        )
+        files["pkg/incidents.py"] += (
+            "def also(e):\n"
+            "    return e.get(\"kind\") == JournalEvent.ORPHAN\n"
+        )
+        a = _fixture(tmp_path, files, **_INCIDENT_CFG)
+        hits = _rules_hit(a, ip.rule_dlr018_incident_schema_contract)
+        assert len(hits) == 1
+        v = hits[0]
+        assert v.path == "pkg/incidents.py"
+        assert "JournalEvent.ORPHAN" in v.message
+        assert "CORRELATED_KINDS" in v.message
+
+    def test_correlation_entry_not_a_declared_kind(self, tmp_path):
+        files = dict(_INCIDENT_CLEAN)
+        files["pkg/incidents.py"] = files["pkg/incidents.py"].replace(
+            "CORRELATED_KINDS = (JournalEvent.PLANNED,)\n",
+            "CORRELATED_KINDS = (JournalEvent.PLANNED, "
+            "JournalEvent.TYPOD,)\n",
+        )
+        a = _fixture(tmp_path, files, **_INCIDENT_CFG)
+        hits = _rules_hit(a, ip.rule_dlr018_incident_schema_contract)
+        assert len(hits) == 1
+        assert hits[0].path == "pkg/incidents.py"
+        assert "TYPOD" in hits[0].message
+        assert "not declared" in hits[0].message
+
+    def test_unreachable_phase_in_all(self, tmp_path):
+        # a Phase.ALL member no journal kind transitions into can never
+        # accrue seconds — flagged at the _TRANSITIONS map
+        files = dict(_INCIDENT_CLEAN)
+        files["pkg/journal.py"] = files["pkg/journal.py"].replace(
+            "    ALL = (PRODUCTIVE, DETECT)\n",
+            "    RESTORE = \"restore\"\n"
+            "    ALL = (PRODUCTIVE, DETECT, RESTORE)\n",
+        )
+        a = _fixture(tmp_path, files, **_INCIDENT_CFG)
+        hits = _rules_hit(a, ip.rule_dlr018_incident_schema_contract)
+        assert len(hits) == 1
+        assert hits[0].path == "pkg/journal.py"
+        assert "Phase.RESTORE" in hits[0].message
+        assert "no journal kind transitions into it" in hits[0].message
+
+    def test_productive_start_phase_needs_no_transition(self, tmp_path):
+        # PRODUCTIVE is the state machine's start phase: reachable at
+        # t=0 by construction, exempt from the reachability check
+        a = _fixture(tmp_path, _INCIDENT_CLEAN, **_INCIDENT_CFG)
+        hits = _rules_hit(a, ip.rule_dlr018_incident_schema_contract)
+        assert all("PRODUCTIVE" not in h.message for h in hits)
+
+    def test_rule_is_silent_without_an_incidents_module(self, tmp_path):
+        # packages that ship no stitcher (fixture trees for other rules)
+        # must not be forced to declare one
+        files = {k: v for k, v in _INCIDENT_CLEAN.items()
+                 if k != "pkg/incidents.py"}
+        # even with an unreachable phase present, the rule stays quiet
+        files["pkg/journal.py"] = files["pkg/journal.py"].replace(
+            "    ALL = (PRODUCTIVE, DETECT)\n",
+            "    RESTORE = \"restore\"\n"
+            "    ALL = (PRODUCTIVE, DETECT, RESTORE)\n",
+        )
+        a = _fixture(tmp_path, files, **_INCIDENT_CFG)
+        assert _rules_hit(
+            a, ip.rule_dlr018_incident_schema_contract) == []
 
 
 # -- whole-package run -------------------------------------------------------

@@ -179,7 +179,9 @@ def test_journal_ring_caps_and_counts_drops():
     for i in range(25):
         j.record(JournalEvent.STEP_RESUMED, step=i)
     assert len(j) == 10
-    assert j.dropped == 15
+    # 25 step events + the one journal_ring_overflow note the first
+    # drop records (one per overflow episode) = 26 records, ring of 10
+    assert j.dropped == 16
     assert [e["data"]["step"] for e in j.events()] == list(range(15, 25))
 
 

@@ -1,0 +1,135 @@
+"""Matmul replay (observability/replay.py): trace loading, top-k ``mm``
+selection with flops-weighted aggregation, and equivalent-FLOPs shape
+reconstruction. Parsing paths are pure CPU; the one end-to-end replay
+runs a tiny matmul chain on the CPU backend — no TPU required.
+"""
+
+import json
+
+import pytest
+
+from dlrover_tpu.observability.replay import (
+    _round_up,
+    load_trace,
+    replay,
+    select_matmuls,
+)
+
+
+def _mm(name, dur_us, flops):
+    return {"ph": "X", "cat": "mm", "name": name, "ts": 0.0,
+            "dur": dur_us, "args": {"flops": flops}}
+
+
+FIXTURE_EVENTS = [
+    _mm("dot_general.1", 100.0, 4.0e9),
+    _mm("dot_general.1", 300.0, 4.0e9),
+    _mm("dot_general.2", 50.0, 1.0e9),
+    # flops can also ride at the top level (older producers)
+    {"ph": "X", "cat": "mm", "name": "dot_general.3", "ts": 0.0,
+     "dur": 500.0, "flops": 2.0e9},
+    # no flops payload → unreplayable, must be dropped
+    _mm("dot_general.noflops", 9999.0, 0.0),
+    # non-mm categories never selected
+    {"ph": "X", "cat": "span", "name": "rdzv.join", "ts": 0.0,
+     "dur": 1e6, "args": {"flops": 1e12}},
+]
+
+
+# -- load_trace -------------------------------------------------------------
+
+
+def test_load_trace_reads_file_and_both_payload_shapes(tmp_path):
+    wrapped = tmp_path / "wrapped.json"
+    wrapped.write_text(json.dumps({"traceEvents": FIXTURE_EVENTS}))
+    assert load_trace(str(wrapped)) == FIXTURE_EVENTS
+    # a bare event list (no {"traceEvents": ...} wrapper) works too
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps(FIXTURE_EVENTS))
+    assert load_trace(str(bare)) == FIXTURE_EVENTS
+    # a dict without traceEvents degrades to an empty list
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"other": 1}))
+    assert load_trace(str(empty)) == []
+
+
+def test_load_trace_raises_on_malformed_json_and_missing_file(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not valid json")
+    with pytest.raises(json.JSONDecodeError):
+        load_trace(str(bad))
+    with pytest.raises(OSError):
+        load_trace(str(tmp_path / "missing.json"))
+
+
+# -- top-k selection --------------------------------------------------------
+
+
+def test_select_matmuls_aggregates_and_ranks_by_total_duration():
+    picked = select_matmuls(FIXTURE_EVENTS, top_k=5)
+    # zero-flops kernels and non-mm categories are gone
+    names = [a["name"] for a in picked]
+    assert "dot_general.noflops" not in names
+    assert "rdzv.join" not in names
+    # ranked by TOTAL duration: .3 (500) > .1 (400) > .2 (50)
+    assert names == ["dot_general.3", "dot_general.1", "dot_general.2"]
+    one = next(a for a in picked if a["name"] == "dot_general.1")
+    assert one["count"] == 2
+    assert one["total_dur_us"] == pytest.approx(400.0)
+    assert one["mean_dur_us"] == pytest.approx(200.0)
+    # representative per-call flops is the MEAN, total is preserved
+    assert one["flops"] == pytest.approx(4.0e9)
+    assert one["total_flops"] == pytest.approx(8.0e9)
+
+
+def test_select_matmuls_top_k_truncates():
+    assert len(select_matmuls(FIXTURE_EVENTS, top_k=1)) == 1
+    assert select_matmuls(FIXTURE_EVENTS, top_k=1)[0]["name"] == \
+        "dot_general.3"
+    assert select_matmuls([], top_k=5) == []
+
+
+# -- equivalent-FLOPs shape reconstruction ----------------------------------
+
+
+def test_round_up_to_mxu_tile():
+    assert _round_up(1, 128) == 128
+    assert _round_up(128, 128) == 128
+    assert _round_up(129, 128) == 256
+    assert _round_up(1000, 128) == 1024
+
+
+def test_replay_reconstructs_tile_aligned_shapes_on_cpu(tmp_path):
+    """End to end on the CPU backend: the replayed n must be the MXU
+    128-tile rounding of the per-call flops (floored at 256, capped for
+    CPU smoke), and the report must carry recorded vs replayed rates."""
+    jax = pytest.importorskip("jax")
+    if jax.default_backend() not in ("cpu",):
+        pytest.skip("CPU-backend smoke only")
+    trace = tmp_path / "trace.json"
+    trace.write_text(json.dumps({"traceEvents": [
+        # 2*512^3 flops → exact cube root lands on the 512 CPU cap
+        _mm("dot_general.cap", 1000.0, 2.0 * 512 ** 3),
+        # tiny kernel → floored at the 256 minimum
+        _mm("dot_general.floor", 10.0, 2.0e6),
+    ]}))
+    report = replay(str(trace), top_k=2, iters=1)
+    by_name = {k["name"]: k for k in report["kernels"]}
+    assert by_name["dot_general.cap"]["replay_n"] == 512
+    assert by_name["dot_general.floor"]["replay_n"] == 256
+    for k in report["kernels"]:
+        assert k["replay_n"] % 128 == 0
+        assert k["recorded_tflops"] > 0
+        assert k["replayed_tflops"] > 0
+        assert k["ratio"] == pytest.approx(
+            k["replayed_tflops"] / k["recorded_tflops"], rel=1e-2)
+    json.dumps(report)  # the CLI prints this verbatim
+
+
+def test_replay_with_no_replayable_kernels_returns_empty_report(
+        tmp_path):
+    trace = tmp_path / "trace.json"
+    trace.write_text(json.dumps(
+        {"traceEvents": [_mm("dot.noflops", 100.0, 0.0)]}))
+    report = replay(str(trace), top_k=5)
+    assert report["kernels"] == []

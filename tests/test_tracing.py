@@ -275,8 +275,9 @@ def test_flight_recorder_bundle_contents(tmp_path):
     path = fr.capture(REASON_CRASH, extra={"error": "boom"})
     assert path is not None and os.path.isdir(path)
     files = sorted(os.listdir(path))
-    assert files == ["config.json", "journal.json", "manifest.json",
-                     "metrics.prom", "stacks.txt", "traces.json"]
+    assert files == ["config.json", "incidents.json", "journal.json",
+                     "manifest.json", "metrics.prom", "stacks.txt",
+                     "traces.json"]
 
     with open(os.path.join(path, "traces.json")) as f:
         traces = json.load(f)
