@@ -303,7 +303,7 @@ class HeartbeatRouter:
 
     def heartbeat(self, global_step: int = 0, step_timestamp: float = 0.0,
                   gauges=None, rdzv_round: int = -1,
-                  op_telemetry=None) -> comm.HeartbeatResponse:
+                  op_telemetry=None, memory=None) -> comm.HeartbeatResponse:
         """Same signature/semantics as MasterClient.heartbeat — raises
         ConnectionError only when BOTH the parent and the master are
         unreachable (parent failure alone falls back transparently)."""
@@ -326,6 +326,7 @@ class HeartbeatRouter:
                 gauges=gauges or {},
                 rdzv_round=rdzv_round,
                 op_telemetry=op_telemetry or {},
+                memory=memory or {},
             ))
             if resp.fanin_epoch < 0 or resp.fanin_epoch == epoch:
                 return resp
@@ -338,6 +339,7 @@ class HeartbeatRouter:
                 gauges=gauges or {},
                 rdzv_round=rdzv_round,
                 op_telemetry=op_telemetry or {},
+                memory=memory or {},
             )
             try:
                 resp = parent.call("heartbeat", req,
@@ -354,7 +356,7 @@ class HeartbeatRouter:
         resp = self._mc.heartbeat(
             global_step=global_step, step_timestamp=step_timestamp,
             gauges=gauges, rdzv_round=rdzv_round,
-            op_telemetry=op_telemetry,
+            op_telemetry=op_telemetry, memory=memory,
         )
         self._apply(resp, from_master=True)
         return resp

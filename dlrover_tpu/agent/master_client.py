@@ -209,7 +209,8 @@ class MasterClient:
 
     def heartbeat(self, global_step: int = 0, step_timestamp: float = 0.0,
                   gauges=None, rdzv_round: int = -1,
-                  op_telemetry=None, shard_acks=None) -> comm.HeartbeatResponse:
+                  op_telemetry=None, shard_acks=None,
+                  memory=None) -> comm.HeartbeatResponse:
         # bounded budget (2 attempts, ~3s deadline): a heartbeat that can't
         # get through IS the partition signal the agent's degraded-mode
         # detector consumes — the old 30-attempt default hid it for minutes
@@ -227,6 +228,7 @@ class MasterClient:
                 # forget — the ledger dedupes; callers wanting the revoke
                 # feedback use report_shard_acks)
                 shard_acks=list(shard_acks or []),
+                memory=memory or {},
             ),
             policy=retry.HEARTBEAT,
         )

@@ -26,6 +26,10 @@ HBM_KEY_PREFIX = "hbm/"
 # SharedDict key prefix for worker-published cumulative op-class telemetry
 # snapshots (worker.publish_step writes f"{OPTEL_KEY_PREFIX}{local_rank}")
 OPTEL_KEY_PREFIX = "optel/"
+# SharedDict key prefix for worker-published device-memory ledger
+# snapshots (worker.publish_step writes f"{MEM_KEY_PREFIX}{local_rank}";
+# the master's FleetMemoryMonitor aggregates them)
+MEM_KEY_PREFIX = "mem/"
 
 
 def collect_host_usage() -> Dict[str, float]:
@@ -107,6 +111,40 @@ class OpTelemetryCollector:
             except (TypeError, ValueError):
                 logger.warning("ignoring malformed op-telemetry entry %r",
                                key)
+        return out
+
+
+class MemorySnapshotCollector:
+    """Scrape the ``mem/<local_rank>`` accountant snapshots workers
+    publish through the SharedDict and re-key them by *global* rank for
+    the heartbeat uplink (observability/memory.py FleetMemoryMonitor
+    consumes them master-side). Same shape discipline as
+    :class:`OpTelemetryCollector`."""
+
+    def __init__(self, ipc_server):
+        self._ipc_server = ipc_server
+
+    def collect(self) -> Dict[str, Dict]:
+        """``{str(global_rank): wire_snapshot}``; empty when nothing
+        published yet (heartbeat then omits the field)."""
+        out: Dict[str, Dict] = {}
+        try:
+            metrics = dict(self._ipc_server.local_dict(TRAINING_METRICS_DICT))
+        except Exception:  # noqa: DLR003 — IPC briefly down (worker
+            # restart in flight) means one heartbeat without the ledger;
+            # logging every beat of an outage would flood the agent log
+            return out
+        for key, value in metrics.items():
+            if not isinstance(key, str) or \
+                    not key.startswith(MEM_KEY_PREFIX):
+                continue
+            try:
+                snap = dict(value)
+                rank = int(snap.get("rank", key[len(MEM_KEY_PREFIX):]))
+                out[str(rank)] = snap
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed memory-snapshot entry "
+                               "%r", key)
         return out
 
 

@@ -220,9 +220,15 @@ class ElasticTrainingAgent:
         )
         # worker-published op-class histograms re-keyed by global rank for
         # the heartbeat uplink (master/skew_monitor.py consumes them)
-        from dlrover_tpu.agent.monitor import OpTelemetryCollector
+        from dlrover_tpu.agent.monitor import (
+            MemorySnapshotCollector,
+            OpTelemetryCollector,
+        )
 
         self._op_telemetry = OpTelemetryCollector(self._ipc_server)
+        # worker-published device-memory ledger snapshots re-keyed by
+        # global rank (master's FleetMemoryMonitor consumes them)
+        self._mem_snapshots = MemorySnapshotCollector(self._ipc_server)
         self._events = get_emitter(f"agent_{config.node_rank}")
         self._training_monitor = None
         self._replica_service = None
@@ -515,6 +521,7 @@ class ElasticTrainingAgent:
                         gauges=self._diagnosis.collect_gauges(),
                         rdzv_round=self._current_round,
                         op_telemetry=self._op_telemetry.collect(),
+                        memory=self._mem_snapshots.collect(),
                     )
                 except ConnectionError:
                     self._note_heartbeat_failure()

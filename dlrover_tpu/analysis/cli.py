@@ -30,6 +30,7 @@ from dlrover_tpu.analysis.engine import (
     interproc_package,
     load_baseline,
     package_root,
+    reconcile_stale_noqa,
     write_baseline,
 )
 from dlrover_tpu.analysis.rules import ALL_RULES
@@ -157,6 +158,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             root=root, stale_noqa_out=stale_noqa
         )
         violations.sort(key=lambda v: (v.path, v.line, v.rule))
+        stale_noqa = reconcile_stale_noqa(stale_noqa)
 
     if args.fix_noqa:
         changed = fix_stale_noqa(stale_noqa, root=root)

@@ -197,6 +197,35 @@ def interproc_package(
     return out
 
 
+def reconcile_stale_noqa(stale: List[StaleNoqa]) -> List[StaleNoqa]:
+    """Joint staleness for rule ids owned by BOTH passes (e.g. DLR013:
+    per-file ``.labels`` flows + the interproc vocabulary contract).
+    Each pass judges noqa staleness against only its own firings, so a
+    noqa earned in one pass is reported stale by the other. Both passes
+    walk the same package files, so for a shared id an entry is
+    genuinely stale only when both passes agreed (two reports); a
+    singleton is the other pass's earned suppression and drops out."""
+    from dlrover_tpu.analysis import interproc as ip
+
+    shared_ids = (
+        {getattr(r, "rule_id", "") for r in ALL_RULES}
+        & {getattr(r, "rule_id", "") for r in ip.INTERPROC_RULES}
+    )
+    if not shared_ids:
+        return stale
+    counts = Counter((s.path, s.line, s.code) for s in stale)
+    out: List[StaleNoqa] = []
+    seen: set = set()
+    for s in stale:
+        key = (s.path, s.line, s.code)
+        if s.code in shared_ids:
+            if counts[key] < 2 or key in seen:
+                continue
+            seen.add(key)
+        out.append(s)
+    return out
+
+
 def analyze_package(
     rules: Optional[Sequence[RuleFn]] = None,
     baseline_path: Optional[str] = None,
@@ -218,6 +247,7 @@ def analyze_package(
             root=root, stale_noqa_out=stale_noqa
         )
         violations.sort(key=lambda v: (v.path, v.line, v.rule))
+        stale_noqa = reconcile_stale_noqa(stale_noqa)
     report = check(violations, load_baseline(baseline_path))
     report.stale_noqa = stale_noqa
     return report

@@ -45,6 +45,13 @@ machinery as the per-file set):
   member must be reachable from some journal kind, so a new phase (or a
   newly consumed kind) can't drift in without the map entry that makes
   it attributable.
+- **DLR013** (interproc extension of the per-file unbounded-label rule):
+  device-plane vocabulary contract — a literal ``category=`` /``dim=``
+  keyword anywhere in the package must name a member of
+  ``MetricLabel.MEMORY_CATEGORIES`` / ``MetricLabel.STORM_DIMS``, and a
+  composed value at those keywords is unbounded by construction. Bare
+  names and non-string constants are accepted (the per-file DLR013
+  already polices ``.labels`` flows).
 """
 
 import ast
@@ -55,7 +62,11 @@ from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from dlrover_tpu.analysis import callgraph as cg
 from dlrover_tpu.analysis.callgraph import CallGraph, build_callgraph
-from dlrover_tpu.analysis.rules import Violation, _dotted
+from dlrover_tpu.analysis.rules import (
+    Violation,
+    _dotted,
+    _unbounded_label_reason,
+)
 
 INTERPROC_RULES: List = []
 
@@ -84,6 +95,7 @@ class InterprocConfig:
     journal_event_class: str = "JournalEvent"
     incidents_rel: str = "dlrover_tpu/observability/incidents.py"
     phase_class: str = "Phase"
+    metric_label_class: str = "MetricLabel"
 
 
 @dataclass
@@ -941,6 +953,102 @@ def rule_dlr018_incident_schema_contract(
                 "phase can never accrue seconds; add a _TRANSITIONS "
                 "entry or retire the phase",
             )
+
+
+# -- DLR013 (interproc): bounded device-plane vocabularies ---------------------
+
+# keyword name -> the MetricLabel tuple its literal values must come from
+_PLANE_VOCAB_KWARGS = {
+    "category": "MEMORY_CATEGORIES",
+    "dim": "STORM_DIMS",
+}
+
+
+def _plane_vocabs(analysis: Analysis) -> Dict[str, Tuple[Set[str], int]]:
+    """``{tuple attr: (member values, line)}`` parsed from the
+    ``MetricLabel`` class in ``constants_rel`` — string members resolve
+    through the class's own ``NAME = "value"`` assignments."""
+    cfg = analysis.config
+    mod = next((m for m in analysis.graph.modules.values()
+                if m.path == cfg.constants_rel), None)
+    out: Dict[str, Tuple[Set[str], int]] = {}
+    if mod is None:
+        return out
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == cfg.metric_label_class):
+            continue
+        attr_values: Dict[str, str] = {}
+        tuples: Dict[str, Tuple[List[ast.expr], int]] = {}
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            name = stmt.targets[0].id
+            if isinstance(stmt.value, ast.Constant) and isinstance(
+                stmt.value.value, str
+            ):
+                attr_values[name] = stmt.value.value
+            elif isinstance(stmt.value, ast.Tuple):
+                tuples[name] = (list(stmt.value.elts), stmt.lineno)
+        for vocab, (elts, line) in tuples.items():
+            vals: Set[str] = set()
+            for elt in elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    vals.add(elt.value)
+                elif isinstance(elt, ast.Name):
+                    if elt.id in attr_values:
+                        vals.add(attr_values[elt.id])
+                elif isinstance(elt, ast.Attribute):
+                    if elt.attr in attr_values:
+                        vals.add(attr_values[elt.attr])
+            out[vocab] = (vals, line)
+    return out
+
+
+@_interproc_rule
+def rule_dlr013_bounded_plane_vocab(
+    analysis: Analysis,
+) -> Iterator[Violation]:
+    """literal ``category=``/``dim=`` kwargs must name a vocabulary
+    member; composed values at those keywords are unbounded."""
+    cfg = analysis.config
+    vocabs = _plane_vocabs(analysis)
+    if not any(v in vocabs for v in _PLANE_VOCAB_KWARGS.values()):
+        return  # fixture tree without the device-plane registry
+    for mod in analysis.graph.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                vocab_name = _PLANE_VOCAB_KWARGS.get(kw.arg or "")
+                if vocab_name is None or vocab_name not in vocabs:
+                    continue
+                members, _line = vocabs[vocab_name]
+                val = kw.value
+                if isinstance(val, ast.Constant):
+                    if not isinstance(val.value, str):
+                        continue  # ints/None are other planes' keywords
+                    if val.value not in members:
+                        yield analysis.violation(
+                            "DLR013", mod.path, val.lineno,
+                            f"{kw.arg}={val.value!r} is not a member of "
+                            f"{cfg.metric_label_class}.{vocab_name} — "
+                            "device-plane label values come from the "
+                            "constant vocabulary, not ad-hoc strings",
+                        )
+                    continue
+                reason = _unbounded_label_reason(val)
+                if reason:
+                    yield analysis.violation(
+                        "DLR013", mod.path, val.lineno,
+                        f"composed value at {kw.arg}= ({reason}) — the "
+                        f"{kw.arg} keyword is a bounded device-plane "
+                        f"vocabulary ({cfg.metric_label_class}."
+                        f"{vocab_name}); pass a member constant",
+                    )
 
 
 # -- contracts report ----------------------------------------------------------

@@ -76,6 +76,7 @@ class BrainAdvisor:
         ramp_min_slope: float = DEFAULT_RAMP_MIN_SLOPE,
         preempt_ckpt: Optional[Callable[[int, float], None]] = None,
         ckpt_interval_sink: Optional[Callable[[float], None]] = None,
+        memory_guard: Optional[Callable[[], Optional[Dict[str, Any]]]] = None,
         ckpt_cost_s: float = 15.0,
         monotonic: Callable[[], float] = time.monotonic,
     ):
@@ -107,6 +108,12 @@ class BrainAdvisor:
         self._ramp_min_slope = ramp_min_slope
         self._preempt_ckpt = preempt_ckpt
         self._ckpt_interval_sink = ckpt_interval_sink
+        # () -> {"headroom_bytes": int, "kv_bytes_per_replica": int} | None
+        # (observability/memory.py FleetMemoryMonitor): pre-scaling a
+        # replica set whose projected KV residency exceeds the tightest
+        # rank's headroom is refused, journaled, and scored like any
+        # other prediction
+        self._memory_guard = memory_guard
         self._ckpt_cost_s = ckpt_cost_s
         self._last_ckpt_interval: Optional[float] = None
         self._lock = threading.Lock()
@@ -166,6 +173,13 @@ class BrainAdvisor:
                 self._settle("straggler",
                              lambda p: p["node_id"] == node_id,
                              outcome="hit", actual={"node_id": node_id})
+        elif kind == JournalEvent.MEMORY_PRESSURE:
+            # pressure materialized even WITHOUT the refused scale-up:
+            # the refusal's claim (the fleet had no KV headroom) held
+            self._settle("mem_refusal", lambda p: True, outcome="hit",
+                         actual={"category": data.get("category"),
+                                 "headroom_frac":
+                                     data.get("headroom_frac")})
 
     def observe_step_time(self, config_sig: str, step_time_s: float) -> None:
         self.step_model.observe(config_sig, step_time_s)
@@ -239,6 +253,7 @@ class BrainAdvisor:
                 "failure": JournalEvent.BRAIN_PREDICTED_FAILURE,
                 "ramp": JournalEvent.BRAIN_PREDICTED_RAMP,
                 "straggler": JournalEvent.BRAIN_PREDICTED_STRAGGLER,
+                "mem_refusal": JournalEvent.BRAIN_PRESCALE_REFUSED,
             }[kind]
             self._journal.record(journal_kind, source="brain",
                                  prediction_id=pred["id"],
@@ -416,6 +431,8 @@ class BrainAdvisor:
             needed = max(needed, target + 1)
         if needed <= target:
             return None
+        if self._refuse_for_memory(target, needed):
+            return None
         if not self._cooled("serve_prescale"):
             return None
         # the prediction's claim: load will reach the CURRENT replica
@@ -428,6 +445,42 @@ class BrainAdvisor:
         self._record_action("serve_prescale", target=needed,
                             predicted_load=round(predicted, 1))
         return needed
+
+    def _refuse_for_memory(self, target: int, needed: int) -> bool:
+        """Device-plane veto on pre-scaling: when the extra replicas'
+        projected KV residency exceeds the tightest fresh rank's
+        headroom, refuse the scale-up (journaled as
+        ``brain_prescale_refused``) and open a ``mem_refusal``
+        prediction — scored a hit if ``memory_pressure`` arrives within
+        the horizon even without the scale-up, a miss on expiry."""
+        if self._memory_guard is None:
+            return False
+        try:
+            guard = self._memory_guard()
+        except Exception:  # noqa: BLE001 — advice must not crash
+            logger.exception("memory guard failed; pre-scale unguarded")
+            return False
+        if not guard:
+            return False
+        headroom = guard.get("headroom_bytes")
+        per_replica = float(guard.get("kv_bytes_per_replica") or 0.0)
+        if headroom is None or per_replica <= 0.0:
+            return False
+        projected = (needed - target) * per_replica
+        if projected <= float(headroom):
+            return False
+        if self._cooled("mem_refusal"):
+            self._open_prediction(
+                "mem_refusal", target=needed,
+                projected_kv_bytes=int(projected),
+                headroom_bytes=int(headroom),
+            )
+            self._record_action(
+                "serve_prescale_refused", target=needed,
+                projected_kv_bytes=int(projected),
+                headroom_bytes=int(headroom),
+            )
+        return True
 
     # -- consumers -----------------------------------------------------------
 

@@ -156,6 +156,16 @@ class SharedMemoryHandler:
     def name(self) -> str:
         return self._name
 
+    def _ledger(self) -> None:
+        """Sync this segment's claim in the device-memory ledger to its
+        currently-mapped size (0 = released)."""
+        from dlrover_tpu.common.constants import MetricLabel
+        from dlrover_tpu.observability.memory import get_accountant
+
+        get_accountant().adjust(
+            MetricLabel.MEM_STAGING, f"ckpt_shm/{self._name}",
+            int(self._shm.size) if self._shm is not None else 0)
+
     def _ensure(self, size: int) -> bool:
         if self._shm is not None and self._shm.size >= size:
             return True
@@ -165,18 +175,21 @@ class SharedMemoryHandler:
         # round up generously so step-to-step meta jitter doesn't re-create
         alloc = max(1024, int(size * 1.05))
         self._shm = create_shared_memory(self._name, create=True, size=alloc)
+        self._ledger()
         return self._shm is not None
 
     def open(self) -> bool:
         if self._shm is not None:
             return True
         self._shm = create_shared_memory(self._name, create=False)
+        self._ledger()
         return self._shm is not None
 
     def close(self) -> None:
         if self._shm is not None:
             self._shm.close()
             self._shm = None
+            self._ledger()
         if self._fd is not None:
             try:
                 import os

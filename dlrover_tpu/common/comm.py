@@ -240,6 +240,11 @@ class HeartbeatRequest:
     # revoke feedback only comes back on the dedicated report_shard_acks
     # RPC) — [TaskResult]; unknown to old masters, dropped by _decode
     shard_acks: List[Any] = field(default_factory=list)
+    # per-rank device-memory ledger snapshots, keyed by str(global_rank)
+    # (observability/memory.py wire format) — consumed by the master's
+    # FleetMemoryMonitor for min-headroom surfacing and brain pre-scale
+    # refusal; unknown to old masters, dropped by _decode
+    memory: Dict[str, Any] = field(default_factory=dict)
 
 
 @message

@@ -387,6 +387,15 @@ class ConfigKey:
     SERVE_TAIL_PCTL = "DLROVER_TPU_SERVE_TAIL_PCTL"
     SERVE_TAIL_MIN_WINDOW = "DLROVER_TPU_SERVE_TAIL_MIN_WINDOW"
     SERVE_TRACE_WORST = "DLROVER_TPU_SERVE_TRACE_WORST"
+    # device-plane memory/compile observability (observability/memory.py,
+    # observability/compile_watch.py): synthetic HBM limit for CPU CI
+    # (bytes; 0 = use PJRT's reported limit), the headroom fraction below
+    # which memory_pressure journals + a forensics bundle captures, and
+    # the distinct-signature count per jit fn per window that counts as a
+    # recompile storm
+    HBM_LIMIT_BYTES = "DLROVER_TPU_HBM_LIMIT_BYTES"
+    MEM_PRESSURE_FRAC = "DLROVER_TPU_MEM_PRESSURE_FRAC"
+    COMPILE_STORM_N = "DLROVER_TPU_COMPILE_STORM_N"
 
 
 class SpanName:
@@ -508,6 +517,10 @@ class ChaosSite:
     # brain telemetry/advisory plane (dlrover_tpu/brain/)
     BRAIN_PERSIST = "brain.persist"
     BRAIN_QUERY = "brain.query"
+    # device-plane memory accountant (observability/memory.py): forces
+    # the pressure → journal → bundle path deterministically by shrinking
+    # the reconciled headroom below the breach threshold
+    MEM_PRESSURE = "mem.pressure"
 
 
 class MetricLabel:
@@ -552,6 +565,35 @@ class MetricLabel:
     CKPT_TRIGGER_PERIODIC = "periodic"
     CKPT_TRIGGER_BREAKPOINT = "breakpoint"
     CKPT_TRIGGER_PREEMPTIVE = "preemptive"
+    # device-memory ledger categories (observability/memory.py): every
+    # byte the MemoryAccountant tracks is attributed to exactly one of
+    # these; ``dlrover_memory_bytes{category}`` and the memory_pressure
+    # journal payload draw from this vocabulary ONLY (the interproc half
+    # of DLR013 certifies call sites against it)
+    MEM_PARAMS = "params"
+    MEM_OPT_STATE = "opt_state"
+    MEM_ACTIVATIONS = "activations"
+    MEM_KV_CACHE = "kv_cache"
+    MEM_PREFIX_CACHE = "prefix_cache"
+    MEM_STAGING = "staging"
+    MEM_OTHER = "other"
+    MEMORY_CATEGORIES = (
+        MEM_PARAMS, MEM_OPT_STATE, MEM_ACTIVATIONS, MEM_KV_CACHE,
+        MEM_PREFIX_CACHE, MEM_STAGING, MEM_OTHER,
+    )
+    # recompile-storm varying-dimension attribution (observability/
+    # compile_watch.py): the signature axis whose churn explains a storm;
+    # ``recompile_storm{dim}`` and ``dlrover_compile_storms_total{dim}``
+    # draw from this vocabulary ONLY
+    STORM_DIM_BATCH = "batch"
+    STORM_DIM_SEQ_LEN = "seq_len"
+    STORM_DIM_FN = "fn"
+    STORM_DIM_DTYPE = "dtype"
+    STORM_DIM_UNKNOWN = "unknown"
+    STORM_DIMS = (
+        STORM_DIM_BATCH, STORM_DIM_SEQ_LEN, STORM_DIM_FN, STORM_DIM_DTYPE,
+        STORM_DIM_UNKNOWN,
+    )
 
 
 class GRPC:

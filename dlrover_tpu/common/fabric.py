@@ -333,6 +333,14 @@ class _FetchSession:
         self.stripes = stripes
         self.reporter = reporter
         self._buf = bytearray(total)
+        # the assembly buffer is the fabric's staging claim in the
+        # device-memory ledger; released when run() hands the payload off
+        from dlrover_tpu.common.constants import MetricLabel
+        from dlrover_tpu.observability.memory import get_accountant
+
+        self._ledger_name = f"fabric/{key}/{step}"
+        get_accountant().register(
+            MetricLabel.MEM_STAGING, self._ledger_name, total)
         self._cond = threading.Condition()
         self._abort_evt = threading.Event()
         self._missing = shared(set(range(len(stripes))), "fabric.missing")
@@ -544,6 +552,11 @@ class _FetchSession:
                 detail = (
                     f"assembled crc {got} != content address {self.crc}"
                 )
+        from dlrover_tpu.common.constants import MetricLabel
+        from dlrover_tpu.observability.memory import get_accountant
+
+        get_accountant().release(
+            MetricLabel.MEM_STAGING, self._ledger_name)
         return abort, detail
 
     def stats(self) -> Dict[str, Any]:

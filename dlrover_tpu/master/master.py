@@ -78,6 +78,7 @@ class JobMaster:
         # journal tail + metrics + config + stacks) on node faults,
         # injected chaos, or GET /debug/bundle
         from dlrover_tpu.observability.flight_recorder import (
+            REASON_MEMORY as _FR_REASON_MEMORY,
             REASON_NODE_FAULT as _FR_REASON_NODE_FAULT,
             FlightRecorder,
         )
@@ -86,6 +87,9 @@ class JobMaster:
             source="master",
             journal=self.event_journal,
             registry=self.metrics_registry,
+            # OOM forensics: bundles embed the breach-time HBM ledger
+            # (local accountant + fleet view) as memory.json
+            memory_snapshot_fn=lambda: self._memory_snapshot(),
         )
         # first step report after a recovery phase closes it (step_resumed)
         self.perf_monitor.journal = self.event_journal
@@ -148,6 +152,30 @@ class JobMaster:
         self.rdzv_managers[RendezvousName.TRAINING].straggler_history = (
             self.skew_monitor.node_straggler_counts
         )
+        # device-plane memory observability (observability/memory.py):
+        # per-rank ledger snapshots ride the heartbeat into the fleet
+        # monitor (min-headroom rank, GET /memory, memory_pressure
+        # journaling); the master process's OWN accountant is re-wired
+        # into the journal with a breach hook that snapshots an
+        # OOM-forensics bundle (memory.json inside)
+        from dlrover_tpu.observability.memory import (
+            FleetMemoryMonitor,
+            MemoryAccountant,
+            set_accountant,
+        )
+
+        self.memory_monitor = FleetMemoryMonitor(
+            event_journal=self.event_journal,
+            registry=self.metrics_registry,
+        )
+        set_accountant(MemoryAccountant(
+            journal=self.event_journal,
+            registry=self.metrics_registry,
+            source="master",
+            breach_hook=lambda data: self.flight_recorder.capture(
+                _FR_REASON_MEMORY, extra=data,
+            ),
+        ))
         # elastic data plane: the shard ledger journals its dispatch/ack
         # lifecycle and biases shard stealing by the same straggler
         # history the rdzv world-cut logic consults
@@ -251,6 +279,7 @@ class JobMaster:
             skew_monitor=self.skew_monitor,
             fanin_plane=self.fanin_plane,
             serve_registry=self.serve_registry,
+            memory_monitor=self.memory_monitor,
         )
         # bridge journal kinds into PerfMonitor's lost-time bookkeeping —
         # fault_happened/fault_recovered get their (only) callers here
@@ -325,11 +354,22 @@ class JobMaster:
                             f"p={probability:.2f}"),
                 ))
 
+            def _memory_guard():
+                headroom = self.memory_monitor.fleet_headroom_bytes()
+                if headroom is None:
+                    return None
+                return {
+                    "headroom_bytes": headroom,
+                    "kv_bytes_per_replica":
+                        self.memory_monitor.kv_bytes_per_replica(),
+                }
+
             self.brain_advisor = BrainAdvisor(
                 store=self.brain_store,
                 job_uuid=self._brain_job_uuid,
                 journal=self.event_journal,
                 registry=self.metrics_registry,
+                memory_guard=_memory_guard,
                 preempt_ckpt=_preempt_ckpt,
                 ckpt_interval_sink=lambda s:
                     self.strategy_generator.set_ckpt_interval(
@@ -455,6 +495,13 @@ class JobMaster:
                         _json.dumps(self.brain_status()),
                     ),
                 )
+                self._http_server.add_get_route(
+                    "/memory",
+                    lambda: (
+                        "application/json",
+                        _json.dumps(self.memory_monitor.status()),
+                    ),
+                )
             except ValueError:
                 logger.warning(
                     "DLROVER_TPU_HTTP_PORT=%r is not a port; http "
@@ -548,6 +595,17 @@ class JobMaster:
     @property
     def addr(self) -> str:
         return f"127.0.0.1:{self.port}"
+
+    def _memory_snapshot(self) -> dict:
+        """Flight-recorder ``memory.json`` payload: the master process's
+        own ledger snapshot plus the fleet view (per-rank headroom)."""
+        from dlrover_tpu.observability.memory import get_accountant
+
+        snap = get_accountant().snapshot()
+        monitor = getattr(self, "memory_monitor", None)
+        if monitor is not None:
+            snap["fleet"] = monitor.status()
+        return snap
 
     def brain_status(self) -> dict:
         """The ``GET /brain`` payload: persister flush/degradation stats,

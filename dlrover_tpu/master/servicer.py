@@ -36,6 +36,7 @@ class MasterServicer:
         skew_monitor=None,
         fanin_plane=None,
         serve_registry=None,
+        memory_monitor=None,
     ):
         self._job_manager = job_manager
         self._rdzv_managers = rdzv_managers
@@ -50,6 +51,9 @@ class MasterServicer:
         self._skew_monitor = skew_monitor
         self._fanin_plane = fanin_plane
         self._serve_registry = serve_registry
+        # observability/memory.py FleetMemoryMonitor: per-rank ledger
+        # snapshots riding the heartbeat land here
+        self._memory_monitor = memory_monitor
         self._start_time = time.monotonic()  # uptime base
 
     # -- rendezvous --------------------------------------------------------
@@ -223,6 +227,13 @@ class MasterServicer:
                 plane.note_shed()
             else:
                 self._skew_monitor.observe(req.node_id, req.op_telemetry)
+        if self._memory_monitor is not None and req.memory:
+            # memory snapshots follow the same shed gating as skew
+            # telemetry: beats are liveness, ledgers are telemetry
+            if shed:
+                plane.note_shed()
+            else:
+                self._memory_monitor.observe(req.node_id, req.memory)
         if req.shard_acks and self._task_manager is not None:
             # one-way delivery (no revoke feedback on this path — workers
             # that want the steal signal use rpc_report_shard_acks)
@@ -268,6 +279,10 @@ class MasterServicer:
                 )
             if not shed and self._diagnosis_master is not None:
                 self._diagnosis_master.observe_heartbeat(beat)
+            if (not shed and self._memory_monitor is not None
+                    and beat.memory):
+                # per-beat ingest (payloads are small; no merged strip)
+                self._memory_monitor.observe(beat.node_id, beat.memory)
             if action.action_type != DiagnosisActionType.NONE:
                 actions[beat.node_id] = [
                     action.action_type,

@@ -14,6 +14,9 @@ process:
         journal.json    the event journal tail (EventJournal.to_json())
         incidents.json  stitched fault→recovery Incident records for the
                         same journal tail (observability/incidents.py)
+        memory.json     the device-memory ledger snapshot (category
+                        waterfall, top-N buffers, recent deltas) when a
+                        MemoryAccountant is wired (observability/memory.py)
         metrics.prom    a /metrics snapshot (MetricsRegistry.render())
         config.json     config fingerprint: every ConfigKey/EnvKey knob
                         currently set in the environment
@@ -49,6 +52,7 @@ REASON_CRASH = "unhandled_exception"
 REASON_PARTITION = "partition_degraded"
 REASON_CHAOS = "chaos_fault"
 REASON_NODE_FAULT = "node_fault"
+REASON_MEMORY = "memory_pressure"
 
 DEFAULT_COOLDOWN_S = 30.0
 
@@ -104,6 +108,7 @@ class FlightRecorder:
         registry=None,
         cooldown_s: Optional[float] = None,
         worst_traces_fn=None,
+        memory_snapshot_fn=None,
     ):
         self.source = source
         self.out_dir = out_dir or default_trace_dir()
@@ -112,6 +117,11 @@ class FlightRecorder:
         # () -> list of worst-request summaries (TailAttributor on a
         # serving replica): bundles then embed the N worst waterfalls
         self.worst_traces_fn = worst_traces_fn
+        # () -> MemoryAccountant.snapshot(): bundles then embed the HBM
+        # ledger as memory.json — the OOM-forensics half of the device
+        # plane (observability/memory.py wires its breach hook to
+        # ``capture(REASON_MEMORY)`` on the same recorder)
+        self.memory_snapshot_fn = memory_snapshot_fn
         self.cooldown_s = (
             env_float(ConfigKey.TRACE_BUNDLE_COOLDOWN_S, DEFAULT_COOLDOWN_S)
             if cooldown_s is None else cooldown_s
@@ -200,6 +210,7 @@ class FlightRecorder:
         if journal_dict is not None:
             from dlrover_tpu.observability.timeline import (
                 brain_track_events,
+                device_track_events,
                 incident_track_events,
                 job_phase_events,
                 skew_track_events,
@@ -209,6 +220,7 @@ class FlightRecorder:
             events.extend(skew_track_events(journal_dict))
             events.extend(brain_track_events(journal_dict))
             events.extend(incident_track_events(journal_dict))
+            events.extend(device_track_events(journal_dict))
         with open(os.path.join(bundle_dir, "traces.json"), "w") as f:
             json.dump({"traceEvents": events}, f)
 
@@ -231,6 +243,18 @@ class FlightRecorder:
                             rec.get("trace_id"), []))
                         for rec in worst
                     ], f)
+
+        if self.memory_snapshot_fn is not None:
+            try:
+                snap = self.memory_snapshot_fn()
+            except Exception:  # noqa: BLE001 — optional device detail,
+                # never the reason a crash bundle fails to write
+                logger.warning("memory snapshot dump failed", exc_info=True)
+                snap = None
+            if snap is not None:
+                with open(os.path.join(bundle_dir, "memory.json"),
+                          "w") as f:
+                    json.dump(snap, f)
 
         if journal_dict is not None:
             with open(os.path.join(bundle_dir, "journal.json"), "w") as f:

@@ -176,6 +176,25 @@ class JournalEvent:
     # learner warm-restore from the rollout fleet after a learner death,
     # and the ROSE elasticity handshake legs (demand → drain → regrow).
     # All informational — no phase transitions.
+    # device-plane observability (observability/memory.py +
+    # compile_watch.py): a category's reconciled headroom crossed the
+    # pressure threshold (data: {category, headroom_frac, limit_bytes,
+    # total_bytes}), the accountant's device sweep degraded (PJRT stats
+    # unavailable where they were expected — replaces the old silent
+    # debug-swallow in worker.py), and a recompile storm — ≥N distinct
+    # compile signatures inside the sliding window — attributed to the
+    # varying signature dimension (data: {dim, count, window_s, fn}).
+    # All informational — the device plane never suspends goodput
+    # attribution by itself.
+    MEMORY_PRESSURE = "memory_pressure"
+    MEMORY_DEGRADED = "memory_degraded"
+    RECOMPILE_STORM = "recompile_storm"
+    # brain refusal verdict (brain/advisor.py): a serve pre-scale the
+    # traffic forecaster wanted was refused because the projected KV
+    # bytes for the target replica set exceed the fleet's reconciled HBM
+    # headroom (data: {target, projected_bytes, headroom_bytes}); scored
+    # like every other prediction via brain_prediction_scored.
+    BRAIN_PRESCALE_REFUSED = "brain_prescale_refused"
     RL_TRAJECTORY_ACKED = "rl_trajectory_acked"
     RL_LEASE_REQUEUED = "rl_lease_requeued"
     RL_TRAIN_COMMIT = "rl_train_commit"
@@ -209,6 +228,8 @@ class JournalEvent:
         FABRIC_SOURCE_FAILED, FABRIC_STRIPE_RETRIED,
         FABRIC_SESSION_COMPLETE, FABRIC_SESSION_ABORTED,
         UNIFIED_FAILOVER, UNIFIED_JOB_ABORT,
+        MEMORY_PRESSURE, MEMORY_DEGRADED, RECOMPILE_STORM,
+        BRAIN_PRESCALE_REFUSED,
         RL_TRAJECTORY_ACKED, RL_LEASE_REQUEUED, RL_TRAIN_COMMIT,
         RL_WEIGHT_SYNC, RL_LEARNER_RESTORED, RL_LEARNER_DEMAND,
         RL_ROLLOUT_DRAINED, RL_ROLLOUT_REGROWN, RL_STALENESS_VIOLATION,

@@ -8,7 +8,11 @@ Accepts either the master's ``GET /events`` payload saved to a file
 observability/flight_recorder.py (its ``journal.json`` is used). Output:
 one incident table (MTTR/MTTD, winning rung, rollback) and a goodput
 waterfall (seconds lost per phase, summed over incidents) — the offline
-twin of ``GET /incidents``.
+twin of ``GET /incidents``. Bundles captured with a device-memory
+snapshot (``memory.json`` — observability/memory.py) additionally get
+the OOM-forensics section: the category waterfall against its peak
+watermarks, the reconciled headroom line, and the per-step watermark
+table.
 """
 
 import argparse
@@ -39,8 +43,29 @@ def load_journal(source: str) -> Dict:
     return payload
 
 
+def load_memory(source: str) -> Optional[Dict]:
+    """``memory.json`` from a bundle directory; None for plain journal
+    dumps and for bundles captured without a memory snapshot."""
+    if not os.path.isdir(source):
+        return None
+    path = os.path.join(source, "memory.json")
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
 def _fmt(value: Optional[float], suffix: str = "s") -> str:
     return "-" if value is None else f"{value:.2f}{suffix}"
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{int(n)}B" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
 
 
 def render_report(incidents: List[Incident], now_t: float) -> str:
@@ -107,6 +132,56 @@ def render_report(incidents: List[Incident], now_t: float) -> str:
     return "\n".join(lines)
 
 
+def render_memory(snap: Dict) -> str:
+    """The OOM-forensics section from a bundle's memory.json: category
+    waterfall vs peak watermarks, the reconciled headroom line, and the
+    per-step watermark table (deterministic — golden-tested)."""
+    lines: List[str] = []
+    lines.append("device memory (HBM ledger at capture):")
+    cats = {str(c): int(b) for c, b in (snap.get("categories") or
+                                        {}).items()}
+    marks = {str(c): int(b) for c, b in (snap.get("watermarks") or
+                                         {}).items()}
+    live = [c for c in sorted(cats, key=lambda c: (-cats[c], c))
+            if cats[c] or marks.get(c, 0)]
+    if not live:
+        lines.append("  (ledger empty)")
+    else:
+        widest = max(cats[c] for c in live) or 1
+        for cat in live:
+            bar = "#" * max(1, round(24 * cats[cat] / widest)) \
+                if cats[cat] else ""
+            lines.append(
+                f"  {cat:<13} {_fmt_bytes(cats[cat]):>10}  "
+                f"(peak {_fmt_bytes(marks.get(cat, 0))})  {bar}".rstrip()
+            )
+    rec = snap.get("reconcile") or {}
+    if rec.get("limit_bytes"):
+        frac = float(rec.get("headroom_frac", 1.0))
+        lines.append(
+            f"  limit {_fmt_bytes(rec['limit_bytes'])}, "
+            f"headroom {_fmt_bytes(rec.get('headroom_bytes', 0))} "
+            f"({100.0 * frac:.1f}%), "
+            f"unattributed {_fmt_bytes(rec.get('unattributed_bytes', 0))}"
+        )
+    rows = snap.get("step_watermarks") or []
+    if rows:
+        cols = [c for c in sorted(
+            {c for row in rows for c in row if c != "step"})
+            if any(int(row.get(c, 0)) for row in rows)]
+        lines.append("")
+        lines.append(f"step watermarks (last {len(rows)} step(s)):")
+        header = f"  {'step':>6}  " + "  ".join(f"{c:>12}" for c in cols)
+        lines.append(header)
+        for row in rows:
+            lines.append(
+                f"  {int(row.get('step', 0)):>6}  "
+                + "  ".join(f"{_fmt_bytes(int(row.get(c, 0))):>12}"
+                            for c in cols)
+            )
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m dlrover_tpu.observability.report",
@@ -131,6 +206,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                                     step_time_s=args.step_time_s)
     print(render_report(incidents,
                         float(journal.get("now_t", 0.0))))
+    try:
+        memory = load_memory(args.source)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: memory.json unreadable: {e}", file=sys.stderr)
+        return 2
+    if memory is not None:
+        print()
+        print(render_memory(memory))
     return 0
 
 

@@ -57,6 +57,7 @@ _SKEW_TRACK_PID = 9998
 _BRAIN_TRACK_PID = 9997
 _SERVING_TRACK_PID = 9996
 _INCIDENTS_PID = 9995
+_DEVICE_PLANE_PID = 9994
 
 # chrome-trace palette names per goodput phase, so an incident's
 # waterfall reads at a glance (green = productive, red = waiting on
@@ -254,6 +255,67 @@ def incident_track_events(journal: dict) -> List[dict]:
     return events
 
 
+def device_track_events(journal: dict) -> List[dict]:
+    """Chrome-trace events for the device plane (observability/memory.py
+    + compile_watch.py): a headroom-fraction counter ("C") sampled at
+    each ``memory_pressure`` verdict, instants for pressure / degraded /
+    recompile-storm / brain-prescale-refusal events — so an HBM squeeze
+    or a retrace storm lines up with the kernel slices and job phases it
+    actually stole time from."""
+    from dlrover_tpu.observability.journal import JournalEvent
+
+    raw = journal.get("events", [])
+    events: List[dict] = [
+        {
+            "ph": "M", "pid": _DEVICE_PLANE_PID, "name": "process_name",
+            "args": {"name": "device plane"},
+        },
+        {
+            "ph": "M", "pid": _DEVICE_PLANE_PID, "tid": 0,
+            "name": "thread_name", "args": {"name": "memory / compile"},
+        },
+    ]
+    for e in raw:
+        kind = e.get("kind", "")
+        data = e.get("data", {}) or {}
+        ts_us = float(e.get("t", 0.0)) * 1e6
+        if kind == JournalEvent.MEMORY_PRESSURE:
+            events.append({
+                "ph": "C", "pid": _DEVICE_PLANE_PID, "tid": 0,
+                "name": "headroom_frac", "cat": "memory", "ts": ts_us,
+                "args": {"headroom_frac":
+                         float(data.get("headroom_frac", 0.0))},
+            })
+            events.append({
+                "ph": "i", "pid": _DEVICE_PLANE_PID, "tid": 0, "s": "p",
+                "name": (f"memory pressure ({data.get('category', '?')} "
+                         f"headroom={data.get('headroom_frac', '?')})"),
+                "cat": "memory", "ts": ts_us, "args": dict(data),
+            })
+        elif kind == JournalEvent.MEMORY_DEGRADED:
+            events.append({
+                "ph": "i", "pid": _DEVICE_PLANE_PID, "tid": 0, "s": "p",
+                "name": f"memory degraded ({data.get('reason', '?')})",
+                "cat": "memory", "ts": ts_us, "args": dict(data),
+            })
+        elif kind == JournalEvent.RECOMPILE_STORM:
+            events.append({
+                "ph": "i", "pid": _DEVICE_PLANE_PID, "tid": 0, "s": "p",
+                "name": (f"recompile storm {data.get('fn', '?')} "
+                         f"dim={data.get('dim', '?')} "
+                         f"×{data.get('count', '?')}"),
+                "cat": "compile", "ts": ts_us, "args": dict(data),
+            })
+        elif kind == JournalEvent.BRAIN_PRESCALE_REFUSED:
+            events.append({
+                "ph": "i", "pid": _DEVICE_PLANE_PID, "tid": 0, "s": "p",
+                "name": (f"prescale → {data.get('target', '?')} refused "
+                         "(KV would not fit)"),
+                "cat": "memory", "ts": ts_us, "args": dict(data),
+            })
+    return events
+
+
 def serving_request_events(spans: List, t0: Optional[float] = None,
                            now_t: Optional[float] = None) -> List[dict]:
     """Chrome-trace events for per-request serving waterfalls: a
@@ -347,6 +409,7 @@ def merge_timelines(
             events.extend(skew_track_events(journal))
             events.extend(brain_track_events(journal))
             events.extend(incident_track_events(journal))
+            events.extend(device_track_events(journal))
     with open(out_path, "w") as f:
         json.dump({"traceEvents": events}, f)
     return found

@@ -40,7 +40,23 @@ import threading
 from dataclasses import dataclass
 from typing import Any, List, Sequence
 
+from dlrover_tpu.common.constants import MetricLabel
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.compile_watch import get_watcher
+from dlrover_tpu.observability.memory import get_accountant
+
+
+def _shape_sig(key):
+    """Map an engine shape key onto a (fn, structured dims) compile
+    signature — the dims are what lets the watcher attribute a storm to
+    its varying dimension (ragged buckets → seq_len)."""
+    name = key[0]
+    dims = {}
+    if name in ("prefill", "insert") and len(key) > 1:
+        dims["bucket"] = key[1]
+    elif name == "prefill_sfx" and len(key) > 2:
+        dims["bucket"], dims["prefix_len"] = key[1], key[2]
+    return f"engine.{name}", dims
 
 
 @dataclass
@@ -73,11 +89,22 @@ class ToyEngine:
         self._counts = [0] * slots
         self._shapes_lock = threading.Lock()
         self._shapes = set()
+        # nominal KV residency (16 bytes/token, the prefix_entry rate) so
+        # toy-backed fleet tests exercise the same ledger as the jax path
+        get_accountant().register(
+            MetricLabel.MEM_KV_CACHE, f"toy_engine/{id(self):x}/kv",
+            16 * slots * cache_len)
 
     @property
     def compile_count(self) -> int:
         with self._shapes_lock:
             return len(self._shapes)
+
+    def _note_shape(self, key) -> None:
+        with self._shapes_lock:
+            self._shapes.add(key)
+        fn, dims = _shape_sig(key)
+        get_watcher().note(fn, **dims)
 
     @staticmethod
     def _seed(prompt: Sequence[int]) -> int:
@@ -92,8 +119,7 @@ class ToyEngine:
             import time
 
             time.sleep(self._prefill_delay_s)  # simulated prefill work
-        with self._shapes_lock:
-            self._shapes.add(("prefill", bucket_len))
+        self._note_shape(("prefill", bucket_len))
         seed = self._seed(prompt)
         return PrefillResult(
             first_token=self._token(seed, 0),
@@ -121,8 +147,7 @@ class ToyEngine:
 
             time.sleep(
                 self._prefill_delay_s * (len(prompt) - m) / len(prompt))
-        with self._shapes_lock:
-            self._shapes.add(("prefill_sfx", bucket_len, m))
+        self._note_shape(("prefill_sfx", bucket_len, m))
         seed = self._seed(prompt)
         return PrefillResult(
             first_token=self._token(seed, 0),
@@ -143,8 +168,7 @@ class ToyEngine:
             import time
 
             time.sleep(self._step_delay_s)  # simulated decode work
-        with self._shapes_lock:
-            self._shapes.add(("step",))
+        self._note_shape(("step",))
         out = []
         for s in range(self.slots):
             if active[s]:
@@ -232,17 +256,42 @@ class BatchDecodeEngine:
         # matched lengths are block-quantized by the prefix cache so the
         # trace count stays bounded
         self._sfx_jit = jax.jit(self._prefill_suffix_fn)
+        # claim the slot caches in the device-memory ledger — the serving
+        # term that scales with slots × context, exactly what the
+        # max-slots ceiling projection divides headroom by
+        get_accountant().register(
+            MetricLabel.MEM_KV_CACHE, f"engine/{id(self):x}/kv",
+            self.kv_cache_bytes())
+
+    def kv_cache_bytes(self) -> int:
+        """Actual resident bytes of the slot caches (k/v buffers plus the
+        quantization scales) — the accountant's measured counterpart to
+        memory.kv_bytes_per_slot_theoretical."""
+        return int(sum(
+            b.nbytes
+            for bufs in (self._k, self._v, self._ks, self._vs)
+            for b in bufs
+        ))
+
+    @property
+    def kv_bytes_per_slot(self) -> int:
+        return self.kv_cache_bytes() // self.slots
 
     @property
     def compile_count(self) -> int:
         with self._shapes_lock:
             return len(self._shapes)
 
-    def _note_shape(self, key) -> None:
+    def _note_shape(self, key):
+        """Track the shape locally (compile_count invariant) and return
+        the process watcher's timer: a first-seen signature times the
+        enclosed jit call as a compile."""
         with self._shapes_lock:
             if key not in self._shapes:
                 self._shapes.add(key)
                 logger.info("serving engine traces %s", key)
+        fn, dims = _shape_sig(key)
+        return get_watcher().time(fn, **dims)
 
     # -- pure prefill (prefill-worker threads) -----------------------------
 
@@ -303,13 +352,13 @@ class BatchDecodeEngine:
         if bucket_len > self.cache_len:
             raise ValueError(
                 f"bucket {bucket_len} exceeds cache length {self.cache_len}")
-        self._note_shape(("prefill", bucket_len))
         padded = list(prompt) + [0] * (bucket_len - len(prompt))
-        first, ks, vs = self._prefill_jit(
-            self._params,
-            jnp.asarray(padded, jnp.int32),
-            jnp.int32(len(prompt)),
-        )
+        with self._note_shape(("prefill", bucket_len)):
+            first, ks, vs = self._prefill_jit(
+                self._params,
+                jnp.asarray(padded, jnp.int32),
+                jnp.int32(len(prompt)),
+            )
         return PrefillResult(
             first_token=int(first),
             real_len=len(prompt),
@@ -395,16 +444,16 @@ class BatchDecodeEngine:
             raise ValueError(
                 f"prompt {len(prompt)} / bucket {bucket_len} exceed "
                 f"cache length {self.cache_len}")
-        self._note_shape(("prefill_sfx", bucket_len, m))
         pre_ks, pre_vs = entry
         padded = list(prompt) + [0] * (bucket_len - len(prompt))
-        first, ks, vs = self._sfx_jit(
-            self._params,
-            jnp.asarray(padded[m:], jnp.int32),
-            jnp.int32(len(prompt)),
-            pre_ks[:, :, :m],
-            pre_vs[:, :, :m],
-        )
+        with self._note_shape(("prefill_sfx", bucket_len, m)):
+            first, ks, vs = self._sfx_jit(
+                self._params,
+                jnp.asarray(padded[m:], jnp.int32),
+                jnp.int32(len(prompt)),
+                pre_ks[:, :, :m],
+                pre_vs[:, :, :m],
+            )
         return PrefillResult(
             first_token=int(first),
             real_len=len(prompt),
@@ -452,11 +501,12 @@ class BatchDecodeEngine:
         import jax.numpy as jnp
 
         ks, vs = result.payload
-        self._note_shape(("insert", result.bucket_len))
-        self._k, self._v, self._ks, self._vs, self._pos = self._insert_jit(
-            self._k, self._v, self._ks, self._vs, self._pos, ks, vs,
-            jnp.int32(slot), jnp.int32(result.real_len),
-        )
+        with self._note_shape(("insert", result.bucket_len)):
+            self._k, self._v, self._ks, self._vs, self._pos = \
+                self._insert_jit(
+                    self._k, self._v, self._ks, self._vs, self._pos, ks, vs,
+                    jnp.int32(slot), jnp.int32(result.real_len),
+                )
         return result.first_token
 
     def _step_fn(self, params, k_bufs, v_bufs, ks_bufs, vs_bufs, pos,
@@ -567,13 +617,14 @@ class BatchDecodeEngine:
              active: Sequence[bool]) -> List[int]:
         import jax.numpy as jnp
 
-        self._note_shape(("step",))
-        (nxt, self._k, self._v, self._ks, self._vs,
-         self._pos) = self._step_jit(
-            self._params, self._k, self._v, self._ks, self._vs, self._pos,
-            jnp.asarray(list(tokens), jnp.int32),
-            jnp.asarray(list(active), bool),
-        )
+        with self._note_shape(("step",)):
+            (nxt, self._k, self._v, self._ks, self._vs,
+             self._pos) = self._step_jit(
+                self._params, self._k, self._v, self._ks, self._vs,
+                self._pos,
+                jnp.asarray(list(tokens), jnp.int32),
+                jnp.asarray(list(active), bool),
+            )
         return [int(t) for t in nxt]
 
     def set_params(self, params) -> None:

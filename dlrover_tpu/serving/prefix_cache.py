@@ -40,9 +40,16 @@ import threading
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 from dlrover_tpu.analysis.race_detector import shared
-from dlrover_tpu.common.constants import ChaosSite, ConfigKey, env_flag, env_int
+from dlrover_tpu.common.constants import (
+    ChaosSite,
+    ConfigKey,
+    MetricLabel,
+    env_flag,
+    env_int,
+)
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.observability.journal import JournalEvent
+from dlrover_tpu.observability.memory import get_accountant
 from dlrover_tpu.observability.registry import get_registry
 
 SERVE_PREFIX_SITE = ChaosSite.SERVE_PREFIX
@@ -95,6 +102,13 @@ class RadixPrefixCache:
             {}, "serve.prefix_entries")
         self.bytes = 0
         self.evictions = 0
+        # the cache's residency in the device-memory ledger; synced after
+        # every byte mutation (insert/invalidate/evict)
+        self._ledger_name = f"prefix_cache/{id(self):x}"
+
+    def _sync_ledger(self) -> None:
+        get_accountant().adjust(
+            MetricLabel.MEM_PREFIX_CACHE, self._ledger_name, self.bytes)
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -156,6 +170,7 @@ class RadixPrefixCache:
                 node = node.children.setdefault(t, _Node())
                 node.keys.add(toks)
             self._evict_to_budget()
+            self._sync_ledger()
 
     def invalidate(self, key) -> bool:
         """Drop one entry (chaos fallback path). True when it was
@@ -166,6 +181,7 @@ class RadixPrefixCache:
                 return False
             self.bytes -= entry.nbytes
             self._remove_from_trie(key)
+            self._sync_ledger()
             return True
 
     def _remove_from_trie(self, key) -> None:

@@ -18,7 +18,10 @@ from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from dlrover_tpu.common.constants import MetricLabel
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.observability.compile_watch import get_watcher
+from dlrover_tpu.observability.memory import get_accountant
 from dlrover_tpu.parallel.mesh import ElasticMeshManager, MeshPlan, plan_mesh
 
 
@@ -150,10 +153,40 @@ class ElasticTrainer:
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
+    def _register_state(self, state) -> None:
+        """Claim the training state in the device-memory ledger: params,
+        optimizer state, and the f32 grad accumulator the scan carries
+        (the trainer's known activation workspace). Re-claimed at every
+        retrace — buffer shapes only change when the world does."""
+        try:
+            params_b = sum(int(leaf.nbytes)
+                           for leaf in jax.tree.leaves(state["params"]))
+            opt_b = sum(int(leaf.nbytes)
+                        for leaf in jax.tree.leaves(state["opt_state"]))
+            accum_b = sum(4 * int(leaf.size)
+                          for leaf in jax.tree.leaves(state["params"]))
+        except (KeyError, AttributeError, TypeError):
+            return  # toy states without nbytes-bearing leaves
+        acc = get_accountant()
+        acc.register(MetricLabel.MEM_PARAMS, "trainer/params", params_b)
+        acc.register(MetricLabel.MEM_OPT_STATE, "trainer/opt_state", opt_b)
+        acc.register(MetricLabel.MEM_ACTIVATIONS, "trainer/grad_accum",
+                     accum_b)
+
     def train_step(self, state, batch):
         if self._train_step is None:
             self._train_step = self._build_step()
-        return self._train_step(state, batch)
+            self._register_state(state)
+        shape = tuple(getattr(batch, "shape", ()) or ())
+        # structured compile signature: a varying rows-per-microbatch is
+        # exactly the ragged-batch storm the watcher attributes
+        with get_watcher().time(
+            "trainer.train_step",
+            accum=self.grad_accum_steps,
+            batch=shape[1] if len(shape) > 1 else 0,
+            seq_len=shape[2] if len(shape) > 2 else 0,
+        ):
+            return self._train_step(state, batch)
 
 
 def optax_global_norm(tree) -> jnp.ndarray:

@@ -75,10 +75,12 @@ class WorkerContext:
             return
         from dlrover_tpu.agent.monitor import (
             HBM_KEY_PREFIX,
+            MEM_KEY_PREFIX,
             OPTEL_KEY_PREFIX,
             TRAINING_METRICS_DICT,
         )
         from dlrover_tpu.common.multi_process import SharedDict
+        from dlrover_tpu.observability.memory import get_accountant
         from dlrover_tpu.observability.op_telemetry import get_accumulator
 
         if not hasattr(self, "_metrics_dict"):
@@ -88,11 +90,18 @@ class WorkerContext:
             self._last_hbm_publish = 0.0
         payload = {"step": step, "ts": time.time()}
         now = time.time()
+        mem_acc = get_accountant()
+        mem_acc.step_mark(step)
         if now - self._last_hbm_publish > 15.0:
             self._last_hbm_publish = now
             hbm = self._collect_hbm()
             if hbm:
                 payload[f"{HBM_KEY_PREFIX}{self.local_rank}"] = hbm
+            # the accountant's ledger rides the same cadence; stamped
+            # with the global rank the master attributes against
+            snap = mem_acc.wire_snapshot()
+            snap["rank"] = self.rank
+            payload[f"{MEM_KEY_PREFIX}{self.local_rank}"] = snap
         acc = get_accumulator()
         if acc.seq:
             # cumulative op-class histograms for the master's skew monitor;
@@ -108,24 +117,18 @@ class WorkerContext:
 
     @staticmethod
     def _collect_hbm() -> dict:
-        """Per-local-device {id: {hbm_used_mb, hbm_total_mb}} from PJRT
-        memory stats; {} when the backend doesn't expose them (CPU)."""
-        try:
-            import jax
+        """Per-local-device {id: {hbm_used_mb, hbm_total_mb}}, via the
+        process MemoryAccountant's reconciliation sweep — ONE collection
+        path for device stats (observability/memory.py). A sweep that
+        can't see the device journals ``memory_degraded`` once per
+        episode instead of debug-swallowing here."""
+        from dlrover_tpu.observability.memory import (
+            get_accountant,
+            per_device_stats,
+        )
 
-            out = {}
-            for d in jax.local_devices():
-                stats = d.memory_stats()
-                if not stats:
-                    continue
-                out[d.id] = {
-                    "hbm_used_mb": stats.get("bytes_in_use", 0) / (1 << 20),
-                    "hbm_total_mb": stats.get("bytes_limit", 0) / (1 << 20),
-                }
-            return out
-        except Exception:  # noqa: BLE001 — telemetry is best-effort
-            logger.debug("device memory_stats unavailable", exc_info=True)
-            return {}
+        get_accountant().reconcile()
+        return per_device_stats()
 
 
 def _enable_compilation_cache() -> None:

@@ -676,6 +676,81 @@ class TestDLR018:
             a, ip.rule_dlr018_incident_schema_contract) == []
 
 
+# -- DLR013 (interproc): bounded device-plane vocabularies --------------------
+
+_PLANE_CLEAN = {
+    "pkg/constants.py": (
+        "class MetricLabel:\n"
+        "    MEM_KV_CACHE = \"kv_cache\"\n"
+        "    MEM_OTHER = \"other\"\n"
+        "    MEMORY_CATEGORIES = (MEM_KV_CACHE, MEM_OTHER)\n"
+        "    STORM_DIM_BATCH = \"batch\"\n"
+        "    STORM_DIMS = (STORM_DIM_BATCH, \"unknown\")\n"
+    ),
+    "pkg/mem.py": (
+        "from pkg.constants import MetricLabel\n"
+        "def emit(counter, cat):\n"
+        "    counter.labels(category=\"kv_cache\").inc()\n"
+        "    counter.labels(category=MetricLabel.MEM_OTHER).inc()\n"
+        "    counter.labels(category=cat).inc()\n"
+        "    counter.labels(dim=\"batch\").inc()\n"
+        "    journal_record(dim=\"unknown\", count=7)\n"
+    ),
+}
+
+
+class TestDLR013Interproc:
+    def test_vocabulary_members_and_name_flows_are_clean(self, tmp_path):
+        a = _fixture(tmp_path, _PLANE_CLEAN)
+        assert _rules_hit(a, ip.rule_dlr013_bounded_plane_vocab) == []
+
+    def test_literal_outside_vocabulary_fires(self, tmp_path):
+        files = dict(_PLANE_CLEAN)
+        files["pkg/bad.py"] = (
+            "def emit(counter):\n"
+            "    counter.labels(category=\"bogus\").inc()\n"
+        )
+        a = _fixture(tmp_path, files)
+        hits = _rules_hit(a, ip.rule_dlr013_bounded_plane_vocab)
+        assert len(hits) == 1
+        v = hits[0]
+        assert v.path == "pkg/bad.py" and v.line == 2
+        assert "MEMORY_CATEGORIES" in v.message and "'bogus'" in v.message
+
+    def test_composed_dim_value_fires(self, tmp_path):
+        files = dict(_PLANE_CLEAN)
+        files["pkg/bad.py"] = (
+            "def emit(journal, key):\n"
+            "    journal.record(\"storm\", dim=f\"dim_{key}\", count=3)\n"
+        )
+        a = _fixture(tmp_path, files)
+        hits = _rules_hit(a, ip.rule_dlr013_bounded_plane_vocab)
+        assert len(hits) == 1
+        assert "STORM_DIMS" in hits[0].message
+        assert "f-string" in hits[0].message
+
+    def test_non_string_and_foreign_keywords_skip(self, tmp_path):
+        files = dict(_PLANE_CLEAN)
+        files["pkg/ok.py"] = (
+            "def emit(fn, counter):\n"
+            "    fn(category=3)\n"  # other planes' ints are not labels
+            "    counter.labels(reason=\"whatever_here\").inc()\n"
+        )
+        a = _fixture(tmp_path, files)
+        assert _rules_hit(a, ip.rule_dlr013_bounded_plane_vocab) == []
+
+    def test_tree_without_vocabulary_is_exempt(self, tmp_path):
+        """Fixture packages that never declare the MetricLabel tuples
+        (every other rule's fixtures) must not trip the plane rule."""
+        a = _fixture(tmp_path, {
+            "pkg/mod.py": (
+                "def emit(counter):\n"
+                "    counter.labels(category=\"anything\").inc()\n"
+            ),
+        })
+        assert _rules_hit(a, ip.rule_dlr013_bounded_plane_vocab) == []
+
+
 # -- whole-package run -------------------------------------------------------
 
 
